@@ -1,0 +1,424 @@
+"""CoreClient: the in-process runtime every driver and worker embeds.
+
+Analog of the reference's CoreWorker (src/ray/core_worker/core_worker.h:271)
++ its Cython binding: task submission, put/get/wait, actor calls, the
+function table cache, and ref counting — over one connection to the node
+service plus direct (zero-copy) access to the shared-memory store.
+
+Ref-counting protocol (single-directory variant of the reference's
+ownership model, reference_count.h:64):
+  * creating a ref (put / task return) => entry born with count 1, the
+    creator's ObjectRef owns it;
+  * a ref serialized INTO a stored object/task spec => +1 "embedded hold",
+    owned by the containing entry/task and released when that entry is
+    deleted (or the task finishes);
+  * a ref deserialized FROM the wire => +1 announced at construction,
+    -1 on GC.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.protocol import Connection, connect_uds
+from ray_tpu._private.shm_store import ShmObjectStore
+from ray_tpu.object_ref import ObjectRef
+
+_global_client: Optional["CoreClient"] = None
+_global_lock = threading.Lock()
+
+
+def get_global_client() -> Optional["CoreClient"]:
+    return _global_client
+
+
+def set_global_client(client: Optional["CoreClient"]) -> None:
+    global _global_client
+    with _global_lock:
+        _global_client = client
+
+
+class CoreClient:
+    def __init__(self, socket_path: str, kind: str = "driver",
+                 client_id: Optional[bytes] = None,
+                 push_handler: Optional[Callable[[dict], None]] = None,
+                 ) -> None:
+        self.kind = kind
+        self.client_id = client_id or os.urandom(16)
+        sock = connect_uds(socket_path)
+        self.conn = Connection(sock, push_handler=push_handler)
+        reply = self.conn.call({"type": "register_client", "kind": kind,
+                                "client_id": self.client_id})
+        self.store = ShmObjectStore(reply["store_path"])
+        self.session_dir = reply["session_dir"]
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._registered_fns: set = set()
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        self.conn.close()
+        self.store.close()
+
+    # ------------------------------------------------------------------
+    # ref counting
+    # ------------------------------------------------------------------
+    def add_ref_async(self, oid: bytes) -> None:
+        try:
+            self.conn.notify({"type": "add_ref", "object_id": oid})
+        except Exception:
+            pass
+
+    def remove_ref_async(self, oid: bytes) -> None:
+        try:
+            self.conn.notify({"type": "remove_ref", "object_id": oid})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # serialization with ref extraction
+    # ------------------------------------------------------------------
+    def serialize_with_refs(self, obj: Any) -> Tuple[ser.SerializedObject,
+                                                     List[bytes]]:
+        embedded: List[bytes] = []
+
+        def reducer(o):
+            if isinstance(o, ObjectRef):
+                embedded.append(o.binary())
+                return (ObjectRef._from_wire, (o.binary(),))
+            return None
+
+        s = ser.serialize(obj, ref_reducer=reducer)
+        # Embedded holds: +1 per occurrence, owned by the container.
+        for oid in embedded:
+            self.add_ref_async(oid)
+        return s, embedded
+
+    # ------------------------------------------------------------------
+    # put / get / wait
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed "
+                            "(matches the reference's behavior)")
+        s, embedded = self.serialize_with_refs(value)
+        oid = ObjectID.from_random()
+        inline_limit = config.max_direct_call_object_size
+        if s.total_size <= inline_limit:
+            self.conn.call({"type": "put_object", "object_id": oid.binary(),
+                            "loc": "inline", "data": s.to_bytes(),
+                            "size": s.total_size, "embedded": embedded})
+        else:
+            buf = self.store.create(oid, s.total_size)
+            s.write_into(buf)
+            self.store.seal(oid)
+            # Creator pin intentionally NOT released: the directory owns
+            # it (unevictable while the entry lives) and releases it on
+            # delete — the analog of the reference pinning primary copies.
+            self.conn.call({"type": "put_object", "object_id": oid.binary(),
+                            "loc": "shm", "data": None,
+                            "size": s.total_size, "embedded": embedded})
+        return ObjectRef(oid.binary(), owned=True)
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        oids = [r.binary() for r in refs]
+        reply = self._blocking_call(
+            {"type": "get_objects", "object_ids": oids, "timeout": timeout})
+        if reply.get("timed_out"):
+            raise exc.GetTimeoutError(
+                f"get() timed out after {timeout}s")
+        out = []
+        for oid in oids:
+            loc, data, size = reply["results"][oid]
+            out.append(self._materialize(oid, loc, data))
+        return out
+
+    def _materialize(self, oid: bytes, loc: str, data: Optional[bytes]) -> Any:
+        if loc == "inline":
+            value = ser.deserialize(memoryview(data), copy_buffers=True)
+        elif loc == "shm":
+            mv = self.store.get_autoreleased_view(ObjectID(oid))
+            if mv is None:
+                raise exc.ObjectLostError(oid.hex(), "missing from shm store")
+            # Zero-copy deserialize; the read pin auto-releases when the
+            # last aliasing array is GC'd (see get_autoreleased_view).
+            value = ser.deserialize(mv, copy_buffers=False)
+        elif loc == "error":
+            err = ser.loads(data)
+            raise err
+        else:
+            raise exc.ObjectLostError(oid.hex(), f"unexpected loc {loc}")
+        return value
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        oids = [r.binary() for r in refs]
+        reply = self._blocking_call(
+            {"type": "wait", "object_ids": oids,
+             "num_returns": num_returns, "timeout": timeout})
+        ready_set = set(reply["ready"])
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.binary() in ready_set and len(ready) < num_returns
+             else not_ready).append(r)
+        return ready, not_ready
+
+    def _blocking_call(self, msg: dict) -> dict:
+        """RPC that may block server-side; workers report blocked status so
+        the scheduler can backfill their CPU (avoids nested-task deadlock,
+        reference: worker lease release on blocking Get)."""
+        if self.kind != "worker":
+            return self.conn.call(msg)
+        probe = dict(msg)
+        probe["timeout"] = 0
+        reply = self.conn.call(probe)
+        if not reply.get("timed_out"):
+            if msg.get("timeout") == 0 or not _reply_incomplete(msg, reply):
+                return reply
+        self.conn.notify({"type": "worker_blocked"})
+        try:
+            return self.conn.call(msg)
+        finally:
+            self.conn.notify({"type": "worker_unblocked"})
+
+    # ------------------------------------------------------------------
+    # function table
+    # ------------------------------------------------------------------
+    def register_function(self, blob: bytes) -> bytes:
+        fid = hashlib.sha1(blob).digest()[:16]
+        with self._lock:
+            if fid in self._registered_fns:
+                return fid
+        self.conn.call({"type": "fn_register", "function_id": fid,
+                        "blob": blob})
+        with self._lock:
+            self._registered_fns.add(fid)
+        return fid
+
+    def fetch_function(self, fid: bytes) -> Any:
+        with self._lock:
+            if fid in self._fn_cache:
+                return self._fn_cache[fid]
+        reply = self.conn.call({"type": "fn_fetch", "function_id": fid})
+        if reply["blob"] is None:
+            raise RuntimeError(f"function {fid.hex()} not in table")
+        import cloudpickle
+        fn = cloudpickle.loads(reply["blob"])
+        with self._lock:
+            self._fn_cache[fid] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # task submission
+    # ------------------------------------------------------------------
+    def submit_task(self, function_id: bytes, name: str,
+                    args: tuple, kwargs: dict, num_returns: int,
+                    resources: Dict[str, float], retries: int,
+                    actor_id: Optional[bytes] = None,
+                    method_name: Optional[str] = None,
+                    is_actor_creation: bool = False,
+                    actor_spec_extra: Optional[dict] = None,
+                    ) -> List[ObjectRef]:
+        spec_args, embedded = self._pack_args(args, kwargs)
+        return_ids = [os.urandom(16) for _ in range(num_returns)]
+        spec = {
+            "task_id": os.urandom(16),
+            "name": name,
+            "function_id": function_id,
+            "args": spec_args,
+            "embedded": embedded,
+            "num_returns": num_returns,
+            "return_ids": return_ids,
+            "resources": resources,
+            "retries": retries,
+            "actor_id": actor_id,
+            "method_name": method_name,
+            "is_actor_creation": is_actor_creation,
+            "owner": self.client_id,
+        }
+        if actor_spec_extra:
+            spec.update(actor_spec_extra)
+        self.conn.call({"type": "submit_task", "spec": spec})
+        return [ObjectRef(oid, owned=True) for oid in return_ids]
+
+    def _pack_args(self, args: tuple, kwargs: dict
+                   ) -> Tuple[List[tuple], List[bytes]]:
+        """Top-level ObjectRef args become dependencies (resolved to values
+        before execution, like the reference); everything else ships as one
+        serialized (args, kwargs) blob with nested refs left as refs."""
+        packed: List[tuple] = []
+        all_embedded: List[bytes] = []
+        positional: List[Any] = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                self.add_ref_async(a.binary())   # held until task completes
+                all_embedded.append(a.binary())
+                packed.append(("ref", a.binary()))
+                positional.append(None)          # placeholder slot
+            else:
+                positional.append(a)
+        ref_slots = [i for i, a in enumerate(args)
+                     if isinstance(a, ObjectRef)]
+        kw_refs = {k: v.binary() for k, v in kwargs.items()
+                   if isinstance(v, ObjectRef)}
+        for k, oid in kw_refs.items():
+            self.add_ref_async(oid)
+            all_embedded.append(oid)
+            packed.append(("ref", oid))
+        plain_kwargs = {k: v for k, v in kwargs.items() if k not in kw_refs}
+        s, embedded = self.serialize_with_refs(
+            (positional, ref_slots, list(kw_refs.items()), plain_kwargs))
+        all_embedded.extend(embedded)
+        if s.total_size <= config.inline_small_args_size:
+            packed.insert(0, ("inline", s.to_bytes()))
+        else:
+            oid = ObjectID.from_random()
+            buf = self.store.create(oid, s.total_size)
+            s.write_into(buf)
+            self.store.seal(oid)  # creator pin kept — owned by directory
+            self.conn.call({"type": "put_object", "object_id": oid.binary(),
+                            "loc": "shm", "data": None, "size": s.total_size,
+                            "embedded": []})
+            packed.insert(0, ("blob", oid.binary()))
+            all_embedded.append(oid.binary())
+        return packed, all_embedded
+
+    def unpack_args(self, packed: List[tuple]) -> Tuple[tuple, dict]:
+        """Worker side of _pack_args."""
+        head = packed[0]
+        if head[0] == "inline":
+            payload = ser.deserialize(memoryview(head[1]), copy_buffers=True)
+        else:  # blob in shm
+            payload = self._materialize(head[1], "shm", None)
+        positional, ref_slots, kw_ref_items, plain_kwargs = payload
+        ref_args = [t[1] for t in packed[1:] if t[0] == "ref"]
+        n_pos = len(ref_slots)
+        pos_values = self.get([ObjectRef._from_wire(o)
+                               for o in ref_args[:n_pos]])
+        for slot, v in zip(ref_slots, pos_values):
+            positional[slot] = v
+        kwargs = dict(plain_kwargs)
+        kw_vals = self.get([ObjectRef._from_wire(oid)
+                            for _, oid in kw_ref_items])
+        for (k, _), v in zip(kw_ref_items, kw_vals):
+            kwargs[k] = v
+        return tuple(positional), kwargs
+
+    # ------------------------------------------------------------------
+    # task results (worker side)
+    # ------------------------------------------------------------------
+    def build_return_meta(self, oid: bytes, value: Any) -> tuple:
+        """Returns (oid, loc, data, size, embedded_refs) for task_done."""
+        s, embedded = self.serialize_with_refs(value)
+        if s.total_size <= config.max_direct_call_object_size:
+            return (oid, "inline", s.to_bytes(), s.total_size, embedded)
+        obj = ObjectID(oid)
+        buf = self.store.create(obj, s.total_size)
+        s.write_into(buf)
+        self.store.seal(obj)  # creator pin kept — owned by directory
+        return (oid, "shm", None, s.total_size, embedded)
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+    def create_actor(self, class_id: bytes, name_repr: str, args: tuple,
+                     kwargs: dict, resources: Dict[str, float],
+                     max_restarts: int, max_concurrency: int,
+                     name: Optional[str], namespace: str,
+                     detached: bool) -> Tuple[bytes, ObjectRef]:
+        actor_id = os.urandom(16)
+        spec_args, embedded = self._pack_args(args, kwargs)
+        creation_task = {
+            "task_id": os.urandom(16),
+            "name": f"{name_repr}.__init__",
+            "function_id": class_id,
+            "args": spec_args,
+            "embedded": embedded,
+            "num_returns": 1,
+            "return_ids": [os.urandom(16)],
+            "resources": resources,
+            "retries": 0,
+            "actor_id": actor_id,
+            "method_name": None,
+            "is_actor_creation": True,
+            "max_concurrency": max_concurrency,
+            "owner": self.client_id,
+        }
+        spec = {
+            "actor_id": actor_id,
+            "name": name,
+            "namespace": namespace,
+            "detached": detached,
+            "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
+            "class_id": class_id,
+            "resources": resources,
+            "creation_task": creation_task,
+        }
+        self.conn.call({"type": "create_actor", "spec": spec})
+        return actor_id, ObjectRef(creation_task["return_ids"][0],
+                                   owned=True)
+
+    def submit_actor_task(self, actor_id: bytes, class_id: bytes,
+                          method_name: str, args: tuple, kwargs: dict,
+                          num_returns: int, retries: int = 0
+                          ) -> List[ObjectRef]:
+        return self.submit_task(
+            function_id=class_id, name=method_name, args=args,
+            kwargs=kwargs, num_returns=num_returns, resources={},
+            retries=retries, actor_id=actor_id, method_name=method_name)
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
+        self.conn.call({"type": "kill_actor", "actor_id": actor_id,
+                        "no_restart": no_restart})
+
+    def actor_state(self, actor_id: bytes) -> dict:
+        return self.conn.call({"type": "actor_state", "actor_id": actor_id})
+
+    def lookup_named_actor(self, name: str, namespace: str) -> dict:
+        return self.conn.call({"type": "lookup_named_actor", "name": name,
+                               "namespace": namespace})
+
+    def list_named_actors(self, namespace: Optional[str]) -> List[str]:
+        return self.conn.call({"type": "list_named_actors",
+                               "namespace": namespace})["names"]
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+    def kv_put(self, ns: str, key: bytes, value: bytes,
+               overwrite: bool = True) -> bool:
+        return self.conn.call({"type": "kv_put", "ns": ns, "key": key,
+                               "value": value, "overwrite": overwrite})["ok"]
+
+    def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        return self.conn.call({"type": "kv_get", "ns": ns,
+                               "key": key})["value"]
+
+    def kv_del(self, ns: str, key: bytes) -> bool:
+        return self.conn.call({"type": "kv_del", "ns": ns, "key": key})["ok"]
+
+    def kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
+        return self.conn.call({"type": "kv_keys", "ns": ns,
+                               "prefix": prefix})["keys"]
+
+    def cluster_resources(self) -> dict:
+        return self.conn.call({"type": "cluster_resources"})
+
+    def store_stats(self) -> dict:
+        return self.conn.call({"type": "store_stats"})["stats"]
+
+
+def _reply_incomplete(msg: dict, reply: dict) -> bool:
+    if msg["type"] == "wait":
+        return len(reply.get("ready", [])) < msg["num_returns"]
+    return False

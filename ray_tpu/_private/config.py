@@ -1,0 +1,145 @@
+"""Central config registry.
+
+TPU-native analog of the reference's single C++ config registry
+(`src/ray/common/ray_config_def.h` — 217 RAY_CONFIG(type, name, default)
+entries, each overridable via a `RAY_<name>` env var).  We keep the same
+shape: every knob is declared once here, typed, defaulted, and overridable
+via `RAY_TPU_<NAME>` environment variables or programmatically via
+``ray_tpu.init(_system_config={...})``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class _ConfigEntry:
+    name: str
+    type: type
+    default: Any
+    doc: str = ""
+
+
+class ConfigRegistry:
+    """Typed, env-overridable config registry (singleton at module scope)."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, _ConfigEntry] = {}
+        self._overrides: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def declare(self, name: str, type_: type, default: Any, doc: str = "") -> None:
+        self._entries[name] = _ConfigEntry(name, type_, default, doc)
+
+    def get(self, name: str) -> Any:
+        entry = self._entries[name]
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        if env is not None:
+            if entry.type is bool:
+                return _parse_bool(env)
+            return entry.type(env)
+        return entry.default
+
+    def set(self, name: str, value: Any) -> None:
+        entry = self._entries.get(name)
+        if entry is None:
+            raise KeyError(f"Unknown config: {name}")
+        with self._lock:
+            self._overrides[name] = entry.type(value)
+
+    def update(self, overrides: Dict[str, Any]) -> None:
+        for k, v in (overrides or {}).items():
+            self.set(k, v)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._overrides.clear()
+
+    def __getattr__(self, name: str) -> Any:
+        # Attribute-style access: config.object_store_memory
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self.get(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def describe(self) -> Dict[str, Any]:
+        return {n: self.get(n) for n in self._entries}
+
+
+config = ConfigRegistry()
+_D = config.declare
+
+# ---------------------------------------------------------------------------
+# Core runtime
+# ---------------------------------------------------------------------------
+_D("object_store_memory", int, 256 * 1024 * 1024,
+   "Bytes of shared memory for the per-node object store.")
+_D("object_store_min_alloc", int, 64, "Allocation granularity / alignment.")
+_D("max_direct_call_object_size", int, 100 * 1024,
+   "Results <= this many bytes are returned inline (in-process memory "
+   "store) instead of the shared-memory store.  Mirrors the reference's "
+   "max_direct_call_object_size (ray_config_def.h).")
+_D("worker_register_timeout_s", float, 30.0,
+   "Seconds to wait for a spawned worker process to register.")
+_D("task_default_num_cpus", float, 1.0, "Default CPU requirement per task.")
+_D("actor_default_num_cpus", float, 0.0,
+   "Default CPU requirement for an actor process (reference default: "
+   "actors reserve 0 CPUs when running, 1 for placement).")
+_D("worker_pool_prestart", int, 0, "Workers to prestart on init.")
+_D("worker_idle_timeout_s", float, 600.0,
+   "Idle worker processes are reaped after this many seconds.")
+_D("heartbeat_interval_s", float, 1.0, "Node -> GCS heartbeat period.")
+_D("health_check_failure_threshold", int, 5,
+   "Missed heartbeats before a node is marked dead (reference: "
+   "health_check_failure_threshold).")
+_D("scheduler_spread_threshold", float, 0.5,
+   "Utilization below which the hybrid policy packs; above, spreads "
+   "(reference: scheduler_spread_threshold).")
+_D("scheduler_top_k_fraction", float, 0.2,
+   "Top-k fraction for hybrid scheduling randomization.")
+_D("max_pending_lease_requests_per_scheduling_category", int, 10,
+   "Pipelined lease requests per scheduling key (reference name kept).")
+_D("max_task_retries", int, 3, "Default retries for normal tasks.")
+_D("max_actor_restarts", int, 0, "Default actor restarts.")
+_D("log_to_driver", bool, True, "Forward worker stdout/stderr to driver.")
+_D("session_dir_prefix", str, "/tmp/ray_tpu",
+   "Prefix for per-session scratch directories.")
+_D("inline_small_args_size", int, 100 * 1024,
+   "Task args <= this many bytes are shipped inline in the task spec.")
+_D("testing_rpc_failure", str, "",
+   "Chaos: 'method:max_failures' pairs, comma separated — injected "
+   "failures in the message layer (reference: RAY_testing_rpc_failure).")
+_D("testing_asio_delay_us", str, "",
+   "Chaos: 'method:min:max' artificial delays in message dispatch "
+   "(reference: RAY_testing_asio_delay_us).")
+_D("object_spilling_threshold", float, 0.8,
+   "Fraction of the object store that may fill before spilling begins.")
+_D("object_spilling_dir", str, "",
+   "Directory for spilled objects (default: <session_dir>/spill).")
+_D("min_spilling_size", int, 1024 * 1024,
+   "Batch spills until at least this many bytes are queued.")
+
+# ---------------------------------------------------------------------------
+# TPU / mesh execution layer
+# ---------------------------------------------------------------------------
+_D("tpu_chips_per_host", int, 4, "Chips per TPU host (v5e/v5p default 4).")
+_D("mesh_default_axes", str, "dp,fsdp,tp",
+   "Default logical mesh axis names, outer to inner.")
+_D("train_report_queue_size", int, 64, "Buffered train.report() messages.")
+_D("prefetch_buffer_size", int, 2,
+   "Device prefetch depth for host->HBM input pipelines.")

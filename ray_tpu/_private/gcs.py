@@ -1,0 +1,82 @@
+"""Global control state (GCS-lite).
+
+Analog of the reference's GCS server (src/ray/gcs/gcs_server/gcs_server.h:79)
+scoped to what the control plane owns: internal KV (gcs_kv_manager.h),
+the function/class table (pushed by drivers, fetched+cached by workers),
+the actor directory (gcs_actor_manager.h:308), and named actors.
+
+Single-node deployments embed this in the head node service; the
+multi-node path serves the same object over TCP (see node_service.py).
+All methods are thread-safe.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class GlobalControlState:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._kv: Dict[str, Dict[bytes, bytes]] = {}
+        self._functions: Dict[bytes, bytes] = {}
+        self._named_actors: Dict[str, bytes] = {}  # "ns/name" -> actor_id
+
+    # -- internal KV -------------------------------------------------------
+    def kv_put(self, ns: str, key: bytes, value: bytes,
+               overwrite: bool = True) -> bool:
+        with self._lock:
+            table = self._kv.setdefault(ns, {})
+            if not overwrite and key in table:
+                return False
+            table[key] = value
+            return True
+
+    def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(ns, {}).get(key)
+
+    def kv_del(self, ns: str, key: bytes) -> bool:
+        with self._lock:
+            return self._kv.get(ns, {}).pop(key, None) is not None
+
+    def kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
+        with self._lock:
+            return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
+
+    # -- function table ----------------------------------------------------
+    def register_function(self, function_id: bytes, blob: bytes) -> None:
+        with self._lock:
+            self._functions[function_id] = blob
+
+    def fetch_function(self, function_id: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._functions.get(function_id)
+
+    # -- named actors ------------------------------------------------------
+    def register_named_actor(self, ns: str, name: str,
+                             actor_id: bytes) -> bool:
+        with self._lock:
+            key = f"{ns}/{name}"
+            if key in self._named_actors:
+                return False
+            self._named_actors[key] = actor_id
+            return True
+
+    def lookup_named_actor(self, ns: str, name: str) -> Optional[bytes]:
+        with self._lock:
+            return self._named_actors.get(f"{ns}/{name}")
+
+    def drop_named_actor(self, actor_id: bytes) -> None:
+        with self._lock:
+            dead = [k for k, v in self._named_actors.items() if v == actor_id]
+            for k in dead:
+                del self._named_actors[k]
+
+    def list_named_actors(self, ns: Optional[str] = None) -> List[str]:
+        with self._lock:
+            if ns is None:
+                return list(self._named_actors)
+            return [k.split("/", 1)[1] for k in self._named_actors
+                    if k.startswith(ns + "/")]

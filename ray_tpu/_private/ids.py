@@ -1,0 +1,99 @@
+"""Unique identifiers for objects, tasks, actors, nodes, workers.
+
+Analog of the reference's `src/ray/common/id.h` family.  We use flat
+16-byte random IDs (hex-printable) rather than the reference's structured
+composed IDs; ownership metadata travels alongside the ID instead of being
+packed into it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ID_SIZE = 16
+
+
+class BaseID:
+    __slots__ = ("_bytes",)
+
+    def __init__(self, id_bytes: bytes) -> None:
+        if len(id_bytes) != _ID_SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {_ID_SIZE} bytes, got "
+                f"{len(id_bytes)}")
+        self._bytes = bytes(id_bytes)
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(os.urandom(_ID_SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str) -> "BaseID":
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\x00" * _ID_SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * _ID_SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self._bytes))
+
+    def __eq__(self, other: object) -> bool:
+        return type(other) is type(self) and other._bytes == self._bytes  # type: ignore[attr-defined]
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self._bytes.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class ObjectID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class JobID(BaseID):
+    pass
+
+
+class _Counter:
+    """Monotonic counter for sequence numbers."""
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value

@@ -1,0 +1,961 @@
+"""Per-node control plane: scheduler, worker pool, object directory.
+
+This is the analog of the reference's raylet (src/ray/raylet/node_manager.h:119
+NodeManager + worker_pool.h:174 WorkerPool + scheduling/cluster_task_manager.h:42)
+fused with the single-node portion of the GCS.  Differences by design:
+
+* One coarse-grained state lock + thread-per-connection instead of an asio
+  event loop — connection counts on a node are small (tens of workers).
+* The object *data* plane never touches this service: payloads live in the
+  native shm store (shared mmap) or inline in messages; the service holds
+  only the directory (who's ready, where, refcounts) the way the
+  reference's ownership tables do (core_worker/reference_count.h:64).
+* Dependency tracking happens here (tasks are dispatched only when their
+  top-level ObjectRef args are ready), mirroring the reference's
+  raylet-side DependencyManager rather than blocking workers.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import config
+from ray_tpu._private.gcs import GlobalControlState
+from ray_tpu._private.protocol import ConnectionLost, recv_msg, send_msg
+from ray_tpu import exceptions as exc
+
+# Object directory entry states.
+PENDING = "pending"
+READY = "ready"
+FAILED = "error"
+
+
+class ObjectEntry:
+    __slots__ = ("state", "loc", "data", "size", "refcount", "waiters",
+                 "producing_task", "deleted", "embedded")
+
+    def __init__(self) -> None:
+        self.state = PENDING
+        self.loc = None          # "inline" | "shm"
+        self.data: Optional[bytes] = None
+        self.size = 0
+        self.refcount = 1
+        self.waiters: List[Callable[[], None]] = []
+        self.producing_task: Optional[bytes] = None  # lineage hook
+        self.deleted = False
+        self.embedded: List[bytes] = []  # refs held by this object's payload
+
+
+class TaskRecord:
+    __slots__ = ("task_id", "spec", "deps", "state", "worker",
+                 "retries_left", "is_actor_creation", "actor_id")
+
+    def __init__(self, spec: dict) -> None:
+        self.task_id: bytes = spec["task_id"]
+        self.spec = spec
+        self.deps = {a[1] for a in spec["args"] if a[0] == "ref"}
+        self.state = "pending"     # pending | dispatched | done
+        self.worker: Optional[WorkerHandle] = None
+        self.retries_left: int = spec.get("retries", 0)
+        self.is_actor_creation = spec.get("is_actor_creation", False)
+        self.actor_id: Optional[bytes] = spec.get("actor_id")
+
+
+class ActorRecord:
+    __slots__ = ("actor_id", "spec", "state", "worker", "queue",
+                 "restarts_left", "name", "namespace", "detached",
+                 "in_flight", "death_reason", "holds_released")
+
+    def __init__(self, actor_id: bytes, spec: dict) -> None:
+        self.actor_id = actor_id
+        self.spec = spec
+        self.state = "pending"     # pending | alive | restarting | dead
+        self.worker: Optional[WorkerHandle] = None
+        self.queue: deque = deque()    # TaskRecords awaiting aliveness/deps
+        self.in_flight: Dict[bytes, TaskRecord] = {}
+        self.restarts_left = spec.get("max_restarts", 0)
+        self.name = spec.get("name")
+        self.namespace = spec.get("namespace", "default")
+        self.detached = spec.get("detached", False)
+        self.death_reason = ""
+        # Creation-task embedded ref holds live as long as the actor can
+        # restart (the spec is replayed); released exactly once at
+        # permanent death via _release_actor_holds.
+        self.holds_released = False
+
+
+class WorkerHandle:
+    __slots__ = ("worker_id", "conn_send", "proc", "state", "tpu",
+                 "current_task", "actor_id", "resources_held",
+                 "last_idle_time", "pid")
+
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen,
+                 tpu: bool) -> None:
+        self.worker_id = worker_id
+        self.conn_send: Optional[Callable[[dict], None]] = None
+        self.proc = proc
+        self.state = "starting"    # starting | idle | busy | blocked | dead
+        self.tpu = tpu
+        self.current_task: Optional[TaskRecord] = None
+        self.actor_id: Optional[bytes] = None
+        self.resources_held: Dict[str, float] = {}
+        self.last_idle_time = time.time()
+        self.pid = proc.pid if proc else 0
+
+
+class _ConnCtx:
+    """Per-connection server-side context."""
+
+    __slots__ = ("sock", "send_lock", "kind", "worker", "client_id")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.kind = "unknown"
+        self.worker: Optional[WorkerHandle] = None
+        self.client_id: Optional[bytes] = None
+
+    def send(self, msg: dict) -> None:
+        try:
+            send_msg(self.sock, msg, self.send_lock)
+        except (OSError, ConnectionLost):
+            pass
+
+    def reply(self, req: dict, payload: dict) -> None:
+        payload["__reply_to__"] = req["__req_id__"]
+        self.send(payload)
+
+
+class NodeService:
+    """Head/node daemon. Runs inside the driver process (threads)."""
+
+    def __init__(self, session_dir: str, resources: Dict[str, float],
+                 store_path: str, store_capacity: int,
+                 gcs: Optional[GlobalControlState] = None) -> None:
+        self.session_dir = session_dir
+        self.socket_path = os.path.join(session_dir, "node.sock")
+        self.store_path = store_path
+        self.store_capacity = store_capacity
+        self.gcs = gcs or GlobalControlState()
+        self.lock = threading.RLock()
+        self.objects: Dict[bytes, ObjectEntry] = {}
+        self.tasks: Dict[bytes, TaskRecord] = {}
+        self.pending_queue: deque = deque()          # TaskRecords to place
+        self.actors: Dict[bytes, ActorRecord] = {}
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.resources_total = dict(resources)
+        self.resources_avail = dict(resources)
+        self._conns: List[_ConnCtx] = []
+        self._shutdown = False
+        self._listener: Optional[socket.socket] = None
+        self._next_worker_seq = 0
+        self._deadline_waiters: List[Tuple[float, Callable[[], None]]] = []
+        self._max_workers = int(os.environ.get(
+            "RAY_TPU_MAX_WORKERS", max(8, int(resources.get("CPU", 4)) * 2)))
+        # Circuit breaker: consecutive workers that died before ever
+        # registering.  When tripped, stop respawning and fail pending
+        # work instead of fork-bombing on a broken environment.
+        self._spawn_failures = 0
+        self._spawn_failure_limit = 5
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        from ray_tpu._private.shm_store import ShmObjectStore
+        ShmObjectStore(self.store_path, self.store_capacity,
+                       create=True).close()
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(128)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="rtpu-node-accept").start()
+        threading.Thread(target=self._monitor_loop, daemon=True,
+                         name="rtpu-node-monitor").start()
+        for _ in range(config.worker_pool_prestart):
+            self._spawn_worker(tpu=False)
+
+    def shutdown(self) -> None:
+        with self.lock:
+            self._shutdown = True
+            workers = list(self.workers.values())
+        for w in workers:
+            if w.conn_send:
+                try:
+                    w.conn_send({"type": "exit"})
+                except Exception:
+                    pass
+        deadline = time.time() + 2.0
+        for w in workers:
+            if w.proc is None:
+                continue
+            try:
+                w.proc.wait(timeout=max(0.05, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+        if self._listener:
+            self._listener.close()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        try:
+            os.unlink(self.store_path)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    # connection handling
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            ctx = _ConnCtx(sock)
+            with self.lock:
+                self._conns.append(ctx)
+            threading.Thread(target=self._conn_loop, args=(ctx,),
+                             daemon=True, name="rtpu-node-conn").start()
+
+    def _conn_loop(self, ctx: _ConnCtx) -> None:
+        try:
+            while not self._shutdown:
+                msg = recv_msg(ctx.sock)
+                self._dispatch(ctx, msg)
+        except (ConnectionLost, OSError, EOFError):
+            pass
+        finally:
+            self._on_disconnect(ctx)
+
+    def _dispatch(self, ctx: _ConnCtx, msg: dict) -> None:
+        handler = getattr(self, "_h_" + msg["type"], None)
+        if handler is None:
+            if "__req_id__" in msg:
+                ctx.reply(msg, {"__error__": f"unknown rpc {msg['type']}"})
+            return
+        try:
+            handler(ctx, msg)
+        except Exception as e:  # handler bug — surface to caller
+            if "__req_id__" in msg:
+                ctx.reply(msg, {"__error__": e})
+
+    def _on_disconnect(self, ctx: _ConnCtx) -> None:
+        with self.lock:
+            if ctx in self._conns:
+                self._conns.remove(ctx)
+            w = ctx.worker
+            if w is None or w.state == "dead":
+                return
+            self._handle_worker_death(w, "worker connection lost")
+            self._schedule()
+
+    # ------------------------------------------------------------------
+    # message handlers (all named _h_<type>)
+    # ------------------------------------------------------------------
+    def _h_register_client(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            ctx.kind = m["kind"]
+            ctx.client_id = m["client_id"]
+            if m["kind"] == "worker":
+                w = self.workers.get(m["client_id"])
+                if w is None:
+                    ctx.reply(m, {"__error__": "unknown worker"})
+                    return
+                ctx.worker = w
+                w.conn_send = ctx.send
+                w.state = "idle"
+                w.last_idle_time = time.time()
+                self._spawn_failures = 0
+                self._schedule()
+            ctx.reply(m, {"ok": True,
+                          "store_path": self.store_path,
+                          "session_dir": self.session_dir})
+
+    def _h_submit_task(self, ctx: _ConnCtx, m: dict) -> None:
+        spec = m["spec"]
+        with self.lock:
+            rec = TaskRecord(spec)
+            if self._spawn_failures >= self._spawn_failure_limit:
+                self.tasks[rec.task_id] = rec
+                for oid in spec["return_ids"]:
+                    self.objects.setdefault(oid, ObjectEntry())
+                self._fail_task_returns(rec, exc.WorkerCrashedError(
+                    "worker environment is broken (spawn circuit breaker "
+                    "tripped); task rejected"))
+                ctx.reply(m, {"ok": True})
+                return
+            self.tasks[rec.task_id] = rec
+            for oid in spec["return_ids"]:
+                entry = self.objects.get(oid)
+                if entry is None:
+                    entry = ObjectEntry()
+                    self.objects[oid] = entry
+                entry.producing_task = rec.task_id
+            # Drop deps that are already ready.
+            rec.deps = {d for d in rec.deps
+                        if not self._object_ready(d)}
+            if rec.actor_id is not None and not rec.is_actor_creation:
+                self._enqueue_actor_task(rec)
+            else:
+                self.pending_queue.append(rec)
+            self._schedule()
+        ctx.reply(m, {"ok": True})
+
+    def _object_ready(self, oid: bytes) -> bool:
+        e = self.objects.get(oid)
+        return e is not None and e.state in (READY, FAILED)
+
+    def _h_put_object(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            self._register_object(m["object_id"], m["loc"],
+                                  m.get("data"), m["size"],
+                                  embedded=m.get("embedded") or [])
+            self._schedule()
+        ctx.reply(m, {"ok": True})
+
+    def _register_object(self, oid: bytes, loc: str,
+                         data: Optional[bytes], size: int,
+                         state: str = READY,
+                         embedded: Optional[List[bytes]] = None) -> None:
+        entry = self.objects.get(oid)
+        if entry is None:
+            entry = ObjectEntry()
+            self.objects[oid] = entry
+        entry.state = state
+        entry.loc = loc
+        entry.data = data
+        entry.size = size
+        if embedded:
+            entry.embedded = list(embedded)
+        waiters, entry.waiters = entry.waiters, []
+        for wake in waiters:
+            wake()
+        # Unblock tasks waiting on this object.
+        for rec in list(self.pending_queue):
+            rec.deps.discard(oid)
+        for actor in self.actors.values():
+            touched = False
+            for rec in actor.queue:
+                if oid in rec.deps:
+                    rec.deps.discard(oid)
+                    touched = True
+            if touched:
+                self._drain_actor_queue(actor)
+
+    def _h_get_objects(self, ctx: _ConnCtx, m: dict) -> None:
+        """Blocking get: reply once every requested object is ready."""
+        oids: List[bytes] = m["object_ids"]
+        timeout = m.get("timeout")
+        deadline = time.time() + timeout if timeout is not None else None
+        done = threading.Event()   # reply-once guard
+        registered: List[ObjectEntry] = []
+
+        def try_reply(timed_out: bool = False) -> None:
+            with self.lock:
+                if done.is_set():
+                    return
+                missing = [o for o in oids if not self._object_ready(o)]
+                if missing and not timed_out:
+                    return
+                done.set()
+                _unregister_waiter(registered, try_reply)
+                results = {}
+                for o in oids:
+                    e = self.objects.get(o)
+                    if e is None or e.state == PENDING:
+                        results[o] = ("missing", None, 0)
+                    else:
+                        results[o] = (e.loc if e.state == READY else "error",
+                                      e.data, e.size)
+                ctx.reply(m, {"results": results,
+                              "timed_out": bool(missing)})
+
+        with self.lock:
+            missing = [o for o in oids if not self._object_ready(o)]
+            for o in missing:
+                entry = self.objects.get(o)
+                if entry is None:
+                    entry = ObjectEntry()
+                    # get for an unknown object: wait for someone to put it
+                    entry.refcount = 0
+                    self.objects[o] = entry
+                entry.waiters.append(try_reply)
+                registered.append(entry)
+            if timeout == 0:
+                try_reply(timed_out=True)
+                return
+            if deadline is not None and missing:
+                self._deadline_waiters.append(
+                    (deadline, lambda: try_reply(timed_out=True)))
+        try_reply()
+
+    def _h_wait(self, ctx: _ConnCtx, m: dict) -> None:
+        oids: List[bytes] = m["object_ids"]
+        num_returns: int = m["num_returns"]
+        timeout = m.get("timeout")
+        deadline = time.time() + timeout if timeout is not None else None
+        done = threading.Event()
+        registered: List[ObjectEntry] = []
+
+        def try_reply(timed_out: bool = False) -> None:
+            with self.lock:
+                if done.is_set():
+                    return
+                ready = [o for o in oids if self._object_ready(o)]
+                if len(ready) < num_returns and not timed_out:
+                    return
+                done.set()
+                _unregister_waiter(registered, try_reply)
+                satisfied = len(ready) >= num_returns
+                if satisfied:
+                    ready = ready[:num_returns]
+                ctx.reply(m, {"ready": ready, "timed_out": not satisfied})
+
+        with self.lock:
+            for o in oids:
+                if not self._object_ready(o):
+                    entry = self.objects.get(o)
+                    if entry is None:
+                        entry = ObjectEntry()
+                        entry.refcount = 0
+                        self.objects[o] = entry
+                    entry.waiters.append(try_reply)
+                    registered.append(entry)
+            if timeout == 0:
+                try_reply(timed_out=True)
+                return
+            if deadline is not None:
+                self._deadline_waiters.append(
+                    (deadline, lambda: try_reply(timed_out=True)))
+        try_reply()
+
+    def _h_task_done(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            rec = self.tasks.pop(m["task_id"], None)
+            w = ctx.worker
+            for oid, loc, data, size, embedded in m["returns"]:
+                entry = self.objects.get(oid)
+                if entry is not None and entry.deleted:
+                    continue
+                self._register_object(
+                    oid, loc, data, size,
+                    state=FAILED if loc == "error" else READY,
+                    embedded=embedded)
+            if rec is not None:
+                rec.state = "done"
+                # Release the holds the submitter took on arg/embedded
+                # refs — EXCEPT for actor creation tasks, whose spec may
+                # be replayed on restart (holds released at permanent
+                # actor death instead).
+                if not rec.is_actor_creation:
+                    for dep in rec.spec.get("embedded") or []:
+                        self._decref(dep)
+                if rec.is_actor_creation and rec.actor_id:
+                    self._on_actor_created(rec, failed=m.get("failed", False))
+                actor = self.actors.get(rec.actor_id) if rec.actor_id else None
+                if actor is not None:
+                    actor.in_flight.pop(rec.task_id, None)
+            if w is not None and w.state == "busy" and w.actor_id is None:
+                self._release_worker(w)
+            elif w is not None and w.actor_id is not None:
+                w.current_task = None
+            self._schedule()
+
+    def _h_worker_blocked(self, ctx: _ConnCtx, m: dict) -> None:
+        # A worker blocked in get(): return its CPU to the pool so nested
+        # tasks can run (reference: worker blocked-on-get lease release).
+        with self.lock:
+            w = ctx.worker
+            if w is not None and w.state == "busy":
+                w.state = "blocked"
+                self._give_back(w.resources_held)
+                self._schedule()
+
+    def _h_worker_unblocked(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            w = ctx.worker
+            if w is not None and w.state == "blocked":
+                # Overcommit on purpose: the task must finish.
+                self._take(w.resources_held, allow_negative=True)
+                w.state = "busy"
+
+    def _h_add_ref(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            e = self.objects.get(m["object_id"])
+            if e is not None:
+                e.refcount += 1
+        if "__req_id__" in m:
+            ctx.reply(m, {"ok": True})
+
+    def _h_remove_ref(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            self._decref(m["object_id"])
+
+    def _delete_object(self, oid: bytes, e: ObjectEntry) -> None:
+        e.deleted = True
+        e.data = None
+        self.objects.pop(oid, None)
+        if e.loc == "shm":
+            # Release the creator pin the directory owns, then delete
+            # (deferred store-side while readers still hold pins).
+            try:
+                store = self._store()
+                store.release(_OID(oid))
+                store.delete(_OID(oid))
+            except Exception:
+                pass
+        # Release refs embedded in this object's payload (may cascade).
+        embedded, e.embedded = e.embedded, []
+        for dep in embedded:
+            self._decref(dep)
+
+    def _decref(self, oid: bytes) -> None:
+        e = self.objects.get(oid)
+        if e is None:
+            return
+        e.refcount -= 1
+        if e.refcount <= 0:
+            self._delete_object(oid, e)
+
+    _store_client = None
+
+    def _store(self):
+        if NodeService._store_client is None:
+            from ray_tpu._private.shm_store import ShmObjectStore
+            NodeService._store_client = ShmObjectStore(self.store_path)
+        return NodeService._store_client
+
+    # -- GCS passthrough ---------------------------------------------------
+    def _h_kv_put(self, ctx: _ConnCtx, m: dict) -> None:
+        ok = self.gcs.kv_put(m["ns"], m["key"], m["value"],
+                             m.get("overwrite", True))
+        ctx.reply(m, {"ok": ok})
+
+    def _h_kv_get(self, ctx: _ConnCtx, m: dict) -> None:
+        ctx.reply(m, {"value": self.gcs.kv_get(m["ns"], m["key"])})
+
+    def _h_kv_del(self, ctx: _ConnCtx, m: dict) -> None:
+        ctx.reply(m, {"ok": self.gcs.kv_del(m["ns"], m["key"])})
+
+    def _h_kv_keys(self, ctx: _ConnCtx, m: dict) -> None:
+        ctx.reply(m, {"keys": self.gcs.kv_keys(m["ns"], m.get("prefix", b""))})
+
+    def _h_fn_register(self, ctx: _ConnCtx, m: dict) -> None:
+        self.gcs.register_function(m["function_id"], m["blob"])
+        ctx.reply(m, {"ok": True})
+
+    def _h_fn_fetch(self, ctx: _ConnCtx, m: dict) -> None:
+        ctx.reply(m, {"blob": self.gcs.fetch_function(m["function_id"])})
+
+    # -- actors ------------------------------------------------------------
+    def _h_create_actor(self, ctx: _ConnCtx, m: dict) -> None:
+        spec = m["spec"]
+        actor_id = spec["actor_id"]
+        with self.lock:
+            if spec.get("name"):
+                ok = self.gcs.register_named_actor(
+                    spec.get("namespace", "default"), spec["name"], actor_id)
+                if not ok:
+                    ctx.reply(m, {"__error__": ValueError(
+                        f"actor name {spec['name']!r} already taken")})
+                    return
+            actor = ActorRecord(actor_id, spec)
+            self.actors[actor_id] = actor
+            rec = TaskRecord(spec["creation_task"])
+            self.tasks[rec.task_id] = rec
+            for oid in rec.spec["return_ids"]:
+                e = self.objects.setdefault(oid, ObjectEntry())
+                e.producing_task = rec.task_id
+            rec.deps = {d for d in rec.deps if not self._object_ready(d)}
+            self.pending_queue.append(rec)
+            self._schedule()
+        ctx.reply(m, {"ok": True})
+
+    def _on_actor_created(self, rec: TaskRecord, failed: bool) -> None:
+        actor = self.actors.get(rec.actor_id)
+        if actor is None:
+            return
+        if actor.state == "dead":
+            # kill() raced creation: do not resurrect — tear the worker
+            # down instead of letting a killed actor serve calls.
+            w = rec.worker
+            if w is not None and w.state != "dead":
+                w.state = "dead"
+                self._give_back(w.resources_held)
+                w.resources_held = {}
+                if w.conn_send:
+                    w.conn_send({"type": "exit"})
+                if w.proc is not None:
+                    w.proc.terminate()
+                self.workers.pop(w.worker_id, None)
+            return
+        if failed:
+            actor.state = "dead"
+            actor.death_reason = "creation task failed"
+            self._release_actor_holds(actor)
+            self._fail_actor_queue(actor)
+            if actor.worker is not None:
+                self._handle_worker_death(actor.worker, "creation failed",
+                                          actor_already_handled=True)
+            return
+        actor.state = "alive"
+        actor.worker = rec.worker
+        if rec.worker is not None:
+            rec.worker.actor_id = actor.actor_id
+            rec.worker.current_task = None
+        self._drain_actor_queue(actor)
+
+    def _enqueue_actor_task(self, rec: TaskRecord) -> None:
+        actor = self.actors.get(rec.actor_id)
+        if actor is None or actor.state == "dead":
+            reason = actor.death_reason if actor else "unknown actor"
+            self._fail_task_returns(rec, exc.ActorDiedError(
+                rec.actor_id.hex(), reason))
+            return
+        actor.queue.append(rec)
+        self._drain_actor_queue(actor)
+
+    def _drain_actor_queue(self, actor: ActorRecord) -> None:
+        if actor.state != "alive" or actor.worker is None:
+            return
+        # Head-of-line blocking on unmet deps preserves the sync-actor
+        # strict submission-order guarantee (a later no-dep call must not
+        # overtake an earlier call waiting on its argument).
+        while actor.queue and not actor.queue[0].deps:
+            rec = actor.queue.popleft()
+            rec.state = "dispatched"
+            actor.in_flight[rec.task_id] = rec
+            actor.worker.conn_send({"type": "execute_task",
+                                    "spec": rec.spec})
+
+    def _release_actor_holds(self, actor: ActorRecord) -> None:
+        """Release the creation-task embedded ref holds exactly once, at
+        permanent actor death (they must outlive restarts: the creation
+        spec and its arg blob are replayed)."""
+        if actor.holds_released:
+            return
+        actor.holds_released = True
+        for dep in actor.spec["creation_task"].get("embedded") or []:
+            self._decref(dep)
+
+    def _fail_actor_queue(self, actor: ActorRecord) -> None:
+        err = exc.ActorDiedError(actor.actor_id.hex(), actor.death_reason)
+        while actor.queue:
+            self._fail_task_returns(actor.queue.popleft(), err)
+        for rec in list(actor.in_flight.values()):
+            self._fail_task_returns(rec, err)
+        actor.in_flight.clear()
+
+    def _h_kill_actor(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            actor = self.actors.get(m["actor_id"])
+            if actor is None:
+                ctx.reply(m, {"ok": False})
+                return
+            if m.get("no_restart", True):
+                actor.restarts_left = 0
+            actor.state = "dead"
+            actor.death_reason = "killed via kill()"
+            self.gcs.drop_named_actor(actor.actor_id)
+            self._release_actor_holds(actor)
+            self._fail_actor_queue(actor)
+            w = actor.worker
+            if w is not None:
+                w.state = "dead"
+                self._give_back(w.resources_held)
+                w.resources_held = {}
+                if w.conn_send:
+                    w.conn_send({"type": "exit"})
+                if w.proc is not None:
+                    w.proc.terminate()
+                self.workers.pop(w.worker_id, None)
+        ctx.reply(m, {"ok": True})
+
+    def _h_actor_state(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            a = self.actors.get(m["actor_id"])
+            ctx.reply(m, {"state": a.state if a else "unknown",
+                          "reason": a.death_reason if a else ""})
+
+    def _h_lookup_named_actor(self, ctx: _ConnCtx, m: dict) -> None:
+        aid = self.gcs.lookup_named_actor(m["namespace"], m["name"])
+        spec = None
+        with self.lock:
+            if aid is not None and aid in self.actors:
+                spec = {k: v for k, v in self.actors[aid].spec.items()
+                        if k != "creation_task"}
+        ctx.reply(m, {"actor_id": aid, "spec": spec})
+
+    def _h_list_named_actors(self, ctx: _ConnCtx, m: dict) -> None:
+        ctx.reply(m, {"names": self.gcs.list_named_actors(m.get("namespace"))})
+
+    # -- cluster info ------------------------------------------------------
+    def _h_cluster_resources(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            ctx.reply(m, {"total": dict(self.resources_total),
+                          "available": dict(self.resources_avail)})
+
+    def _h_store_stats(self, ctx: _ConnCtx, m: dict) -> None:
+        ctx.reply(m, {"stats": self._store().stats()})
+
+    def _h_shutdown(self, ctx: _ConnCtx, m: dict) -> None:
+        ctx.reply(m, {"ok": True})
+        threading.Thread(target=self.shutdown, daemon=True).start()
+
+    # ------------------------------------------------------------------
+    # scheduling
+    # ------------------------------------------------------------------
+    def _take(self, res: Dict[str, float], allow_negative: bool = False) -> bool:
+        for k, v in res.items():
+            if not allow_negative and self.resources_avail.get(k, 0.0) < v - 1e-9:
+                return False
+        for k, v in res.items():
+            self.resources_avail[k] = self.resources_avail.get(k, 0.0) - v
+        return True
+
+    def _give_back(self, res: Dict[str, float]) -> None:
+        for k, v in res.items():
+            self.resources_avail[k] = self.resources_avail.get(k, 0.0) + v
+
+    def _release_worker(self, w: WorkerHandle) -> None:
+        self._give_back(w.resources_held)
+        w.resources_held = {}
+        w.current_task = None
+        w.state = "idle"
+        w.last_idle_time = time.time()
+
+    def _schedule(self) -> None:
+        """Dispatch every runnable pending task. Caller holds self.lock."""
+        if self._shutdown:
+            return
+        progressed = True
+        while progressed:
+            progressed = False
+            for rec in list(self.pending_queue):
+                if rec.deps:
+                    continue
+                res = dict(rec.spec.get("resources") or {})
+                needs_tpu = res.get("TPU", 0) > 0
+                if not self._take(res):
+                    continue
+                w = self._find_idle_worker(tpu=needs_tpu)
+                if w is None:
+                    self._give_back(res)
+                    self._maybe_spawn(tpu=needs_tpu)
+                    continue
+                self.pending_queue.remove(rec)
+                rec.state = "dispatched"
+                rec.worker = w
+                w.state = "busy"
+                w.current_task = rec
+                w.resources_held = res
+                w.conn_send({"type": "execute_task", "spec": rec.spec})
+                progressed = True
+
+    def _find_idle_worker(self, tpu: bool) -> Optional[WorkerHandle]:
+        for w in self.workers.values():
+            if w.state == "idle" and w.tpu == tpu and w.actor_id is None:
+                return w
+        return None
+
+    def _maybe_spawn(self, tpu: bool) -> None:
+        starting = sum(1 for w in self.workers.values()
+                       if w.state == "starting" and w.tpu == tpu)
+        if self._spawn_failures >= self._spawn_failure_limit:
+            return
+        demand = sum(
+            1 for r in self.pending_queue
+            if not r.deps
+            and (((r.spec.get("resources") or {}).get("TPU", 0) > 0) == tpu)
+        ) or 1
+        alive = sum(1 for w in self.workers.values() if w.state != "dead")
+        want = min(demand - starting, self._max_workers - alive)
+        for _ in range(max(want, 0)):
+            self._spawn_worker(tpu)
+
+    def _spawn_worker(self, tpu: bool) -> WorkerHandle:
+        self._next_worker_seq += 1
+        worker_id = os.urandom(16)
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_NODE_SOCKET"] = self.socket_path
+        env["RAY_TPU_STORE_PATH"] = self.store_path
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        # Workers must find ray_tpu even when the driver added it to
+        # sys.path manually (running from an unrelated cwd).
+        import ray_tpu
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_parent not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_parent + os.pathsep + existing
+                                 if existing else pkg_parent)
+        if not tpu:
+            # Plain workers must not grab the TPU chip: jax in a worker
+            # sees CPU unless the task explicitly asked for TPU resources.
+            env["JAX_PLATFORMS"] = "cpu"
+            # Skip TPU-platform plugin registration hooks (e.g. axon's
+            # sitecustomize imports jax in every interpreter): CPU workers
+            # must start in ~0.3s, not seconds.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, cwd=os.getcwd())
+        w = WorkerHandle(worker_id, proc, tpu)
+        self.workers[worker_id] = w
+        return w
+
+    def _handle_worker_death(self, w: WorkerHandle, reason: str,
+                             actor_already_handled: bool = False) -> None:
+        if w.state == "dead":
+            return
+        if w.state == "starting":
+            self._spawn_failures += 1
+            if self._spawn_failures >= self._spawn_failure_limit:
+                err = exc.WorkerCrashedError(
+                    f"{self._spawn_failures} consecutive workers died "
+                    f"before registering (last: {reason}); worker "
+                    "environment is broken — failing pending tasks")
+                for rec in list(self.pending_queue):
+                    self._fail_task_returns(rec, err)
+                self.pending_queue.clear()
+        if w.state == "busy":
+            # ("blocked" workers already returned their resources when
+            # they blocked — giving back again would double-credit.)
+            self._give_back(w.resources_held)
+        w.state = "dead"
+        self.workers.pop(w.worker_id, None)
+        rec = w.current_task
+        if rec is not None and rec.state == "dispatched":
+            if rec.retries_left > 0 and not rec.is_actor_creation:
+                rec.retries_left -= 1
+                rec.state = "pending"
+                rec.worker = None
+                self.pending_queue.append(rec)
+            else:
+                self._fail_task_returns(
+                    rec, exc.WorkerCrashedError(
+                        f"worker died while running "
+                        f"{rec.spec.get('name')}: {reason}"))
+                if rec.is_actor_creation and rec.actor_id is not None:
+                    # A crash during __init__ must not strand the actor
+                    # in 'pending' (method calls would hang forever) —
+                    # restart or declare it dead.
+                    actor = self.actors.get(rec.actor_id)
+                    if actor is not None and actor.state != "dead":
+                        self._on_actor_worker_death(
+                            actor, f"worker died during creation: {reason}")
+        if w.actor_id is not None and not actor_already_handled:
+            actor = self.actors.get(w.actor_id)
+            if actor is not None and actor.state != "dead":
+                self._on_actor_worker_death(actor, reason)
+
+    def _on_actor_worker_death(self, actor: ActorRecord, reason: str) -> None:
+        # Fail in-flight calls; restart if budget remains.
+        err = exc.ActorDiedError(actor.actor_id.hex(), reason)
+        for rec in list(actor.in_flight.values()):
+            self._fail_task_returns(rec, err)
+        actor.in_flight.clear()
+        actor.worker = None
+        if actor.restarts_left != 0:
+            if actor.restarts_left > 0:
+                actor.restarts_left -= 1
+            actor.state = "restarting"
+            creation = dict(actor.spec["creation_task"])
+            creation["task_id"] = os.urandom(16)
+            # Fresh return object for the restart's creation result.
+            creation["return_ids"] = [os.urandom(16)]
+            rec = TaskRecord(creation)
+            # Init args produced before the first creation are READY now;
+            # without pruning, stale deps would block the restart forever.
+            rec.deps = {d for d in rec.deps if not self._object_ready(d)}
+            self.tasks[rec.task_id] = rec
+            for oid in creation["return_ids"]:
+                e = self.objects.setdefault(oid, ObjectEntry())
+                e.producing_task = rec.task_id
+            self.pending_queue.append(rec)
+            self._schedule()
+        else:
+            actor.state = "dead"
+            actor.death_reason = reason
+            self.gcs.drop_named_actor(actor.actor_id)
+            self._release_actor_holds(actor)
+            self._fail_actor_queue(actor)
+
+    def _fail_task_returns(self, rec: TaskRecord, error: Exception) -> None:
+        blob = ser.dumps(error)
+        rec.state = "done"
+        self.tasks.pop(rec.task_id, None)
+        try:
+            self.pending_queue.remove(rec)
+        except ValueError:
+            pass
+        for oid in rec.spec["return_ids"]:
+            self._register_object(oid, "error", blob, len(blob),
+                                  state=FAILED)
+        if not rec.is_actor_creation:
+            for dep in rec.spec.get("embedded") or []:
+                self._decref(dep)
+
+    # ------------------------------------------------------------------
+    # monitor: deadlines, dead procs, idle reaping
+    # ------------------------------------------------------------------
+    def _monitor_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(0.05)
+            now = time.time()
+            fire = []
+            with self.lock:
+                remaining = []
+                for deadline, cb in self._deadline_waiters:
+                    if now >= deadline:
+                        fire.append(cb)
+                    else:
+                        remaining.append((deadline, cb))
+                self._deadline_waiters = remaining
+                for w in list(self.workers.values()):
+                    if (w.proc is not None and w.proc.poll() is not None
+                            and w.state != "dead"):
+                        self._handle_worker_death(
+                            w, f"worker process exited "
+                               f"(code {w.proc.returncode})")
+                        self._schedule()
+                idle_timeout = config.worker_idle_timeout_s
+                for w in list(self.workers.values()):
+                    if (w.state == "idle" and w.actor_id is None
+                            and now - w.last_idle_time > idle_timeout):
+                        w.state = "dead"
+                        self.workers.pop(w.worker_id, None)
+                        if w.conn_send:
+                            w.conn_send({"type": "exit"})
+            for cb in fire:
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+
+def _unregister_waiter(entries: List[ObjectEntry], cb) -> None:
+    """Remove a satisfied/expired waiter so polling loops on never-ready
+    objects don't grow entry.waiters unboundedly. Caller holds the lock."""
+    for e in entries:
+        try:
+            e.waiters.remove(cb)
+        except ValueError:
+            pass
+    entries.clear()
+
+
+def _OID(b: bytes):
+    from ray_tpu._private.ids import ObjectID
+    return ObjectID(b)

@@ -1,0 +1,241 @@
+"""Message transport: length-prefixed pickle frames over unix sockets.
+
+This is the local-node control-plane transport (analog of the reference's
+gRPC layer, src/ray/rpc/).  Every client (driver or worker) keeps ONE
+connection to its node service; replies are matched to requests by id, and
+unsolicited pushes (task execution requests) are routed to a handler —
+mirroring how the reference multiplexes PushTask onto core-worker gRPC
+streams.
+
+Chaos hooks replicate the reference's RAY_testing_rpc_failure /
+RAY_testing_asio_delay_us env-driven fault injection (src/ray/rpc/
+rpc_chaos.h:23, ray_config_def.h:833-841) so failure-handling tests can
+exercise retry paths deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu._private.config import config
+
+_LEN = struct.Struct("<Q")
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Chaos injection (reference: rpc_chaos.h)
+# ---------------------------------------------------------------------------
+class _Chaos:
+    def __init__(self) -> None:
+        self._fail_budget: Dict[str, int] = {}
+        self._delays: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self._parsed = False
+
+    def _parse(self) -> None:
+        if self._parsed:
+            return
+        self._parsed = True
+        spec = config.testing_rpc_failure
+        if spec:
+            for part in spec.split(","):
+                method, _, n = part.partition(":")
+                self._fail_budget[method.strip()] = int(n or 1)
+        dspec = config.testing_asio_delay_us
+        if dspec:
+            for part in dspec.split(","):
+                method, lo, hi = part.split(":")
+                self._delays[method.strip()] = (int(lo), int(hi))
+
+    def maybe_inject(self, method: str) -> None:
+        self._parse()
+        if not self._fail_budget and not self._delays:
+            return
+        with self._lock:
+            if method in self._delays:
+                lo, hi = self._delays[method]
+                time.sleep(random.uniform(lo, hi) / 1e6)
+            budget = self._fail_budget.get(method, 0)
+            if budget > 0 and random.random() < 0.5:
+                self._fail_budget[method] = budget - 1
+                raise ConnectionLost(f"chaos: injected failure for {method}")
+
+
+chaos = _Chaos()
+
+
+def send_msg(sock: socket.socket, msg: Any, lock: Optional[threading.Lock] = None) -> None:
+    data = pickle.dumps(msg, protocol=5)
+    frame = _LEN.pack(len(data)) + data
+    if lock:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        try:
+            chunk = sock.recv(min(n, 4 << 20))
+        except (ConnectionResetError, OSError) as e:
+            raise ConnectionLost(str(e)) from e
+        if not chunk:
+            raise ConnectionLost("socket closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class Connection:
+    """A request/reply + push connection over a unix socket.
+
+    Thread-safe: any thread may `call` (blocking RPC) or `notify`
+    (one-way); a dedicated receiver thread routes replies by request id
+    and hands pushes to `push_handler`.
+    """
+
+    def __init__(self, sock: socket.socket,
+                 push_handler: Optional[Callable[[dict], None]] = None,
+                 on_disconnect: Optional[Callable[[], None]] = None) -> None:
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._push_handler = push_handler
+        self._on_disconnect = on_disconnect
+        self._pending: Dict[int, "_Waiter"] = {}
+        self._pending_lock = threading.Lock()
+        self._req_counter = 0
+        self._closed = False
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True, name="rtpu-conn-recv")
+        self._recv_thread.start()
+
+    def _next_req_id(self) -> int:
+        with self._pending_lock:
+            self._req_counter += 1
+            return self._req_counter
+
+    def _recv_loop(self) -> None:
+        try:
+            while True:
+                msg = recv_msg(self._sock)
+                rid = msg.get("__reply_to__")
+                if rid is not None:
+                    with self._pending_lock:
+                        waiter = self._pending.pop(rid, None)
+                    if waiter is not None:
+                        waiter.set(msg)
+                elif self._push_handler is not None:
+                    self._push_handler(msg)
+        except (ConnectionLost, pickle.UnpicklingError, EOFError):
+            pass
+        finally:
+            self._closed = True
+            with self._pending_lock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for w in pending:
+                w.fail(ConnectionLost("connection to node service lost"))
+            if self._on_disconnect:
+                self._on_disconnect()
+
+    def call(self, msg: dict, timeout: Optional[float] = None) -> dict:
+        """Blocking request/reply."""
+        chaos.maybe_inject(msg.get("type", "?"))
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        rid = self._next_req_id()
+        msg["__req_id__"] = rid
+        waiter = _Waiter()
+        with self._pending_lock:
+            self._pending[rid] = waiter
+        send_msg(self._sock, msg, self._send_lock)
+        reply = waiter.wait(timeout)
+        if reply is None:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise TimeoutError(f"rpc {msg.get('type')} timed out")
+        if isinstance(reply, Exception):
+            raise reply
+        err = reply.get("__error__")
+        if err is not None:
+            raise err if isinstance(err, Exception) else RuntimeError(err)
+        return reply
+
+    def notify(self, msg: dict) -> None:
+        """One-way message (no reply expected)."""
+        chaos.maybe_inject(msg.get("type", "?"))
+        send_msg(self._sock, msg, self._send_lock)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class _Waiter:
+    __slots__ = ("_event", "_value")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._value: Any = None
+
+    def set(self, value: Any) -> None:
+        self._value = value
+        self._event.set()
+
+    def fail(self, exc: Exception) -> None:
+        self._value = exc
+        self._event.set()
+
+    def wait(self, timeout: Optional[float]) -> Any:
+        if not self._event.wait(timeout):
+            return None
+        return self._value
+
+
+def connect_uds(path: str, deadline_s: float = 10.0) -> socket.socket:
+    start = time.time()
+    while True:
+        try:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect(path)
+            return sock
+        except (FileNotFoundError, ConnectionRefusedError):
+            if time.time() - start > deadline_s:
+                raise
+            time.sleep(0.02)
+
+
+def connect_tcp(host: str, port: int, deadline_s: float = 10.0) -> socket.socket:
+    start = time.time()
+    while True:
+        try:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.connect((host, port))
+            return sock
+        except (ConnectionRefusedError, OSError):
+            if time.time() - start > deadline_s:
+                raise
+            time.sleep(0.05)

@@ -1,0 +1,159 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band zero-copy buffers.
+
+Analog of the reference's `python/ray/_private/serialization.py` plus its
+vendored cloudpickle: we use stock cloudpickle for closures/classes and
+pickle protocol 5 `buffer_callback` to extract large contiguous buffers
+(numpy arrays, bytes) out-of-band so they can be written into / read from
+the shared-memory object store without copies.
+
+Wire format of a stored object (all little-endian):
+
+    u32 magic 'RTO1'
+    u32 n_buffers
+    u64 inband_len
+    n_buffers * (u64 offset_from_start, u64 length)
+    inband pickle bytes
+    ...64-byte-aligned buffer payloads...
+
+Deserialization maps buffers as memoryviews straight out of shared memory
+(zero-copy for numpy via PickleBuffer).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+
+MAGIC = b"RTO1"
+_ALIGN = 64
+_HDR = struct.Struct("<4sIQ")
+_BUF = struct.Struct("<QQ")
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class SerializedObject:
+    """In-band bytes + out-of-band buffers, with total-size accounting."""
+
+    __slots__ = ("inband", "buffers", "total_size")
+
+    def __init__(self, inband: bytes, buffers: List[pickle.PickleBuffer]):
+        self.inband = inband
+        self.buffers = buffers
+        size = _HDR.size + _BUF.size * len(buffers) + len(inband)
+        for b in buffers:
+            size = _align(size) + memoryview(b).nbytes
+        self.total_size = size
+
+    def write_into(self, dest: memoryview) -> int:
+        """Serialize into a writable buffer; returns bytes written."""
+        n = len(self.buffers)
+        off = _HDR.size + _BUF.size * n + len(self.inband)
+        offsets = []
+        for b in self.buffers:
+            off = _align(off)
+            offsets.append((off, memoryview(b).nbytes))
+            off += memoryview(b).nbytes
+        _HDR.pack_into(dest, 0, MAGIC, n, len(self.inband))
+        pos = _HDR.size
+        for o, ln in offsets:
+            _BUF.pack_into(dest, pos, o, ln)
+            pos += _BUF.size
+        dest[pos:pos + len(self.inband)] = self.inband
+        for (o, ln), b in zip(offsets, self.buffers):
+            mv = memoryview(b).cast("B")
+            dest[o:o + ln] = mv
+        return off
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def _device_arrays_to_host(obj: Any) -> Any:
+    """jax.Arrays cannot cross processes; pull them to host numpy lazily.
+
+    Registered as a cloudpickle reducer-by-value at serialize time via the
+    persistent hooks below (we avoid importing jax unless it is already
+    loaded, so the core runtime has no hard jax dependency).
+    """
+    return obj
+
+
+class _Pickler(cloudpickle.Pickler):
+    def __init__(self, file, buffer_callback, ref_reducer=None):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+        self._ref_reducer = ref_reducer
+
+    def reducer_override(self, obj):
+        # jax.Array -> numpy (host transfer) — only if jax is loaded.
+        import sys
+        jax = sys.modules.get("jax")
+        if jax is not None and isinstance(obj, jax.Array):
+            import numpy as np
+            return (np.asarray, (np.asarray(obj),))
+        if self._ref_reducer is not None:
+            r = self._ref_reducer(obj)
+            if r is not None:
+                return r
+        return NotImplemented
+
+
+def serialize(
+    obj: Any,
+    ref_reducer: Optional[Callable] = None,
+) -> SerializedObject:
+    """Serialize `obj`; `ref_reducer(obj)` may return a custom reduce tuple
+    for ObjectRef instances (used by the worker layer to track borrows)."""
+    buffers: List[pickle.PickleBuffer] = []
+
+    def cb(buf: pickle.PickleBuffer) -> bool:
+        # Only take large buffers out-of-band; small ones stay in-band.
+        if memoryview(buf).nbytes >= 512:
+            buffers.append(buf)
+            return False
+        return True
+
+    f = io.BytesIO()
+    _Pickler(f, cb, ref_reducer).dump(obj)
+    return SerializedObject(f.getvalue(), buffers)
+
+
+def deserialize(data: memoryview, copy_buffers: bool = False) -> Any:
+    """Deserialize from a (possibly shared-memory-backed) buffer.
+
+    With copy_buffers=False, returned numpy arrays alias `data` — callers
+    must keep the backing store segment alive (the object store pins it
+    via the ref count until released).
+    """
+    data = memoryview(data).cast("B")
+    magic, n, inband_len = _HDR.unpack_from(data, 0)
+    if magic != MAGIC:
+        raise ValueError("Corrupt object header")
+    pos = _HDR.size
+    bufs = []
+    for _ in range(n):
+        o, ln = _BUF.unpack_from(data, pos)
+        pos += _BUF.size
+        mv = data[o:o + ln]
+        if copy_buffers:
+            mv = memoryview(bytes(mv))
+        bufs.append(mv)
+    inband = data[pos:pos + inband_len]
+    return pickle.loads(inband, buffers=bufs)
+
+
+def dumps(obj: Any) -> bytes:
+    """One-shot helper (control-plane messages, function table entries)."""
+    return serialize(obj).to_bytes()
+
+
+def loads(data: bytes) -> Any:
+    return deserialize(memoryview(data), copy_buffers=True)

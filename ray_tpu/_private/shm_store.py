@@ -1,0 +1,191 @@
+"""ctypes binding + zero-copy Python client for the native shm object store.
+
+The Python side mmaps the same store file the C++ library manages, so
+object reads hand out memoryviews directly over shared memory — the same
+zero-copy property plasma clients get in the reference
+(src/ray/object_manager/plasma/client.cc) without a socket round-trip.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import weakref
+from typing import Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError
+from ray_tpu.native.build import build_library
+
+# Status codes — keep in sync with shm_store.cc.
+OK = 0
+NOTFOUND = -1
+EXISTS = -2
+FULL = -3
+CREATING = -4
+ERROR = -5
+TABLE_FULL = -6
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = build_library("shmstore", ["shm_store.cc"])
+    lib = ctypes.CDLL(so)
+    u64 = ctypes.c_uint64
+    p_u64 = ctypes.POINTER(u64)
+    lib.shm_store_create.argtypes = [ctypes.c_char_p, u64]
+    lib.shm_store_open.argtypes = [ctypes.c_char_p]
+    lib.shm_store_close.argtypes = [ctypes.c_int]
+    lib.shm_store_create_object.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, u64, p_u64]
+    lib.shm_store_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.shm_store_abort.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.shm_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p, p_u64, p_u64]
+    lib.shm_store_contains.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.shm_store_release.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.shm_store_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.shm_store_stats.argtypes = [ctypes.c_int, p_u64, p_u64, p_u64, p_u64]
+    _lib = lib
+    return lib
+
+
+class ShmObjectStore:
+    """Per-process client of one host-wide shared-memory store segment."""
+
+    def __init__(self, path: str, capacity: Optional[int] = None,
+                 create: bool = False) -> None:
+        lib = _load()
+        self._path = path
+        if create:
+            self._handle = lib.shm_store_create(path.encode(), capacity)
+            if self._handle < 0:
+                raise RuntimeError(f"failed to create shm store at {path}")
+        else:
+            self._handle = lib.shm_store_open(path.encode())
+            if self._handle < 0:
+                raise RuntimeError(f"failed to open shm store at {path}")
+        self._fd = os.open(path, os.O_RDWR)
+        self._mm = mmap.mmap(self._fd, 0)
+        self._mv = memoryview(self._mm)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        if self._handle >= 0:
+            self._mv.release()
+            try:
+                self._mm.close()
+            except BufferError:
+                # Zero-copy views handed to callers are still alive; leave
+                # the mapping for process exit to reclaim.
+                pass
+            os.close(self._fd)
+            _load().shm_store_close(self._handle)
+            self._handle = -1
+
+    def destroy(self) -> None:
+        self.close()
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+
+    # -- object ops --------------------------------------------------------
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        """Allocate a writable buffer for a new object (state CREATING)."""
+        off = ctypes.c_uint64()
+        rc = _load().shm_store_create_object(
+            self._handle, object_id.binary(), size, ctypes.byref(off))
+        if rc == FULL:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes does not fit "
+                f"(store stats: {self.stats()})")
+        if rc == EXISTS:
+            raise FileExistsError(f"object {object_id.hex()} already exists")
+        if rc != OK:
+            raise RuntimeError(f"shm create failed rc={rc}")
+        return self._mv[off.value:off.value + size]
+
+    def seal(self, object_id: ObjectID) -> None:
+        rc = _load().shm_store_seal(self._handle, object_id.binary())
+        if rc != OK:
+            raise RuntimeError(f"seal failed rc={rc}")
+
+    def abort(self, object_id: ObjectID) -> None:
+        _load().shm_store_abort(self._handle, object_id.binary())
+
+    def put(self, object_id: ObjectID, data) -> None:
+        """Copy `data` (bytes-like) in as a sealed object."""
+        data = memoryview(data).cast("B")
+        buf = self.create(object_id, data.nbytes)
+        buf[:] = data
+        self.seal(object_id)
+        self.release(object_id)  # drop the creator pin
+
+    def get(self, object_id: ObjectID) -> Optional[memoryview]:
+        """Pinned zero-copy view of a sealed object, or None if absent.
+
+        The object stays pinned (unevictable) until `release`.
+        """
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = _load().shm_store_get(
+            self._handle, object_id.binary(),
+            ctypes.byref(off), ctypes.byref(size))
+        if rc in (NOTFOUND, CREATING):
+            return None
+        if rc != OK:
+            raise RuntimeError(f"shm get failed rc={rc}")
+        return self._mv[off.value:off.value + size.value]
+
+    def get_autoreleased_view(self, object_id: ObjectID
+                              ) -> Optional[memoryview]:
+        """Pinned zero-copy view whose pin auto-releases when the LAST
+        aliasing buffer (numpy array, memoryview) is garbage-collected.
+
+        Implementation: a private per-object mmap of the store file;
+        views slice it, so its weakref-finalizer fires only once every
+        alias is dead — the safe-lifetime property plasma gets from its
+        client-side buffer objects (reference: plasma/client.cc Release).
+        """
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = _load().shm_store_get(
+            self._handle, object_id.binary(),
+            ctypes.byref(off), ctypes.byref(size))
+        if rc in (NOTFOUND, CREATING):
+            return None
+        if rc != OK:
+            raise RuntimeError(f"shm get failed rc={rc}")
+        page = off.value & ~(mmap.ALLOCATIONGRANULARITY - 1)
+        delta = off.value - page
+        mm = mmap.mmap(self._fd, delta + size.value, offset=page)
+        handle, id_bytes = self._handle, object_id.binary()
+        weakref.finalize(
+            mm, lambda: _load().shm_store_release(handle, id_bytes))
+        return memoryview(mm)[delta:delta + size.value]
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return _load().shm_store_contains(
+            self._handle, object_id.binary()) == 1
+
+    def release(self, object_id: ObjectID) -> None:
+        _load().shm_store_release(self._handle, object_id.binary())
+
+    def delete(self, object_id: ObjectID) -> None:
+        _load().shm_store_delete(self._handle, object_id.binary())
+
+    def stats(self) -> dict:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        ev = ctypes.c_uint64()
+        _load().shm_store_stats(self._handle, ctypes.byref(used),
+                                ctypes.byref(cap), ctypes.byref(n),
+                                ctypes.byref(ev))
+        return {"used_bytes": used.value, "capacity_bytes": cap.value,
+                "num_objects": n.value, "num_evictions": ev.value}

@@ -1,0 +1,223 @@
+"""Worker process main loop.
+
+Analog of the reference's default_worker.py + the execution half of the
+core worker (CoreWorker::ExecuteTask, core_worker.cc:2913 →
+task_execution_handler, _raylet.pyx:2222).  One worker executes one task
+at a time; a worker that becomes an actor stays dedicated to it (actor
+scheduling queues, transport/task_receiver.h:51):
+
+* sync actors: strict arrival-order execution (the per-connection FIFO
+  plus this single consumer thread gives the reference's sequential
+  actor ordering guarantee);
+* max_concurrency>1: a thread pool (threaded actors);
+* async actors (any coroutine method): an asyncio loop thread with a
+  max_concurrency-bounded semaphore (reference runs boost::fibers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import queue
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.client import CoreClient, set_global_client
+
+
+class WorkerRuntime:
+    def __init__(self) -> None:
+        self.task_queue: "queue.Queue[dict]" = queue.Queue()
+        self.client: Optional[CoreClient] = None
+        self.actors: Dict[bytes, Any] = {}
+        self.actor_pool: Optional[ThreadPoolExecutor] = None
+        self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
+        self.actor_semaphore: Optional[asyncio.Semaphore] = None
+        self.max_concurrency = 1
+
+    # -- push messages from the node service -------------------------------
+    def handle_push(self, msg: dict) -> None:
+        if msg["type"] == "execute_task":
+            self.task_queue.put(msg)
+        elif msg["type"] == "exit":
+            os._exit(0)
+
+    def run(self) -> None:
+        worker_id = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])
+        self.client = CoreClient(
+            os.environ["RAY_TPU_NODE_SOCKET"], kind="worker",
+            client_id=worker_id, push_handler=self.handle_push)
+        set_global_client(self.client)
+        # Make the worker context importable by user code.
+        import ray_tpu
+        ray_tpu._mark_worker_connected(self.client)
+        while True:
+            msg = self.task_queue.get()
+            self.execute(msg["spec"])
+
+    # ------------------------------------------------------------------
+    def execute(self, spec: dict) -> None:
+        if spec.get("is_actor_creation"):
+            self._execute_actor_creation(spec)
+        elif spec.get("actor_id") is not None:
+            self._execute_actor_method(spec)
+        else:
+            self._execute_and_report(spec, self._run_function, spec)
+
+    def _run_function(self, spec: dict) -> Any:
+        fn = self.client.fetch_function(spec["function_id"])
+        args, kwargs = self.client.unpack_args(spec["args"])
+        return fn(*args, **kwargs)
+
+    def _execute_actor_creation(self, spec: dict) -> None:
+        def create(spec: dict) -> Any:
+            cls = self.client.fetch_function(spec["function_id"])
+            args, kwargs = self.client.unpack_args(spec["args"])
+            instance = cls(*args, **kwargs)
+            self.actors[spec["actor_id"]] = instance
+            self.max_concurrency = spec.get("max_concurrency", 1)
+            has_async = any(
+                inspect.iscoroutinefunction(m)
+                for _, m in inspect.getmembers(type(instance),
+                                               inspect.isfunction))
+            if has_async:
+                self._start_actor_loop()
+            elif self.max_concurrency > 1:
+                self.actor_pool = ThreadPoolExecutor(
+                    max_workers=self.max_concurrency,
+                    thread_name_prefix="rtpu-actor")
+            return None
+
+        self._execute_and_report(spec, create, spec)
+
+    def _start_actor_loop(self) -> None:
+        self.actor_loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def runner() -> None:
+            asyncio.set_event_loop(self.actor_loop)
+            self.actor_semaphore = asyncio.Semaphore(
+                max(self.max_concurrency, 1))
+            started.set()
+            self.actor_loop.run_forever()
+
+        threading.Thread(target=runner, daemon=True,
+                         name="rtpu-actor-loop").start()
+        started.wait()
+
+    def _execute_actor_method(self, spec: dict) -> None:
+        instance = self.actors.get(spec["actor_id"])
+        if instance is None:
+            self._report_error(spec, exc.ActorDiedError(
+                spec["actor_id"].hex(), "actor instance missing in worker"))
+            return
+        method = getattr(instance, spec["method_name"], None)
+        if method is None:
+            self._report_error(spec, AttributeError(
+                f"actor has no method {spec['method_name']!r}"))
+            return
+
+        if inspect.iscoroutinefunction(method) and self.actor_loop:
+            async def run_async() -> Any:
+                async with self.actor_semaphore:
+                    args, kwargs = self.client.unpack_args(spec["args"])
+                    return await method(*args, **kwargs)
+
+            def done_cb(fut) -> None:
+                try:
+                    self._report_value(spec, fut.result())
+                except BaseException as e:  # noqa: BLE001
+                    self._report_error(spec, e)
+
+            fut = asyncio.run_coroutine_threadsafe(run_async(),
+                                                   self.actor_loop)
+            fut.add_done_callback(done_cb)
+            return
+
+        def call(_spec: dict) -> Any:
+            args, kwargs = self.client.unpack_args(_spec["args"])
+            return method(*args, **kwargs)
+
+        if self.actor_pool is not None:
+            self.actor_pool.submit(self._execute_and_report, spec, call, spec)
+        elif self.actor_loop is not None:
+            # Async actor, sync method: run on the loop's executor so it
+            # doesn't block coroutines.
+            self.actor_loop.call_soon_threadsafe(
+                lambda: self.actor_loop.run_in_executor(
+                    None, self._execute_and_report, spec, call, spec))
+        else:
+            self._execute_and_report(spec, call, spec)
+
+    # ------------------------------------------------------------------
+    def _execute_and_report(self, spec: dict, fn, *args) -> None:
+        try:
+            value = fn(*args)
+        except BaseException as e:  # noqa: BLE001
+            self._report_error(spec, e)
+            return
+        self._report_value(spec, value)
+
+    def _report_value(self, spec: dict, value: Any) -> None:
+        n = spec["num_returns"]
+        return_ids = spec["return_ids"]
+        try:
+            if n == 1:
+                values = [value]
+            else:
+                values = list(value)
+                if len(values) != n:
+                    raise ValueError(
+                        f"task declared num_returns={n} but returned "
+                        f"{len(values)} values")
+            returns = [self.client.build_return_meta(oid, v)
+                       for oid, v in zip(return_ids, values)]
+        except BaseException as e:  # noqa: BLE001
+            self._report_error(spec, e)
+            return
+        self.client.conn.notify({"type": "task_done",
+                                 "task_id": spec["task_id"],
+                                 "returns": returns, "failed": False})
+
+    def _report_error(self, spec: dict, error: BaseException) -> None:
+        name = spec.get("name", "<task>")
+        if isinstance(error, exc.TaskError):
+            task_err: Exception = error  # propagate nested task errors as-is
+        else:
+            task_err = exc.TaskError.from_exception(name, error)
+            if spec.get("actor_id") is not None:
+                task_err = exc.ActorError(name, task_err.traceback_str,
+                                          cause=task_err.cause)
+        try:
+            blob = ser.dumps(task_err)
+        except Exception:
+            blob = ser.dumps(exc.TaskError(
+                name, "".join(traceback.format_exception(
+                    type(error), error, error.__traceback__)), cause=None))
+        returns = [(oid, "error", blob, len(blob), [])
+                   for oid in spec["return_ids"]]
+        self.client.conn.notify({"type": "task_done",
+                                 "task_id": spec["task_id"],
+                                 "returns": returns, "failed": True})
+
+
+def main() -> None:
+    sys.path.insert(0, os.getcwd())
+    try:
+        WorkerRuntime().run()
+    except (ConnectionError, EOFError):
+        pass  # node service went away (shutdown) — exit quietly
+    except Exception as e:
+        from ray_tpu._private.protocol import ConnectionLost
+        if not isinstance(e, ConnectionLost):
+            raise
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Actor API: @remote classes, handles, methods.
+
+Analog of the reference's python/ray/actor.py (ActorClass :581,
+ActorClass._remote :869, ActorHandle :1238, ActorMethod :116).  An actor
+is a dedicated worker process holding the instance; method calls are
+ordered tasks routed to that worker (sequential by default, threaded with
+max_concurrency>1, asyncio for coroutine methods).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private.config import config
+from ray_tpu.remote_function import _resources_from_options
+
+_VALID_ACTOR_OPTIONS = {
+    "num_cpus", "num_tpus", "resources", "max_restarts", "max_concurrency",
+    "name", "namespace", "lifetime", "max_task_retries",
+}
+
+
+def method(num_returns: int = 1):
+    """Per-method options decorator (reference: @ray.method)."""
+
+    def deco(fn):
+        fn.__rtpu_num_returns__ = num_returns
+        return fn
+
+    return deco
+
+
+class ActorClass:
+    def __init__(self, cls: type,
+                 options: Optional[Dict[str, Any]] = None) -> None:
+        self._cls = cls
+        self._options = dict(options or {})
+        bad = set(self._options) - _VALID_ACTOR_OPTIONS
+        if bad:
+            raise ValueError(f"invalid actor options: {sorted(bad)}")
+        self._blob: Optional[bytes] = None
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            "directly; use .remote().")
+
+    def options(self, **overrides) -> "ActorClass":
+        ac = ActorClass(self._cls, {**self._options, **overrides})
+        ac._blob = self._blob
+        return ac
+
+    def remote(self, *args, **kwargs) -> "ActorHandle":
+        import ray_tpu
+        client = ray_tpu._ensure_connected()
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._cls)
+        class_id = client.register_function(self._blob)
+        resources = _resources_from_options(
+            self._options, config.actor_default_num_cpus)
+        detached = self._options.get("lifetime") == "detached"
+        actor_id, ready_ref = client.create_actor(
+            class_id=class_id,
+            name_repr=self._cls.__name__,
+            args=args, kwargs=kwargs, resources=resources,
+            max_restarts=self._options.get(
+                "max_restarts", config.max_actor_restarts),
+            max_concurrency=self._options.get("max_concurrency", 1),
+            name=self._options.get("name"),
+            namespace=self._options.get("namespace", "default"),
+            detached=detached)
+        method_meta = _method_meta(self._cls)
+        return ActorHandle(actor_id, class_id, self._cls.__name__,
+                           method_meta, creation_ref=ready_ref)
+
+
+def _method_meta(cls: type) -> Dict[str, int]:
+    meta = {}
+    for name in dir(cls):
+        if name.startswith("__"):
+            continue
+        fn = getattr(cls, name, None)
+        if callable(fn):
+            meta[name] = getattr(fn, "__rtpu_num_returns__", 1)
+    return meta
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int) -> None:
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: Optional[int] = None) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name,
+                           num_returns if num_returns is not None
+                           else self._num_returns)
+
+    def remote(self, *args, **kwargs):
+        import ray_tpu
+        client = ray_tpu._ensure_connected()
+        refs = client.submit_actor_task(
+            self._handle._actor_id, self._handle._class_id, self._name,
+            args, kwargs, self._num_returns)
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"Actor method {self._name!r} cannot be called "
+                        "directly; use .remote().")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: bytes, class_id: bytes, class_name: str,
+                 method_meta: Dict[str, int], creation_ref=None) -> None:
+        self._actor_id = actor_id
+        self._class_id = class_id
+        self._class_name = class_name
+        self._method_meta = method_meta
+        # Holding the creation ref lets callers `get` it to await/verify
+        # construction; dropping it is harmless.
+        self._creation_ref = creation_ref
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_meta:
+            raise AttributeError(
+                f"actor {self._class_name!r} has no method {name!r}")
+        return ActorMethod(self, name, self._method_meta[name])
+
+    def __repr__(self) -> str:
+        return (f"ActorHandle({self._class_name}, "
+                f"{self._actor_id.hex()[:12]})")
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_id,
+                              self._class_name, self._method_meta))

@@ -1,0 +1,90 @@
+"""User-facing exception types.
+
+Analog of the reference's `python/ray/exceptions.py`.  Task errors are
+captured in the worker, serialized (with a formatted remote traceback),
+stored as the task's result object, and re-raised on `ray_tpu.get`.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception remotely; re-raised at `get`.
+
+    Mirrors the reference's `RayTaskError` (python/ray/exceptions.py):
+    carries the remote traceback string and the underlying cause when it
+    was picklable.
+    """
+
+    def __init__(self, function_name: str, traceback_str: str,
+                 cause: Optional[BaseException] = None) -> None:
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(function_name, traceback_str)
+
+    def __str__(self) -> str:
+        return (f"{type(self).__name__}: task {self.function_name!r} "
+                f"failed remotely:\n{self.traceback_str}")
+
+    @staticmethod
+    def from_exception(function_name: str, exc: BaseException) -> "TaskError":
+        tb = "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__))
+        return TaskError(function_name, tb, cause=exc)
+
+
+class ActorError(TaskError):
+    """An actor task failed (actor method raised or actor died mid-call)."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead; pending and future calls fail with this."""
+
+    def __init__(self, actor_id_hex: str, reason: str = "") -> None:
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(f"Actor {actor_id_hex} is dead. {reason}")
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is transiently unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """All copies of the object are lost and it cannot be reconstructed."""
+
+    def __init__(self, object_id_hex: str, reason: str = "") -> None:
+        self.object_id_hex = object_id_hex
+        super().__init__(f"Object {object_id_hex} is lost. {reason}")
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The shared-memory store could not satisfy an allocation."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get(..., timeout=)` expired before the object became available."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Preparing a task/actor runtime environment failed."""
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    """The placement group cannot fit on the cluster."""
+
+
+class NodeDiedError(RayTpuError):
+    """A node was declared dead by health checking."""

@@ -1,0 +1,39 @@
+"""Lazy native build: compiles the C++ runtime libraries with g++ on first
+use and caches the .so next to the sources (rebuilds when sources are newer).
+
+The reference ships prebuilt bazel artifacts; we compile at import time so
+the repo needs no install step.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+
+_CXX = os.environ.get("CXX", "g++")
+_FLAGS = ["-O2", "-g", "-fPIC", "-shared", "-std=c++17", "-pthread", "-Wall"]
+
+
+def build_library(name: str, sources: list[str]) -> str:
+    """Compile `sources` (relative to native/) into lib<name>.so; returns
+    the .so path. No-op when the cached .so is newer than all sources."""
+    so_path = os.path.join(_NATIVE_DIR, f"lib{name}.so")
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in sources]
+    with _LOCK:
+        if os.path.exists(so_path):
+            so_mtime = os.path.getmtime(so_path)
+            if all(os.path.getmtime(s) <= so_mtime for s in srcs):
+                return so_path
+        tmp = so_path + f".tmp.{os.getpid()}"
+        cmd = [_CXX, *_FLAGS, "-o", tmp, *srcs]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build failed: {' '.join(cmd)}\n{e.stderr}") from e
+        os.replace(tmp, so_path)
+    return so_path

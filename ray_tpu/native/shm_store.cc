@@ -1,0 +1,547 @@
+// Shared-memory object store — TPU-native analog of the reference's plasma
+// store (reference: src/ray/object_manager/plasma/store.h:55,
+// object_lifecycle_manager.h:101, eviction_policy.h:160, dlmalloc.cc).
+//
+// Design differences from the reference, on purpose:
+//  * The store is a single mmap'ed file (tmpfs/shm) shared by every process
+//    on the host; there is no store *server* process brokering access over a
+//    unix socket + fd-passing (plasma.fbs / fling.cc).  Instead the object
+//    table, allocator and eviction policy live *inside* the shared segment,
+//    guarded by a robust process-shared mutex, and every worker links this
+//    library and operates on the segment directly.  That removes a
+//    round-trip from the create/get hot path entirely (the reference pays a
+//    UDS RPC per create/get) while keeping crash-safety via robust futexes.
+//  * Allocation is a first-fit free list with boundary-tag coalescing over
+//    the data region (the reference uses dlmalloc-on-mmap).
+//  * Eviction is LRU over sealed, refcount-zero objects, exactly like the
+//    reference's LRUCache (eviction_policy.h:105).
+//
+// Exported as a plain C ABI for ctypes.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5254505553544f31ULL;  // "RTPUSTO1"
+constexpr uint32_t kIdSize = 16;
+constexpr uint64_t kAlign = 64;
+constexpr uint32_t kTableCapacity = 1 << 16;  // 65536 entries, power of two
+
+// ---- status codes (keep in sync with _private/shm_store.py) ----
+constexpr int kOK = 0;
+constexpr int kNotFound = -1;
+constexpr int kExists = -2;
+constexpr int kFull = -3;
+constexpr int kCreating = -4;
+constexpr int kError = -5;
+constexpr int kTableFull = -6;
+
+enum ObjState : uint32_t {
+  kEmpty = 0,
+  kStateCreating = 1,
+  kSealed = 2,
+  kTombstone = 3,  // deleted slot, reusable on insert, skipped on probe-stop
+};
+
+struct Entry {
+  uint8_t id[kIdSize];
+  uint64_t offset;      // file offset of object payload
+  uint64_t size;        // payload bytes
+  uint64_t lru_tick;    // last-touched tick for LRU eviction
+  uint32_t state;
+  uint32_t refcnt;
+  uint32_t pending_delete;
+  uint32_t pad;
+};
+
+// Allocator block header (boundary tags). Lives immediately before each
+// payload in the data region. Sizes include the header itself.
+struct Block {
+  uint64_t size;       // total block size incl. header
+  uint64_t prev_size;  // size of the physically previous block (0 if first)
+  uint32_t free;
+  uint32_t pad;
+  // When free, the first 16 payload bytes hold the free-list links:
+  uint64_t next_free;  // file offset of next free block (0 = none)
+  uint64_t prev_free;  // file offset of prev free block (0 = none)
+};
+constexpr uint64_t kBlockHdr = 24;  // size, prev_size, free+pad
+constexpr uint64_t kMinBlock = kBlockHdr + 16;
+
+struct Header {
+  uint64_t magic;
+  uint64_t total_size;
+  uint64_t data_offset;    // start of allocator region
+  uint64_t data_size;
+  uint64_t used_bytes;     // payload bytes in live objects
+  uint64_t num_objects;
+  uint64_t lru_clock;
+  uint64_t free_head;      // offset of first free block (0 = none)
+  uint64_t num_evictions;
+  uint64_t bytes_evicted;
+  pthread_mutex_t mutex;
+  Entry table[kTableCapacity];
+};
+
+struct Store {
+  uint8_t* base = nullptr;
+  uint64_t size = 0;
+  int fd = -1;
+  bool in_use = false;
+};
+
+constexpr int kMaxStores = 16;
+Store g_stores[kMaxStores];
+pthread_mutex_t g_stores_mutex = PTHREAD_MUTEX_INITIALIZER;
+
+inline Header* H(Store& s) { return reinterpret_cast<Header*>(s.base); }
+inline Block* B(Store& s, uint64_t off) {
+  return reinterpret_cast<Block*>(s.base + off);
+}
+
+class Locker {
+ public:
+  explicit Locker(Header* h) : h_(h) {
+    int rc = pthread_mutex_lock(&h_->mutex);
+    if (rc == EOWNERDEAD) {
+      // A worker died holding the lock; the segment metadata is still
+      // consistent enough for our operations (every mutation below is
+      // ordered so a torn update is at worst a leaked block).
+      pthread_mutex_consistent(&h_->mutex);
+    }
+  }
+  ~Locker() { pthread_mutex_unlock(&h_->mutex); }
+
+ private:
+  Header* h_;
+};
+
+uint64_t hash_id(const uint8_t* id) {
+  uint64_t h;
+  memcpy(&h, id, 8);
+  uint64_t h2;
+  memcpy(&h2, id + 8, 8);
+  h ^= h2 * 0x9e3779b97f4a7c15ULL;
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdULL;
+  h ^= h >> 33;
+  return h;
+}
+
+// Find entry for id; returns nullptr if absent.
+Entry* find(Header* h, const uint8_t* id) {
+  uint64_t idx = hash_id(id) & (kTableCapacity - 1);
+  for (uint32_t probe = 0; probe < kTableCapacity; ++probe) {
+    Entry& e = h->table[idx];
+    if (e.state == kEmpty) return nullptr;
+    if (e.state != kTombstone && memcmp(e.id, id, kIdSize) == 0) return &e;
+    idx = (idx + 1) & (kTableCapacity - 1);
+  }
+  return nullptr;
+}
+
+// Find slot to insert id (first tombstone or empty); nullptr if table full
+// or id already present (then *existing is set).
+Entry* insert_slot(Header* h, const uint8_t* id, Entry** existing) {
+  *existing = nullptr;
+  uint64_t idx = hash_id(id) & (kTableCapacity - 1);
+  Entry* slot = nullptr;
+  for (uint32_t probe = 0; probe < kTableCapacity; ++probe) {
+    Entry& e = h->table[idx];
+    if (e.state == kEmpty) {
+      return slot ? slot : &e;
+    }
+    if (e.state == kTombstone) {
+      if (!slot) slot = &e;
+    } else if (memcmp(e.id, id, kIdSize) == 0) {
+      *existing = &e;
+      return nullptr;
+    }
+    idx = (idx + 1) & (kTableCapacity - 1);
+  }
+  return slot;
+}
+
+// ---------------- allocator ----------------
+
+void freelist_remove(Store& s, uint64_t off) {
+  Header* h = H(s);
+  Block* b = B(s, off);
+  if (b->prev_free) {
+    B(s, b->prev_free)->next_free = b->next_free;
+  } else {
+    h->free_head = b->next_free;
+  }
+  if (b->next_free) B(s, b->next_free)->prev_free = b->prev_free;
+}
+
+void freelist_push(Store& s, uint64_t off) {
+  Header* h = H(s);
+  Block* b = B(s, off);
+  b->free = 1;
+  b->next_free = h->free_head;
+  b->prev_free = 0;
+  if (h->free_head) B(s, h->free_head)->prev_free = off;
+  h->free_head = off;
+}
+
+uint64_t data_end(Store& s) { return H(s)->data_offset + H(s)->data_size; }
+
+// Free a block at `off`, coalescing with physical neighbors.
+void block_free(Store& s, uint64_t off) {
+  Block* b = B(s, off);
+  // Coalesce with next.
+  uint64_t next_off = off + b->size;
+  if (next_off < data_end(s)) {
+    Block* nb = B(s, next_off);
+    if (nb->free) {
+      freelist_remove(s, next_off);
+      b->size += nb->size;
+    }
+  }
+  // Coalesce with prev.
+  if (b->prev_size) {
+    uint64_t prev_off = off - b->prev_size;
+    Block* pb = B(s, prev_off);
+    if (pb->free) {
+      freelist_remove(s, prev_off);
+      pb->size += b->size;
+      off = prev_off;
+      b = pb;
+    }
+  }
+  // Fix prev_size of the block after the (possibly grown) free block.
+  uint64_t after = off + b->size;
+  if (after < data_end(s)) B(s, after)->prev_size = b->size;
+  freelist_push(s, off);
+}
+
+// Allocate `payload` bytes; returns payload file offset or 0 on failure.
+uint64_t block_alloc(Store& s, uint64_t payload) {
+  Header* h = H(s);
+  uint64_t need = kBlockHdr + payload;
+  need = (need + kAlign - 1) & ~(kAlign - 1);
+  if (need < kMinBlock) need = kMinBlock;
+  uint64_t off = h->free_head;
+  while (off) {
+    Block* b = B(s, off);
+    if (b->size >= need) {
+      freelist_remove(s, off);
+      b->free = 0;
+      uint64_t rem = b->size - need;
+      if (rem >= kMinBlock) {
+        b->size = need;
+        uint64_t rem_off = off + need;
+        Block* rb = B(s, rem_off);
+        rb->size = rem;
+        rb->prev_size = need;
+        rb->free = 1;
+        uint64_t after = rem_off + rem;
+        if (after < data_end(s)) B(s, after)->prev_size = rem;
+        freelist_push(s, rem_off);
+      }
+      return off + kBlockHdr;
+    }
+    off = b->next_free;
+  }
+  return 0;
+}
+
+// Evict sealed refcnt==0 objects in LRU order until at least `bytes` of
+// payload could plausibly be allocated. Returns evicted byte count.
+uint64_t evict_lru(Store& s, uint64_t bytes) {
+  Header* h = H(s);
+  uint64_t freed = 0;
+  while (freed < bytes + kBlockHdr) {
+    Entry* victim = nullptr;
+    for (uint32_t i = 0; i < kTableCapacity; ++i) {
+      Entry& e = h->table[i];
+      if (e.state == kSealed && e.refcnt == 0 &&
+          (!victim || e.lru_tick < victim->lru_tick)) {
+        victim = &e;
+      }
+    }
+    if (!victim) break;
+    freed += victim->size + kBlockHdr;
+    h->used_bytes -= victim->size;
+    h->num_objects--;
+    h->num_evictions++;
+    h->bytes_evicted += victim->size;
+    block_free(s, victim->offset - kBlockHdr);
+    victim->state = kTombstone;
+  }
+  return freed;
+}
+
+int get_store(int handle, Store** out) {
+  if (handle < 0 || handle >= kMaxStores) return kError;
+  Store& s = g_stores[handle];
+  if (!s.in_use) return kError;
+  *out = &s;
+  return kOK;
+}
+
+int alloc_handle() {
+  pthread_mutex_lock(&g_stores_mutex);
+  int h = -1;
+  for (int i = 0; i < kMaxStores; ++i) {
+    if (!g_stores[i].in_use) {
+      g_stores[i].in_use = true;
+      h = i;
+      break;
+    }
+  }
+  pthread_mutex_unlock(&g_stores_mutex);
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new store file of `size` bytes at `path` and initialize it.
+int shm_store_create(const char* path, uint64_t size) {
+  if (size < sizeof(Header) + (1 << 20)) return kError;
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return kError;
+  if (ftruncate(fd, (off_t)size) != 0) {
+    close(fd);
+    unlink(path);
+    return kError;
+  }
+  void* base =
+      mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    unlink(path);
+    return kError;
+  }
+  int handle = alloc_handle();
+  if (handle < 0) {
+    munmap(base, size);
+    close(fd);
+    unlink(path);
+    return kError;
+  }
+  Store& s = g_stores[handle];
+  s.base = static_cast<uint8_t*>(base);
+  s.size = size;
+  s.fd = fd;
+
+  Header* h = H(s);
+  memset(h, 0, sizeof(Header));
+  h->total_size = size;
+  h->data_offset = (sizeof(Header) + kAlign - 1) & ~(kAlign - 1);
+  h->data_size = size - h->data_offset;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  // One giant free block spanning the data region.
+  Block* b = B(s, h->data_offset);
+  b->size = h->data_size & ~(kAlign - 1);
+  b->prev_size = 0;
+  b->free = 1;
+  b->next_free = 0;
+  b->prev_free = 0;
+  h->free_head = h->data_offset;
+
+  __sync_synchronize();
+  h->magic = kMagic;  // publish: openers spin on this
+  return handle;
+}
+
+int shm_store_open(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return kError;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return kError;
+  }
+  void* base =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return kError;
+  }
+  Header* h = reinterpret_cast<Header*>(base);
+  if (h->magic != kMagic) {
+    munmap(base, st.st_size);
+    close(fd);
+    return kError;
+  }
+  int handle = alloc_handle();
+  if (handle < 0) {
+    munmap(base, st.st_size);
+    close(fd);
+    return kError;
+  }
+  g_stores[handle].base = static_cast<uint8_t*>(base);
+  g_stores[handle].size = st.st_size;
+  g_stores[handle].fd = fd;
+  return handle;
+}
+
+int shm_store_close(int handle) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  munmap(s->base, s->size);
+  close(s->fd);
+  s->base = nullptr;
+  s->fd = -1;
+  pthread_mutex_lock(&g_stores_mutex);
+  s->in_use = false;
+  pthread_mutex_unlock(&g_stores_mutex);
+  return kOK;
+}
+
+// Begin creating an object: allocates space (evicting if needed), marks it
+// CREATING with refcnt 1 (held by the creator), returns payload offset.
+int shm_store_create_object(int handle, const uint8_t* id, uint64_t size,
+                            uint64_t* offset_out) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  Entry* existing;
+  Entry* slot = insert_slot(h, id, &existing);
+  if (existing) return kExists;
+  if (!slot) return kTableFull;
+  uint64_t off = block_alloc(*s, size);
+  if (!off) {
+    evict_lru(*s, size);
+    off = block_alloc(*s, size);
+    if (!off) return kFull;
+  }
+  memcpy(slot->id, id, kIdSize);
+  slot->offset = off;
+  slot->size = size;
+  slot->state = kStateCreating;
+  slot->refcnt = 1;
+  slot->pending_delete = 0;
+  slot->lru_tick = ++h->lru_clock;
+  h->used_bytes += size;
+  h->num_objects++;
+  *offset_out = off;
+  return kOK;
+}
+
+int shm_store_seal(int handle, const uint8_t* id) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  Entry* e = find(h, id);
+  if (!e) return kNotFound;
+  if (e->state != kStateCreating) return kError;
+  e->state = kSealed;
+  return kOK;
+}
+
+// Abort a creation (failed write): frees the allocation.
+int shm_store_abort(int handle, const uint8_t* id) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  Entry* e = find(h, id);
+  if (!e) return kNotFound;
+  if (e->state != kStateCreating) return kError;
+  h->used_bytes -= e->size;
+  h->num_objects--;
+  block_free(*s, e->offset - kBlockHdr);
+  e->state = kTombstone;
+  return kOK;
+}
+
+// Get a sealed object: bumps refcnt (pin) and LRU tick.
+int shm_store_get(int handle, const uint8_t* id, uint64_t* offset_out,
+                  uint64_t* size_out) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  Entry* e = find(h, id);
+  if (!e) return kNotFound;
+  if (e->state == kStateCreating) return kCreating;
+  e->refcnt++;
+  e->lru_tick = ++h->lru_clock;
+  *offset_out = e->offset;
+  *size_out = e->size;
+  return kOK;
+}
+
+int shm_store_contains(int handle, const uint8_t* id) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  Entry* e = find(h, id);
+  return (e && e->state == kSealed) ? 1 : 0;
+}
+
+// Release a pin taken by get (or by create after seal).
+int shm_store_release(int handle, const uint8_t* id) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  Entry* e = find(h, id);
+  if (!e) return kNotFound;
+  if (e->refcnt > 0) e->refcnt--;
+  if (e->refcnt == 0 && e->pending_delete) {
+    h->used_bytes -= e->size;
+    h->num_objects--;
+    block_free(*s, e->offset - kBlockHdr);
+    e->state = kTombstone;
+  }
+  return kOK;
+}
+
+// Delete an object (frees immediately if unpinned, else when released).
+int shm_store_delete(int handle, const uint8_t* id) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  Entry* e = find(h, id);
+  if (!e) return kNotFound;
+  if (e->refcnt > 0) {
+    e->pending_delete = 1;
+    return kOK;
+  }
+  h->used_bytes -= e->size;
+  h->num_objects--;
+  block_free(*s, e->offset - kBlockHdr);
+  e->state = kTombstone;
+  return kOK;
+}
+
+int shm_store_stats(int handle, uint64_t* used, uint64_t* capacity,
+                    uint64_t* num_objects, uint64_t* num_evictions) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  *used = h->used_bytes;
+  *capacity = h->data_size;
+  *num_objects = h->num_objects;
+  *num_evictions = h->num_evictions;
+  return kOK;
+}
+
+}  // extern "C"

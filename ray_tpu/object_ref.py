@@ -1,0 +1,77 @@
+"""ObjectRef: a first-class future/handle to an object in the store.
+
+Analog of the reference's `ObjectRef` (python/ray/_raylet.pyx / includes
+object_ref.pxi).  Lifetime protocol (see _private/client.py for the
+counting rules): a ref constructed as `owned` carries the entry's initial
+refcount; a ref reconstructed from the wire announces itself with add_ref
+on construction and remove_ref on GC.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owned", "_released", "__weakref__")
+
+    def __init__(self, id_bytes: bytes, owned: bool = True,
+                 _announce: bool = True) -> None:
+        self._id = id_bytes
+        self._owned = owned
+        self._released = False
+        if not owned and _announce:
+            client = _get_client()
+            if client is not None:
+                client.add_ref_async(id_bytes)
+
+    # -- identity ----------------------------------------------------------
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id.hex()})"
+
+    # -- future interface --------------------------------------------------
+    def get(self, timeout: Optional[float] = None) -> Any:
+        from ray_tpu import get
+        return get(self, timeout=timeout)
+
+    def __reduce__(self):
+        # Plain pickling (no client-mediated serialize) — e.g. a ref stored
+        # in a config dict.  The counting hook lives in the client's
+        # ref-aware serializer; this fallback just reconstructs a borrowed
+        # ref in the target process.
+        return (ObjectRef._from_wire, (self._id,))
+
+    @staticmethod
+    def _from_wire(id_bytes: bytes) -> "ObjectRef":
+        return ObjectRef(id_bytes, owned=False)
+
+    def _release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        client = _get_client()
+        if client is not None:
+            client.remove_ref_async(self._id)
+
+    def __del__(self) -> None:
+        try:
+            self._release()
+        except Exception:
+            pass
+
+
+def _get_client():
+    from ray_tpu._private.client import get_global_client
+    return get_global_client()

@@ -1,0 +1,90 @@
+"""@remote functions.
+
+Analog of the reference's python/ray/remote_function.py:40 (RemoteFunction,
+_remote at :266): the decorator wraps a function; `.remote()` registers the
+pickled function in the GCS function table once, then submits tasks that
+reference it by id; `.options()` returns a shallow copy with overrides.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private.config import config
+
+_VALID_OPTIONS = {
+    "num_returns", "num_cpus", "num_tpus", "resources", "max_retries",
+    "name",
+}
+
+
+def _resources_from_options(options: Dict[str, Any],
+                            default_cpus: float) -> Dict[str, float]:
+    res = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    res["CPU"] = float(default_cpus if num_cpus is None else num_cpus)
+    num_tpus = options.get("num_tpus")
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    return {k: v for k, v in res.items() if v}
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None) -> None:
+        self._fn = fn
+        self._options = dict(options or {})
+        bad = set(self._options) - _VALID_OPTIONS
+        if bad:
+            raise ValueError(f"invalid @remote options: {sorted(bad)}")
+        self._blob: Optional[bytes] = None
+        self._function_id: Optional[bytes] = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._fn.__name__!r} cannot be called "
+            "directly; use .remote().")
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = {**self._options, **overrides}
+        rf = RemoteFunction(self._fn, merged)
+        rf._blob = self._blob  # function bytes are option-independent
+        return rf
+
+    def _ensure_registered(self, client) -> bytes:
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._fn)
+        # register_function dedupes by content hash client- and GCS-side.
+        self._function_id = client.register_function(self._blob)
+        return self._function_id
+
+    def remote(self, *args, **kwargs):
+        import ray_tpu
+        client = ray_tpu._ensure_connected()
+        fid = self._ensure_registered(client)
+        num_returns = self._options.get("num_returns", 1)
+        resources = _resources_from_options(
+            self._options, config.task_default_num_cpus)
+        refs = client.submit_task(
+            function_id=fid,
+            name=self._options.get("name") or self._fn.__qualname__,
+            args=args, kwargs=kwargs, num_returns=num_returns,
+            resources=resources,
+            retries=self._options.get("max_retries", config.max_task_retries))
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __reduce__(self):
+        # Ship the underlying function + options.  The function is handed
+        # to the OUTER pickler (not dumped eagerly) so its memo table can
+        # break self-reference cycles (a recursive remote function's
+        # closure contains this very wrapper).
+        return (_rebuild_remote_function, (self._fn, self._options))
+
+
+def _rebuild_remote_function(fn, options: Dict[str, Any]) -> RemoteFunction:
+    return RemoteFunction(fn, options)

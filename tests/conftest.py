@@ -1,0 +1,47 @@
+"""Shared test fixtures.
+
+Mirrors the reference's conftest strategy (python/ray/tests/conftest.py:419
+ray_start_regular): a real single-node runtime per test (or shared), plus a
+virtual 8-device CPU mesh for all sharding/parallelism tests (the TPU-build
+equivalent of the reference's fake multi-node cluster_utils.Cluster).
+"""
+
+import os
+
+# Force an 8-device CPU platform for jax BEFORE jax is imported anywhere.
+# Sharding/pjit tests exercise real multi-device meshes this way; the
+# driver validates real-TPU behavior separately via bench.py.
+os.environ.setdefault("XLA_FLAGS",
+                      (os.environ.get("XLA_FLAGS", "") +
+                       " --xla_force_host_platform_device_count=8").strip())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start():
+    """Fresh runtime per test (reference: ray_start_regular)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, _system_config={
+        "worker_idle_timeout_s": 60.0,
+    })
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def ray_shared():
+    """Session-shared runtime (reference: ray_start_shared)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "conftest must force 8 host devices"
+    return devs

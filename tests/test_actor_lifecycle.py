@@ -1,0 +1,138 @@
+"""Actor lifecycle edge cases found in review: creation crashes, kill
+races, restart with ref args, strict ordering under dependency stalls.
+(Reference analog: test_actor_failures.py / gcs_actor_manager semantics.)"""
+
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_ordering_preserved_under_dep_stall(ray_start):
+    """A later no-dep call must not overtake an earlier call whose arg is
+    still being produced (sync actors guarantee submission order)."""
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(1.0)
+        return 5
+
+    @ray_tpu.remote
+    class Cell:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    c = Cell.remote()
+    c.set.remote(slow_value.remote())
+    # Submitted after set(), must observe its effect.
+    assert ray_tpu.get(c.get.remote(), timeout=60) == 5
+
+
+def test_crash_during_init_does_not_hang(ray_start):
+    @ray_tpu.remote
+    class DieOnInit:
+        def __init__(self):
+            os._exit(1)
+
+        def m(self):
+            return 1
+
+    a = DieOnInit.remote()
+    with pytest.raises((exc.ActorDiedError, exc.TaskError,
+                        exc.WorkerCrashedError)):
+        ray_tpu.get(a.m.remote(), timeout=60)
+
+
+def test_kill_during_creation_no_resurrection(ray_start):
+    @ray_tpu.remote
+    class SlowInit:
+        def __init__(self):
+            time.sleep(2.0)
+
+        def m(self):
+            return 1
+
+    a = SlowInit.remote()
+    time.sleep(0.2)  # creation in flight
+    ray_tpu.kill(a)
+    with pytest.raises((exc.ActorDiedError, exc.TaskError,
+                        exc.WorkerCrashedError)):
+        ray_tpu.get(a.m.remote(), timeout=60)
+
+
+def test_restart_with_ref_init_args(ray_start):
+    """Restart replays the creation spec; its ObjectRef init args (and the
+    >100KB packed arg blob) must still exist on the second creation."""
+    big = np.arange(200_000, dtype=np.float64)  # ~1.6 MB arg blob
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, data, ref_arg):
+            self.total = float(np.sum(data)) + ref_arg
+
+        def get_total(self):
+            return self.total
+
+        def die(self):
+            os._exit(1)
+
+    h = Holder.options(max_restarts=1).remote(big, ray_tpu.put(1.0))
+    expected = float(np.sum(big)) + 1.0
+    assert ray_tpu.get(h.get_total.remote(), timeout=60) == expected
+    h.die.remote()
+    deadline = time.time() + 60
+    val = None
+    while time.time() < deadline:
+        try:
+            val = ray_tpu.get(h.get_total.remote(), timeout=15)
+            break
+        except (exc.ActorDiedError, exc.TaskError, exc.GetTimeoutError):
+            time.sleep(0.3)
+    assert val == expected, "restarted actor must rebuild from same args"
+
+
+def test_embedded_ref_survives_creation(ray_start):
+    """The driver's ref passed as an init arg must remain gettable after
+    the actor is created and killed (no unbalanced decref)."""
+    @ray_tpu.remote
+    class Eph:
+        def __init__(self, x):
+            self.x = x
+
+        def ping(self):
+            return 1
+
+    data_ref = ray_tpu.put(np.ones(1000))
+    e = Eph.options(max_restarts=1).remote(data_ref)
+    assert ray_tpu.get(e.ping.remote()) == 1
+    ray_tpu.kill(e)
+    time.sleep(0.5)
+    gc.collect()
+    # Driver's own ref must still resolve.
+    assert float(np.sum(ray_tpu.get(data_ref))) == 1000.0
+
+
+def test_wait_polling_does_not_leak_waiters(ray_start):
+    @ray_tpu.remote
+    def never():
+        time.sleep(60)
+
+    ref = never.remote()
+    for _ in range(50):
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0.01)
+        assert ready == []
+    sess = ray_tpu._session
+    with sess.node_service.lock:
+        entry = sess.node_service.objects.get(ref.binary())
+        n_waiters = len(entry.waiters) if entry else 0
+    assert n_waiters <= 2, f"waiter leak: {n_waiters} stale waiters"

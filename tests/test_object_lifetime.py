@@ -1,0 +1,104 @@
+"""Object lifetime / refcount / store-pressure regression tests.
+
+These pin the fixes for bugs found in review: actor dep-drain, read-pin
+auto-release, kill-actor resource return, and no-silent-eviction of live
+objects (reference invariant: primary copies are pinned,
+reference_count.h:64 / local_object_manager.h).
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_actor_task_with_pending_dep_dispatches(ray_start):
+    """An actor call whose arg is produced by a slow task must run once
+    the dep resolves (regression: queued actor tasks were never drained
+    on dep-ready)."""
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(1.0)
+        return 41
+
+    @ray_tpu.remote
+    class A:
+        def plus_one(self, x):
+            return x + 1
+
+    a = A.remote()
+    out = a.plus_one.remote(slow_value.remote())
+    assert ray_tpu.get(out, timeout=60) == 42
+
+
+def test_store_not_exhausted_by_read_pins(ray_start):
+    """Repeated put -> get -> drop of large objects must recycle store
+    space (regression: get() pins were never released)."""
+    for i in range(30):
+        ref = ray_tpu.put(np.full(4 << 20, i, dtype=np.uint8))  # 4 MiB
+        arr = ray_tpu.get(ref)
+        assert arr[0] == i
+        del ref, arr
+        gc.collect()
+    # 30 * 4 MiB = 120 MiB through a 256 MiB store: succeeds only if
+    # space is reclaimed.
+
+
+def test_unread_objects_survive_pressure(ray_start):
+    """Live-but-never-read refs must NOT be silently evicted; when the
+    store is truly full the PUT fails, not a later get."""
+    held = [ray_tpu.put(np.full(8 << 20, i, dtype=np.uint8))
+            for i in range(8)]  # 64 MiB held live
+    # Churn more data through the store.
+    for i in range(10):
+        r = ray_tpu.put(np.zeros(8 << 20, dtype=np.uint8))
+        ray_tpu.get(r)
+        del r
+        gc.collect()
+    # Every held ref must still materialize correctly.
+    for i, ref in enumerate(held):
+        assert ray_tpu.get(ref)[0] == i
+
+
+def test_store_full_raises_on_put(ray_start):
+    refs = []
+    with pytest.raises(exc.ObjectStoreFullError):
+        for i in range(80):  # 80 * 8 MiB >> 256 MiB store
+            refs.append(ray_tpu.put(np.zeros(8 << 20, dtype=np.uint8)))
+
+
+def test_kill_actor_returns_resources(ray_start):
+    @ray_tpu.remote
+    class Greedy:
+        def ping(self):
+            return 1
+
+    before = ray_tpu.available_resources()["CPU"]
+    g = Greedy.options(num_cpus=2).remote()
+    assert ray_tpu.get(g.ping.remote()) == 1
+    assert ray_tpu.available_resources()["CPU"] == before - 2
+    ray_tpu.kill(g)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources()["CPU"] == before:
+            break
+        time.sleep(0.1)
+    assert ray_tpu.available_resources()["CPU"] == before
+
+
+def test_del_releases_object(ray_start):
+    ref = ray_tpu.put(np.zeros(4 << 20, dtype=np.uint8))
+    client = ray_tpu._ensure_connected()
+    used_with = client.store_stats()["used_bytes"]
+    del ref
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if client.store_stats()["used_bytes"] < used_with:
+            break
+        time.sleep(0.1)
+    assert client.store_stats()["used_bytes"] < used_with

@@ -1,0 +1,160 @@
+"""Native shm store unit tests (reference analog: plasma store tests,
+src/ray/object_manager/plasma/test/)."""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.shm_store import ShmObjectStore
+from ray_tpu._private import serialization as ser
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = "/dev/shm/rtpu_test_%d" % os.getpid()
+    st = ShmObjectStore(path, capacity=16 * 1024 * 1024, create=True)
+    yield st
+    st.destroy()
+
+
+def test_put_get_roundtrip(store):
+    oid = ObjectID.from_random()
+    store.put(oid, b"abc" * 1000)
+    mv = store.get(oid)
+    assert bytes(mv[:3]) == b"abc"
+    assert mv.nbytes == 3000
+    store.release(oid)
+
+
+def test_get_missing_returns_none(store):
+    assert store.get(ObjectID.from_random()) is None
+
+
+def test_create_seal_protocol(store):
+    oid = ObjectID.from_random()
+    buf = store.create(oid, 100)
+    # Unsealed objects are not gettable.
+    assert store.get(oid) is None
+    assert not store.contains(oid)
+    buf[:5] = b"hello"
+    store.seal(oid)
+    assert store.contains(oid)
+    mv = store.get(oid)
+    assert bytes(mv[:5]) == b"hello"
+    store.release(oid)
+    store.release(oid)  # creator pin
+
+
+def test_abort(store):
+    oid = ObjectID.from_random()
+    store.create(oid, 100)
+    store.abort(oid)
+    assert store.get(oid) is None
+    # Space is reusable.
+    oid2 = ObjectID.from_random()
+    store.put(oid2, b"x" * 100)
+
+
+def test_duplicate_create_raises(store):
+    oid = ObjectID.from_random()
+    store.put(oid, b"x")
+    with pytest.raises(FileExistsError):
+        store.create(oid, 10)
+
+
+def test_delete_frees_space(store):
+    before = store.stats()
+    oid = ObjectID.from_random()
+    store.put(oid, b"y" * (1024 * 1024))
+    assert store.stats()["used_bytes"] > before["used_bytes"]
+    store.delete(oid)
+    assert store.stats()["used_bytes"] == before["used_bytes"]
+    assert not store.contains(oid)
+
+
+def test_pinned_delete_deferred(store):
+    oid = ObjectID.from_random()
+    store.put(oid, b"z" * 1000)
+    mv = store.get(oid)  # pin
+    store.delete(oid)
+    # Data still intact while pinned.
+    assert bytes(mv[:1]) == b"z"
+    del mv
+    store.release(oid)
+    assert not store.contains(oid)
+
+
+def test_lru_eviction_and_pinning(store):
+    pinned = ObjectID.from_random()
+    store.put(pinned, b"p" * 1000)
+    assert store.get(pinned) is not None  # pin it
+    # Overfill: 1 MiB objects into a 16 MiB store.
+    for i in range(40):
+        store.put(ObjectID.from_random(), np.full(1 << 20, i, np.uint8))
+    stats = store.stats()
+    assert stats["num_evictions"] > 0
+    assert stats["used_bytes"] <= stats["capacity_bytes"]
+    assert store.contains(pinned), "pinned object must not be evicted"
+    store.release(pinned)
+    store.release(pinned)
+
+
+def test_too_large_raises(store):
+    with pytest.raises(ObjectStoreFullError):
+        store.create(ObjectID.from_random(), 1 << 30)
+
+
+def test_alloc_free_coalescing(store):
+    """Fragmentation torture: interleaved create/delete must coalesce so a
+    large allocation still fits afterwards."""
+    oids = [ObjectID.from_random() for _ in range(64)]
+    for oid in oids:
+        store.put(oid, b"a" * (128 * 1024))
+    for oid in oids[::2]:
+        store.delete(oid)
+    for oid in oids[1::2]:
+        store.delete(oid)
+    # All space coalesced: a 12 MiB object fits again.
+    big = ObjectID.from_random()
+    store.put(big, b"b" * (12 * 1024 * 1024))
+    assert store.contains(big)
+
+
+def test_zero_copy_serialization_roundtrip(store):
+    arr = np.arange(500_000, dtype=np.float64)
+    s = ser.serialize({"arr": arr, "tag": "x"})
+    oid = ObjectID.from_random()
+    buf = store.create(oid, s.total_size)
+    s.write_into(buf)
+    store.seal(oid)
+    out = ser.deserialize(store.get(oid))
+    assert np.array_equal(out["arr"], arr)
+    assert out["tag"] == "x"
+    assert not out["arr"].flags.owndata  # aliases shared memory
+    del out
+    store.release(oid)
+    store.release(oid)
+
+
+def _child_proc(path, oid_bytes, q):
+    st = ShmObjectStore(path)
+    mv = st.get(ObjectID(oid_bytes))
+    q.put(bytes(mv[:5]))
+    st.release(ObjectID(oid_bytes))
+    st.close()
+
+
+def test_cross_process_visibility(store):
+    oid = ObjectID.from_random()
+    store.put(oid, b"cross-process")
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_proc,
+                    args=(store._path, oid.binary(), q))
+    p.start()
+    assert q.get(timeout=30) == b"cross"
+    p.join(timeout=10)
