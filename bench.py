@@ -1,0 +1,102 @@
+"""Benchmark driver: training throughput on the attached TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Metric: GPT-2-small-class causal-LM training tokens/sec on one chip —
+the analog of BASELINE.json config #1 ("GPT-2 small TorchTrainer, 1
+worker").  The reference publishes no tokens/sec numbers
+(BASELINE.md: "published": {}), so vs_baseline is defined as measured
+model-FLOPs throughput versus a 40%-MFU run on the same chip (a strong
+torch/XLA GPT-2 baseline level): vs_baseline = MFU / 0.40.  >1.0 beats
+that baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+PEAK_FLOPS = {
+    # bf16 peak per chip.
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e
+    "cpu": 1e11,
+}
+
+
+def _peak_for(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for name, peak in PEAK_FLOPS.items():
+        if kind.startswith(name):
+            return peak
+    return PEAK_FLOPS["cpu"]
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.train.train_step import CompiledTrainStep, make_optimizer
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    if on_tpu:
+        cfg = tfm.PRESETS["gpt2-small"]
+        batch, seq, steps = 8, 1024, 10
+    else:  # CPU smoke fallback so the bench always emits a line
+        cfg = tfm.PRESETS["tiny"]
+        batch, seq, steps = 4, 128, 3
+
+    mesh = make_mesh(MeshSpec(), devices=[dev])
+    step = CompiledTrainStep(
+        cfg, mesh, optimizer=make_optimizer(total_steps=1000),
+        donate_state=True)
+    state = step.init_state(seed=0)
+    n_params = tfm.num_params(
+        jax.eval_shape(lambda: tfm.init_params(cfg, jax.random.PRNGKey(0))))
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size,
+                         size=(batch, seq + 1)).astype(np.int32)
+    batch_dev = step.shard_batch(tokens)
+
+    # Warmup (compile) then timed steps.  NOTE: a host transfer (float())
+    # is the sync point — block_until_ready can return early through
+    # tunneled TPU backends (axon), which would fake the timing.
+    for _ in range(2):
+        state, metrics = step(state, batch_dev)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_dev)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+    # Model FLOPs: 6N per token + attention 12*L*s*d (PaLM appendix B).
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * seq * cfg.d_model
+    mfu = tok_s * flops_per_token / _peak_for(dev)
+    result = {
+        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 3),
+        "mfu": round(mfu, 4),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "params": n_params,
+        "batch": batch, "seq": seq,
+        "step_ms": round(dt / steps * 1000, 1),
+        "loss": round(float(metrics["loss"]), 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,294 @@
+"""Decoder-only transformer family (Llama-style and GPT-2-style), pure JAX.
+
+TPU-first design decisions:
+  * Parameters are a plain pytree with a parallel tree of *logical axis
+    names* (parallel/sharding.py) — pjit shards params/activations from
+    rule tables; model code never mentions devices.
+  * Layers run under `lax.scan` over stacked per-layer params: one
+    compiled layer body regardless of depth (fast compiles, XLA-friendly).
+  * bf16 activations/matmuls with f32 softmax/norm/logits; params f32.
+  * Attention dispatches to the pallas flash kernel on TPU, the reference
+    path elsewhere; with an `sp` mesh axis it uses ring attention.
+  * `jax.checkpoint` (remat) around each layer trades FLOPs for HBM.
+
+Reference contrast: the reference has no model zoo of its own (RLlib
+models aside); Train wraps torch models.  This transformer is the
+flagship workload for the Train/bench path (BASELINE.json configs 1-2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.parallel.sharding import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None      # None => MHA
+    d_ff: Optional[int] = None            # None => arch default
+    max_seq: int = 2048
+    arch: str = "llama"                   # "llama" | "gpt2"
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16             # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    tie_embeddings: bool = False
+    remat: bool = True
+    attn_impl: str = "auto"               # auto | flash | reference
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.arch == "llama":
+            # 8/3 * d rounded up to a 128 multiple: MXU-tile friendly and
+            # divisible by any power-of-two tp degree.
+            return ((int(self.d_model * 8 / 3) + 127) // 128) * 128
+        return 4 * self.d_model
+
+
+# -- presets (flagship + test) ----------------------------------------------
+PRESETS: Dict[str, TransformerConfig] = {
+    "tiny": TransformerConfig(vocab_size=512, d_model=128, n_layers=2,
+                              n_heads=4, max_seq=256, remat=False),
+    "gpt2-small": TransformerConfig(vocab_size=50_304, d_model=768,
+                                    n_layers=12, n_heads=12, arch="gpt2",
+                                    max_seq=1024, rope_theta=0.0),
+    "llama-1b": TransformerConfig(vocab_size=128_256, d_model=2048,
+                                  n_layers=16, n_heads=32, n_kv_heads=8,
+                                  d_ff=8192, max_seq=8192),
+    "llama-8b": TransformerConfig(vocab_size=128_256, d_model=4096,
+                                  n_layers=32, n_heads=32, n_kv_heads=8,
+                                  d_ff=14_336, max_seq=8192),
+}
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    """Returns the parameter pytree (per-layer params stacked on axis 0)."""
+    keys = jax.random.split(key, 8)
+    d, h, hkv, dh, f = (cfg.d_model, cfg.n_heads, cfg.kv_heads,
+                        cfg.head_dim, cfg.ff_dim)
+    L = cfg.n_layers
+    pd = cfg.param_dtype
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale
+                ).astype(pd)
+
+    scale_in = 1.0 / math.sqrt(d)
+    scale_out = 1.0 / math.sqrt(d) / math.sqrt(2 * L)
+
+    def layer_init(key):
+        ks = jax.random.split(key, 8)
+        p = {
+            "attn_norm": jnp.ones((d,), pd),
+            "wq": normal(ks[0], (d, h, dh), scale_in),
+            "wk": normal(ks[1], (d, hkv, dh), scale_in),
+            "wv": normal(ks[2], (d, hkv, dh), scale_in),
+            "wo": normal(ks[3], (h, dh, d), scale_out),
+            "mlp_norm": jnp.ones((d,), pd),
+            "w_down": normal(ks[5], (f, d), scale_out),
+        }
+        if cfg.arch == "llama":
+            p["w_gate"] = normal(ks[4], (d, f), scale_in)
+            p["w_up"] = normal(ks[6], (d, f), scale_in)
+        else:
+            p["w_up"] = normal(ks[6], (d, f), scale_in)
+            p["b_up"] = jnp.zeros((f,), pd)
+            p["b_down"] = jnp.zeros((d,), pd)
+            p["attn_norm_b"] = jnp.zeros((d,), pd)
+            p["mlp_norm_b"] = jnp.zeros((d,), pd)
+        return p
+
+    layer_keys = jax.random.split(keys[0], L)
+    layers = jax.vmap(layer_init)(layer_keys)
+
+    params: Dict[str, Any] = {
+        "tok_embed": normal(keys[1], (cfg.vocab_size, d), 1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), pd),
+    }
+    if cfg.arch == "gpt2":
+        params["pos_embed"] = normal(keys[2], (cfg.max_seq, d), 0.01)
+        params["final_norm_b"] = jnp.zeros((d,), pd)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(keys[3], (d, cfg.vocab_size), scale_in)
+    return params
+
+
+def logical_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Pytree (matching init_params) of logical axis-name tuples."""
+    layer = {
+        "attn_norm": ("embed",),
+        "wq": ("embed", "heads", "head_dim"),
+        "wk": ("embed", "kv_heads", "head_dim"),
+        "wv": ("embed", "kv_heads", "head_dim"),
+        "wo": ("heads", "head_dim", "embed"),
+        "mlp_norm": ("embed",),
+        "w_down": ("mlp", "embed"),
+    }
+    if cfg.arch == "llama":
+        layer["w_gate"] = ("embed", "mlp")
+        layer["w_up"] = ("embed", "mlp")
+    else:
+        layer["w_up"] = ("embed", "mlp")
+        layer["b_up"] = ("mlp",)
+        layer["b_down"] = ("embed",)
+        layer["attn_norm_b"] = ("embed",)
+        layer["mlp_norm_b"] = ("embed",)
+    # stacked layer axis is the scan ("layers") axis
+    layer = {k: ("layers",) + v for k, v in layer.items()}
+    axes: Dict[str, Any] = {
+        "tok_embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("embed",),
+    }
+    if cfg.arch == "gpt2":
+        axes["pos_embed"] = (None, "embed")
+        axes["final_norm_b"] = ("embed",)
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _norm(x, w, b, eps, rms: bool):
+    xf = x.astype(jnp.float32)
+    if rms:
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    else:
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    y = y * w.astype(jnp.float32)
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """x: [B, S, H, Dh]; rotary embedding over the head dim."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = jnp.exp(-math.log(theta) *
+                    jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _layer_body(cfg: TransformerConfig, mesh, x, p, positions):
+    """One decoder layer. x: [B, S, D]."""
+    rms = cfg.arch == "llama"
+    h = _norm(x, p["attn_norm"], p.get("attn_norm_b"), cfg.norm_eps, rms)
+    q = jnp.einsum("bsd,dhk->bshk", h, p["wq"].astype(h.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, p["wk"].astype(h.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, p["wv"].astype(h.dtype))
+    if cfg.arch == "llama":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    q = q.transpose(0, 2, 1, 3)   # [B, H, S, Dh]
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q = constrain(q, ("batch", "heads", "seq", None), mesh=mesh)
+    k = constrain(k, ("batch", "kv_heads", "seq", None), mesh=mesh)
+    v = constrain(v, ("batch", "kv_heads", "seq", None), mesh=mesh)
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        o = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+    else:
+        o = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    o = o.transpose(0, 2, 1, 3)   # [B, S, H, Dh]
+    attn_out = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(o.dtype))
+    x = x + constrain(attn_out, ("batch", "seq", "embed"), mesh=mesh)
+
+    h = _norm(x, p["mlp_norm"], p.get("mlp_norm_b"), cfg.norm_eps, rms)
+    if cfg.arch == "llama":
+        gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"].astype(h.dtype))
+        up = jnp.einsum("bsd,df->bsf", h, p["w_up"].astype(h.dtype))
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up
+    else:
+        up = jnp.einsum("bsd,df->bsf", h, p["w_up"].astype(h.dtype))
+        up = up + p["b_up"].astype(h.dtype)
+        act = jax.nn.gelu(up.astype(jnp.float32)).astype(h.dtype)
+    act = constrain(act, ("batch", "seq", "mlp"), mesh=mesh)
+    down = jnp.einsum("bsf,fd->bsd", act, p["w_down"].astype(act.dtype))
+    if cfg.arch == "gpt2":
+        down = down + p["b_down"].astype(down.dtype)
+    return x + constrain(down, ("batch", "seq", "embed"), mesh=mesh)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            cfg: TransformerConfig, mesh=None) -> jax.Array:
+    """tokens: [B, S] int32 -> logits [B, S, vocab] (f32)."""
+    B, S = tokens.shape
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    if cfg.arch == "gpt2":
+        x = x + params["pos_embed"][:S][None].astype(cfg.dtype)
+    x = constrain(x, ("batch", "seq", "embed"), mesh=mesh)
+
+    body = functools.partial(_layer_body, cfg, mesh, positions=positions)
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_fn(x, layer_params):
+        return body(x, layer_params), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+
+    rms = cfg.arch == "llama"
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"),
+              cfg.norm_eps, rms)
+    w_out = (params["tok_embed"].T if cfg.tie_embeddings
+             else params["lm_head"])
+    # bf16 operands + f32 accumulation: full MXU rate, f32-exact softmax.
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(cfg.dtype),
+                        w_out.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return constrain(logits, ("batch", "seq", "vocab"), mesh=mesh)
+
+
+def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy. tokens: [B, S]; predicts tokens[:,1:]."""
+    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = jnp.mean(nll)
+    return loss, {"loss": loss, "ppl": jnp.exp(loss)}
+
+
+def num_params(params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))

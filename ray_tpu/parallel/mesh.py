@@ -1,0 +1,113 @@
+"""Device meshes: the TPU-native replacement for process groups.
+
+Where the reference wires NCCL process groups per strategy (DP via torch
+DDP in train/torch/config.py:115, TP/PP orchestrated for external libs,
+collective groups in util/collective), the TPU build has ONE primitive: a
+`jax.sharding.Mesh` over the chips with named logical axes, and XLA emits
+the collectives.  This module owns mesh construction and axis conventions:
+
+    dp    — pure data parallel (replicated params)
+    fsdp  — data parallel with sharded params/opt-state (ZeRO-3 analog)
+    tp    — tensor parallel (Megatron-style, intra-layer)
+    sp    — sequence/context parallel (ring attention)
+    ep    — expert parallel (MoE)
+    pp    — pipeline stages (sub-meshes)
+
+Multi-host: the same axis spec, built over jax.devices() after
+jax.distributed.initialize — handled by parallel/mesh_group.py actors.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. -1 on at most one axis means 'fill with the
+    remaining devices' (like a reshape wildcard)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed > n_devices:
+            raise ValueError(
+                f"mesh spec {sizes} needs {fixed} devices, have "
+                f"{n_devices}")
+        # fixed < n_devices: the mesh uses the first `fixed` devices (a
+        # sub-mesh), matching how a job may claim part of a slice.
+        return sizes
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None,
+              axis_sizes: Optional[Dict[str, int]] = None):
+    """Build a jax.sharding.Mesh.
+
+    Device order matters for ICI locality: jax.devices() enumerates chips
+    so that adjacent indices are ICI neighbors on a slice; we put the
+    innermost (most communication-heavy) axes — tp, then sp — fastest-
+    varying so their collectives ride ICI rings, and dp/pp outermost so
+    cross-slice / DCN traffic lands there (scaling-book recipe; reference
+    contrast: NCCL ranks are flat, ray.util.collective
+    nccl_collective_group.py gives topology no meaning).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axis_sizes is None:
+        spec = spec or MeshSpec(dp=-1)
+        axis_sizes = spec.resolve(n)
+    names = [a for a in AXIS_ORDER if axis_sizes.get(a, 1) > 1]
+    if not names:
+        names = ["dp"]
+    shape = [axis_sizes.get(a, 1) for a in names]
+    needed = math.prod(shape)
+    if needed > n:
+        raise ValueError(f"axis sizes {axis_sizes} need {needed} devices, "
+                         f"have {n}")
+    dev_array = np.asarray(devices[:needed]).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def mesh_axis_size(mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)
+
+
+def sub_mesh_for_stage(mesh, stage: int):
+    """Slice a pp-axis mesh into the per-stage sub-mesh (pipeline
+    parallelism: each stage gets a contiguous block of devices)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if "pp" not in mesh.axis_names:
+        raise ValueError("mesh has no pp axis")
+    idx = mesh.axis_names.index("pp")
+    dev = np.take(mesh.devices, stage, axis=idx)
+    names = [a for a in mesh.axis_names if a != "pp"]
+    return Mesh(dev, names)

@@ -1,0 +1,131 @@
+"""Sharded training step: the compile-once pjit analog of the reference's
+per-step Train loop.
+
+Where the reference's TorchTrainer runs an eager torch loop with NCCL DDP
+(train/torch/config.py:115 init_process_group) and stays out of the step
+path (SURVEY.md §3.5), the TPU build compiles the ENTIRE step — forward,
+backward, optimizer, metrics — into one XLA program over the mesh.  All
+parallelism (dp / fsdp / tp / sp) is induced by the sharding rule table
+(parallel/sharding.py); XLA inserts the psum/reduce-scatter/all-gather
+collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import transformer
+from ray_tpu.parallel.sharding import (DEFAULT_RULES, Rules, tree_specs,
+                                       tree_shardings, use_mesh)
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def make_optimizer(learning_rate: float = 3e-4, warmup_steps: int = 100,
+                   total_steps: int = 10_000, weight_decay: float = 0.1,
+                   b1: float = 0.9, b2: float = 0.95,
+                   grad_clip: float = 1.0) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+class CompiledTrainStep:
+    """Holds the jitted step + sharded state constructors for one model."""
+
+    def __init__(self, cfg: transformer.TransformerConfig, mesh,
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 rules: Optional[Rules] = None,
+                 donate_state: bool = True) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.rules = rules if rules is not None else DEFAULT_RULES
+        self.optimizer = optimizer or make_optimizer()
+
+        params_axes = transformer.logical_axes(cfg)
+        self.param_shardings = tree_shardings(params_axes, mesh, self.rules)
+        # Data: tokens [B, S+1] shard batch only — S+1 is odd-sized vs the
+        # sp axis; activation constraints inside the model shard seq.
+        from jax.sharding import NamedSharding
+        from ray_tpu.parallel.sharding import spec_for
+        self.data_sharding = NamedSharding(
+            mesh, spec_for(("batch", None), self.rules, mesh))
+
+        def init_fn(key):
+            params = transformer.init_params(cfg, key)
+            opt_state = self.optimizer.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32),
+                              params=params, opt_state=opt_state)
+
+        # Resolve opt-state shardings from its structure (eval_shape).
+        key = jax.random.PRNGKey(0)
+        state_shape = jax.eval_shape(init_fn, key)
+        self.state_shardings = self._state_shardings(state_shape,
+                                                    params_axes)
+        self._init = jax.jit(init_fn,
+                             out_shardings=self.state_shardings)
+
+        def step_fn(state: TrainState, tokens) -> Tuple[TrainState, Dict]:
+            with use_mesh(mesh):
+                grad_fn = jax.value_and_grad(
+                    lambda p: transformer.loss_fn(p, tokens, cfg, mesh),
+                    has_aux=True)
+                (loss, metrics), grads = grad_fn(state.params)
+                updates, new_opt = self.optimizer.update(
+                    grads, state.opt_state, state.params)
+                new_params = optax.apply_updates(state.params, updates)
+                metrics = dict(metrics)
+                metrics["grad_norm"] = optax.global_norm(grads)
+                return TrainState(state.step + 1, new_params,
+                                  new_opt), metrics
+
+        self._step = jax.jit(
+            step_fn,
+            in_shardings=(self.state_shardings, self.data_sharding),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,) if donate_state else ())
+
+    def _state_shardings(self, state_shape, params_axes):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        params_shardings = self.param_shardings
+
+        # Optimizer leaves whose shape matches a parameter (adam mu/nu)
+        # inherit that parameter's sharding — the ZeRO property; scalar
+        # counts/schedule state stay replicated.
+        shape_map = {}
+        for p, s in zip(jax.tree.leaves(state_shape.params),
+                        jax.tree.leaves(params_shardings)):
+            shape_map.setdefault(p.shape, s)
+
+        def pick(leaf):
+            return shape_map.get(getattr(leaf, "shape", ()), replicated)
+
+        return TrainState(
+            step=replicated,
+            params=params_shardings,
+            opt_state=jax.tree.map(pick, state_shape.opt_state))
+
+    # -- public API --------------------------------------------------------
+    def init_state(self, seed: int = 0) -> TrainState:
+        return self._init(jax.random.PRNGKey(seed))
+
+    def shard_batch(self, tokens) -> jax.Array:
+        return jax.device_put(tokens, self.data_sharding)
+
+    def __call__(self, state: TrainState, tokens
+                 ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        return self._step(state, tokens)

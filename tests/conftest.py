@@ -11,10 +11,27 @@ import os
 # Force an 8-device CPU platform for jax BEFORE jax is imported anywhere.
 # Sharding/pjit tests exercise real multi-device meshes this way; the
 # driver validates real-TPU behavior separately via bench.py.
-os.environ.setdefault("XLA_FLAGS",
-                      (os.environ.get("XLA_FLAGS", "") +
-                       " --xla_force_host_platform_device_count=8").strip())
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+# Force CPU even when the ambient env pins a TPU platform (e.g. axon):
+# the suite must run identically with or without a chip attached.  jax may
+# already be imported (TPU plugin sitecustomize hooks), so the env var
+# alone is too late — update the live config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except RuntimeError:
+    # Backends already initialized (a plugin touched jax.devices() before
+    # pytest started).  The XLA_FLAGS fallback above may still provide 8
+    # host devices; if not, the cpu_mesh_devices fixture will fail with a
+    # clear message rather than aborting collection here.
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,75 @@
+"""Mesh + logical sharding rule tests (8-device virtual CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh, sub_mesh_for_stage
+from ray_tpu.parallel.sharding import DEFAULT_RULES, spec_for, tree_specs
+
+
+def test_mesh_spec_resolve():
+    assert MeshSpec(dp=-1).resolve(8) == {
+        "pp": 1, "dp": 8, "fsdp": 1, "ep": 1, "sp": 1, "tp": 1}
+    sizes = MeshSpec(dp=2, fsdp=2, tp=2).resolve(8)
+    assert sizes["dp"] == 2 and sizes["fsdp"] == 2 and sizes["tp"] == 2
+    # Smaller-than-cluster specs are sub-meshes (first N devices).
+    assert MeshSpec(dp=3).resolve(8)["dp"] == 3
+    with pytest.raises(ValueError):
+        MeshSpec(dp=16).resolve(8)  # more than available
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=3).resolve(8)  # 8 not divisible by 3
+
+
+def test_make_mesh_shapes(cpu_mesh_devices):
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "tp": 2}
+    # tp must be the innermost (fastest-varying) axis for ICI locality.
+    assert mesh.axis_names[-1] == "tp"
+    mesh2 = make_mesh(MeshSpec(fsdp=-1))
+    assert dict(mesh2.shape) == {"fsdp": 8}
+
+
+def test_pp_sub_mesh(cpu_mesh_devices):
+    mesh = make_mesh(MeshSpec(pp=2, dp=2, tp=2))
+    sub = sub_mesh_for_stage(mesh, 1)
+    assert dict(sub.shape) == {"dp": 2, "tp": 2}
+    assert set(np.ravel(sub.devices)) <= set(np.ravel(mesh.devices))
+
+
+def test_spec_for_basic(cpu_mesh_devices):
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    # embed's fsdp is already used by batch; seq has no sp axis here.
+    assert spec_for(("batch", "seq", "embed"), mesh=mesh) == P(
+        ("dp", "fsdp"))
+    assert spec_for(("embed", "mlp"), mesh=mesh) == P("fsdp", "tp")
+    assert spec_for(("vocab", "embed"), mesh=mesh) == P("tp", "fsdp")
+
+
+def test_spec_for_drops_absent_axes(cpu_mesh_devices):
+    mesh = make_mesh(MeshSpec(dp=8))  # no fsdp/tp axes
+    assert spec_for(("embed", "mlp"), mesh=mesh) == P()
+    assert spec_for(("batch", "seq", "embed"), mesh=mesh) == P("dp")
+
+
+def test_spec_no_duplicate_mesh_axis(cpu_mesh_devices):
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    # batch takes fsdp; a later fsdp-mapped logical axis must not reuse it.
+    s = spec_for(("batch", "embed"), mesh=mesh)
+    assert s == P("fsdp")
+
+
+def test_tree_specs(cpu_mesh_devices):
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    tree = {"w": ("embed", "mlp"), "b": ("mlp",),
+            "nested": {"x": ("batch", None, "embed")}}
+    specs = tree_specs(tree, mesh=mesh)
+    assert specs["w"] == P("fsdp", "tp")
+    assert specs["b"] == P("tp")
+    assert specs["nested"]["x"] == P(("dp", "fsdp"), None, "fsdp"
+                                     ) or specs["nested"]["x"] == P(
+                                         ("dp", "fsdp"))
