@@ -791,15 +791,23 @@ class NodeService:
         env["RAY_TPU_NODE_SOCKET"] = self.socket_path
         env["RAY_TPU_STORE_PATH"] = self.store_path
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        # Workers must find ray_tpu even when the driver added it to
-        # sys.path manually (running from an unrelated cwd).
+        # Workers inherit the driver's import environment: the ray_tpu
+        # package location plus every driver sys.path entry (so functions
+        # pickled by reference from driver-importable modules resolve —
+        # the local-cluster behavior the reference gets from its default
+        # working_dir runtime env).
+        import sys as _sys
         import ray_tpu
         pkg_parent = os.path.dirname(os.path.dirname(
             os.path.abspath(ray_tpu.__file__)))
-        existing = env.get("PYTHONPATH", "")
-        if pkg_parent not in existing.split(os.pathsep):
-            env["PYTHONPATH"] = (pkg_parent + os.pathsep + existing
-                                 if existing else pkg_parent)
+        existing = env.get("PYTHONPATH", "").split(os.pathsep)
+        extra = [pkg_parent] + [p for p in _sys.path
+                                if p and os.path.isdir(p)]
+        merged = []
+        for p in extra + [e for e in existing if e]:
+            if p not in merged:
+                merged.append(p)
+        env["PYTHONPATH"] = os.pathsep.join(merged)
         if not tpu:
             # Plain workers must not grab the TPU chip: jax in a worker
             # sees CPU unless the task explicitly asked for TPU resources.

@@ -103,7 +103,10 @@ class _Pickler(cloudpickle.Pickler):
             r = self._ref_reducer(obj)
             if r is not None:
                 return r
-        return NotImplemented
+        # Delegate to cloudpickle's reducer_override — it implements
+        # by-value pickling of lambdas/local functions there; returning
+        # NotImplemented here would silently disable that.
+        return super().reducer_override(obj)
 
 
 def serialize(

@@ -1,0 +1,10 @@
+"""ray_tpu.data: streaming distributed datasets (reference: Ray Data)."""
+
+from ray_tpu.data.block import Block
+from ray_tpu.data.dataset import (Dataset, from_items, from_numpy,
+                                  from_pandas, read_csv, read_json,
+                                  read_parquet)
+range = Dataset.range  # noqa: A001 — mirrors ray.data.range
+
+__all__ = ["Block", "Dataset", "from_items", "from_numpy", "from_pandas",
+           "read_csv", "read_json", "read_parquet", "range"]

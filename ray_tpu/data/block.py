@@ -1,0 +1,93 @@
+"""Block model: a block is a column batch (dict of numpy arrays).
+
+Analog of the reference's Block/BlockAccessor (data/block.py:196/221)
+where a block is an Arrow/Pandas chunk in plasma.  We use dict-of-numpy
+as the canonical in-memory format — it serializes zero-copy through the
+shm object store (pickle-5 buffers) and converts for free to jax device
+arrays; pyarrow/pandas conversions are provided at the edges.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+
+
+def block_from_rows(rows: Sequence[Dict[str, Any]]) -> Block:
+    if not rows:
+        return {}
+    cols: Dict[str, List[Any]] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r[k])
+    return {k: np.asarray(v) for k, v in cols.items()}
+
+
+def block_from_items(items: Sequence[Any]) -> Block:
+    if items and isinstance(items[0], dict):
+        return block_from_rows(items)  # type: ignore[arg-type]
+    return {"item": np.asarray(items)}
+
+
+def block_num_rows(block: Block) -> int:
+    for v in block.values():
+        return len(v)
+    return 0
+
+
+def block_slice(block: Block, start: int, end: int) -> Block:
+    return {k: v[start:end] for k, v in block.items()}
+
+
+def block_concat(blocks: Sequence[Block]) -> Block:
+    blocks = [b for b in blocks if block_num_rows(b) > 0]
+    if not blocks:
+        return {}
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def block_take(block: Block, indices: np.ndarray) -> Block:
+    return {k: v[indices] for k, v in block.items()}
+
+
+def block_rows(block: Block) -> Iterator[Dict[str, Any]]:
+    n = block_num_rows(block)
+    keys = list(block.keys())
+    for i in range(n):
+        yield {k: block[k][i] for k in keys}
+
+
+def block_nbytes(block: Block) -> int:
+    return sum(v.nbytes for v in block.values()
+               if isinstance(v, np.ndarray))
+
+
+def block_to_pandas(block: Block):
+    import pandas as pd
+    return pd.DataFrame({k: list(v) if v.ndim > 1 else v
+                         for k, v in block.items()})
+
+
+def block_from_pandas(df) -> Block:
+    return {c: df[c].to_numpy() for c in df.columns}
+
+
+def block_to_arrow(block: Block):
+    import pyarrow as pa
+    return pa.table({k: (v.tolist() if v.ndim > 1 else v)
+                     for k, v in block.items()})
+
+
+def block_from_arrow(table) -> Block:
+    out = {}
+    for name in table.column_names:
+        col = table.column(name)
+        try:
+            out[name] = col.to_numpy(zero_copy_only=False)
+        except Exception:
+            out[name] = np.asarray(col.to_pylist(), dtype=object)
+    return out

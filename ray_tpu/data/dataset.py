@@ -1,0 +1,380 @@
+"""Dataset: lazy, distributed, streaming-executed column datasets.
+
+Analog of the reference's Ray Data Dataset (data/dataset.py:141): a lazy
+logical plan over blocks (stored as ObjectRefs in the shm store),
+executed by a streaming pull loop that keeps a bounded number of block
+tasks in flight (the round-1 stand-in for the reference's
+StreamingExecutor, _internal/execution/streaming_executor.py:48, with
+concurrency-cap backpressure).  Chained row/batch transforms are FUSED
+into one task per block (reference: operator fusion in
+_internal/logical/rules/operator_fusion.py).
+
+TPU addition: `iter_device_batches` pipelines host->HBM transfers with
+double buffering (the input-pipeline role the reference leaves to
+torch DataLoader; see SURVEY.md §5 'distributed communication backend').
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Tuple, Union)
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+Batch = Dict[str, np.ndarray]
+
+_DEFAULT_BLOCK_ROWS = 4096
+_MAX_IN_FLIGHT = 8
+
+
+# Block-transform stages are plain functions Block -> List[Block]
+# (list so filter/flat ops can drop/split).
+Stage = Callable[[B.Block], List[B.Block]]
+
+
+@ray_tpu.remote
+def _apply_stages(block: B.Block, stages: List[Stage]) -> B.Block:
+    for stage in stages:
+        outs = stage(block)
+        block = B.block_concat(outs) if len(outs) != 1 else outs[0]
+    return block
+
+
+@ray_tpu.remote
+def _read_source(read_fn) -> B.Block:
+    return read_fn()
+
+
+class Dataset:
+    """Lazy dataset = input block sources + fused transform stages."""
+
+    def __init__(self, sources: List[Any], stages: List[Stage],
+                 materialized: Optional[List[ray_tpu.ObjectRef]] = None):
+        # sources: list of either ObjectRef (ready block) or zero-arg
+        # callables (deferred reads, executed as tasks).
+        self._sources = sources
+        self._stages = stages
+        self._materialized = materialized
+
+    # ------------------------------------------------------------------
+    # constructors
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_items(items: Sequence[Any],
+                   block_rows: int = _DEFAULT_BLOCK_ROWS) -> "Dataset":
+        refs = []
+        for i in range(0, len(items), block_rows):
+            refs.append(ray_tpu.put(
+                B.block_from_items(items[i:i + block_rows])))
+        return Dataset(refs, [])
+
+    @staticmethod
+    def range(n: int, block_rows: int = _DEFAULT_BLOCK_ROWS) -> "Dataset":
+        refs = []
+        for i in range(0, n, block_rows):
+            hi = min(i + block_rows, n)
+            refs.append(ray_tpu.put({"id": np.arange(i, hi)}))
+        return Dataset(refs, [])
+
+    @staticmethod
+    def from_numpy(arrays: Dict[str, np.ndarray],
+                   block_rows: int = _DEFAULT_BLOCK_ROWS) -> "Dataset":
+        n = len(next(iter(arrays.values())))
+        refs = []
+        for i in range(0, n, block_rows):
+            refs.append(ray_tpu.put(
+                {k: v[i:i + block_rows] for k, v in arrays.items()}))
+        return Dataset(refs, [])
+
+    @staticmethod
+    def from_pandas(df, block_rows: int = _DEFAULT_BLOCK_ROWS) -> "Dataset":
+        return Dataset.from_numpy(B.block_from_pandas(df), block_rows)
+
+    @staticmethod
+    def read_parquet(paths: Union[str, List[str]]) -> "Dataset":
+        files = _expand_paths(paths, (".parquet",))
+
+        def make_reader(path):
+            def read():
+                import pyarrow.parquet as pq
+                return B.block_from_arrow(pq.read_table(path))
+            return read
+
+        return Dataset([make_reader(f) for f in files], [])
+
+    @staticmethod
+    def read_csv(paths: Union[str, List[str]]) -> "Dataset":
+        files = _expand_paths(paths, (".csv",))
+
+        def make_reader(path):
+            def read():
+                import pyarrow.csv as pacsv
+                return B.block_from_arrow(pacsv.read_csv(path))
+            return read
+
+        return Dataset([make_reader(f) for f in files], [])
+
+    @staticmethod
+    def read_json(paths: Union[str, List[str]]) -> "Dataset":
+        files = _expand_paths(paths, (".json", ".jsonl"))
+
+        def make_reader(path):
+            def read():
+                import pyarrow.json as pajson
+                return B.block_from_arrow(pajson.read_json(path))
+            return read
+
+        return Dataset([make_reader(f) for f in files], [])
+
+    # ------------------------------------------------------------------
+    # transforms (lazy, fused per block)
+    # ------------------------------------------------------------------
+    def _with_stage(self, stage: Stage) -> "Dataset":
+        return Dataset(self._sources, self._stages + [stage], None)
+
+    def map_batches(self, fn: Callable[[Batch], Batch]) -> "Dataset":
+        return self._with_stage(lambda b: [fn(b)])
+
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]
+            ) -> "Dataset":
+        def stage(b: B.Block) -> List[B.Block]:
+            return [B.block_from_rows([fn(r) for r in B.block_rows(b)])]
+        return self._with_stage(stage)
+
+    def filter(self, fn: Callable[[Dict[str, Any]], bool]) -> "Dataset":
+        def stage(b: B.Block) -> List[B.Block]:
+            keep = np.asarray([bool(fn(r)) for r in B.block_rows(b)])
+            return [B.block_take(b, np.nonzero(keep)[0])]
+        return self._with_stage(stage)
+
+    def add_column(self, name: str,
+                   fn: Callable[[Batch], np.ndarray]) -> "Dataset":
+        def stage(b: B.Block) -> List[B.Block]:
+            out = dict(b)
+            out[name] = np.asarray(fn(b))
+            return [out]
+        return self._with_stage(stage)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_stage(
+            lambda b: [{k: b[k] for k in cols}])
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_stage(
+            lambda b: [{k: v for k, v in b.items() if k not in cols}])
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def _launch(self, src) -> ray_tpu.ObjectRef:
+        """Submit one source block through the fused stage pipeline."""
+        ref = _read_source.remote(src) if callable(src) else src
+        if self._stages:
+            ref = _apply_stages.remote(ref, self._stages)
+        return ref
+
+    def _block_refs(self) -> List[ray_tpu.ObjectRef]:
+        """Launch the fused pipeline; returns refs for all output blocks
+        (submission is eager; completion streams)."""
+        if self._materialized is not None:
+            return list(self._materialized)
+        return [self._launch(src) for src in self._sources]
+
+    def _iter_blocks(self) -> Iterator[B.Block]:
+        """Streaming pull: bounded in-flight tasks, in-order yield."""
+        if self._materialized is not None:
+            for ref in self._materialized:
+                yield ray_tpu.get(ref)
+            return
+        pending: List[ray_tpu.ObjectRef] = []
+        srcs = list(self._sources)
+        while srcs or pending:
+            while srcs and len(pending) < _MAX_IN_FLIGHT:
+                pending.append(self._launch(srcs.pop(0)))
+            yield ray_tpu.get(pending.pop(0))
+
+    def materialize(self) -> "Dataset":
+        refs = self._block_refs()
+        ray_tpu.wait(refs, num_returns=len(refs))
+        return Dataset([], [], materialized=refs)
+
+    # ------------------------------------------------------------------
+    # global ops (stage breaks)
+    # ------------------------------------------------------------------
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        """Full shuffle: materialize, permute rows across blocks."""
+        blocks = list(self._iter_blocks())
+        if not blocks:
+            return Dataset([], [])
+        whole = B.block_concat(blocks)
+        n = B.block_num_rows(whole)
+        rng = np.random.RandomState(seed)
+        perm = rng.permutation(n)
+        shuffled = B.block_take(whole, perm)
+        rows = max(1, (n + len(blocks) - 1) // len(blocks))
+        refs = [ray_tpu.put(B.block_slice(shuffled, i, min(i + rows, n)))
+                for i in range(0, n, rows)]
+        return Dataset([], [], materialized=refs)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        blocks = list(self._iter_blocks())
+        whole = B.block_concat(blocks)
+        n = B.block_num_rows(whole)
+        rows = max(1, (n + num_blocks - 1) // num_blocks)
+        refs = [ray_tpu.put(B.block_slice(whole, i, min(i + rows, n)))
+                for i in range(0, n, rows)]
+        return Dataset([], [], materialized=refs)
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Split into n sub-datasets by block round-robin (reference:
+        Dataset.split for per-worker shards)."""
+        refs = self._block_refs()
+        parts: List[List[ray_tpu.ObjectRef]] = [[] for _ in range(n)]
+        for i, ref in enumerate(refs):
+            parts[i % n].append(ref)
+        return [Dataset([], [], materialized=p) for p in parts]
+
+    def union(self, other: "Dataset") -> "Dataset":
+        a = self._block_refs()
+        b = other._block_refs()
+        return Dataset([], [], materialized=a + b)
+
+    def limit(self, n: int) -> "Dataset":
+        out: List[ray_tpu.ObjectRef] = []
+        taken = 0
+        for ref in self._block_refs():
+            blk = ray_tpu.get(ref)
+            rows = B.block_num_rows(blk)
+            if taken + rows > n:
+                # Boundary block: slice and re-store.
+                out.append(ray_tpu.put(B.block_slice(blk, 0, n - taken)))
+                taken = n
+            else:
+                out.append(ref)  # whole block kept: reuse its ref
+                taken += rows
+            if taken >= n:
+                break
+        return Dataset([], [], materialized=out)
+
+    # ------------------------------------------------------------------
+    # consumption
+    # ------------------------------------------------------------------
+    def iter_batches(self, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Batch]:
+        carry: Optional[B.Block] = None
+        for blk in self._iter_blocks():
+            if carry is not None:
+                blk = B.block_concat([carry, blk])
+                carry = None
+            n = B.block_num_rows(blk)
+            i = 0
+            while n - i >= batch_size:
+                out = B.block_slice(blk, i, i + batch_size)
+                yield self._format(out, batch_format)
+                i += batch_size
+            if i < n:
+                carry = B.block_slice(blk, i, n)
+        if carry is not None and not drop_last:
+            yield self._format(carry, batch_format)
+
+    @staticmethod
+    def _format(blk: B.Block, fmt: str):
+        if fmt == "numpy":
+            return blk
+        if fmt == "pandas":
+            return B.block_to_pandas(blk)
+        if fmt == "pyarrow":
+            return B.block_to_arrow(blk)
+        raise ValueError(f"unknown batch_format {fmt!r}")
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for blk in self._iter_blocks():
+            yield from B.block_rows(blk)
+
+    def iter_device_batches(self, batch_size: int, sharding=None,
+                            prefetch: int = 2,
+                            drop_last: bool = True) -> Iterator[Any]:
+        """Double-buffered host->HBM pipeline: the next `prefetch`
+        batches are already on device (or in flight) while the caller
+        consumes the current one."""
+        import jax
+        from collections import deque
+
+        def put(batch):
+            if sharding is not None:
+                return {k: jax.device_put(v, sharding)
+                        for k, v in batch.items()}
+            return {k: jax.device_put(v) for k, v in batch.items()}
+
+        buf: deque = deque()
+        for batch in self.iter_batches(batch_size, drop_last=drop_last):
+            buf.append(put(batch))
+            if len(buf) > prefetch:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
+    # ------------------------------------------------------------------
+    # info
+    # ------------------------------------------------------------------
+    def count(self) -> int:
+        return sum(B.block_num_rows(b) for b in self._iter_blocks())
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def schema(self) -> Dict[str, str]:
+        for b in self._iter_blocks():
+            return {k: str(v.dtype) for k, v in b.items()}
+        return {}
+
+    def num_blocks(self) -> int:
+        if self._materialized is not None:
+            return len(self._materialized)
+        return len(self._sources)
+
+    def __repr__(self) -> str:
+        return (f"Dataset(blocks={self.num_blocks()}, "
+                f"stages={len(self._stages)})")
+
+
+def _expand_paths(paths: Union[str, List[str]],
+                  exts: Tuple[str, ...]) -> List[str]:
+    import os
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for ext in exts:
+                files.extend(sorted(
+                    globlib.glob(os.path.join(p, f"*{ext}"))))
+        elif any(ch in p for ch in "*?["):
+            files.extend(sorted(globlib.glob(p)))
+        else:
+            if not os.path.exists(p):
+                raise FileNotFoundError(p)
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no files match {paths}")
+    return files
+
+
+# Module-level constructors mirroring ray.data.* entry points.
+from_items = Dataset.from_items
+range_ = Dataset.range
+from_numpy = Dataset.from_numpy
+from_pandas = Dataset.from_pandas
+read_parquet = Dataset.read_parquet
+read_csv = Dataset.read_csv
+read_json = Dataset.read_json

@@ -1,0 +1,32 @@
+"""ray_tpu.train: distributed training orchestration (reference: Ray Train).
+
+Public surface mirrors ray.train: TpuTrainer (DataParallelTrainer
+analog), ScalingConfig/RunConfig/FailureConfig/CheckpointConfig, Result,
+Checkpoint, session get_context()/report(); plus the TPU-native
+compile-once sharded step (CompiledTrainStep) replacing torch DDP
+backends.  The jax/optax-heavy train_step symbols are lazy (PEP 562) so
+CPU-only trainer workers don't pay the jax import.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.session import get_context, report
+from ray_tpu.train.trainer import (CheckpointConfig, DataParallelTrainer,
+                                   FailureConfig, Result, RunConfig,
+                                   ScalingConfig, TpuTrainer)
+
+_LAZY = {"CompiledTrainStep", "TrainState", "make_optimizer"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from ray_tpu.train import train_step
+        return getattr(train_step, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "Checkpoint", "CheckpointManager", "get_context", "report",
+    "CheckpointConfig", "DataParallelTrainer", "FailureConfig", "Result",
+    "RunConfig", "ScalingConfig", "TpuTrainer", "CompiledTrainStep",
+    "TrainState", "make_optimizer",
+]

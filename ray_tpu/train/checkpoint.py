@@ -1,0 +1,142 @@
+"""Checkpointing: directory-handle checkpoints + top-K retention manager.
+
+Analog of the reference's ray.train.Checkpoint (train/_checkpoint.py:56 —
+a handle to a directory on pluggable storage) and CheckpointManager
+(train/_internal/checkpoint_manager.py — keep top-K by metric).  The TPU
+difference: sharded jax pytrees are saved/restored via orbax, which
+writes per-shard tensorstore files in parallel across hosts — the
+TPU-native equivalent of torch.distributed checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory (local or fsspec-style path)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # -- pytree (jax) payloads ------------------------------------------
+    @classmethod
+    def save_pytree(cls, path: str, tree: Any,
+                    metadata: Optional[Dict[str, Any]] = None
+                    ) -> "Checkpoint":
+        """Save a (possibly sharded) jax pytree with orbax."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(path, "state"), tree, force=True)
+        ckptr.wait_until_finished()
+        if metadata:
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(metadata, f)
+        return cls(path)
+
+    def load_pytree(self, abstract_tree: Any = None) -> Any:
+        """Restore; `abstract_tree` (jax.eval_shape output with shardings)
+        restores shards to the right devices."""
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(os.path.join(self.path, "state"),
+                             abstract_tree)
+
+    def metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, "metadata.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self) -> str:
+        return f"Checkpoint({self.path})"
+
+
+@dataclass
+class _Tracked:
+    checkpoint: Checkpoint
+    metrics: Dict[str, Any]
+    index: int
+
+
+class CheckpointManager:
+    """Keep the best K checkpoints by a metric (reference:
+    CheckpointConfig(num_to_keep, checkpoint_score_attribute, ...))."""
+
+    def __init__(self, directory: str, num_to_keep: Optional[int] = 2,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max") -> None:
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._tracked: List[_Tracked] = []
+        self._counter = 0
+
+    def next_checkpoint_path(self) -> str:
+        return os.path.join(self.directory,
+                            f"checkpoint_{self._counter:06d}")
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[Dict[str, Any]] = None) -> None:
+        # Re-reporting the same directory updates the entry in place —
+        # never track one path twice, or eviction would rmtree data the
+        # latest checkpoint still points to.
+        for t in self._tracked:
+            if t.checkpoint.path == checkpoint.path:
+                t.metrics = metrics or {}
+                t.index = self._counter
+                self._counter += 1
+                return
+        self._tracked.append(
+            _Tracked(checkpoint, metrics or {}, self._counter))
+        self._counter += 1
+        self._evict()
+
+    def _score(self, t: _Tracked) -> float:
+        if self.score_attribute is None:
+            return float(t.index)  # recency
+        v = float(t.metrics.get(self.score_attribute, float("-inf")))
+        return v if self.score_order == "max" else -v
+
+    def _evict(self) -> None:
+        if self.num_to_keep is None:
+            return
+        while len(self._tracked) > self.num_to_keep:
+            worst = min(self._tracked, key=self._score)
+            self._tracked.remove(worst)
+            shutil.rmtree(worst.checkpoint.path, ignore_errors=True)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=self._score).checkpoint
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=lambda t: t.index).checkpoint
+
+    def list_checkpoints(self) -> List[Checkpoint]:
+        return [t.checkpoint for t in
+                sorted(self._tracked, key=lambda t: t.index)]

@@ -1,0 +1,104 @@
+"""Per-worker training session context.
+
+Analog of the reference's train session (train/_internal/session.py:111
+_TrainSession + ray.train.get_context()): inside a training worker,
+user code calls `get_context()` for rank info and `report(metrics,
+checkpoint=...)` to stream results to the driver.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_context: Optional["TrainContext"] = None
+
+
+@dataclass
+class _Report:
+    metrics: Dict[str, Any]
+    checkpoint_path: Optional[str] = None
+
+
+class TrainContext:
+    def __init__(self, world_size: int, world_rank: int,
+                 trial_dir: str, restore_checkpoint: Optional[str],
+                 config: Dict[str, Any],
+                 report_ns: Optional[str] = None) -> None:
+        self._world_size = world_size
+        self._world_rank = world_rank
+        self._trial_dir = trial_dir
+        self._restore = restore_checkpoint
+        self._config = config
+        self._reports: List[_Report] = []
+        self._lock = threading.Lock()
+        self._finished = False
+        # Reports are written through to the control plane's KV so they
+        # survive worker death (a checkpoint reported the instant before
+        # a crash must still be restorable — reference semantics: report
+        # is synchronized with the driver, session.py:111).
+        self._report_ns = report_ns
+        self._seq = 0
+
+    # -- public API (mirrors ray.train context) -------------------------
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def get_world_rank(self) -> int:
+        return self._world_rank
+
+    def get_trial_dir(self) -> str:
+        return self._trial_dir
+
+    def get_config(self) -> Dict[str, Any]:
+        return self._config
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        """Checkpoint to resume from (set after failure restarts)."""
+        if self._restore is None:
+            return None
+        return Checkpoint(self._restore)
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        rep = _Report(dict(metrics),
+                      checkpoint.path if checkpoint else None)
+        if self._report_ns is not None:
+            import pickle
+            from ray_tpu._private.client import get_global_client
+            client = get_global_client()
+            with self._lock:
+                seq = self._seq
+                self._seq += 1
+            key = f"{self._world_rank:05d}:{seq:09d}".encode()
+            client.kv_put(self._report_ns, key,
+                          pickle.dumps((rep.metrics, rep.checkpoint_path)))
+        else:
+            with self._lock:
+                self._reports.append(rep)
+
+    # -- driver-facing (drained by trainer polls) ------------------------
+    def drain_reports(self) -> List[_Report]:
+        with self._lock:
+            out, self._reports = self._reports, []
+            return out
+
+
+def get_context() -> TrainContext:
+    if _context is None:
+        raise RuntimeError("get_context() called outside a train worker")
+    return _context
+
+
+def set_context(ctx: Optional[TrainContext]) -> None:
+    global _context
+    _context = ctx
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Module-level convenience mirroring ray.train.report."""
+    get_context().report(metrics, checkpoint)

@@ -1,0 +1,146 @@
+"""Ray-Train-equivalent trainer tests (reference: python/ray/train/tests).
+
+End-to-end: trainer spawns worker actors, user loop reports metrics +
+checkpoints, FailureConfig restarts from the latest checkpoint.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (Checkpoint, CheckpointManager, FailureConfig,
+                           RunConfig, ScalingConfig, TpuTrainer)
+
+
+def test_trainer_basic(ray_start, tmp_path):
+    def loop(config):
+        from ray_tpu.train import session
+        ctx = session.get_context()
+        assert ctx.get_world_size() == 2
+        for step in range(3):
+            session.report({"step": step, "rank": ctx.get_world_rank(),
+                            "loss": 1.0 / (step + 1)})
+
+    trainer = TpuTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="basic", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_dataframe) == 3  # rank-0 reports only
+
+
+def test_trainer_checkpointing(ray_start, tmp_path):
+    def loop(config):
+        from ray_tpu.train import session
+        ctx = session.get_context()
+        for step in range(3):
+            ckpt_dir = os.path.join(ctx.get_trial_dir(),
+                                    f"my_ckpt_{step}")
+            os.makedirs(ckpt_dir, exist_ok=True)
+            with open(os.path.join(ckpt_dir, "state.json"), "w") as f:
+                json.dump({"step": step}, f)
+            session.report({"step": step},
+                           checkpoint=Checkpoint(ckpt_dir))
+
+    trainer = TpuTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="ckpt", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    with open(os.path.join(result.checkpoint.path, "state.json")) as f:
+        assert json.load(f)["step"] == 2
+
+
+def test_trainer_user_error_surfaces(ray_start, tmp_path):
+    def loop(config):
+        raise RuntimeError("train loop exploded")
+
+    trainer = TpuTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is not None
+    assert "exploded" in str(result.error)
+
+
+def test_trainer_failure_restart_from_checkpoint(ray_start, tmp_path):
+    marker = str(tmp_path / "crashed_once")
+
+    def loop(config):
+        from ray_tpu.train import session
+        ctx = session.get_context()
+        start = 0
+        ckpt = ctx.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["step"] + 1
+        for step in range(start, 4):
+            ckpt_dir = os.path.join(ctx.get_trial_dir(), f"c{step}")
+            os.makedirs(ckpt_dir, exist_ok=True)
+            with open(os.path.join(ckpt_dir, "state.json"), "w") as f:
+                json.dump({"step": step}, f)
+            session.report({"step": step, "resumed": start > 0},
+                           checkpoint=Checkpoint(ckpt_dir))
+            if step == 1 and not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)  # hard-kill the worker actor
+
+    trainer = TpuTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="ft", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)))
+    result = trainer.fit()
+    assert result.error is None, f"unexpected: {result.error}"
+    assert result.metrics["step"] == 3
+    assert result.metrics["resumed"] is True  # continued, not restarted
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "cm"), num_to_keep=2,
+                            score_attribute="acc", score_order="max")
+    paths = []
+    for i, acc in enumerate([0.1, 0.9, 0.5]):
+        p = mgr.next_checkpoint_path()
+        os.makedirs(p, exist_ok=True)
+        paths.append(p)
+        mgr.register(Checkpoint(p), {"acc": acc})
+    kept = [c.path for c in mgr.list_checkpoints()]
+    assert len(kept) == 2
+    assert paths[0] not in kept          # worst evicted
+    assert not os.path.exists(paths[0])  # and deleted from disk
+    assert mgr.best_checkpoint.path == paths[1]
+
+
+def test_orbax_pytree_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    tree = {"w": jnp.arange(8, dtype=jnp.float32),
+            "nested": {"b": jnp.ones((2, 3))}}
+    ckpt = Checkpoint.save_pytree(str(tmp_path / "ck"), tree,
+                                  metadata={"step": 7})
+    restored = ckpt.load_pytree()
+    assert ckpt.metadata()["step"] == 7
+    np.testing.assert_array_equal(restored["w"], np.arange(8))
+    np.testing.assert_array_equal(restored["nested"]["b"], np.ones((2, 3)))
+
+
+def test_checkpoint_manager_same_path_reregister(tmp_path):
+    """Re-reporting one directory must not let eviction delete it
+    (regression: rmtree of the path latest_checkpoint points to)."""
+    mgr = CheckpointManager(str(tmp_path / "cm2"), num_to_keep=2)
+    p = str(tmp_path / "cm2" / "shared")
+    os.makedirs(p, exist_ok=True)
+    for step in range(5):
+        mgr.register(Checkpoint(p), {"step": step})
+    assert os.path.exists(p)
+    assert mgr.latest_checkpoint.path == p
+    assert len(mgr.list_checkpoints()) == 1
