@@ -36,6 +36,9 @@ def _peak_for(device) -> float:
 
 
 def main() -> None:
+    import dataclasses
+    import os
+
     import jax
     import numpy as np
 
@@ -47,11 +50,23 @@ def main() -> None:
     on_tpu = dev.platform != "cpu"
 
     if on_tpu:
-        cfg = tfm.PRESETS["gpt2-small"]
-        batch, seq, steps = 8, 1024, 10
+        # gpt2-small fits un-remat'ed at batch 32 on a 16 GB chip with the
+        # fused (chunked) cross-entropy; saving activations beats
+        # recomputing them (~30% fewer FLOPs in the bwd pass).
+        cfg = dataclasses.replace(tfm.PRESETS["gpt2-small"],
+                                  remat=False, xent_chunk=2048)
+        batch, seq, steps = 32, 1024, 10
     else:  # CPU smoke fallback so the bench always emits a line
         cfg = tfm.PRESETS["tiny"]
         batch, seq, steps = 4, 128, 3
+    batch = int(os.environ.get("BENCH_BATCH", batch))
+    steps = int(os.environ.get("BENCH_STEPS", steps))
+    if os.environ.get("BENCH_REMAT"):
+        cfg = dataclasses.replace(
+            cfg, remat=True, remat_policy=os.environ["BENCH_REMAT"])
+    if os.environ.get("BENCH_XENT_CHUNK"):
+        c = int(os.environ["BENCH_XENT_CHUNK"])
+        cfg = dataclasses.replace(cfg, xent_chunk=c if c > 0 else None)
 
     mesh = make_mesh(MeshSpec(), devices=[dev])
     step = CompiledTrainStep(

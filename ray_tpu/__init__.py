@@ -46,12 +46,19 @@ def _detect_tpu_chips() -> int:
     chips = len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/*"))
     if chips:
         return chips
-    # Fall back to asking jax if it's already imported (e.g. tunneled
-    # devices that have no /dev entry).
+    # Fall back to asking jax — but ONLY if a backend is already
+    # initialized in this process.  Merely-imported jax (axon's
+    # sitecustomize imports it in every interpreter) must not be probed:
+    # jax.devices() would *initialize* the tunneled TPU backend here in
+    # the driver — seconds of startup, and a deadlock when another
+    # process holds the tunnel.
     import sys
     jax = sys.modules.get("jax")
     if jax is not None:
         try:
+            from jax._src import xla_bridge as xb
+            if not xb.backends_are_initialized():
+                return 0
             return len([d for d in jax.devices()
                         if d.platform not in ("cpu",)])
         except Exception:

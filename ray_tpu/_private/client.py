@@ -56,7 +56,8 @@ class CoreClient:
         sock = connect_uds(socket_path)
         self.conn = Connection(sock, push_handler=push_handler)
         reply = self.conn.call({"type": "register_client", "kind": kind,
-                                "client_id": self.client_id})
+                                "client_id": self.client_id,
+                                "pid": os.getpid()})
         self.store = ShmObjectStore(reply["store_path"])
         self.session_dir = reply["session_dir"]
         self._fn_cache: Dict[bytes, Any] = {}
@@ -111,10 +112,16 @@ class CoreClient:
         s, embedded = self.serialize_with_refs(value)
         oid = ObjectID.from_random()
         inline_limit = config.max_direct_call_object_size
+        # One-way: registration is ordered ahead of any later RPC on this
+        # connection (server processes a connection's frames in order),
+        # so a subsequent get()/submit referencing the ref always finds
+        # the directory entry.  Saves a round-trip per put (the hot path
+        # the reference optimizes with plasma's async create).
         if s.total_size <= inline_limit:
-            self.conn.call({"type": "put_object", "object_id": oid.binary(),
-                            "loc": "inline", "data": s.to_bytes(),
-                            "size": s.total_size, "embedded": embedded})
+            self.conn.notify({"type": "put_object",
+                              "object_id": oid.binary(),
+                              "loc": "inline", "data": s.to_bytes(),
+                              "size": s.total_size, "embedded": embedded})
         else:
             buf = self.store.create(oid, s.total_size)
             s.write_into(buf)
@@ -122,9 +129,10 @@ class CoreClient:
             # Creator pin intentionally NOT released: the directory owns
             # it (unevictable while the entry lives) and releases it on
             # delete — the analog of the reference pinning primary copies.
-            self.conn.call({"type": "put_object", "object_id": oid.binary(),
-                            "loc": "shm", "data": None,
-                            "size": s.total_size, "embedded": embedded})
+            self.conn.notify({"type": "put_object",
+                              "object_id": oid.binary(),
+                              "loc": "shm", "data": None,
+                              "size": s.total_size, "embedded": embedded})
         return ObjectRef(oid.binary(), owned=True)
 
     def get(self, refs: Sequence[ObjectRef],
@@ -247,7 +255,11 @@ class CoreClient:
         }
         if actor_spec_extra:
             spec.update(actor_spec_extra)
-        self.conn.call({"type": "submit_task", "spec": spec})
+        # One-way submit: return ids are generated client-side and any
+        # failure (infeasible, worker crash) is delivered through the
+        # return objects — no reply to wait for.  This is what makes
+        # submission pipeline (reference: lease reuse + PushTask stream).
+        self.conn.notify({"type": "submit_task", "spec": spec})
         return [ObjectRef(oid, owned=True) for oid in return_ids]
 
     def _pack_args(self, args: tuple, kwargs: dict
@@ -285,9 +297,10 @@ class CoreClient:
             buf = self.store.create(oid, s.total_size)
             s.write_into(buf)
             self.store.seal(oid)  # creator pin kept — owned by directory
-            self.conn.call({"type": "put_object", "object_id": oid.binary(),
-                            "loc": "shm", "data": None, "size": s.total_size,
-                            "embedded": []})
+            self.conn.notify({"type": "put_object",
+                              "object_id": oid.binary(),
+                              "loc": "shm", "data": None,
+                              "size": s.total_size, "embedded": []})
             packed.insert(0, ("blob", oid.binary()))
             all_embedded.append(oid.binary())
         return packed, all_embedded
@@ -322,7 +335,22 @@ class CoreClient:
         if s.total_size <= config.max_direct_call_object_size:
             return (oid, "inline", s.to_bytes(), s.total_size, embedded)
         obj = ObjectID(oid)
-        buf = self.store.create(obj, s.total_size)
+        try:
+            buf = self.store.create(obj, s.total_size)
+        except FileExistsError:
+            # A prior attempt of this task died around create/seal
+            # (ADVICE r1).  reset_stale frees the leftover (CREATING or
+            # sealed-but-unregistered) iff its creator is dead; then we
+            # write fresh — keeping `embedded` consistent with the
+            # payload.  If the creator is somehow still alive (death
+            # detection raced), fall back to reusing its sealed copy.
+            if self.store.reset_stale(obj):
+                buf = self.store.create(obj, s.total_size)
+            else:
+                mv = self.store.get(obj)
+                if mv is None:
+                    raise
+                return (oid, "shm", None, len(mv), embedded)
         s.write_into(buf)
         self.store.seal(obj)  # creator pin kept — owned by directory
         return (oid, "shm", None, s.total_size, embedded)

@@ -1,19 +1,51 @@
-"""Global control state (GCS-lite).
+"""Global control state (GCS).
 
-Analog of the reference's GCS server (src/ray/gcs/gcs_server/gcs_server.h:79)
-scoped to what the control plane owns: internal KV (gcs_kv_manager.h),
-the function/class table (pushed by drivers, fetched+cached by workers),
-the actor directory (gcs_actor_manager.h:308), and named actors.
+Analog of the reference's GCS server state (src/ray/gcs/gcs_server/
+gcs_server.h:79): internal KV (gcs_kv_manager.h), the function/class
+table, named actors + the actor location directory
+(gcs_actor_manager.h:308), node membership & resource views
+(gcs_node_manager.h:45, gcs_resource_manager.h:59), and the object
+location directory (the reference resolves locations through owners,
+ownership_based_object_directory.cc — here the GCS holds them directly,
+a deliberate simplification that keeps the pull path one hop).
 
-Single-node deployments embed this in the head node service; the
-multi-node path serves the same object over TCP (see node_service.py).
-All methods are thread-safe.
+Single-node deployments embed this in the head node service; multi-node
+clusters serve the same object over TCP via gcs_service.GcsServer.
+All methods are thread-safe.  Pubsub: `sub_*` callbacks fire inline
+under no lock contention guarantees beyond per-call atomicity.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+
+class NodeInfo:
+    __slots__ = ("node_id", "host", "control_port", "transfer_port",
+                 "resources_total", "resources_avail", "last_heartbeat",
+                 "state")
+
+    def __init__(self, node_id: bytes, host: str, control_port: int,
+                 transfer_port: int, resources_total: Dict[str, float]
+                 ) -> None:
+        self.node_id = node_id
+        self.host = host
+        self.control_port = control_port
+        self.transfer_port = transfer_port
+        self.resources_total = dict(resources_total)
+        self.resources_avail = dict(resources_total)
+        self.last_heartbeat = time.time()
+        self.state = "alive"        # alive | dead
+
+    def to_dict(self) -> dict:
+        return {"node_id": self.node_id, "host": self.host,
+                "control_port": self.control_port,
+                "transfer_port": self.transfer_port,
+                "resources_total": dict(self.resources_total),
+                "resources_avail": dict(self.resources_avail),
+                "state": self.state}
 
 
 class GlobalControlState:
@@ -22,6 +54,17 @@ class GlobalControlState:
         self._kv: Dict[str, Dict[bytes, bytes]] = {}
         self._functions: Dict[bytes, bytes] = {}
         self._named_actors: Dict[str, bytes] = {}  # "ns/name" -> actor_id
+        # -- multi-node tables --
+        self._nodes: Dict[bytes, NodeInfo] = {}
+        # oid -> (set of node_ids holding a copy, size)
+        self._locations: Dict[bytes, Tuple[Set[bytes], int]] = {}
+        # oid -> (kind, data) for small payloads the GCS can hand out
+        # directly: "inline" values and serialized errors.
+        self._small_objects: Dict[bytes, Tuple[str, bytes]] = {}
+        self._actor_nodes: Dict[bytes, bytes] = {}  # actor_id -> node_id
+        # subscriptions (server wires these to connection pushes)
+        self._loc_subs: Dict[bytes, List[Callable[[bytes, dict], None]]] = {}
+        self._node_subs: List[Callable[[str, dict], None]] = []
 
     # -- internal KV -------------------------------------------------------
     def kv_put(self, ns: str, key: bytes, value: bytes,
@@ -80,3 +123,184 @@ class GlobalControlState:
                 return list(self._named_actors)
             return [k.split("/", 1)[1] for k in self._named_actors
                     if k.startswith(ns + "/")]
+
+    # -- node membership & resources (gcs_node_manager.h:45) ---------------
+    def register_node(self, node_id: bytes, host: str, control_port: int,
+                      transfer_port: int,
+                      resources_total: Dict[str, float]) -> None:
+        with self._lock:
+            self._nodes[node_id] = NodeInfo(
+                node_id, host, control_port, transfer_port, resources_total)
+        self._publish_node("node_added", self._nodes[node_id].to_dict())
+
+    def heartbeat(self, node_id: bytes,
+                  resources_avail: Dict[str, float]) -> None:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None or n.state == "dead":
+                return
+            n.last_heartbeat = time.time()
+            n.resources_avail = dict(resources_avail)
+
+    def mark_node_dead(self, node_id: bytes, reason: str = "") -> None:
+        lost_notifies = []
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None or n.state == "dead":
+                return
+            n.state = "dead"
+            # Copies on a dead node are gone.  Subscribers waiting on an
+            # object whose LAST copy just vanished must hear about it
+            # (kind="lost") or they would block forever.
+            for oid in list(self._locations):
+                holders, size = self._locations[oid]
+                holders.discard(node_id)
+                if not holders and oid not in self._small_objects:
+                    del self._locations[oid]
+                    subs = self._loc_subs.pop(oid, [])
+                    if subs:
+                        lost_notifies.append((oid, size, subs))
+            dead_actors = [a for a, nid in self._actor_nodes.items()
+                           if nid == node_id]
+            for a in dead_actors:
+                del self._actor_nodes[a]
+                self.drop_named_actor(a)
+            info = n.to_dict()
+        for oid, size, subs in lost_notifies:
+            evt = {"object_id": oid, "node_id": None, "size": size,
+                   "kind": "lost"}
+            for cb in subs:
+                try:
+                    cb(oid, evt)
+                except Exception:
+                    pass
+        info["reason"] = reason
+        info["dead_actors"] = dead_actors
+        self._publish_node("node_dead", info)
+
+    def nodes(self, alive_only: bool = True) -> List[dict]:
+        with self._lock:
+            return [n.to_dict() for n in self._nodes.values()
+                    if not alive_only or n.state == "alive"]
+
+    def node_info(self, node_id: bytes) -> Optional[dict]:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            return n.to_dict() if n else None
+
+    def check_health(self, timeout_s: float) -> List[dict]:
+        """Mark nodes with stale heartbeats dead; returns newly-dead."""
+        now = time.time()
+        with self._lock:
+            stale = [n.node_id for n in self._nodes.values()
+                     if n.state == "alive"
+                     and now - n.last_heartbeat > timeout_s]
+        newly_dead = []
+        for nid in stale:
+            self.mark_node_dead(nid, "missed heartbeats")
+            newly_dead.append(self.node_info(nid))
+        return newly_dead
+
+    # -- object locations --------------------------------------------------
+    def add_location(self, oid: bytes, node_id: Optional[bytes], size: int,
+                     kind: str = "shm", data: Optional[bytes] = None
+                     ) -> None:
+        """Register a copy.  kind 'inline'/'error' payloads ride in the
+        GCS record itself (small by construction) so readers skip the
+        node-to-node pull."""
+        with self._lock:
+            holders, _ = self._locations.get(oid, (set(), 0))
+            if node_id is not None:
+                holders.add(node_id)
+            self._locations[oid] = (holders, size)
+            if kind in ("inline", "error") and data is not None:
+                self._small_objects[oid] = (kind, data)
+            subs = list(self._loc_subs.get(oid, ()))
+        evt = {"object_id": oid, "node_id": node_id, "size": size,
+               "kind": kind}
+        for cb in subs:
+            try:
+                cb(oid, evt)
+            except Exception:
+                pass
+
+    def get_locations(self, oid: bytes) -> dict:
+        with self._lock:
+            holders, size = self._locations.get(oid, (set(), 0))
+            small = self._small_objects.get(oid)
+            alive = [self._nodes[h].to_dict() for h in holders
+                     if h in self._nodes and self._nodes[h].state == "alive"]
+        out = {"nodes": alive, "size": size}
+        if small is not None:
+            out["kind"], out["data"] = small
+        else:
+            out["kind"] = "shm" if alive else None
+        return out
+
+    def remove_object(self, oid: bytes) -> List[bytes]:
+        """Owner-driven delete: drop the record; returns holder node ids
+        (the server publishes object_deleted to them)."""
+        with self._lock:
+            holders, _ = self._locations.pop(oid, (set(), 0))
+            self._small_objects.pop(oid, None)
+            self._loc_subs.pop(oid, None)
+            return list(holders)
+
+    def sub_location(self, oid: bytes,
+                     cb: Callable[[bytes, dict], None]) -> None:
+        fire = None
+        with self._lock:
+            if oid in self._locations or oid in self._small_objects:
+                holders, size = self._locations.get(oid, (set(), 0))
+                small = self._small_objects.get(oid)
+                if small is not None:
+                    fire = {"object_id": oid, "node_id": None,
+                            "size": size, "kind": small[0]}
+                elif holders:
+                    fire = {"object_id": oid,
+                            "node_id": next(iter(holders)),
+                            "size": size, "kind": "shm"}
+            self._loc_subs.setdefault(oid, []).append(cb)
+        if fire is not None:
+            cb(oid, fire)
+
+    def unsub_location(self, oid: bytes, cb) -> None:
+        with self._lock:
+            subs = self._loc_subs.get(oid)
+            if subs and cb in subs:
+                subs.remove(cb)
+                if not subs:
+                    del self._loc_subs[oid]
+
+    # -- actor directory ---------------------------------------------------
+    def set_actor_node(self, actor_id: bytes, node_id: bytes) -> None:
+        with self._lock:
+            self._actor_nodes[actor_id] = node_id
+
+    def get_actor_node(self, actor_id: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._actor_nodes.get(actor_id)
+
+    def drop_actor(self, actor_id: bytes) -> None:
+        with self._lock:
+            self._actor_nodes.pop(actor_id, None)
+        self.drop_named_actor(actor_id)
+
+    # -- node event pubsub -------------------------------------------------
+    def sub_nodes(self, cb: Callable[[str, dict], None]) -> None:
+        with self._lock:
+            self._node_subs.append(cb)
+
+    def unsub_nodes(self, cb) -> None:
+        with self._lock:
+            if cb in self._node_subs:
+                self._node_subs.remove(cb)
+
+    def _publish_node(self, event: str, info: dict) -> None:
+        with self._lock:
+            subs = list(self._node_subs)
+        for cb in subs:
+            try:
+                cb(event, info)
+            except Exception:
+                pass

@@ -1,0 +1,400 @@
+"""GCS as a standalone service: TCP server + client.
+
+The reference runs one gcs_server process per cluster
+(src/ray/gcs/gcs_server/gcs_server_main.cc) that every raylet and worker
+talks to over gRPC, with long-poll pubsub (src/ray/pubsub/).  Here the
+same framed-pickle Connection transport used node-locally carries the
+GCS protocol over TCP; pubsub events ride the same connection as
+unsolicited pushes (matched by the absence of __reply_to__), exactly how
+task-execution pushes work on the worker<->node connection.
+
+Run standalone:  python -m ray_tpu._private.gcs_service --port 0
+(prints the bound port on stdout; the Cluster fixture scrapes it).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu._private.config import config
+from ray_tpu._private.gcs import GlobalControlState
+from ray_tpu._private.protocol import (Connection, ConnectionLost,
+                                       connect_tcp, recv_msg, send_msg)
+
+
+class _GcsConn:
+    __slots__ = ("sock", "send_lock", "node_id", "loc_subs", "sub_nodes_cb")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.node_id: Optional[bytes] = None
+        self.loc_subs: set = set()
+        self.sub_nodes_cb = None
+
+    def send(self, msg: dict) -> None:
+        try:
+            send_msg(self.sock, msg, self.send_lock)
+        except (OSError, ConnectionLost):
+            pass
+
+    def reply(self, req: dict, payload: dict) -> None:
+        rid = req.get("__req_id__")
+        if rid is None:
+            return
+        payload["__reply_to__"] = rid
+        self.send(payload)
+
+
+class GcsServer:
+    """Serves a GlobalControlState over TCP + runs node health checks
+    (reference: gcs_health_check_manager.h:39)."""
+
+    def __init__(self, state: Optional[GlobalControlState] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.state = state or GlobalControlState()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.host = host
+        self.port = self._listener.getsockname()[1]
+        self._conns: List[_GcsConn] = []
+        self._lock = threading.Lock()
+        self._shutdown = False
+
+    def start(self) -> None:
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="rtpu-gcs-accept").start()
+        threading.Thread(target=self._health_loop, daemon=True,
+                         name="rtpu-gcs-health").start()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _GcsConn(sock)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True, name="rtpu-gcs-conn").start()
+
+    def _conn_loop(self, conn: _GcsConn) -> None:
+        try:
+            while not self._shutdown:
+                msg = recv_msg(conn.sock)
+                self._dispatch(conn, msg)
+        except (ConnectionLost, OSError, EOFError):
+            pass
+        finally:
+            self._drop_conn(conn)
+
+    def _drop_conn(self, conn: _GcsConn) -> None:
+        with self._lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+        for oid, cb in list(conn.loc_subs):
+            self.state.unsub_location(oid, cb)
+        if conn.sub_nodes_cb is not None:
+            self.state.unsub_nodes(conn.sub_nodes_cb)
+        # NOTE: a node's record stays "alive" until health check expiry —
+        # a control-connection blip is not node death (reference: GCS
+        # tolerates transient disconnects; death comes from health check).
+
+    def _dispatch(self, conn: _GcsConn, m: dict) -> None:
+        handler = getattr(self, "_h_" + m["type"], None)
+        if handler is None:
+            conn.reply(m, {"__error__": f"unknown gcs rpc {m['type']}"})
+            return
+        try:
+            handler(conn, m)
+        except Exception as e:
+            conn.reply(m, {"__error__": e})
+
+    def _health_loop(self) -> None:
+        interval = config.heartbeat_interval_s
+        timeout = interval * config.health_check_failure_threshold
+        while not self._shutdown:
+            time.sleep(interval)
+            self.state.check_health(timeout)
+
+    # -- handlers ----------------------------------------------------------
+    def _h_register_node(self, conn, m):
+        self.state.register_node(m["node_id"], m["host"],
+                                 m["control_port"], m["transfer_port"],
+                                 m["resources_total"])
+        conn.node_id = m["node_id"]
+        conn.reply(m, {"ok": True})
+
+    def _h_heartbeat(self, conn, m):
+        self.state.heartbeat(m["node_id"], m["resources_avail"])
+
+    def _h_nodes(self, conn, m):
+        conn.reply(m, {"nodes": self.state.nodes(
+            alive_only=m.get("alive_only", True))})
+
+    def _h_kv_put(self, conn, m):
+        conn.reply(m, {"ok": self.state.kv_put(
+            m["ns"], m["key"], m["value"], m.get("overwrite", True))})
+
+    def _h_kv_get(self, conn, m):
+        conn.reply(m, {"value": self.state.kv_get(m["ns"], m["key"])})
+
+    def _h_kv_del(self, conn, m):
+        conn.reply(m, {"ok": self.state.kv_del(m["ns"], m["key"])})
+
+    def _h_kv_keys(self, conn, m):
+        conn.reply(m, {"keys": self.state.kv_keys(
+            m["ns"], m.get("prefix", b""))})
+
+    def _h_fn_register(self, conn, m):
+        self.state.register_function(m["function_id"], m["blob"])
+        conn.reply(m, {"ok": True})
+
+    def _h_fn_fetch(self, conn, m):
+        conn.reply(m, {"blob": self.state.fetch_function(m["function_id"])})
+
+    def _h_register_named_actor(self, conn, m):
+        conn.reply(m, {"ok": self.state.register_named_actor(
+            m["ns"], m["name"], m["actor_id"])})
+
+    def _h_lookup_named_actor(self, conn, m):
+        conn.reply(m, {"actor_id": self.state.lookup_named_actor(
+            m["ns"], m["name"])})
+
+    def _h_drop_named_actor(self, conn, m):
+        self.state.drop_named_actor(m["actor_id"])
+
+    def _h_list_named_actors(self, conn, m):
+        conn.reply(m, {"names": self.state.list_named_actors(m.get("ns"))})
+
+    def _h_add_location(self, conn, m):
+        self.state.add_location(m["object_id"], m.get("node_id"),
+                                m["size"], m.get("kind", "shm"),
+                                m.get("data"))
+
+    def _h_get_locations(self, conn, m):
+        conn.reply(m, self.state.get_locations(m["object_id"]))
+
+    def _h_remove_object(self, conn, m):
+        holders = self.state.remove_object(m["object_id"])
+        # Tell every holder to drop its copy (owner-driven delete).
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            if c.node_id in holders and c.node_id != conn.node_id:
+                c.send({"type": "object_deleted",
+                        "object_id": m["object_id"]})
+
+    def _h_sub_location(self, conn, m):
+        oid = m["object_id"]
+
+        def cb(o, evt, _conn=conn):
+            _conn.send({"type": "location_event", **evt})
+
+        conn.loc_subs.add((oid, cb))
+        self.state.sub_location(oid, cb)
+        conn.reply(m, {"ok": True})
+
+    def _h_unsub_location(self, conn, m):
+        oid = m["object_id"]
+        for pair in list(conn.loc_subs):
+            if pair[0] == oid:
+                conn.loc_subs.discard(pair)
+                self.state.unsub_location(oid, pair[1])
+
+    def _h_sub_nodes(self, conn, m):
+        def cb(event, info, _conn=conn):
+            _conn.send({"type": "node_event", "event": event, "info": info})
+
+        conn.sub_nodes_cb = cb
+        self.state.sub_nodes(cb)
+        conn.reply(m, {"ok": True})
+
+    def _h_set_actor_node(self, conn, m):
+        self.state.set_actor_node(m["actor_id"], m["node_id"])
+
+    def _h_get_actor_node(self, conn, m):
+        conn.reply(m, {"node_id": self.state.get_actor_node(m["actor_id"])})
+
+    def _h_drop_actor(self, conn, m):
+        self.state.drop_actor(m["actor_id"])
+
+    def _h_ping(self, conn, m):
+        conn.reply(m, {"ok": True})
+
+
+class GcsClient:
+    """Node-side client: the same surface GlobalControlState exposes,
+    shipped over TCP, plus location/node subscriptions delivered via the
+    connection's push channel."""
+
+    def __init__(self, host: str, port: int,
+                 push_handler: Optional[Callable[[dict], None]] = None
+                 ) -> None:
+        self.host, self.port = host, port
+        self._push_handler = push_handler
+        self.conn = Connection(connect_tcp(host, port),
+                               push_handler=self._on_push)
+        self._loc_cbs: Dict[bytes, List[Callable]] = {}
+        self._node_cbs: List[Callable] = []
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        self.conn.close()
+
+    def _on_push(self, msg: dict) -> None:
+        t = msg.get("type")
+        if t == "location_event":
+            with self._lock:
+                cbs = list(self._loc_cbs.get(msg["object_id"], ()))
+            for cb in cbs:
+                cb(msg["object_id"], msg)
+        elif t == "node_event":
+            with self._lock:
+                cbs = list(self._node_cbs)
+            for cb in cbs:
+                cb(msg["event"], msg["info"])
+        elif self._push_handler is not None:
+            self._push_handler(msg)
+
+    # -- mirrored surface --------------------------------------------------
+    def register_node(self, node_id, host, control_port, transfer_port,
+                      resources_total):
+        self.conn.call({"type": "register_node", "node_id": node_id,
+                        "host": host, "control_port": control_port,
+                        "transfer_port": transfer_port,
+                        "resources_total": resources_total})
+
+    def heartbeat(self, node_id, resources_avail):
+        self.conn.notify({"type": "heartbeat", "node_id": node_id,
+                          "resources_avail": resources_avail})
+
+    def nodes(self, alive_only: bool = True):
+        return self.conn.call({"type": "nodes",
+                               "alive_only": alive_only})["nodes"]
+
+    def kv_put(self, ns, key, value, overwrite=True):
+        return self.conn.call({"type": "kv_put", "ns": ns, "key": key,
+                               "value": value,
+                               "overwrite": overwrite})["ok"]
+
+    def kv_get(self, ns, key):
+        return self.conn.call({"type": "kv_get", "ns": ns,
+                               "key": key})["value"]
+
+    def kv_del(self, ns, key):
+        return self.conn.call({"type": "kv_del", "ns": ns, "key": key})["ok"]
+
+    def kv_keys(self, ns, prefix=b""):
+        return self.conn.call({"type": "kv_keys", "ns": ns,
+                               "prefix": prefix})["keys"]
+
+    def register_function(self, function_id, blob):
+        self.conn.call({"type": "fn_register", "function_id": function_id,
+                        "blob": blob})
+
+    def fetch_function(self, function_id):
+        return self.conn.call({"type": "fn_fetch",
+                               "function_id": function_id})["blob"]
+
+    def register_named_actor(self, ns, name, actor_id):
+        return self.conn.call({"type": "register_named_actor", "ns": ns,
+                               "name": name, "actor_id": actor_id})["ok"]
+
+    def lookup_named_actor(self, ns, name):
+        return self.conn.call({"type": "lookup_named_actor", "ns": ns,
+                               "name": name})["actor_id"]
+
+    def drop_named_actor(self, actor_id):
+        self.conn.notify({"type": "drop_named_actor", "actor_id": actor_id})
+
+    def list_named_actors(self, ns=None):
+        return self.conn.call({"type": "list_named_actors",
+                               "ns": ns})["names"]
+
+    def add_location(self, oid, node_id, size, kind="shm", data=None):
+        self.conn.notify({"type": "add_location", "object_id": oid,
+                          "node_id": node_id, "size": size, "kind": kind,
+                          "data": data})
+
+    def get_locations(self, oid):
+        return self.conn.call({"type": "get_locations", "object_id": oid})
+
+    def remove_object(self, oid):
+        self.conn.notify({"type": "remove_object", "object_id": oid})
+
+    def sub_location(self, oid, cb):
+        with self._lock:
+            self._loc_cbs.setdefault(oid, []).append(cb)
+        self.conn.call({"type": "sub_location", "object_id": oid})
+
+    def unsub_location(self, oid, cb=None):
+        with self._lock:
+            if cb is None:
+                self._loc_cbs.pop(oid, None)
+            else:
+                cbs = self._loc_cbs.get(oid, [])
+                if cb in cbs:
+                    cbs.remove(cb)
+                if not cbs:
+                    self._loc_cbs.pop(oid, None)
+        self.conn.notify({"type": "unsub_location", "object_id": oid})
+
+    def sub_nodes(self, cb):
+        with self._lock:
+            self._node_cbs.append(cb)
+        self.conn.call({"type": "sub_nodes"})
+
+    def set_actor_node(self, actor_id, node_id):
+        self.conn.notify({"type": "set_actor_node", "actor_id": actor_id,
+                          "node_id": node_id})
+
+    def get_actor_node(self, actor_id):
+        return self.conn.call({"type": "get_actor_node",
+                               "actor_id": actor_id})["node_id"]
+
+    def drop_actor(self, actor_id):
+        self.conn.notify({"type": "drop_actor", "actor_id": actor_id})
+
+    def ping(self) -> bool:
+        try:
+            return self.conn.call({"type": "ping"}, timeout=5.0)["ok"]
+        except Exception:
+            return False
+
+
+def main() -> None:
+    import argparse
+    import sys
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    server = GcsServer(host=args.host, port=args.port)
+    server.start()
+    print(f"GCS_PORT={server.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

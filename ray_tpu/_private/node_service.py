@@ -114,7 +114,7 @@ class WorkerHandle:
 class _ConnCtx:
     """Per-connection server-side context."""
 
-    __slots__ = ("sock", "send_lock", "kind", "worker", "client_id")
+    __slots__ = ("sock", "send_lock", "kind", "worker", "client_id", "pid")
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
@@ -122,6 +122,7 @@ class _ConnCtx:
         self.kind = "unknown"
         self.worker: Optional[WorkerHandle] = None
         self.client_id: Optional[bytes] = None
+        self.pid = 0
 
     def send(self, msg: dict) -> None:
         try:
@@ -130,7 +131,11 @@ class _ConnCtx:
             pass
 
     def reply(self, req: dict, payload: dict) -> None:
-        payload["__reply_to__"] = req["__req_id__"]
+        # One-way messages (notify) carry no request id: nothing to send.
+        rid = req.get("__req_id__")
+        if rid is None:
+            return
+        payload["__reply_to__"] = rid
         self.send(payload)
 
 
@@ -165,6 +170,10 @@ class NodeService:
         # work instead of fork-bombing on a broken environment.
         self._spawn_failures = 0
         self._spawn_failure_limit = 5
+        # Dead workers whose processes haven't exited yet; their shm pins
+        # are reaped once the process is observed gone (escalating to
+        # SIGKILL past the deadline).
+        self._pending_reaps: List[Tuple[subprocess.Popen, int, float]] = []
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -266,6 +275,7 @@ class NodeService:
         with self.lock:
             ctx.kind = m["kind"]
             ctx.client_id = m["client_id"]
+            ctx.pid = m.get("pid", 0)
             if m["kind"] == "worker":
                 w = self.workers.get(m["client_id"])
                 if w is None:
@@ -281,10 +291,28 @@ class NodeService:
                           "store_path": self.store_path,
                           "session_dir": self.session_dir})
 
+    def _infeasible_reason(self, res: Dict[str, float]) -> Optional[str]:
+        """A request no node total can ever satisfy hangs forever unless
+        rejected up front (reference: raylet infeasible-task errors)."""
+        for k, v in (res or {}).items():
+            if v > self.resources_total.get(k, 0.0) + 1e-9:
+                return (f"resource request {{{k}: {v}}} exceeds cluster "
+                        f"total {{{k}: {self.resources_total.get(k, 0.0)}}}")
+        return None
+
     def _h_submit_task(self, ctx: _ConnCtx, m: dict) -> None:
         spec = m["spec"]
         with self.lock:
             rec = TaskRecord(spec)
+            reason = self._infeasible_reason(spec.get("resources"))
+            if reason is not None and spec.get("actor_id") is None:
+                self.tasks[rec.task_id] = rec
+                for oid in spec["return_ids"]:
+                    self.objects.setdefault(oid, ObjectEntry())
+                self._fail_task_returns(rec, exc.InfeasibleResourceError(
+                    f"task {spec.get('name')!r} is infeasible: {reason}"))
+                ctx.reply(m, {"ok": True})
+                return
             if self._spawn_failures >= self._spawn_failure_limit:
                 self.tasks[rec.task_id] = rec
                 for oid in spec["return_ids"]:
@@ -319,14 +347,36 @@ class NodeService:
         with self.lock:
             self._register_object(m["object_id"], m["loc"],
                                   m.get("data"), m["size"],
-                                  embedded=m.get("embedded") or [])
+                                  embedded=m.get("embedded") or [],
+                                  creator_pid=ctx.pid)
             self._schedule()
         ctx.reply(m, {"ok": True})
 
     def _register_object(self, oid: bytes, loc: str,
                          data: Optional[bytes], size: int,
                          state: str = READY,
-                         embedded: Optional[List[bytes]] = None) -> None:
+                         embedded: Optional[List[bytes]] = None,
+                         creator_pid: int = 0) -> None:
+        if loc == "shm" and creator_pid and creator_pid != os.getpid():
+            # Adopt the creator's pin into the directory's ledger so
+            # reaping the (possibly dead) creator leaves it pinned.
+            from ray_tpu._private import shm_store as shm
+            try:
+                store = self._store()
+                rc = store.transfer_pin(_OID(oid), creator_pid, os.getpid())
+                if rc == shm.NOPIN:
+                    # The creator died and its pin was already reaped
+                    # before this registration drained: take a fresh
+                    # directory pin (or declare the object lost if the
+                    # unpinned entry was evicted in the gap).
+                    if store.get(_OID(oid)) is None:
+                        blob = ser.dumps(exc.ObjectLostError(
+                            oid.hex(), "evicted before registration "
+                            "(creator process died)"))
+                        loc, data, size = "error", blob, len(blob)
+                        state = FAILED
+            except Exception:
+                pass
         entry = self.objects.get(oid)
         if entry is None:
             entry = ObjectEntry()
@@ -450,7 +500,7 @@ class NodeService:
                 self._register_object(
                     oid, loc, data, size,
                     state=FAILED if loc == "error" else READY,
-                    embedded=embedded)
+                    embedded=embedded, creator_pid=ctx.pid)
             if rec is not None:
                 rec.state = "done"
                 # Release the holds the submitter took on arg/embedded
@@ -562,6 +612,24 @@ class NodeService:
         spec = m["spec"]
         actor_id = spec["actor_id"]
         with self.lock:
+            reason = self._infeasible_reason(spec.get("resources"))
+            if reason is not None:
+                actor = ActorRecord(actor_id, spec)
+                actor.state = "dead"
+                actor.death_reason = f"infeasible: {reason}"
+                self.actors[actor_id] = actor
+                rec = TaskRecord(spec["creation_task"])
+                self.tasks[rec.task_id] = rec
+                for oid in rec.spec["return_ids"]:
+                    self.objects.setdefault(oid, ObjectEntry())
+                self._fail_task_returns(rec, exc.InfeasibleResourceError(
+                    f"actor {spec.get('name') or actor_id.hex()} is "
+                    f"infeasible: {reason}"))
+                # _fail_task_returns skips embedded decrefs for creation
+                # tasks (restart replay); this actor will never restart.
+                self._release_actor_holds(actor)
+                ctx.reply(m, {"ok": True})
+                return
             if spec.get("name"):
                 ok = self.gcs.register_named_actor(
                     spec.get("namespace", "default"), spec["name"], actor_id)
@@ -588,16 +656,8 @@ class NodeService:
         if actor.state == "dead":
             # kill() raced creation: do not resurrect — tear the worker
             # down instead of letting a killed actor serve calls.
-            w = rec.worker
-            if w is not None and w.state != "dead":
-                w.state = "dead"
-                self._give_back(w.resources_held)
-                w.resources_held = {}
-                if w.conn_send:
-                    w.conn_send({"type": "exit"})
-                if w.proc is not None:
-                    w.proc.terminate()
-                self.workers.pop(w.worker_id, None)
+            if rec.worker is not None:
+                self._teardown_worker(rec.worker)
             return
         if failed:
             actor.state = "dead"
@@ -669,16 +729,8 @@ class NodeService:
             self.gcs.drop_named_actor(actor.actor_id)
             self._release_actor_holds(actor)
             self._fail_actor_queue(actor)
-            w = actor.worker
-            if w is not None:
-                w.state = "dead"
-                self._give_back(w.resources_held)
-                w.resources_held = {}
-                if w.conn_send:
-                    w.conn_send({"type": "exit"})
-                if w.proc is not None:
-                    w.proc.terminate()
-                self.workers.pop(w.worker_id, None)
+            if actor.worker is not None:
+                self._teardown_worker(actor.worker)
         ctx.reply(m, {"ok": True})
 
     def _h_actor_state(self, ctx: _ConnCtx, m: dict) -> None:
@@ -726,6 +778,41 @@ class NodeService:
     def _give_back(self, res: Dict[str, float]) -> None:
         for k, v in res.items():
             self.resources_avail[k] = self.resources_avail.get(k, 0.0) + v
+
+    def _schedule_reap(self, w: WorkerHandle) -> None:
+        """Reclaim a dead worker's shm pins (read pins + unadopted
+        creator pins) — but only once its PROCESS is actually gone:
+        reaping a live process (connection lost, SIGTERM still in
+        flight) would release pins it is still using.  Caller holds the
+        lock."""
+        if not w.pid:
+            return
+        if w.proc is not None and w.proc.poll() is None:
+            self._pending_reaps.append((w.proc, w.pid,
+                                        time.time() + 2.0))
+            return
+        try:
+            self._store().reap_client(w.pid)
+        except Exception:
+            pass
+
+    def _teardown_worker(self, w: WorkerHandle) -> None:
+        """Forcibly stop a worker (kill_actor / kill-race paths).
+        Caller holds the lock."""
+        if w.state == "dead":
+            return
+        w.state = "dead"
+        self._give_back(w.resources_held)
+        w.resources_held = {}
+        if w.conn_send:
+            try:
+                w.conn_send({"type": "exit"})
+            except Exception:
+                pass
+        if w.proc is not None:
+            w.proc.terminate()
+        self.workers.pop(w.worker_id, None)
+        self._schedule_reap(w)
 
     def _release_worker(self, w: WorkerHandle) -> None:
         self._give_back(w.resources_held)
@@ -843,6 +930,7 @@ class NodeService:
             self._give_back(w.resources_held)
         w.state = "dead"
         self.workers.pop(w.worker_id, None)
+        self._schedule_reap(w)
         rec = w.current_task
         if rec is not None and rec.state == "dispatched":
             if rec.retries_left > 0 and not rec.is_actor_creation:
@@ -946,6 +1034,20 @@ class NodeService:
                         self.workers.pop(w.worker_id, None)
                         if w.conn_send:
                             w.conn_send({"type": "exit"})
+                        self._schedule_reap(w)
+                still_pending = []
+                for proc, pid, deadline in self._pending_reaps:
+                    if proc.poll() is not None:
+                        try:
+                            self._store().reap_client(pid)
+                        except Exception:
+                            pass
+                    elif now >= deadline:
+                        proc.kill()
+                        still_pending.append((proc, pid, now + 2.0))
+                    else:
+                        still_pending.append((proc, pid, deadline))
+                self._pending_reaps = still_pending
             for cb in fire:
                 try:
                     cb()

@@ -26,6 +26,7 @@ FULL = -3
 CREATING = -4
 ERROR = -5
 TABLE_FULL = -6
+NOPIN = -7
 
 _lib = None
 
@@ -35,7 +36,12 @@ def _load():
     if _lib is not None:
         return _lib
     so = build_library("shmstore", ["shm_store.cc"])
-    lib = ctypes.CDLL(so)
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        # Stale/wrong-arch cached binary: rebuild from source (ADVICE r1).
+        so = build_library("shmstore", ["shm_store.cc"], force=True)
+        lib = ctypes.CDLL(so)
     u64 = ctypes.c_uint64
     p_u64 = ctypes.POINTER(u64)
     lib.shm_store_create.argtypes = [ctypes.c_char_p, u64]
@@ -50,6 +56,10 @@ def _load():
     lib.shm_store_release.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.shm_store_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.shm_store_stats.argtypes = [ctypes.c_int, p_u64, p_u64, p_u64, p_u64]
+    lib.shm_store_transfer_pin.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                           u64, u64]
+    lib.shm_store_reap_client.argtypes = [ctypes.c_int, u64]
+    lib.shm_store_reset_stale.argtypes = [ctypes.c_int, ctypes.c_char_p]
     _lib = lib
     return lib
 
@@ -178,6 +188,27 @@ class ShmObjectStore:
 
     def delete(self, object_id: ObjectID) -> None:
         _load().shm_store_delete(self._handle, object_id.binary())
+
+    def transfer_pin(self, object_id: ObjectID, from_pid: int,
+                     to_pid: int) -> int:
+        """Move one pin between client ledgers (refcnt unchanged) — the
+        directory adopting a worker's creator pin.  Returns a status
+        code; NOPIN means from_pid's pin was already reaped and the
+        caller must acquire its own pin instead."""
+        return _load().shm_store_transfer_pin(
+            self._handle, object_id.binary(), from_pid, to_pid)
+
+    def reap_client(self, pid: int) -> int:
+        """Release every pin a dead process still holds; frees its
+        half-written CREATING objects. Returns pins released."""
+        rc = _load().shm_store_reap_client(self._handle, pid)
+        return max(rc, 0)
+
+    def reset_stale(self, object_id: ObjectID) -> bool:
+        """Force-free a crashed prior attempt's leftover entry (CREATING
+        or sealed-but-unregistered); refuses while the creator lives."""
+        return _load().shm_store_reset_stale(
+            self._handle, object_id.binary()) == OK
 
     def stats(self) -> dict:
         used = ctypes.c_uint64()

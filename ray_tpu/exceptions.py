@@ -82,6 +82,11 @@ class RuntimeEnvSetupError(RayTpuError):
     """Preparing a task/actor runtime environment failed."""
 
 
+class InfeasibleResourceError(RayTpuError):
+    """The task/actor resource request exceeds every node's total and can
+    never be scheduled (reference: raylet infeasible-task error)."""
+
+
 class PlacementGroupUnschedulableError(RayTpuError):
     """The placement group cannot fit on the cluster."""
 

@@ -24,6 +24,7 @@ import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 
 from ray_tpu.ops.attention import attention
@@ -47,7 +48,11 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     tie_embeddings: bool = False
     remat: bool = True
+    remat_policy: str = "dots"            # dots | nothing
     attn_impl: str = "auto"               # auto | flash | reference
+    # Fused cross-entropy chunk (tokens per logits block). None => dense
+    # [B,S,V] logits path (only sensible for tiny vocab/testing).
+    xent_chunk: Optional[int] = 1024
 
     @property
     def kv_heads(self) -> int:
@@ -227,6 +232,9 @@ def _layer_body(cfg: TransformerConfig, mesh, x, p, positions):
         o = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
     else:
         o = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    # Named for the remat policy: saving the attention output avoids
+    # re-running the flash kernel in the backward pass.
+    o = jax.ad_checkpoint.checkpoint_name(o, "attn_out")
     o = o.transpose(0, 2, 1, 3)   # [B, S, H, Dh]
     attn_out = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(o.dtype))
     x = x + constrain(attn_out, ("batch", "seq", "embed"), mesh=mesh)
@@ -247,9 +255,20 @@ def _layer_body(cfg: TransformerConfig, mesh, x, p, positions):
     return x + constrain(down, ("batch", "seq", "embed"), mesh=mesh)
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array,
-            cfg: TransformerConfig, mesh=None) -> jax.Array:
-    """tokens: [B, S] int32 -> logits [B, S, vocab] (f32)."""
+def _remat_policy(cfg: TransformerConfig):
+    if cfg.remat_policy == "nothing":
+        return jax.checkpoint_policies.nothing_saveable
+    # "dots": save matmul outputs (qkv/wo/mlp projections — no batch dims
+    # in those dot_generals) plus the flash-attention output, so the bwd
+    # pass recomputes only cheap elementwise/norm work.
+    return jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names("attn_out"))
+
+
+def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
+                   cfg: TransformerConfig, mesh=None) -> jax.Array:
+    """tokens: [B, S] int32 -> final-norm hidden states [B, S, D]."""
     B, S = tokens.shape
     x = params["tok_embed"][tokens].astype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -259,8 +278,7 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
 
     body = functools.partial(_layer_body, cfg, mesh, positions=positions)
     if cfg.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(body, policy=_remat_policy(cfg))
 
     def scan_fn(x, layer_params):
         return body(x, layer_params), None
@@ -268,25 +286,75 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
     x, _ = jax.lax.scan(scan_fn, x, params["layers"])
 
     rms = cfg.arch == "llama"
-    x = _norm(x, params["final_norm"], params.get("final_norm_b"),
-              cfg.norm_eps, rms)
-    w_out = (params["tok_embed"].T if cfg.tie_embeddings
-             else params["lm_head"])
+    return _norm(x, params["final_norm"], params.get("final_norm_b"),
+                 cfg.norm_eps, rms)
+
+
+def _w_out(params, cfg: TransformerConfig):
+    return (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            cfg: TransformerConfig, mesh=None) -> jax.Array:
+    """tokens: [B, S] int32 -> logits [B, S, vocab] (f32)."""
+    x = forward_hidden(params, tokens, cfg, mesh)
     # bf16 operands + f32 accumulation: full MXU rate, f32-exact softmax.
     logits = jnp.einsum("bsd,dv->bsv", x.astype(cfg.dtype),
-                        w_out.astype(cfg.dtype),
+                        _w_out(params, cfg).astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
     return constrain(logits, ("batch", "seq", "vocab"), mesh=mesh)
+
+
+def fused_cross_entropy(x: jax.Array, w_out: jax.Array, targets: jax.Array,
+                        cfg: TransformerConfig) -> jax.Array:
+    """Chunked softmax cross-entropy that never materializes the full
+    [B, S, V] logits (f32 logits for gpt2-small at B=32,S=1k are ~6 GB).
+
+    Scans over token chunks; each step computes one [chunk, V] logits
+    block, reduces it to per-token nll, and is rematerialized in the
+    backward pass (jax.checkpoint), so peak memory is one block.
+    """
+    B, S, D = x.shape
+    N = B * S
+    chunk = min(cfg.xent_chunk or N, N)
+    xf = x.reshape(N, D)
+    tf = targets.reshape(N)
+    n = -(-N // chunk)
+    pad = n * chunk - N
+    if pad:
+        xf = jnp.pad(xf, ((0, pad), (0, 0)))
+        tf = jnp.pad(tf, (0, pad), constant_values=-1)
+    wd = w_out.astype(cfg.dtype)
+
+    def body(carry, inp):
+        xc, tc = inp
+        logits = jnp.einsum("cd,dv->cv", xc, wd,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, jnp.maximum(tc, 0)[:, None], axis=1)[:, 0]
+        nll = jnp.where(tc >= 0, lse - tgt, 0.0)
+        return carry + jnp.sum(nll), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(body), jnp.zeros((), jnp.float32),
+        (xf.reshape(n, chunk, D), tf.reshape(n, chunk)))
+    return total / N
 
 
 def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None
             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross-entropy. tokens: [B, S]; predicts tokens[:,1:]."""
-    logits = forward(params, tokens[:, :-1], cfg, mesh)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = jnp.mean(nll)
+    if cfg.xent_chunk is None:
+        logits = forward(params, tokens[:, :-1], cfg, mesh)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = jnp.mean(nll)
+    else:
+        x = forward_hidden(params, tokens[:, :-1], cfg, mesh)
+        loss = fused_cross_entropy(x, _w_out(params, cfg), targets, cfg)
     return loss, {"loss": loss, "ppl": jnp.exp(loss)}
 
 

@@ -1,12 +1,16 @@
 """Lazy native build: compiles the C++ runtime libraries with g++ on first
-use and caches the .so next to the sources (rebuilds when sources are newer).
+use and caches the .so next to the sources.
 
-The reference ships prebuilt bazel artifacts; we compile at import time so
-the repo needs no install step.
+Cache validity is decided by a content hash of the sources + compile
+flags (written to lib<name>.so.hash), not mtimes — a fresh checkout gives
+every file the same mtime, which would silently keep a stale or
+wrong-arch binary (ADVICE r1).  The reference ships prebuilt bazel
+artifacts; we compile at import time so the repo needs no install step.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import threading
@@ -18,16 +22,30 @@ _CXX = os.environ.get("CXX", "g++")
 _FLAGS = ["-O2", "-g", "-fPIC", "-shared", "-std=c++17", "-pthread", "-Wall"]
 
 
-def build_library(name: str, sources: list[str]) -> str:
+def _content_hash(srcs: list[str]) -> str:
+    h = hashlib.sha256()
+    h.update(" ".join([_CXX] + _FLAGS).encode())
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def build_library(name: str, sources: list[str], force: bool = False) -> str:
     """Compile `sources` (relative to native/) into lib<name>.so; returns
-    the .so path. No-op when the cached .so is newer than all sources."""
+    the .so path.  No-op when the cached .so matches the source hash."""
     so_path = os.path.join(_NATIVE_DIR, f"lib{name}.so")
+    hash_path = so_path + ".hash"
     srcs = [os.path.join(_NATIVE_DIR, s) for s in sources]
     with _LOCK:
-        if os.path.exists(so_path):
-            so_mtime = os.path.getmtime(so_path)
-            if all(os.path.getmtime(s) <= so_mtime for s in srcs):
-                return so_path
+        want = _content_hash(srcs)
+        if not force and os.path.exists(so_path):
+            try:
+                with open(hash_path) as f:
+                    if f.read().strip() == want:
+                        return so_path
+            except OSError:
+                pass
         tmp = so_path + f".tmp.{os.getpid()}"
         cmd = [_CXX, *_FLAGS, "-o", tmp, *srcs]
         try:
@@ -36,4 +54,7 @@ def build_library(name: str, sources: list[str]) -> str:
             raise RuntimeError(
                 f"native build failed: {' '.join(cmd)}\n{e.stderr}") from e
         os.replace(tmp, so_path)
+        with open(hash_path + f".tmp.{os.getpid()}", "w") as f:
+            f.write(want)
+        os.replace(hash_path + f".tmp.{os.getpid()}", hash_path)
     return so_path

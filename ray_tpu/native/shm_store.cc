@@ -29,12 +29,18 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <signal.h>
+
 namespace {
 
-constexpr uint64_t kMagic = 0x5254505553544f31ULL;  // "RTPUSTO1"
+constexpr uint64_t kMagic = 0x5254505553544f32ULL;  // "RTPUSTO2"
 constexpr uint32_t kIdSize = 16;
 constexpr uint64_t kAlign = 64;
 constexpr uint32_t kTableCapacity = 1 << 16;  // 65536 entries, power of two
+constexpr uint32_t kMaxClients = 64;         // concurrent pinning processes
+// Distinct concurrently-pinned objects tracked per client; beyond this,
+// pins still work (refcnt) but are untracked by the reaper.
+constexpr uint32_t kClientPinCap = 1 << 9;
 
 // ---- status codes (keep in sync with _private/shm_store.py) ----
 constexpr int kOK = 0;
@@ -44,6 +50,7 @@ constexpr int kFull = -3;
 constexpr int kCreating = -4;
 constexpr int kError = -5;
 constexpr int kTableFull = -6;
+constexpr int kNoPin = -7;  // transfer: from_pid has no recorded pin
 
 enum ObjState : uint32_t {
   kEmpty = 0,
@@ -60,7 +67,7 @@ struct Entry {
   uint32_t state;
   uint32_t refcnt;
   uint32_t pending_delete;
-  uint32_t pad;
+  uint32_t creator_pid;  // pid that called create_object (stale-reset gate)
 };
 
 // Allocator block header (boundary tags). Lives immediately before each
@@ -77,6 +84,22 @@ struct Block {
 constexpr uint64_t kBlockHdr = 24;  // size, prev_size, free+pad
 constexpr uint64_t kMinBlock = kBlockHdr + 16;
 
+// Per-client pin ledger (ADVICE r1): every pin (creator pin from
+// create_object, read pin from get) is recorded under the calling
+// process's slot so the node service can reap a crashed worker's pins —
+// the analog of plasma releasing a disconnected client's refs
+// (reference: plasma store client connection teardown).
+struct PinRec {
+  uint32_t entry_idx_plus1;  // 0 = empty slot; else table index + 1
+  uint32_t count;
+  uint64_t id_lo;            // first 8 id bytes: guards against slot reuse
+};
+
+struct ClientSlot {
+  uint64_t pid;  // 0 = free
+  PinRec pins[kClientPinCap];  // open-addressed by entry index
+};
+
 struct Header {
   uint64_t magic;
   uint64_t total_size;
@@ -90,6 +113,7 @@ struct Header {
   uint64_t bytes_evicted;
   pthread_mutex_t mutex;
   Entry table[kTableCapacity];
+  ClientSlot clients[kMaxClients];
 };
 
 struct Store {
@@ -169,6 +193,100 @@ Entry* insert_slot(Header* h, const uint8_t* id, Entry** existing) {
     idx = (idx + 1) & (kTableCapacity - 1);
   }
   return slot;
+}
+
+// ---------------- client pin ledger ----------------
+
+// Find (or claim) the ClientSlot for `pid`. Reclaims slots whose owner
+// process is gone. Returns nullptr only when every slot belongs to a
+// live process. Caller holds the segment mutex.
+ClientSlot* client_slot(Header* h, uint64_t pid) {
+  ClientSlot* dead = nullptr;
+  ClientSlot* empty = nullptr;
+  for (uint32_t i = 0; i < kMaxClients; ++i) {
+    ClientSlot& c = h->clients[i];
+    if (c.pid == pid) return &c;
+    if (c.pid == 0) {
+      if (!empty) empty = &c;
+    } else if (!dead && kill((pid_t)c.pid, 0) != 0 && errno == ESRCH) {
+      dead = &c;
+    }
+  }
+  ClientSlot* slot = empty ? empty : dead;
+  if (slot) {
+    // NOTE: a reclaimed dead slot may still list unreaped pins; those
+    // refcnts stay leaked exactly as before reclamation — reap_client
+    // is the supported path.  Zero the ledger for the new owner.
+    memset(slot, 0, sizeof(ClientSlot));
+    slot->pid = pid;
+  }
+  return slot;
+}
+
+// Add/remove `delta` pins for (pid, entry). Open addressing with
+// count==0 tombstones (probe chains end only at entry_idx_plus1==0, so
+// decrement-to-zero never breaks lookups of colliding keys).  Records
+// carry an id prefix so a table slot recycled for a different object
+// never matches a stale record.  Returns true iff the ledger was
+// actually updated (false for delta<0 with no matching record —
+// the caller may be trying to move a pin that was already reaped).
+bool record_pin(Header* h, uint64_t pid, Entry* e, int delta) {
+  ClientSlot* c = client_slot(h, pid);
+  if (!c) return delta > 0;  // ledger full: untracked (refcnt still correct)
+  uint32_t entry_idx = (uint32_t)(e - h->table);
+  uint64_t id_lo;
+  memcpy(&id_lo, e->id, 8);
+  uint32_t key = entry_idx + 1;
+  uint32_t idx = entry_idx & (kClientPinCap - 1);
+  PinRec* reuse = nullptr;
+  for (uint32_t probe = 0; probe < kClientPinCap; ++probe) {
+    PinRec& r = c->pins[idx];
+    if (r.entry_idx_plus1 == key && (r.count == 0 || r.id_lo == id_lo)) {
+      if (delta > 0) {
+        r.count += (uint32_t)delta;
+        r.id_lo = id_lo;
+        return true;
+      }
+      if (r.count > 0) {
+        r.count--;
+        return true;
+      }
+      return false;
+    }
+    if (r.entry_idx_plus1 == 0) {  // end of probe chain: key absent
+      if (delta > 0) {
+        PinRec* dst = reuse ? reuse : &r;
+        dst->entry_idx_plus1 = key;
+        dst->count = (uint32_t)delta;
+        dst->id_lo = id_lo;
+        return true;
+      }
+      return false;
+    }
+    if (r.count == 0 && !reuse) reuse = &r;  // tombstone, reusable
+    idx = (idx + 1) & (kClientPinCap - 1);
+  }
+  if (delta > 0 && reuse) {
+    reuse->entry_idx_plus1 = key;
+    reuse->count = (uint32_t)delta;
+    reuse->id_lo = id_lo;
+    return true;
+  }
+  return delta > 0;
+}
+
+void block_free(Store& s, uint64_t off);
+
+// Free an entry's storage. Caller holds the mutex; refcnt must be 0 (or
+// the caller is force-resetting a stale CREATING entry).
+void entry_free(Store& s, Entry* e) {
+  Header* h = H(s);
+  h->used_bytes -= e->size;
+  h->num_objects--;
+  block_free(s, e->offset - kBlockHdr);
+  e->state = kTombstone;
+  e->refcnt = 0;
+  e->pending_delete = 0;
 }
 
 // ---------------- allocator ----------------
@@ -272,12 +390,9 @@ uint64_t evict_lru(Store& s, uint64_t bytes) {
     }
     if (!victim) break;
     freed += victim->size + kBlockHdr;
-    h->used_bytes -= victim->size;
-    h->num_objects--;
     h->num_evictions++;
     h->bytes_evicted += victim->size;
-    block_free(s, victim->offset - kBlockHdr);
-    victim->state = kTombstone;
+    entry_free(s, victim);
   }
   return freed;
 }
@@ -433,9 +548,11 @@ int shm_store_create_object(int handle, const uint8_t* id, uint64_t size,
   slot->state = kStateCreating;
   slot->refcnt = 1;
   slot->pending_delete = 0;
+  slot->creator_pid = (uint32_t)getpid();
   slot->lru_tick = ++h->lru_clock;
   h->used_bytes += size;
   h->num_objects++;
+  record_pin(h, (uint64_t)getpid(), slot, +1);
   *offset_out = off;
   return kOK;
 }
@@ -461,10 +578,8 @@ int shm_store_abort(int handle, const uint8_t* id) {
   Entry* e = find(h, id);
   if (!e) return kNotFound;
   if (e->state != kStateCreating) return kError;
-  h->used_bytes -= e->size;
-  h->num_objects--;
-  block_free(*s, e->offset - kBlockHdr);
-  e->state = kTombstone;
+  record_pin(h, (uint64_t)getpid(), e, -1);
+  entry_free(*s, e);
   return kOK;
 }
 
@@ -480,6 +595,7 @@ int shm_store_get(int handle, const uint8_t* id, uint64_t* offset_out,
   if (e->state == kStateCreating) return kCreating;
   e->refcnt++;
   e->lru_tick = ++h->lru_clock;
+  record_pin(h, (uint64_t)getpid(), e, +1);
   *offset_out = e->offset;
   *size_out = e->size;
   return kOK;
@@ -502,13 +618,11 @@ int shm_store_release(int handle, const uint8_t* id) {
   Locker lock(h);
   Entry* e = find(h, id);
   if (!e) return kNotFound;
-  if (e->refcnt > 0) e->refcnt--;
-  if (e->refcnt == 0 && e->pending_delete) {
-    h->used_bytes -= e->size;
-    h->num_objects--;
-    block_free(*s, e->offset - kBlockHdr);
-    e->state = kTombstone;
+  if (e->refcnt > 0) {
+    e->refcnt--;
+    record_pin(h, (uint64_t)getpid(), e, -1);
   }
+  if (e->refcnt == 0 && e->pending_delete) entry_free(*s, e);
   return kOK;
 }
 
@@ -524,10 +638,87 @@ int shm_store_delete(int handle, const uint8_t* id) {
     e->pending_delete = 1;
     return kOK;
   }
-  h->used_bytes -= e->size;
-  h->num_objects--;
-  block_free(*s, e->offset - kBlockHdr);
-  e->state = kTombstone;
+  entry_free(*s, e);
+  return kOK;
+}
+
+// Move one pin of `id` from `from_pid`'s ledger to `to_pid`'s (refcnt
+// unchanged).  Used by the node service to ADOPT a worker's creator pin
+// when it registers a sealed shm object in the directory — so reaping
+// the worker later does not release directory-owned pins.  Returns
+// kNoPin when from_pid holds no recorded pin (e.g. it was already
+// reaped): the caller must then acquire its own pin instead.
+int shm_store_transfer_pin(int handle, const uint8_t* id,
+                           uint64_t from_pid, uint64_t to_pid) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  Entry* e = find(h, id);
+  if (!e) return kNotFound;
+  if (from_pid == to_pid) return kOK;
+  if (!record_pin(h, from_pid, e, -1)) return kNoPin;
+  record_pin(h, to_pid, e, +1);
+  return kOK;
+}
+
+// Release every pin recorded for `pid` (a dead client).  CREATING
+// entries whose creator died are freed outright.  Returns the number of
+// pins released, or a status code (<0) on error.
+int shm_store_reap_client(int handle, uint64_t pid) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  ClientSlot* c = nullptr;
+  for (uint32_t i = 0; i < kMaxClients; ++i) {
+    if (h->clients[i].pid == pid) {
+      c = &h->clients[i];
+      break;
+    }
+  }
+  if (!c) return 0;
+  int released = 0;
+  for (uint32_t i = 0; i < kClientPinCap; ++i) {
+    PinRec& r = c->pins[i];
+    if (r.entry_idx_plus1 == 0 || r.count == 0) continue;
+    Entry& e = h->table[r.entry_idx_plus1 - 1];
+    uint64_t id_lo;
+    memcpy(&id_lo, e.id, 8);
+    if (id_lo != r.id_lo) continue;  // table slot was recycled: stale rec
+    if (e.state == kSealed || e.state == kStateCreating) {
+      uint32_t n = r.count < e.refcnt ? r.count : e.refcnt;
+      e.refcnt -= n;
+      released += (int)n;
+      if (e.refcnt == 0) {
+        if (e.state == kStateCreating) {
+          entry_free(*s, &e);  // half-written object from a crashed worker
+        } else if (e.pending_delete) {
+          entry_free(*s, &e);
+        }
+      }
+    }
+  }
+  memset(c, 0, sizeof(ClientSlot));
+  return released;
+}
+
+// Force-free a leftover entry from a CRASHED prior task attempt (either
+// half-written CREATING, or sealed-but-never-registered).  Refuses when
+// the creating process is still alive — it may be mid-write, and
+// freeing under it would let its stores corrupt a reallocated block.
+int shm_store_reset_stale(int handle, const uint8_t* id) {
+  Store* s;
+  if (get_store(handle, &s) != kOK) return kError;
+  Header* h = H(*s);
+  Locker lock(h);
+  Entry* e = find(h, id);
+  if (!e) return kNotFound;
+  if (e->state != kStateCreating && e->state != kSealed) return kError;
+  if (e->creator_pid && kill((pid_t)e->creator_pid, 0) == 0) {
+    return kError;  // creator alive (or EPERM): not stale
+  }
+  entry_free(*s, e);
   return kOK;
 }
 

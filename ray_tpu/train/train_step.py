@@ -102,22 +102,37 @@ class CompiledTrainStep:
 
         replicated = NamedSharding(self.mesh, PartitionSpec())
         params_shardings = self.param_shardings
+        params_treedef = jax.tree.structure(state_shape.params)
+        params_leaves = jax.tree.leaves(state_shape.params)
 
-        # Optimizer leaves whose shape matches a parameter (adam mu/nu)
-        # inherit that parameter's sharding — the ZeRO property; scalar
-        # counts/schedule state stay replicated.
-        shape_map = {}
-        for p, s in zip(jax.tree.leaves(state_shape.params),
-                        jax.tree.leaves(params_shardings)):
-            shape_map.setdefault(p.shape, s)
+        def mirrors_params(node) -> bool:
+            # Adam mu/nu mirror the params pytree exactly; match by
+            # structure + leaf shapes (NOT by flat shape — two equal-shaped
+            # params with different rule shardings would alias, ADVICE r1).
+            try:
+                if jax.tree.structure(node) != params_treedef:
+                    return False
+                leaves = jax.tree.leaves(node)
+                return all(getattr(a, "shape", None) == b.shape
+                           for a, b in zip(leaves, params_leaves))
+            except Exception:
+                return False
 
-        def pick(leaf):
-            return shape_map.get(getattr(leaf, "shape", ()), replicated)
+        def assign(node):
+            if mirrors_params(node):
+                return params_shardings
+            if isinstance(node, tuple) and hasattr(node, "_fields"):
+                return type(node)(*[assign(c) for c in node])
+            if isinstance(node, (tuple, list)):
+                return type(node)(assign(c) for c in node)
+            if isinstance(node, dict):
+                return {k: assign(v) for k, v in node.items()}
+            return replicated  # scalar counts / schedule state
 
         return TrainState(
             step=replicated,
             params=params_shardings,
-            opt_state=jax.tree.map(pick, state_shape.opt_state))
+            opt_state=assign(state_shape.opt_state))
 
     # -- public API --------------------------------------------------------
     def init_state(self, seed: int = 0) -> TrainState:

@@ -1,0 +1,147 @@
+"""Core-runtime microbenchmarks, JSON-logged.
+
+Analog of the reference's microbenchmark driver
+(python/ray/_private/ray_perf.py:93, `ray microbenchmark` CLI) whose
+published numbers are the BASELINE.md table (release_logs/2.9.3/
+microbenchmark.json): sync/async actor calls/s, task throughput, object
+put rate and bandwidth, get latency.
+
+Run: python -m ray_tpu.util.microbench [--out FILE]
+Prints one JSON object; with --out also writes it to FILE.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _rate(n: int, dt: float) -> float:
+    return round(n / dt, 1)
+
+
+def bench_actor_calls_sync(ray_tpu, n: int = 300) -> float:
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.x = 0
+
+        def inc(self):
+            self.x += 1
+            return self.x
+
+    a = Counter.remote()
+    ray_tpu.get(a.inc.remote())  # warm: actor alive, worker hot
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(a.inc.remote())
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_actor_calls_async(ray_tpu, n: int = 2000) -> float:
+    """Pipelined (submit all, then drain) — the reference's 'async' mode."""
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return 1
+
+    a = Echo.remote()
+    ray_tpu.get(a.ping.remote())
+    t0 = time.perf_counter()
+    refs = [a.ping.remote() for _ in range(n)]
+    ray_tpu.get(refs[-1])
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_tasks_async(ray_tpu, n: int = 500) -> float:
+    @ray_tpu.remote
+    def nop():
+        return 1
+
+    # Warm the worker pool to steady state first (the reference's
+    # harness also excludes pool growth from the measured window).
+    ray_tpu.get([nop.remote() for _ in range(100)])
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_put_small(ray_tpu, n: int = 2000) -> float:
+    payload = b"x" * 1024
+    ray_tpu.put(payload)
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(payload) for _ in range(n)]
+    dt = time.perf_counter() - t0
+    del refs
+    return _rate(n, dt)
+
+
+def bench_put_gbps(ray_tpu, n: int = 10, mb: int = 64) -> float:
+    import numpy as np
+    payload = np.random.bytes(mb * 1024 * 1024)
+    r = ray_tpu.put(payload)
+    del r
+    t0 = time.perf_counter()
+    for _ in range(n):
+        # Drop each ref immediately so the directory can free the entry;
+        # holding all n would need n*mb of live store.
+        r = ray_tpu.put(payload)
+        del r
+    dt = time.perf_counter() - t0
+    return round(n * mb / 1024 / dt, 2)
+
+
+def bench_get_latency_us(ray_tpu, n: int = 1000) -> float:
+    """Median latency of get() on a small plasma-resident object."""
+    import numpy as np
+    ref = ray_tpu.put(np.arange(64 * 1024, dtype=np.uint8))  # shm-resident
+    ray_tpu.get(ref)
+    lats = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        ray_tpu.get(ref)
+        lats.append(time.perf_counter() - t0)
+    lats.sort()
+    return round(lats[n // 2] * 1e6, 1)
+
+
+def run_all(out_path: str | None = None) -> dict:
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, object_store_memory=1 << 30,
+                 ignore_reinit_error=True)
+    results = {
+        "actor_calls_sync_per_s": bench_actor_calls_sync(ray_tpu),
+        "actor_calls_async_per_s": bench_actor_calls_async(ray_tpu),
+        "tasks_async_per_s": bench_tasks_async(ray_tpu),
+        "put_small_per_s": bench_put_small(ray_tpu),
+        "put_gigabytes_per_s": bench_put_gbps(ray_tpu),
+        "get_64kb_median_us": bench_get_latency_us(ray_tpu),
+        "note": ("this host: 1 vCPU, single client; reference numbers "
+                 "are m5.16xlarge (64 vCPU) with multi-client "
+                 "aggregation for put/task rates"),
+        "reference_baseline": {
+            # release_logs/2.9.3/microbenchmark.json on m5.16xlarge
+            # (64 vCPU); this host has 1 vCPU — rates here are
+            # single-core, the reference's are 64-core.
+            "actor_calls_sync_per_s": 2033,
+            "actor_calls_async_per_s": 8886,
+            "multi_client_tasks_async_per_s": 25166,
+            "put_per_s": 12677,
+            "put_gigabytes_per_s": 35.9,
+        },
+    }
+    blob = json.dumps(results, indent=1)
+    print(blob)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(blob + "\n")
+    ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    run_all(ap.parse_args().out)

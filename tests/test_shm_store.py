@@ -118,9 +118,11 @@ def test_alloc_free_coalescing(store):
         store.delete(oid)
     for oid in oids[1::2]:
         store.delete(oid)
-    # All space coalesced: a 12 MiB object fits again.
+    # All space coalesced: an allocation far larger than any single
+    # freed block (64 x 128 KiB interleaved) fits again.  11 MiB leaves
+    # headroom for the in-segment table + client pin ledger.
     big = ObjectID.from_random()
-    store.put(big, b"b" * (12 * 1024 * 1024))
+    store.put(big, b"b" * (11 * 1024 * 1024))
     assert store.contains(big)
 
 
@@ -158,3 +160,91 @@ def test_cross_process_visibility(store):
     p.start()
     assert q.get(timeout=30) == b"cross"
     p.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# pin ledger (r2): reaping dead clients' pins, pin adoption, stale reset
+# ---------------------------------------------------------------------------
+def test_reap_dead_client_releases_pins(store):
+    """A process that dies holding read pins must not leak capacity:
+    reap_client releases them (reference: plasma releases a disconnected
+    client's refs)."""
+    oid = ObjectID.from_random()
+    store.put(oid, b"z" * 1000)
+
+    def pin_and_die(path, oid_bytes):
+        s2 = ShmObjectStore(path)
+        s2.get(ObjectID(oid_bytes))      # pin, never released
+        os._exit(0)
+
+    p = multiprocessing.Process(target=pin_and_die,
+                                args=(store._path, oid.binary()))
+    p.start()
+    p.join()
+    released = store.reap_client(p.pid)
+    assert released == 1
+    # Now unpinned: delete frees immediately.
+    store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_reap_frees_half_written_object(store):
+    """A crashed creator's CREATING entry is freed by the reap, so a
+    retry can recreate the same object id."""
+    oid = ObjectID.from_random()
+
+    def create_and_die(path, oid_bytes):
+        s2 = ShmObjectStore(path)
+        s2.create(ObjectID(oid_bytes), 5000)   # never sealed
+        os._exit(0)
+
+    p = multiprocessing.Process(target=create_and_die,
+                                args=(store._path, oid.binary()))
+    p.start()
+    p.join()
+    store.reap_client(p.pid)
+    buf = store.create(oid, 100)               # no FileExistsError
+    buf[:] = b"y" * 100
+    store.seal(oid)
+    assert store.contains(oid)
+
+
+def test_transfer_pin_nopin_after_reap(store):
+    from ray_tpu._private.shm_store import NOPIN, OK
+    oid = ObjectID.from_random()
+    buf = store.create(oid, 64)
+    buf[:] = b"a" * 64
+    store.seal(oid)
+    assert store.transfer_pin(oid, os.getpid(), 424242) == OK
+    assert store.reap_client(424242) == 1
+    # The pin is gone; a second adoption attempt must report NOPIN.
+    assert store.transfer_pin(oid, os.getpid(), 434343) == NOPIN
+
+
+def test_reset_stale_refuses_live_creator(store):
+    oid = ObjectID.from_random()
+    store.create(oid, 128)                     # this process is alive
+    assert not store.reset_stale(oid)
+
+
+def test_reset_stale_frees_dead_creators_sealed_entry(store):
+    oid = ObjectID.from_random()
+
+    def seal_and_die(path, oid_bytes):
+        s2 = ShmObjectStore(path)
+        b = s2.create(ObjectID(oid_bytes), 256)
+        b[:] = b"q" * 256
+        s2.seal(ObjectID(oid_bytes))
+        os._exit(0)                            # dies before registering
+
+    p = multiprocessing.Process(target=seal_and_die,
+                                args=(store._path, oid.binary()))
+    p.start()
+    p.join()
+    assert store.reset_stale(oid)
+    buf = store.create(oid, 64)                # rewritable now
+    buf[:] = b"r" * 64
+    store.seal(oid)
+    mv = store.get(oid)
+    assert bytes(mv[:2]) == b"rr"
+    store.release(oid)
