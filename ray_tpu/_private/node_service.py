@@ -140,16 +140,43 @@ class _ConnCtx:
 
 
 class NodeService:
-    """Head/node daemon. Runs inside the driver process (threads)."""
+    """Per-node daemon: scheduler, worker pool, object directory.
+
+    Single-node: runs inside the driver process (threads) with an
+    embedded GlobalControlState.  Multi-node (gcs_address given): the
+    same object connects to a GCS process (gcs_service.GcsClient), opens
+    TCP control + object-transfer listeners for its peers, heartbeats
+    resources, and spills work over / pulls objects across nodes — the
+    raylet role (reference: node_manager.h:119 + object_manager.h:117 +
+    cluster_task_manager.h:42 spillback)."""
 
     def __init__(self, session_dir: str, resources: Dict[str, float],
                  store_path: str, store_capacity: int,
-                 gcs: Optional[GlobalControlState] = None) -> None:
+                 gcs: Optional[GlobalControlState] = None,
+                 gcs_address: Optional[Tuple[str, int]] = None,
+                 node_id: Optional[bytes] = None) -> None:
         self.session_dir = session_dir
         self.socket_path = os.path.join(session_dir, "node.sock")
         self.store_path = store_path
         self.store_capacity = store_capacity
-        self.gcs = gcs or GlobalControlState()
+        self.node_id = node_id or os.urandom(16)
+        self.gcs_address = gcs_address
+        self.multinode = gcs_address is not None
+        if self.multinode:
+            from ray_tpu._private.gcs_service import GcsClient
+            self.gcs = GcsClient(gcs_address[0], gcs_address[1])
+        else:
+            self.gcs = gcs or GlobalControlState()
+        # node_id -> Connection to that node's control listener
+        self._peer_conns: Dict[bytes, Any] = {}
+        # task_id -> (TaskRecord, target node_id) for spilled-over tasks
+        self.forwarded: Dict[bytes, Tuple[TaskRecord, bytes]] = {}
+        # cluster resource view (from GCS), refreshed with each heartbeat
+        self._cluster_view: List[dict] = []
+        # actor_id -> node_id hint for actors created via this node
+        self._actor_homes: Dict[bytes, bytes] = {}
+        self.control_port = 0
+        self.transfer_port = 0
         self.lock = threading.RLock()
         self.objects: Dict[bytes, ObjectEntry] = {}
         self.tasks: Dict[bytes, TaskRecord] = {}
