@@ -71,9 +71,14 @@ def init(num_cpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          object_store_memory: Optional[int] = None,
          namespace: str = "default",
+         gcs_address: Optional[tuple] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = False) -> None:
-    """Start the single-node runtime in this process (head + driver).
+    """Start the runtime in this process (head node + driver).
+
+    With ``gcs_address=(host, port)`` the node joins an existing cluster
+    (its GCS process) as a full member: tasks spill across nodes, objects
+    transfer between stores, actors place cluster-wide.
 
     Reference analog: ray.init local-mode bring-up (worker.py:1260 →
     node.py start_head_processes) — here the node service runs as threads
@@ -105,7 +110,8 @@ def init(num_cpus: Optional[float] = None,
         store_capacity = object_store_memory or config.object_store_memory
         store_path = os.path.join("/dev/shm", f"rtpu_{os.getpid()}_"
                                   f"{int(time.time()*1000) % 100000}")
-        node = NodeService(session_dir, res, store_path, store_capacity)
+        node = NodeService(session_dir, res, store_path, store_capacity,
+                           gcs_address=gcs_address)
         node.start()
         client = CoreClient(node.socket_path, kind="driver")
         set_global_client(client)
@@ -234,9 +240,20 @@ def available_resources() -> Dict[str, float]:
     return _ensure_connected().cluster_resources()["available"]
 
 
+def nodes() -> List[dict]:
+    """Alive cluster nodes (single-node mode: a one-entry synthetic
+    list).  Reference analog: ray.nodes()."""
+    reply = _ensure_connected().cluster_resources()
+    if "nodes" in reply:
+        return reply["nodes"]
+    return [{"node_id": b"local", "host": "127.0.0.1", "state": "alive",
+             "resources_total": reply["total"],
+             "resources_avail": reply["available"]}]
+
+
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "put", "get", "wait",
     "kill", "get_actor", "list_named_actors", "cluster_resources",
-    "available_resources", "method", "ObjectRef", "ActorHandle",
+    "available_resources", "nodes", "method", "ObjectRef", "ActorHandle",
     "exceptions", "__version__",
 ]

@@ -311,7 +311,17 @@ class CoreClient:
         if head[0] == "inline":
             payload = ser.deserialize(memoryview(head[1]), copy_buffers=True)
         else:  # blob in shm
-            payload = self._materialize(head[1], "shm", None)
+            try:
+                payload = self._materialize(head[1], "shm", None)
+            except exc.ObjectLostError:
+                # Forwarded task on another node: the args blob lives on
+                # the owner node's store — resolve through the directory,
+                # which pulls it across (multi-node path).
+                reply = self._blocking_call(
+                    {"type": "get_objects", "object_ids": [head[1]],
+                     "timeout": None})
+                loc, data, _ = reply["results"][head[1]]
+                payload = self._materialize(head[1], loc, data)
         positional, ref_slots, kw_ref_items, plain_kwargs = payload
         ref_args = [t[1] for t in packed[1:] if t[0] == "ref"]
         n_pos = len(ref_slots)

@@ -133,6 +133,9 @@ _D("object_spilling_dir", str, "",
    "Directory for spilled objects (default: <session_dir>/spill).")
 _D("min_spilling_size", int, 1024 * 1024,
    "Batch spills until at least this many bytes are queued.")
+_D("object_transfer_chunk_bytes", int, 4 * 1024 * 1024,
+   "Chunk size for inter-node object transfer (reference: "
+   "object_manager_default_chunk_size, 5 MiB).")
 
 # ---------------------------------------------------------------------------
 # TPU / mesh execution layer

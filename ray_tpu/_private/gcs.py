@@ -239,12 +239,30 @@ class GlobalControlState:
 
     def remove_object(self, oid: bytes) -> List[bytes]:
         """Owner-driven delete: drop the record; returns holder node ids
-        (the server publishes object_deleted to them)."""
+        (the server publishes object_deleted to them).  Subscribers
+        still pulling hear kind='lost' so their pull loops terminate
+        instead of polling a vanished record forever."""
         with self._lock:
-            holders, _ = self._locations.pop(oid, (set(), 0))
+            holders, size = self._locations.pop(oid, (set(), 0))
             self._small_objects.pop(oid, None)
-            self._loc_subs.pop(oid, None)
-            return list(holders)
+            subs = self._loc_subs.pop(oid, [])
+        evt = {"object_id": oid, "node_id": None, "size": size,
+               "kind": "lost"}
+        for cb in subs:
+            try:
+                cb(oid, evt)
+            except Exception:
+                pass
+        return list(holders)
+
+    def remove_location(self, oid: bytes, node_id: bytes) -> None:
+        """Drop one node from an object's holder set (replica freed or
+        observed missing); the record itself stays."""
+        with self._lock:
+            entry = self._locations.get(oid)
+            if entry is None:
+                return
+            entry[0].discard(node_id)
 
     def sub_location(self, oid: bytes,
                      cb: Callable[[bytes, dict], None]) -> None:

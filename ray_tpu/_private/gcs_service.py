@@ -67,13 +67,17 @@ class GcsServer:
         self._shutdown = False
 
     def start(self) -> None:
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name="rtpu-gcs-accept").start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rtpu-gcs-accept")
+        self._accept_thread.start()
         threading.Thread(target=self._health_loop, daemon=True,
                          name="rtpu-gcs-health").start()
 
     def shutdown(self) -> None:
         self._shutdown = True
+        from ray_tpu._private.protocol import wake_and_join_acceptor
+        wake_and_join_acceptor(getattr(self, "_accept_thread", None),
+                               socket.AF_INET, (self.host, self.port))
         try:
             self._listener.close()
         except OSError:
@@ -85,6 +89,12 @@ class GcsServer:
             try:
                 sock, _ = self._listener.accept()
             except OSError:
+                return
+            if self._shutdown:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = _GcsConn(sock)
@@ -199,6 +209,9 @@ class GcsServer:
             if c.node_id in holders and c.node_id != conn.node_id:
                 c.send({"type": "object_deleted",
                         "object_id": m["object_id"]})
+
+    def _h_remove_location(self, conn, m):
+        self.state.remove_location(m["object_id"], m["node_id"])
 
     def _h_sub_location(self, conn, m):
         oid = m["object_id"]
@@ -337,6 +350,10 @@ class GcsClient:
 
     def remove_object(self, oid):
         self.conn.notify({"type": "remove_object", "object_id": oid})
+
+    def remove_location(self, oid, node_id):
+        self.conn.notify({"type": "remove_location", "object_id": oid,
+                          "node_id": node_id})
 
     def sub_location(self, oid, cb):
         with self._lock:

@@ -18,6 +18,7 @@ fused with the single-node portion of the GCS.  Differences by design:
 from __future__ import annotations
 
 import os
+import queue
 import socket
 import subprocess
 import sys
@@ -40,7 +41,7 @@ FAILED = "error"
 
 class ObjectEntry:
     __slots__ = ("state", "loc", "data", "size", "refcount", "waiters",
-                 "producing_task", "deleted", "embedded")
+                 "producing_task", "deleted", "embedded", "foreign")
 
     def __init__(self) -> None:
         self.state = PENDING
@@ -52,6 +53,10 @@ class ObjectEntry:
         self.producing_task: Optional[bytes] = None  # lineage hook
         self.deleted = False
         self.embedded: List[bytes] = []  # refs held by this object's payload
+        # foreign: a copy whose owner directory lives on another node
+        # (pulled replica / forwarded-task return).  Deleting a foreign
+        # copy never removes the global GCS record.
+        self.foreign = False
 
 
 class TaskRecord:
@@ -162,19 +167,37 @@ class NodeService:
         self.node_id = node_id or os.urandom(16)
         self.gcs_address = gcs_address
         self.multinode = gcs_address is not None
+        # GCS pushes + node events are handled on a dedicated thread: the
+        # GcsClient receiver thread must never block on self.lock, or a
+        # blocking gcs.call() made while holding the lock would deadlock
+        # (the reply is parked behind the stuck push).
+        self._gcs_events: "queue.Queue" = queue.Queue()
         if self.multinode:
             from ray_tpu._private.gcs_service import GcsClient
-            self.gcs = GcsClient(gcs_address[0], gcs_address[1])
+            self.gcs = GcsClient(gcs_address[0], gcs_address[1],
+                                 push_handler=lambda m:
+                                 self._gcs_events.put(("push", m)))
         else:
             self.gcs = gcs or GlobalControlState()
         # node_id -> Connection to that node's control listener
         self._peer_conns: Dict[bytes, Any] = {}
+        self._peer_lock = threading.Lock()
         # task_id -> (TaskRecord, target node_id) for spilled-over tasks
         self.forwarded: Dict[bytes, Tuple[TaskRecord, bytes]] = {}
+        # per-peer FIFO forward queues: one sender thread per target so
+        # two calls to the same remote actor can never reorder in flight
+        self._fwd_queues: Dict[bytes, "queue.Queue"] = {}
         # cluster resource view (from GCS), refreshed with each heartbeat
         self._cluster_view: List[dict] = []
-        # actor_id -> node_id hint for actors created via this node
+        # actor_id -> node_id hint for actors living on other nodes
         self._actor_homes: Dict[bytes, bytes] = {}
+        # actor_id -> death reason, for remote actors whose node died
+        self._remote_actor_tombstones: Dict[bytes, str] = {}
+        # object ids with an in-flight pull thread
+        self._pulls_inflight: set = set()
+        # pulls whose local entry was deleted mid-flight: the loop must
+        # exit instead of polling a vanished GCS record forever
+        self._cancelled_pulls: set = set()
         self.control_port = 0
         self.transfer_port = 0
         self.lock = threading.RLock()
@@ -212,10 +235,13 @@ class NodeService:
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
         self._listener.listen(128)
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name="rtpu-node-accept").start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rtpu-node-accept")
+        self._accept_thread.start()
         threading.Thread(target=self._monitor_loop, daemon=True,
                          name="rtpu-node-monitor").start()
+        if self.multinode:
+            self._start_multinode()
         for _ in range(config.worker_pool_prestart):
             self._spawn_worker(tpu=False)
 
@@ -223,6 +249,13 @@ class NodeService:
         with self.lock:
             self._shutdown = True
             workers = list(self.workers.values())
+        # Wake the accept loop(s) with a dummy connection and JOIN them
+        # BEFORE closing the listener fds.  A thread left blocked in
+        # accept() survives close(); when the fd number is reused by the
+        # next session's listener, an EINTR retry (SIGCHLD from dying
+        # workers) can make the stale thread steal and instantly drop the
+        # new session's first connection (BrokenPipe on register_client).
+        self._wake_and_join_acceptors()
         for w in workers:
             if w.conn_send:
                 try:
@@ -239,6 +272,23 @@ class NodeService:
                 w.proc.kill()
         if self._listener:
             self._listener.close()
+        if self.multinode:
+            try:
+                self._peer_listener.close()
+            except Exception:
+                pass
+            with self._peer_lock:
+                conns = list(self._peer_conns.values())
+                self._peer_conns.clear()
+            for c in conns:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            try:
+                self.gcs.close()
+            except Exception:
+                pass
         try:
             os.unlink(self.socket_path)
         except OSError:
@@ -251,11 +301,26 @@ class NodeService:
     # ------------------------------------------------------------------
     # connection handling
     # ------------------------------------------------------------------
+    def _wake_and_join_acceptors(self) -> None:
+        from ray_tpu._private.protocol import wake_and_join_acceptor
+        wake_and_join_acceptor(getattr(self, "_accept_thread", None),
+                               socket.AF_UNIX, self.socket_path)
+        if self.multinode:
+            wake_and_join_acceptor(
+                getattr(self, "_peer_accept_thread", None),
+                socket.AF_INET, (self.host, self.control_port))
+
     def _accept_loop(self) -> None:
         while not self._shutdown:
             try:
                 sock, _ = self._listener.accept()
             except OSError:
+                return
+            if self._shutdown:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 return
             ctx = _ConnCtx(sock)
             with self.lock:
@@ -296,6 +361,582 @@ class NodeService:
             self._schedule()
 
     # ------------------------------------------------------------------
+    # multi-node plane (reference: object_manager.h:117 transfer,
+    # cluster_task_manager.h:42 spillback, ray_syncer.h:88 resource sync)
+    # ------------------------------------------------------------------
+    def _start_multinode(self) -> None:
+        """Open the peer TCP listener, register with the GCS, start the
+        heartbeat + event threads."""
+        self._peer_listener = socket.socket(socket.AF_INET,
+                                            socket.SOCK_STREAM)
+        self._peer_listener.setsockopt(socket.SOL_SOCKET,
+                                       socket.SO_REUSEADDR, 1)
+        host = os.environ.get("RAY_TPU_NODE_HOST", "127.0.0.1")
+        self._peer_listener.bind((host, 0))
+        self._peer_listener.listen(64)
+        self.host = host
+        self.control_port = self._peer_listener.getsockname()[1]
+        self.transfer_port = self.control_port  # one listener, both roles
+        self._peer_accept_thread = threading.Thread(
+            target=self._peer_accept_loop, daemon=True,
+            name="rtpu-peer-accept")
+        self._peer_accept_thread.start()
+        threading.Thread(target=self._gcs_event_loop, daemon=True,
+                         name="rtpu-gcs-events").start()
+        self.gcs.register_node(self.node_id, host, self.control_port,
+                               self.transfer_port, self.resources_total)
+        self.gcs.sub_nodes(lambda ev, info:
+                           self._gcs_events.put(("node", ev, info)))
+        self._cluster_view = self.gcs.nodes()
+        threading.Thread(target=self._heartbeat_loop, daemon=True,
+                         name="rtpu-heartbeat").start()
+
+    def _peer_accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                sock, _ = self._peer_listener.accept()
+            except OSError:
+                return
+            if self._shutdown:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            ctx = _ConnCtx(sock)
+            ctx.kind = "peer"
+            with self.lock:
+                self._conns.append(ctx)
+            threading.Thread(target=self._conn_loop, args=(ctx,),
+                             daemon=True, name="rtpu-peer-conn").start()
+
+    def _heartbeat_loop(self) -> None:
+        interval = config.heartbeat_interval_s
+        while not self._shutdown:
+            try:
+                with self.lock:
+                    avail = dict(self.resources_avail)
+                self.gcs.heartbeat(self.node_id, avail)
+                self._cluster_view = self.gcs.nodes()
+                with self.lock:
+                    self._schedule()   # peer capacity may have freed up
+            except Exception:
+                pass
+            time.sleep(interval * 0.5)
+
+    def _gcs_event_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                item = self._gcs_events.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                if item[0] == "node":
+                    self._on_node_event(item[1], item[2])
+                elif item[0] == "push":
+                    self._on_gcs_push(item[1])
+            except Exception:
+                pass
+
+    def _on_gcs_push(self, msg: dict) -> None:
+        if msg.get("type") == "object_deleted":
+            # Owner-driven delete of an object we hold a foreign copy of.
+            oid = msg["object_id"]
+            with self.lock:
+                e = self.objects.get(oid)
+                if e is None or not e.foreign:
+                    return
+                was_shm = e.loc == "shm"
+                if e.waiters:
+                    # Someone on this node is blocked in get(): turn the
+                    # entry into a lost-tombstone and wake them, instead
+                    # of hanging them forever on a popped entry.
+                    blob = ser.dumps(exc.ObjectLostError(
+                        oid.hex(), "deleted by owner while being read"))
+                    e.state = FAILED
+                    e.loc, e.data, e.size = "error", blob, len(blob)
+                    waiters, e.waiters = e.waiters, []
+                    for wake in waiters:
+                        wake()
+                else:
+                    self.objects.pop(oid, None)
+                    e.deleted = True
+            if was_shm:
+                try:
+                    store = self._store()
+                    store.release(_OID(oid))
+                    store.delete(_OID(oid))
+                except Exception:
+                    pass
+
+    def _on_node_event(self, event: str, info: dict) -> None:
+        nid = info["node_id"]
+        if event == "node_added":
+            if nid != self.node_id:
+                try:
+                    self._cluster_view = self.gcs.nodes()
+                except Exception:
+                    pass
+                with self.lock:
+                    self._schedule()
+            return
+        if event != "node_dead" or nid == self.node_id:
+            return
+        with self._peer_lock:
+            conn = self._peer_conns.pop(nid, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self._cluster_view = [n for n in self._cluster_view
+                              if n["node_id"] != nid]
+        # Tombstone every actor the GCS knew lived there, plus our hints.
+        dead_reason = f"node {nid.hex()[:8]} died: " \
+                      f"{info.get('reason') or 'lost heartbeats'}"
+        retry, fail, pull_check = [], [], []
+        with self.lock:
+            for aid in info.get("dead_actors", ()):
+                self._remote_actor_tombstones[aid] = dead_reason
+            for aid, home in list(self._actor_homes.items()):
+                if home == nid:
+                    self._remote_actor_tombstones[aid] = dead_reason
+                    del self._actor_homes[aid]
+            for tid, (rec, target) in list(self.forwarded.items()):
+                if target != nid:
+                    continue
+                del self.forwarded[tid]
+                pull_check.append(rec)
+        # A forwarded task may have completed before the node died — its
+        # returns are then in the GCS (inline) or on surviving replicas.
+        # Only tasks with no published results are retried/failed.
+        for rec in pull_check:
+            done = True
+            for oid in rec.spec["return_ids"]:
+                try:
+                    locs = self.gcs.get_locations(oid)
+                except Exception:
+                    locs = {}
+                if locs.get("kind") is None:
+                    done = False
+                    break
+            if done:
+                with self.lock:
+                    for oid in rec.spec["return_ids"]:
+                        self._ensure_pull(oid)
+                continue
+            (retry if rec.retries_left > 0
+             and not rec.is_actor_creation else fail).append(rec)
+        with self.lock:
+            for rec in retry:
+                rec.retries_left -= 1
+                rec.state = "pending"
+                rec.worker = None
+                rec.spec.pop("spilled", None)
+                self.tasks[rec.task_id] = rec
+                self.pending_queue.append(rec)
+            for rec in fail:
+                if rec.actor_id is not None and not rec.is_actor_creation:
+                    err: Exception = exc.ActorDiedError(
+                        rec.actor_id.hex(), dead_reason)
+                else:
+                    err = exc.WorkerCrashedError(
+                        f"{dead_reason} while running "
+                        f"{rec.spec.get('name')}")
+                self._fail_task_returns(rec, err)
+                if rec.is_actor_creation:
+                    # _fail_task_returns keeps creation holds for restart
+                    # replay — but this actor's node is gone for good.
+                    for dep in rec.spec.get("embedded") or []:
+                        self._decref(dep)
+            self._schedule()
+
+    # -- peer connections --------------------------------------------------
+    def _peer_conn_to(self, ninfo: dict):
+        """Get (or open) the persistent Connection to a peer node."""
+        from ray_tpu._private.protocol import Connection, connect_tcp
+        nid = ninfo["node_id"]
+        with self._peer_lock:
+            conn = self._peer_conns.get(nid)
+            if conn is not None and not conn._closed:
+                return conn
+        sock = connect_tcp(ninfo["host"], ninfo["control_port"],
+                           deadline_s=5.0)
+        conn = Connection(sock)
+        with self._peer_lock:
+            existing = self._peer_conns.get(nid)
+            if existing is not None and not existing._closed:
+                conn.close()
+                return existing
+            self._peer_conns[nid] = conn
+        return conn
+
+    def _node_info(self, nid: bytes) -> Optional[dict]:
+        for n in self._cluster_view:
+            if n["node_id"] == nid:
+                return n
+        try:
+            self._cluster_view = self.gcs.nodes()
+        except Exception:
+            return None
+        for n in self._cluster_view:
+            if n["node_id"] == nid:
+                return n
+        return None
+
+    # -- object pull manager (reference: pull_manager.h:52) ----------------
+    def _ensure_pull(self, oid: bytes) -> None:
+        """Start pulling an object that lives (or will live) on another
+        node.  Caller holds self.lock."""
+        if not self.multinode:
+            return
+        e = self.objects.get(oid)
+        if e is not None and e.state in (READY, FAILED):
+            return
+        if (e is not None and e.producing_task is not None
+                and e.producing_task in self.tasks):
+            return   # being produced locally; no pull needed
+        if oid in self._pulls_inflight:
+            return
+        self._pulls_inflight.add(oid)
+        threading.Thread(target=self._pull_object, args=(oid,),
+                         daemon=True, name="rtpu-pull").start()
+
+    def _pull_object(self, oid: bytes) -> None:
+        evt = threading.Event()
+        last_event: Dict[str, dict] = {}
+
+        def on_loc(o, e):
+            last_event["evt"] = e
+            evt.set()
+
+        subscribed = False
+        try:
+            try:
+                self.gcs.sub_location(oid, on_loc)
+                subscribed = True
+            except Exception:
+                pass
+            while not self._shutdown:
+                with self.lock:
+                    if oid in self._cancelled_pulls:
+                        return   # local entry deleted mid-pull
+                    ent = self.objects.get(oid)
+                    if ent is not None and ent.state in (READY, FAILED):
+                        return
+                try:
+                    locs = self.gcs.get_locations(oid)
+                except Exception:
+                    time.sleep(0.2)
+                    continue
+                kind = locs.get("kind")
+                if kind in ("inline", "error"):
+                    data = locs["data"]
+                    with self.lock:
+                        self._register_object(
+                            oid, "inline" if kind == "inline" else "error",
+                            data, len(data),
+                            state=READY if kind == "inline" else FAILED,
+                            foreign=True)
+                        self._schedule()
+                    return
+                done = False
+                for n in locs.get("nodes", ()):
+                    if n["node_id"] == self.node_id:
+                        continue
+                    if self._fetch_from(oid, n, locs.get("size", 0)):
+                        done = True
+                        break
+                if done:
+                    return
+                evt.clear()
+                evt.wait(timeout=0.5)
+                le = last_event.get("evt")
+                if le is not None and le.get("kind") == "lost":
+                    blob = ser.dumps(exc.ObjectLostError(
+                        oid.hex(), "all copies lost (node died)"))
+                    with self.lock:
+                        self._register_object(oid, "error", blob,
+                                              len(blob), state=FAILED,
+                                              foreign=True)
+                        self._schedule()
+                    return
+        finally:
+            if subscribed:
+                try:
+                    self.gcs.unsub_location(oid, on_loc)
+                except Exception:
+                    pass
+            with self.lock:
+                self._pulls_inflight.discard(oid)
+                self._cancelled_pulls.discard(oid)
+
+    def _fetch_from(self, oid: bytes, ninfo: dict, size: int) -> bool:
+        """Chunked fetch of one object from a holder node into the local
+        store.  Returns True once the object is registered locally."""
+        from ray_tpu._private.ids import ObjectID
+        try:
+            conn = self._peer_conn_to(ninfo)
+            meta = conn.call({"type": "fetch_object_meta",
+                              "object_id": oid}, timeout=30.0)
+        except Exception:
+            return False
+        if not meta.get("found"):
+            # Stale holder (replica evicted/freed): prune it so later
+            # pulls of this object skip the dead end.
+            try:
+                self.gcs.remove_location(oid, ninfo["node_id"])
+            except Exception:
+                pass
+            return False
+        kind = meta["kind"]
+        if kind in ("inline", "error"):
+            data = meta["data"]
+            with self.lock:
+                self._register_object(
+                    oid, "inline" if kind == "inline" else "error",
+                    data, len(data),
+                    state=READY if kind == "inline" else FAILED,
+                    foreign=True)
+                self._schedule()
+            return True
+        total = meta["size"]
+        store = self._store()
+        obj = ObjectID(oid)
+        try:
+            buf = store.create(obj, total)
+        except FileExistsError:
+            return True     # a concurrent pull beat us to it
+        except Exception:
+            return False    # store full — retry after eviction
+        try:
+            if meta.get("data") is not None:
+                buf[:total] = meta["data"]
+            else:
+                chunk = config.object_transfer_chunk_bytes
+                off = 0
+                while off < total:
+                    r = conn.call({"type": "fetch_object_chunk",
+                                   "object_id": oid, "offset": off,
+                                   "length": min(chunk, total - off)},
+                                  timeout=60.0)
+                    d = r.get("data")
+                    if not d:
+                        store.abort(obj)
+                        return False
+                    buf[off:off + len(d)] = d
+                    off += len(d)
+            store.seal(obj)
+        except Exception:
+            try:
+                store.abort(obj)
+            except Exception:
+                pass
+            return False
+        with self.lock:
+            self._register_object(oid, "shm", None, total,
+                                  creator_pid=os.getpid(), foreign=True)
+            self._schedule()
+        return True
+
+    # -- peer handlers (ride the same _dispatch as local clients) ----------
+    def _h_fetch_object_meta(self, ctx: _ConnCtx, m: dict) -> None:
+        oid = m["object_id"]
+        with self.lock:
+            e = self.objects.get(oid)
+            if e is None or e.state == PENDING:
+                ctx.reply(m, {"found": False})
+                return
+            if e.state == FAILED:
+                ctx.reply(m, {"found": True, "kind": "error",
+                              "data": e.data, "size": e.size})
+                return
+            if e.loc == "inline":
+                ctx.reply(m, {"found": True, "kind": "inline",
+                              "data": e.data, "size": e.size})
+                return
+        mv = self._store().get(_OID(oid))
+        if mv is None:
+            ctx.reply(m, {"found": False})
+            return
+        try:
+            out = {"found": True, "kind": "shm", "size": len(mv)}
+            if len(mv) <= config.object_transfer_chunk_bytes:
+                out["data"] = bytes(mv)
+            ctx.reply(m, out)
+        finally:
+            self._store().release(_OID(oid))
+
+    def _h_fetch_object_chunk(self, ctx: _ConnCtx, m: dict) -> None:
+        mv = self._store().get(_OID(m["object_id"]))
+        if mv is None:
+            ctx.reply(m, {"data": None})
+            return
+        try:
+            off = m["offset"]
+            ctx.reply(m, {"data": bytes(mv[off:off + m["length"]])})
+        finally:
+            self._store().release(_OID(m["object_id"]))
+
+    def _complete_forwarded(self, task_id: bytes) -> None:
+        """Release the owner-side embedded arg holds of a forwarded task
+        exactly once, when its completion is observed (forward_done push
+        or first pulled return).  Caller holds self.lock.
+
+        Applies to forwarded actor creations too: the executing node owns
+        restart replay using its own pulled replicas (pinned there until
+        permanent actor death), so the owner's holds can go as soon as
+        the first creation run completed."""
+        pair = self.forwarded.pop(task_id, None)
+        if pair is None:
+            return
+        rec, _ = pair
+        for dep in rec.spec.get("embedded") or []:
+            self._decref(dep)
+
+    def _h_forward_done(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            self._complete_forwarded(m["task_id"])
+
+    def _h_forward_task(self, ctx: _ConnCtx, m: dict) -> None:
+        """A peer spilled a task (or actor call) over to this node."""
+        spec = m["spec"]
+        spec["owner_node"] = m.get("owner_node")
+        with self.lock:
+            rec = TaskRecord(spec)
+            self.tasks[rec.task_id] = rec
+            for oid in spec["return_ids"]:
+                entry = self.objects.get(oid)
+                if entry is None:
+                    entry = ObjectEntry()
+                    self.objects[oid] = entry
+                entry.producing_task = rec.task_id
+                entry.foreign = True      # owner directory is the sender
+            rec.deps = {d for d in rec.deps if not self._object_ready(d)}
+            for d in rec.deps:
+                self._ensure_pull(d)
+            if rec.actor_id is not None and not rec.is_actor_creation:
+                self._enqueue_actor_task(rec)
+            else:
+                self.pending_queue.append(rec)
+            self._schedule()
+
+    def _h_actor_spec(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            a = self.actors.get(m["actor_id"])
+            spec = ({k: v for k, v in a.spec.items()
+                     if k != "creation_task"} if a else None)
+        ctx.reply(m, {"spec": spec})
+
+    # -- spillback scheduling (reference: cluster_task_manager spillback) --
+    def _local_totals_satisfy(self, res: Dict[str, float]) -> bool:
+        return all(v <= self.resources_total.get(k, 0.0) + 1e-9
+                   for k, v in (res or {}).items())
+
+    def _pick_spill_target(self, res: Dict[str, float],
+                           need_avail: bool) -> Optional[dict]:
+        for n in self._cluster_view:
+            if n["node_id"] == self.node_id or n.get("state") != "alive":
+                continue
+            pool = n["resources_avail"] if need_avail \
+                else n["resources_total"]
+            if all(pool.get(k, 0.0) >= v - 1e-9
+                   for k, v in (res or {}).items()):
+                return n
+        return None
+
+    def _try_spill(self, rec: TaskRecord, res: Dict[str, float]) -> bool:
+        """Decide whether to forward a pending task to a peer.  Caller
+        holds self.lock."""
+        if rec.is_actor_creation or rec.actor_id is not None:
+            return False    # actor placement is decided at create time
+        feasible_local = self._local_totals_satisfy(res)
+        if rec.spec.get("spilled") and feasible_local:
+            return False    # already hopped once; wait for local capacity
+        target = self._pick_spill_target(res, need_avail=True)
+        if target is None and not feasible_local:
+            target = self._pick_spill_target(res, need_avail=False)
+        if target is None:
+            return False
+        self._forward_task(rec, target)
+        return True
+
+    def _forward_task(self, rec: TaskRecord, ninfo: dict) -> None:
+        """Hand a pending task to a peer node.  Caller holds self.lock.
+        Sends ride a per-target FIFO queue + sender thread: connecting
+        off the scheduler lock without reordering same-target sends
+        (sync-actor calls rely on submission order)."""
+        try:
+            self.pending_queue.remove(rec)
+        except ValueError:
+            pass
+        self.tasks.pop(rec.task_id, None)
+        rec.state = "forwarded"
+        nid = ninfo["node_id"]
+        self.forwarded[rec.task_id] = (rec, nid)
+        spec = dict(rec.spec)
+        spec["spilled"] = True
+        # Waiters registered before the spill (get()/wait() blocked while
+        # the task was queued locally) and local tasks depending on the
+        # returns would hang without a pull: their earlier _ensure_pull
+        # short-circuited on "being produced locally".  Re-arm now.
+        for oid in rec.spec["return_ids"]:
+            e = self.objects.get(oid)
+            if e is not None and (e.waiters
+                                  or self._has_local_dependent(oid)):
+                self._ensure_pull(oid)
+        q = self._fwd_queues.get(nid)
+        if q is None:
+            q = queue.Queue()
+            self._fwd_queues[nid] = q
+            threading.Thread(target=self._fwd_sender_loop,
+                             args=(nid, ninfo, q), daemon=True,
+                             name="rtpu-forward").start()
+        q.put((rec, spec))
+
+    def _has_local_dependent(self, oid: bytes) -> bool:
+        """True if any queued local task waits on oid.  Caller holds
+        self.lock."""
+        for r in self.pending_queue:
+            if oid in r.deps:
+                return True
+        for actor in self.actors.values():
+            for r in actor.queue:
+                if oid in r.deps:
+                    return True
+        return False
+
+    def _fwd_sender_loop(self, nid: bytes, ninfo: dict,
+                         q: "queue.Queue") -> None:
+        while not self._shutdown:
+            try:
+                rec, spec = q.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            try:
+                conn = self._peer_conn_to(ninfo)
+                conn.notify({"type": "forward_task", "spec": spec,
+                             "owner_node": self.node_id})
+            except Exception:
+                self._forward_send_failed(rec)
+
+    def _forward_send_failed(self, rec: TaskRecord) -> None:
+        with self.lock:
+            if self.forwarded.pop(rec.task_id, None) is None:
+                return  # node-death handler already resolved it
+            if rec.actor_id is not None and not rec.is_actor_creation:
+                # An actor call must not fall back to the plain-task
+                # queue (no actor instance there): fail it cleanly.
+                self._fail_task_returns(rec, exc.ActorDiedError(
+                    rec.actor_id.hex(), "actor's node is unreachable"))
+            else:
+                rec.state = "pending"
+                self.tasks[rec.task_id] = rec
+                self.pending_queue.append(rec)
+                self._schedule()
+
+    # ------------------------------------------------------------------
     # message handlers (all named _h_<type>)
     # ------------------------------------------------------------------
     def _h_register_client(self, ctx: _ConnCtx, m: dict) -> None:
@@ -320,16 +961,70 @@ class NodeService:
 
     def _infeasible_reason(self, res: Dict[str, float]) -> Optional[str]:
         """A request no node total can ever satisfy hangs forever unless
-        rejected up front (reference: raylet infeasible-task errors)."""
-        for k, v in (res or {}).items():
-            if v > self.resources_total.get(k, 0.0) + 1e-9:
-                return (f"resource request {{{k}: {v}}} exceeds cluster "
-                        f"total {{{k}: {self.resources_total.get(k, 0.0)}}}")
-        return None
+        rejected up front (reference: raylet infeasible-task errors).
+        Multi-node: feasible if ANY alive node's totals cover it."""
+        if not res:
+            return None
+        if self._local_totals_satisfy(res):
+            return None
+        if self.multinode:
+            for n in self._cluster_view:
+                if n.get("state") != "alive":
+                    continue
+                if all(v <= n["resources_total"].get(k, 0.0) + 1e-9
+                       for k, v in res.items()):
+                    return None
+        return (f"resource request {res} exceeds every node's total "
+                f"(local total: {self.resources_total})")
 
     def _h_submit_task(self, ctx: _ConnCtx, m: dict) -> None:
         spec = m["spec"]
+        aid = spec.get("actor_id")
+        home: Optional[bytes] = None
+        if (aid is not None and not spec.get("is_actor_creation")
+                and self.multinode):
+            with self.lock:
+                local = aid in self.actors
+                home = self._actor_homes.get(aid)
+            if not local and home is None:
+                # Actor created elsewhere (e.g. found via get_actor):
+                # resolve its home through the GCS actor directory.
+                # No self.lock held — gcs.call would deadlock under it.
+                try:
+                    home = self.gcs.get_actor_node(aid)
+                except Exception:
+                    home = None
+                if home is not None:
+                    self._actor_homes[aid] = home
         with self.lock:
+            if (aid is not None and aid not in self.actors
+                    and self.multinode):
+                tomb = self._remote_actor_tombstones.get(aid)
+                if tomb is not None:
+                    rec = TaskRecord(spec)
+                    self.tasks[rec.task_id] = rec
+                    for oid in spec["return_ids"]:
+                        self.objects.setdefault(oid, ObjectEntry())
+                    self._fail_task_returns(rec, exc.ActorDiedError(
+                        aid.hex(), tomb))
+                    ctx.reply(m, {"ok": True})
+                    return
+                if home is not None and home != self.node_id:
+                    rec = TaskRecord(spec)
+                    # Remote actor call: forward to its home node; results
+                    # come back through the GCS location directory.
+                    self.tasks[rec.task_id] = rec
+                    for oid in spec["return_ids"]:
+                        e = self.objects.setdefault(oid, ObjectEntry())
+                        e.producing_task = rec.task_id
+                    ninfo = self._node_info(home)
+                    if ninfo is None:
+                        self._fail_task_returns(rec, exc.ActorDiedError(
+                            aid.hex(), "actor's node is gone"))
+                    else:
+                        self._forward_task(rec, ninfo)
+                    ctx.reply(m, {"ok": True})
+                    return
             rec = TaskRecord(spec)
             reason = self._infeasible_reason(spec.get("resources"))
             if reason is not None and spec.get("actor_id") is None:
@@ -359,6 +1054,12 @@ class NodeService:
             # Drop deps that are already ready.
             rec.deps = {d for d in rec.deps
                         if not self._object_ready(d)}
+            if self.multinode:
+                # Deps produced on other nodes (earlier spills, remote
+                # actors) must be pulled or this task waits forever;
+                # _ensure_pull no-ops for locally-producing deps.
+                for d in rec.deps:
+                    self._ensure_pull(d)
             if rec.actor_id is not None and not rec.is_actor_creation:
                 self._enqueue_actor_task(rec)
             else:
@@ -383,7 +1084,8 @@ class NodeService:
                          data: Optional[bytes], size: int,
                          state: str = READY,
                          embedded: Optional[List[bytes]] = None,
-                         creator_pid: int = 0) -> None:
+                         creator_pid: int = 0,
+                         foreign: bool = False) -> None:
         if loc == "shm" and creator_pid and creator_pid != os.getpid():
             # Adopt the creator's pin into the directory's ledger so
             # reaping the (possibly dead) creator leaves it pinned.
@@ -407,6 +1109,12 @@ class NodeService:
         entry = self.objects.get(oid)
         if entry is None:
             entry = ObjectEntry()
+            # Ownership is decided at entry birth and never flips: a
+            # pre-existing entry (created at submit/put on the owner)
+            # stays owned even when its value arrives via a pull —
+            # otherwise owner-driven global delete would be skipped and
+            # forwarded-task results would leak cluster-wide.
+            entry.foreign = foreign
             self.objects[oid] = entry
         entry.state = state
         entry.loc = loc
@@ -414,6 +1122,36 @@ class NodeService:
         entry.size = size
         if embedded:
             entry.embedded = list(embedded)
+        if self.multinode:
+            # A forwarded task's first published return means the remote
+            # run completed — stop tracking it for node-death retry.
+            if entry.producing_task is not None:
+                self._complete_forwarded(entry.producing_task)
+            # Publish to the GCS location directory (inline/error payloads
+            # ride in the record itself; shm copies announce this node).
+            # Pulled inline copies are already in the GCS — skip re-pub.
+            if not (foreign and loc != "shm"):
+                try:
+                    kind = ("error" if state == FAILED
+                            else ("inline" if loc == "inline" else "shm"))
+                    if kind == "inline" and not entry.foreign:
+                        # Local-owned small value: record the location
+                        # only — remote readers fetch the payload from
+                        # this node via fetch_object_meta.  Shipping
+                        # every local put's bytes to the GCS would
+                        # mirror the whole store there.
+                        self.gcs.add_location(oid, self.node_id, size,
+                                              kind="shm", data=None)
+                    else:
+                        # Cross-node results (foreign entries) and error
+                        # blobs carry their payload in the GCS record so
+                        # they survive the producing node's death.
+                        self.gcs.add_location(
+                            oid, self.node_id if kind == "shm" else None,
+                            size, kind=kind,
+                            data=data if kind != "shm" else None)
+                except Exception:
+                    pass
         waiters, entry.waiters = entry.waiters, []
         for wake in waiters:
             wake()
@@ -465,9 +1203,11 @@ class NodeService:
                     entry = ObjectEntry()
                     # get for an unknown object: wait for someone to put it
                     entry.refcount = 0
+                    entry.foreign = True
                     self.objects[o] = entry
                 entry.waiters.append(try_reply)
                 registered.append(entry)
+                self._ensure_pull(o)
             if timeout == 0:
                 try_reply(timed_out=True)
                 return
@@ -505,9 +1245,11 @@ class NodeService:
                     if entry is None:
                         entry = ObjectEntry()
                         entry.refcount = 0
+                        entry.foreign = True
                         self.objects[o] = entry
                     entry.waiters.append(try_reply)
                     registered.append(entry)
+                    self._ensure_pull(o)
             if timeout == 0:
                 try_reply(timed_out=True)
                 return
@@ -517,8 +1259,13 @@ class NodeService:
         try_reply()
 
     def _h_task_done(self, ctx: _ConnCtx, m: dict) -> None:
+        notify_owner: Optional[bytes] = None
         with self.lock:
             rec = self.tasks.pop(m["task_id"], None)
+            if (rec is not None and self.multinode
+                    and rec.spec.get("owner_node") not in (None,
+                                                           self.node_id)):
+                notify_owner = rec.spec["owner_node"]
             w = ctx.worker
             for oid, loc, data, size, embedded in m["returns"]:
                 entry = self.objects.get(oid)
@@ -533,8 +1280,14 @@ class NodeService:
                 # Release the holds the submitter took on arg/embedded
                 # refs — EXCEPT for actor creation tasks, whose spec may
                 # be replayed on restart (holds released at permanent
-                # actor death instead).
-                if not rec.is_actor_creation:
+                # actor death instead), and EXCEPT for forwarded tasks:
+                # the matching increfs live on the OWNER node's entries
+                # (released there via forward_done); decref'ing local
+                # pulled replicas here would be unbalanced and could
+                # free the only copy of an intermediate result.
+                foreign_task = rec.spec.get("owner_node") not in (
+                    None, self.node_id)
+                if not rec.is_actor_creation and not foreign_task:
                     for dep in rec.spec.get("embedded") or []:
                         self._decref(dep)
                 if rec.is_actor_creation and rec.actor_id:
@@ -547,6 +1300,21 @@ class NodeService:
             elif w is not None and w.actor_id is not None:
                 w.current_task = None
             self._schedule()
+        if notify_owner is not None:
+            threading.Thread(target=self._notify_forward_done,
+                             args=(notify_owner, m["task_id"]),
+                             daemon=True, name="rtpu-fwd-done").start()
+
+    def _notify_forward_done(self, owner_node: bytes,
+                             task_id: bytes) -> None:
+        ninfo = self._node_info(owner_node)
+        if ninfo is None:
+            return
+        try:
+            self._peer_conn_to(ninfo).notify(
+                {"type": "forward_done", "task_id": task_id})
+        except Exception:
+            pass
 
     def _h_worker_blocked(self, ctx: _ConnCtx, m: dict) -> None:
         # A worker blocked in get(): return its CPU to the pool so nested
@@ -582,6 +1350,22 @@ class NodeService:
         e.deleted = True
         e.data = None
         self.objects.pop(oid, None)
+        if oid in self._pulls_inflight:
+            self._cancelled_pulls.add(oid)
+        if self.multinode and e.foreign and e.loc == "shm":
+            # Freed a pulled replica: prune this node from the holder set
+            # so peers stop trying to fetch from us (notify — lock-safe).
+            try:
+                self.gcs.remove_location(oid, self.node_id)
+            except Exception:
+                pass
+        if self.multinode and not e.foreign:
+            # Owner-driven global delete: the GCS drops the record and
+            # pushes object_deleted to every holder (notify — lock-safe).
+            try:
+                self.gcs.remove_object(oid)
+            except Exception:
+                pass
         if e.loc == "shm":
             # Release the creator pin the directory owns, then delete
             # (deferred store-side while readers still hold pins).
@@ -638,6 +1422,52 @@ class NodeService:
     def _h_create_actor(self, ctx: _ConnCtx, m: dict) -> None:
         spec = m["spec"]
         actor_id = spec["actor_id"]
+        if self.multinode:
+            # Placement: keep the actor local when this node's totals can
+            # ever run it; otherwise forward the whole creation to a peer
+            # that can (reference: GCS actor scheduling picks a node).
+            res = spec.get("resources") or {}
+            with self.lock:
+                local_ok = self._local_totals_satisfy(res)
+            if not local_ok:
+                target = (self._pick_spill_target(res, need_avail=True)
+                          or self._pick_spill_target(res, need_avail=False))
+                if target is not None:
+                    self._actor_homes[actor_id] = target["node_id"]
+                    # Track the creation like any forwarded task so this
+                    # node's embedded arg holds are released when the
+                    # remote creation completes (forward_done) or its
+                    # node dies — otherwise the constructor args leak
+                    # here forever.
+                    spec = dict(spec)
+                    spec["creation_task"] = dict(spec["creation_task"])
+                    spec["creation_task"]["owner_node"] = self.node_id
+                    crec = TaskRecord(spec["creation_task"])
+                    with self.lock:
+                        self.forwarded[crec.task_id] = (crec,
+                                                        target["node_id"])
+                    try:
+                        conn = self._peer_conn_to(target)
+                        conn.call({"type": "create_actor", "spec": spec},
+                                  timeout=30.0)
+                        ctx.reply(m, {"ok": True})
+                    except Exception as e:
+                        self._actor_homes.pop(actor_id, None)
+                        with self.lock:
+                            self.forwarded.pop(crec.task_id, None)
+                        ctx.reply(m, {"__error__": e})
+                    return
+        # Name reservation happens OUTSIDE the state lock: in multinode
+        # mode this is a blocking RPC to the GCS process, and blocking
+        # gcs.call() under self.lock can deadlock against GCS pushes.
+        if spec.get("name") and \
+                self._infeasible_reason(spec.get("resources")) is None:
+            ok = self.gcs.register_named_actor(
+                spec.get("namespace", "default"), spec["name"], actor_id)
+            if not ok:
+                ctx.reply(m, {"__error__": ValueError(
+                    f"actor name {spec['name']!r} already taken")})
+                return
         with self.lock:
             reason = self._infeasible_reason(spec.get("resources"))
             if reason is not None:
@@ -655,15 +1485,12 @@ class NodeService:
                 # _fail_task_returns skips embedded decrefs for creation
                 # tasks (restart replay); this actor will never restart.
                 self._release_actor_holds(actor)
+                if spec.get("name"):
+                    # The name may have been reserved before the cluster
+                    # view changed under us — release it or it leaks.
+                    self.gcs.drop_named_actor(actor_id)
                 ctx.reply(m, {"ok": True})
                 return
-            if spec.get("name"):
-                ok = self.gcs.register_named_actor(
-                    spec.get("namespace", "default"), spec["name"], actor_id)
-                if not ok:
-                    ctx.reply(m, {"__error__": ValueError(
-                        f"actor name {spec['name']!r} already taken")})
-                    return
             actor = ActorRecord(actor_id, spec)
             self.actors[actor_id] = actor
             rec = TaskRecord(spec["creation_task"])
@@ -672,8 +1499,15 @@ class NodeService:
                 e = self.objects.setdefault(oid, ObjectEntry())
                 e.producing_task = rec.task_id
             rec.deps = {d for d in rec.deps if not self._object_ready(d)}
+            for d in rec.deps:
+                self._ensure_pull(d)
             self.pending_queue.append(rec)
             self._schedule()
+        if self.multinode:
+            try:
+                self.gcs.set_actor_node(actor_id, self.node_id)
+            except Exception:
+                pass
         ctx.reply(m, {"ok": True})
 
     def _on_actor_created(self, rec: TaskRecord, failed: bool) -> None:
@@ -746,6 +1580,18 @@ class NodeService:
     def _h_kill_actor(self, ctx: _ConnCtx, m: dict) -> None:
         with self.lock:
             actor = self.actors.get(m["actor_id"])
+        if actor is None and self.multinode:
+            fwd = self._forward_actor_rpc(m["actor_id"], {
+                "type": "kill_actor", "actor_id": m["actor_id"],
+                "no_restart": m.get("no_restart", True)})
+            if fwd is not None:
+                with self.lock:
+                    self._remote_actor_tombstones[m["actor_id"]] = \
+                        "killed via kill()"
+                ctx.reply(m, fwd)
+                return
+        with self.lock:
+            actor = self.actors.get(m["actor_id"])
             if actor is None:
                 ctx.reply(m, {"ok": False})
                 return
@@ -760,11 +1606,45 @@ class NodeService:
                 self._teardown_worker(actor.worker)
         ctx.reply(m, {"ok": True})
 
+    def _forward_actor_rpc(self, actor_id: bytes,
+                           msg: dict) -> Optional[dict]:
+        """Call an actor RPC on the actor's home node; None if the home
+        is unknown/unreachable.  Never called under self.lock."""
+        home = self._actor_homes.get(actor_id)
+        if home is None:
+            try:
+                home = self.gcs.get_actor_node(actor_id)
+            except Exception:
+                home = None
+        if home is None or home == self.node_id:
+            return None
+        ninfo = self._node_info(home)
+        if ninfo is None:
+            return None
+        try:
+            conn = self._peer_conn_to(ninfo)
+            return conn.call(dict(msg), timeout=30.0)
+        except Exception:
+            return None
+
     def _h_actor_state(self, ctx: _ConnCtx, m: dict) -> None:
         with self.lock:
             a = self.actors.get(m["actor_id"])
-            ctx.reply(m, {"state": a.state if a else "unknown",
-                          "reason": a.death_reason if a else ""})
+            if a is not None:
+                ctx.reply(m, {"state": a.state, "reason": a.death_reason})
+                return
+            tomb = self._remote_actor_tombstones.get(m["actor_id"])
+        if tomb is not None:
+            ctx.reply(m, {"state": "dead", "reason": tomb})
+            return
+        if self.multinode:
+            fwd = self._forward_actor_rpc(m["actor_id"], {
+                "type": "actor_state", "actor_id": m["actor_id"]})
+            if fwd is not None:
+                ctx.reply(m, {"state": fwd["state"],
+                              "reason": fwd["reason"]})
+                return
+        ctx.reply(m, {"state": "unknown", "reason": ""})
 
     def _h_lookup_named_actor(self, ctx: _ConnCtx, m: dict) -> None:
         aid = self.gcs.lookup_named_actor(m["namespace"], m["name"])
@@ -773,6 +1653,11 @@ class NodeService:
             if aid is not None and aid in self.actors:
                 spec = {k: v for k, v in self.actors[aid].spec.items()
                         if k != "creation_task"}
+        if spec is None and aid is not None and self.multinode:
+            fwd = self._forward_actor_rpc(aid, {"type": "actor_spec",
+                                                "actor_id": aid})
+            if fwd is not None:
+                spec = fwd.get("spec")
         ctx.reply(m, {"actor_id": aid, "spec": spec})
 
     def _h_list_named_actors(self, ctx: _ConnCtx, m: dict) -> None:
@@ -780,6 +1665,28 @@ class NodeService:
 
     # -- cluster info ------------------------------------------------------
     def _h_cluster_resources(self, ctx: _ConnCtx, m: dict) -> None:
+        if self.multinode:
+            try:
+                self._cluster_view = self.gcs.nodes()
+            except Exception:
+                pass
+            total: Dict[str, float] = {}
+            avail: Dict[str, float] = {}
+            with self.lock:
+                mine_t = dict(self.resources_total)
+                mine_a = dict(self.resources_avail)
+            for n in self._cluster_view:
+                src_t = (mine_t if n["node_id"] == self.node_id
+                         else n["resources_total"])
+                src_a = (mine_a if n["node_id"] == self.node_id
+                         else n["resources_avail"])
+                for k, v in src_t.items():
+                    total[k] = total.get(k, 0.0) + v
+                for k, v in src_a.items():
+                    avail[k] = avail.get(k, 0.0) + v
+            ctx.reply(m, {"total": total, "available": avail,
+                          "nodes": self._cluster_view})
+            return
         with self.lock:
             ctx.reply(m, {"total": dict(self.resources_total),
                           "available": dict(self.resources_avail)})
@@ -861,6 +1768,8 @@ class NodeService:
                 res = dict(rec.spec.get("resources") or {})
                 needs_tpu = res.get("TPU", 0) > 0
                 if not self._take(res):
+                    if self.multinode and self._try_spill(rec, res):
+                        progressed = True
                     continue
                 w = self._find_idle_worker(tpu=needs_tpu)
                 if w is None:
@@ -1026,7 +1935,9 @@ class NodeService:
         for oid in rec.spec["return_ids"]:
             self._register_object(oid, "error", blob, len(blob),
                                   state=FAILED)
-        if not rec.is_actor_creation:
+        foreign_task = rec.spec.get("owner_node") not in (None,
+                                                          self.node_id)
+        if not rec.is_actor_creation and not foreign_task:
             for dep in rec.spec.get("embedded") or []:
                 self._decref(dep)
 
@@ -1096,3 +2007,47 @@ def _unregister_waiter(entries: List[ObjectEntry], cb) -> None:
 def _OID(b: bytes):
     from ray_tpu._private.ids import ObjectID
     return ObjectID(b)
+
+
+def main() -> None:
+    """Standalone node entry: one raylet-role process joining a cluster.
+
+    python -m ray_tpu._private.node_service --gcs-host H --gcs-port P \
+        [--resources '{"CPU": 4, "remote": 1}'] [--store-capacity BYTES]
+    Prints NODE_READY=<node_id_hex> once serving (the Cluster fixture
+    scrapes it).  Reference: raylet main (src/ray/raylet/main.cc)."""
+    import argparse
+    import json
+    import signal
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gcs-host", required=True)
+    ap.add_argument("--gcs-port", type=int, required=True)
+    ap.add_argument("--resources", default="{}")
+    ap.add_argument("--store-capacity", type=int, default=0)
+    ap.add_argument("--session-prefix", default="")
+    args = ap.parse_args()
+
+    res = {k: float(v) for k, v in json.loads(args.resources).items()}
+    res.setdefault("CPU", float(os.cpu_count() or 1))
+    prefix = args.session_prefix or config.session_dir_prefix
+    session_dir = os.path.join(
+        prefix, f"node_{int(time.time()*1000)}_{os.getpid()}")
+    os.makedirs(session_dir, exist_ok=True)
+    store_path = f"/dev/shm/rtpu_node_{os.getpid()}"
+    capacity = args.store_capacity or config.object_store_memory
+    node = NodeService(session_dir, res, store_path, capacity,
+                       gcs_address=(args.gcs_host, args.gcs_port))
+    node.start()
+    print(f"NODE_READY={node.node_id.hex()}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    while not stop.is_set():
+        stop.wait(0.5)
+    node.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -214,6 +214,24 @@ class _Waiter:
         return self._value
 
 
+def wake_and_join_acceptor(thread, family: int, addr,
+                           join_timeout: float = 2.0) -> None:
+    """Wake a thread blocked in accept() with a dummy connection and join
+    it BEFORE closing the listener fd.  A thread left in accept()
+    survives close(); when the fd number is reused by a later listener,
+    an EINTR retry can make the stale thread steal and instantly drop the
+    new listener's first connection."""
+    try:
+        s = socket.socket(family, socket.SOCK_STREAM)
+        s.settimeout(1.0)
+        s.connect(addr)
+        s.close()
+    except OSError:
+        pass
+    if thread is not None and thread.is_alive():
+        thread.join(timeout=join_timeout)
+
+
 def connect_uds(path: str, deadline_s: float = 10.0) -> socket.socket:
     start = time.time()
     while True:
