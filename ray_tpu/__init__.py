@@ -107,6 +107,15 @@ def init(num_cpus: Optional[float] = None,
                      else _detect_tpu_chips())
         if tpus:
             res["TPU"] = tpus
+            # Slice-head marker for gang scheduling whole TPU slices
+            # (reference: accelerators/tpu.py:360-362 "TPU-{type}-head"):
+            # worker 0 of a slice advertises it so exactly one placement
+            # group head bundle lands per slice.
+            acc_type = (os.environ.get("TPU_ACCELERATOR_TYPE")
+                        or os.environ.get("RAY_TPU_ACCELERATOR_TYPE"))
+            worker_id = os.environ.get("TPU_WORKER_ID", "0")
+            if acc_type and worker_id == "0":
+                res.setdefault(f"TPU-{acc_type}-head", 1.0)
         store_capacity = object_store_memory or config.object_store_memory
         store_path = os.path.join("/dev/shm", f"rtpu_{os.getpid()}_"
                                   f"{int(time.time()*1000) % 100000}")

@@ -235,6 +235,7 @@ class CoreClient:
                     method_name: Optional[str] = None,
                     is_actor_creation: bool = False,
                     actor_spec_extra: Optional[dict] = None,
+                    pg: Optional[dict] = None,
                     ) -> List[ObjectRef]:
         spec_args, embedded = self._pack_args(args, kwargs)
         return_ids = [os.urandom(16) for _ in range(num_returns)]
@@ -252,6 +253,7 @@ class CoreClient:
             "method_name": method_name,
             "is_actor_creation": is_actor_creation,
             "owner": self.client_id,
+            "pg": pg,
         }
         if actor_spec_extra:
             spec.update(actor_spec_extra)
@@ -372,7 +374,8 @@ class CoreClient:
                      kwargs: dict, resources: Dict[str, float],
                      max_restarts: int, max_concurrency: int,
                      name: Optional[str], namespace: str,
-                     detached: bool) -> Tuple[bytes, ObjectRef]:
+                     detached: bool,
+                     pg: Optional[dict] = None) -> Tuple[bytes, ObjectRef]:
         actor_id = os.urandom(16)
         spec_args, embedded = self._pack_args(args, kwargs)
         creation_task = {
@@ -390,6 +393,7 @@ class CoreClient:
             "is_actor_creation": True,
             "max_concurrency": max_concurrency,
             "owner": self.client_id,
+            "pg": pg,
         }
         spec = {
             "actor_id": actor_id,
@@ -401,6 +405,7 @@ class CoreClient:
             "class_id": class_id,
             "resources": resources,
             "creation_task": creation_task,
+            "pg": pg,
         }
         self.conn.call({"type": "create_actor", "spec": spec})
         return actor_id, ObjectRef(creation_task["return_ids"][0],
@@ -448,6 +453,20 @@ class CoreClient:
     def kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
         return self.conn.call({"type": "kv_keys", "ns": ns,
                                "prefix": prefix})["keys"]
+
+    # -- placement groups --------------------------------------------------
+    def create_pg(self, pg_id: bytes, bundles: List[Dict[str, float]],
+                  strategy: str, name: Optional[str],
+                  ready_oid: bytes) -> None:
+        self.conn.call({"type": "create_pg", "pg_id": pg_id,
+                        "bundles": bundles, "strategy": strategy,
+                        "name": name, "ready_oid": ready_oid})
+
+    def remove_pg(self, pg_id: bytes) -> bool:
+        return self.conn.call({"type": "remove_pg", "pg_id": pg_id})["ok"]
+
+    def pg_state(self, pg_id: bytes) -> dict:
+        return self.conn.call({"type": "pg_state", "pg_id": pg_id})
 
     def cluster_resources(self) -> dict:
         return self.conn.call({"type": "cluster_resources"})

@@ -97,10 +97,21 @@ class ActorRecord:
         self.holds_released = False
 
 
+class Bundle:
+    """One reserved resource bundle of a placement group on this node
+    (reference: bundle leases in gcs_placement_group_scheduler.h:283)."""
+
+    __slots__ = ("total", "free")
+
+    def __init__(self, resources: Dict[str, float]) -> None:
+        self.total = dict(resources)
+        self.free = dict(resources)
+
+
 class WorkerHandle:
     __slots__ = ("worker_id", "conn_send", "proc", "state", "tpu",
                  "current_task", "actor_id", "resources_held",
-                 "last_idle_time", "pid")
+                 "last_idle_time", "pid", "bundle_key")
 
     def __init__(self, worker_id: bytes, proc: subprocess.Popen,
                  tpu: bool) -> None:
@@ -114,6 +125,8 @@ class WorkerHandle:
         self.resources_held: Dict[str, float] = {}
         self.last_idle_time = time.time()
         self.pid = proc.pid if proc else 0
+        # (pg_id, bundle_index) the held resources came from, if any
+        self.bundle_key: Optional[Tuple[bytes, int]] = None
 
 
 class _ConnCtx:
@@ -198,6 +211,13 @@ class NodeService:
         # pulls whose local entry was deleted mid-flight: the loop must
         # exit instead of polling a vanished GCS record forever
         self._cancelled_pulls: set = set()
+        # (pg_id, bundle_index) -> Bundle reserved ON THIS NODE
+        self.bundles: Dict[Tuple[bytes, int], Bundle] = {}
+        # pg_id -> coordinator record for PGs created via this node:
+        # {"bundles", "strategy", "name", "ready_oid",
+        #  "state": pending|created|failed|removed,
+        #  "nodes": [node_id per bundle]}
+        self.pgs: Dict[bytes, dict] = {}
         self.control_port = 0
         self.transfer_port = 0
         self.lock = threading.RLock()
@@ -523,6 +543,11 @@ class NodeService:
                     break
             if done:
                 with self.lock:
+                    # Completed remotely but the forward_done notify was
+                    # lost with the node: release the owner-side holds
+                    # here (forwarded entry already popped above).
+                    for dep in rec.spec.get("embedded") or []:
+                        self._decref(dep)
                     for oid in rec.spec["return_ids"]:
                         self._ensure_pull(oid)
                 continue
@@ -851,6 +876,8 @@ class NodeService:
         holds self.lock."""
         if rec.is_actor_creation or rec.actor_id is not None:
             return False    # actor placement is decided at create time
+        if rec.spec.get("pg") is not None:
+            return False    # pg tasks are pinned to their bundle's node
         feasible_local = self._local_totals_satisfy(res)
         if rec.spec.get("spilled") and feasible_local:
             return False    # already hopped once; wait for local capacity
@@ -893,7 +920,7 @@ class NodeService:
             threading.Thread(target=self._fwd_sender_loop,
                              args=(nid, ninfo, q), daemon=True,
                              name="rtpu-forward").start()
-        q.put((rec, spec))
+        q.put(("fwd", rec, spec))
 
     def _has_local_dependent(self, oid: bytes) -> bool:
         """True if any queued local task waits on oid.  Caller holds
@@ -911,15 +938,19 @@ class NodeService:
                          q: "queue.Queue") -> None:
         while not self._shutdown:
             try:
-                rec, spec = q.get(timeout=1.0)
+                kind, a, b = q.get(timeout=1.0)
             except queue.Empty:
                 continue
             try:
                 conn = self._peer_conn_to(ninfo)
-                conn.notify({"type": "forward_task", "spec": spec,
-                             "owner_node": self.node_id})
+                if kind == "fwd":
+                    conn.notify({"type": "forward_task", "spec": b,
+                                 "owner_node": self.node_id})
+                else:           # "notify": pre-built one-way message
+                    conn.notify(a)
             except Exception:
-                self._forward_send_failed(rec)
+                if kind == "fwd":
+                    self._forward_send_failed(a)
 
     def _forward_send_failed(self, rec: TaskRecord) -> None:
         with self.lock:
@@ -935,6 +966,263 @@ class NodeService:
                 self.tasks[rec.task_id] = rec
                 self.pending_queue.append(rec)
                 self._schedule()
+
+    # ------------------------------------------------------------------
+    # placement groups (reference: python/ray/util/placement_group.py:41,
+    # 2PC at src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h:283)
+    # ------------------------------------------------------------------
+    def _h_create_pg(self, ctx: _ConnCtx, m: dict) -> None:
+        pg_id = m["pg_id"]
+        with self.lock:
+            rec = {"bundles": m["bundles"], "strategy": m["strategy"],
+                   "name": m.get("name"), "ready_oid": m["ready_oid"],
+                   "state": "pending", "nodes": None}
+            self.pgs[pg_id] = rec
+            e = self.objects.setdefault(m["ready_oid"], ObjectEntry())
+            e.refcount = max(e.refcount, 1)
+        threading.Thread(target=self._pg_create_loop, args=(pg_id,),
+                         daemon=True, name="rtpu-pg-create").start()
+        ctx.reply(m, {"ok": True})
+
+    def _h_remove_pg(self, ctx: _ConnCtx, m: dict) -> None:
+        pg_id = m["pg_id"]
+        with self.lock:
+            rec = self.pgs.get(pg_id)
+            if rec is None:
+                ctx.reply(m, {"ok": False})
+                return
+            was_pending = rec["state"] == "pending"
+            rec["state"] = "removed"
+            if was_pending:
+                # Resolve pg.ready() waiters instead of hanging them.
+                blob = ser.dumps(ValueError(
+                    "placement group was removed before it was placed"))
+                self._register_object(rec["ready_oid"], "error", blob,
+                                      len(blob), state=FAILED)
+            nodes = rec["nodes"] or []
+            local = [(i, n) for i, n in enumerate(nodes)
+                     if n == self.node_id]
+            remote = [(i, n) for i, n in enumerate(nodes)
+                      if n != self.node_id]
+            for i, _ in local:
+                self._return_bundle_local(pg_id, i)
+            self._schedule()
+        for i, nid in remote:
+            ninfo = self._node_info(nid)
+            if ninfo is not None:
+                try:
+                    self._peer_conn_to(ninfo).notify(
+                        {"type": "return_bundle", "pg_id": pg_id,
+                         "bundle_index": i})
+                except Exception:
+                    pass
+        ctx.reply(m, {"ok": True})
+
+    def _h_pg_state(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            rec = self.pgs.get(m["pg_id"])
+            ctx.reply(m, {"state": rec["state"] if rec else "unknown",
+                          "nodes": rec["nodes"] if rec else None})
+
+    def _h_reserve_bundle(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            ok = self._reserve_bundle_local(
+                m["pg_id"], m["bundle_index"], m["resources"])
+        ctx.reply(m, {"ok": ok})
+
+    def _h_return_bundle(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            self._return_bundle_local(m["pg_id"], m["bundle_index"])
+            self._schedule()
+
+    def _reserve_bundle_local(self, pg_id: bytes, idx: int,
+                              res: Dict[str, float]) -> bool:
+        """Phase-1 reserve: carve the bundle out of this node's available
+        pool.  Caller holds self.lock."""
+        key = (pg_id, idx)
+        if key in self.bundles:
+            return True     # idempotent (2PC retry)
+        if not self._take(res):
+            return False
+        self.bundles[key] = Bundle(res)
+        return True
+
+    def _return_bundle_local(self, pg_id: bytes, idx: int) -> None:
+        """Release a bundle back to the node pool.  Running tasks keep
+        their share until completion (their give-back routes to the node
+        pool once the bundle is gone).  Caller holds self.lock."""
+        b = self.bundles.pop((pg_id, idx), None)
+        if b is not None:
+            self._give_back(b.free)
+
+    def _pg_create_loop(self, pg_id: bytes) -> None:
+        """Coordinator: place bundles, 2PC reserve/commit, retrying while
+        resources are transiently busy; fails the ready object if no
+        placement can ever exist."""
+        while not self._shutdown:
+            with self.lock:
+                rec = self.pgs.get(pg_id)
+                if rec is None or rec["state"] != "pending":
+                    return
+                bundles = rec["bundles"]
+                strategy = rec["strategy"]
+                my_avail = dict(self.resources_avail)
+                my_total = dict(self.resources_total)
+            view = [{"node_id": self.node_id, "self": True,
+                     "resources_avail": my_avail,
+                     "resources_total": my_total, "state": "alive"}]
+            if self.multinode:
+                view += [n for n in self._cluster_view
+                         if n.get("state") == "alive"
+                         and n["node_id"] != self.node_id]
+            assignment = _place_bundles(bundles, strategy, view,
+                                        use_avail=True)
+            if assignment is None:
+                if _place_bundles(bundles, strategy, view,
+                                  use_avail=False) is None:
+                    # No placement even against TOTALS: infeasible.
+                    blob = ser.dumps(exc.InfeasibleResourceError(
+                        f"placement group {pg_id.hex()[:8]} "
+                        f"({strategy}, {bundles}) cannot fit on any "
+                        f"node combination"))
+                    with self.lock:
+                        rec["state"] = "failed"
+                        self._register_object(rec["ready_oid"], "error",
+                                              blob, len(blob),
+                                              state=FAILED)
+                    return
+                time.sleep(0.1)
+                continue
+            if self._pg_try_commit(pg_id, rec, bundles, assignment):
+                return
+            time.sleep(0.1)
+
+    def _pg_try_commit(self, pg_id: bytes, rec: dict, bundles: List[dict],
+                       assignment: List[dict]) -> bool:
+        """2PC: reserve every bundle on its assigned node; roll back all
+        on any failure."""
+        reserved: List[Tuple[int, dict]] = []
+        ok = True
+        for idx, target in enumerate(assignment):
+            if target.get("self"):
+                with self.lock:
+                    got = self._reserve_bundle_local(pg_id, idx,
+                                                     bundles[idx])
+            else:
+                try:
+                    got = self._peer_conn_to(target).call(
+                        {"type": "reserve_bundle", "pg_id": pg_id,
+                         "bundle_index": idx,
+                         "resources": bundles[idx]},
+                        timeout=10.0)["ok"]
+                except Exception:
+                    got = False
+            if not got:
+                ok = False
+                break
+            reserved.append((idx, target))
+        if not ok:
+            for idx, target in reserved:
+                if target.get("self"):
+                    with self.lock:
+                        self._return_bundle_local(pg_id, idx)
+                else:
+                    try:
+                        self._peer_conn_to(target).notify(
+                            {"type": "return_bundle", "pg_id": pg_id,
+                             "bundle_index": idx})
+                    except Exception:
+                        pass
+            return False
+        blob = ser.dumps(True)
+        rollback: List[Tuple[int, dict]] = []
+        with self.lock:
+            if rec["state"] != "pending":
+                # remove_placement_group raced the commit: undo the
+                # reserves instead of resurrecting a removed PG.
+                rollback = reserved
+            else:
+                rec["nodes"] = [t["node_id"] for t in assignment]
+                rec["state"] = "created"
+                self._register_object(rec["ready_oid"], "inline", blob,
+                                      len(blob))
+                self._schedule()
+        for idx, target in rollback:
+            if target.get("self"):
+                with self.lock:
+                    self._return_bundle_local(pg_id, idx)
+            else:
+                try:
+                    self._peer_conn_to(target).notify(
+                        {"type": "return_bundle", "pg_id": pg_id,
+                         "bundle_index": idx})
+                except Exception:
+                    pass
+        return True
+
+    def _create_actor_with_pg(self, ctx: _ConnCtx, m: dict) -> None:
+        """Wait for the actor's placement group to commit, then create
+        the actor locally or forward the whole creation to the bundle's
+        node (side thread; replies to the original create_actor call)."""
+        spec = m["spec"]
+        pg = spec["pg"]
+        deadline = time.time() + 120.0
+        target: Optional[bytes] = None
+        while time.time() < deadline and not self._shutdown:
+            with self.lock:
+                rec = self.pgs.get(pg["id"])
+                state = rec["state"] if rec else "unknown"
+                target = self._pg_bundle_node(pg) if rec else None
+            if state == "created":
+                break
+            if state in ("failed", "removed", "unknown"):
+                ctx.reply(m, {"__error__": ValueError(
+                    f"placement group is {state}")})
+                return
+            time.sleep(0.05)
+        else:
+            ctx.reply(m, {"__error__": TimeoutError(
+                "placement group did not become ready within 120s")})
+            return
+        if target is None or target == self.node_id or not self.multinode:
+            # Bundle is local (or single-node): run the normal creation
+            # path — the bundle check at the top will now pass.
+            self._h_create_actor(ctx, m)
+            return
+        ninfo = self._node_info(target)
+        if ninfo is None:
+            ctx.reply(m, {"__error__": RuntimeError(
+                "placement group bundle's node is gone")})
+            return
+        actor_id = spec["actor_id"]
+        self._actor_homes[actor_id] = target
+        spec2 = dict(spec)
+        spec2["creation_task"] = dict(spec2["creation_task"])
+        spec2["creation_task"]["owner_node"] = self.node_id
+        crec = TaskRecord(spec2["creation_task"])
+        with self.lock:
+            self.forwarded[crec.task_id] = (crec, target)
+        try:
+            conn = self._peer_conn_to(ninfo)
+            conn.call({"type": "create_actor", "spec": spec2},
+                      timeout=30.0)
+            ctx.reply(m, {"ok": True})
+        except Exception as e:
+            self._actor_homes.pop(actor_id, None)
+            with self.lock:
+                self.forwarded.pop(crec.task_id, None)
+            ctx.reply(m, {"__error__": e})
+
+    def _pg_bundle_node(self, pg: dict) -> Optional[bytes]:
+        """Home node of a pg bundle, from the coordinator record.  Caller
+        holds self.lock; returns None while the PG is still pending."""
+        rec = self.pgs.get(pg["id"])
+        if rec is None or rec["nodes"] is None:
+            return None
+        try:
+            return rec["nodes"][pg["bundle"]]
+        except IndexError:
+            return None
 
     # ------------------------------------------------------------------
     # message handlers (all named _h_<type>)
@@ -1026,7 +1314,8 @@ class NodeService:
                     ctx.reply(m, {"ok": True})
                     return
             rec = TaskRecord(spec)
-            reason = self._infeasible_reason(spec.get("resources"))
+            reason = (None if spec.get("pg") is not None
+                      else self._infeasible_reason(spec.get("resources")))
             if reason is not None and spec.get("actor_id") is None:
                 self.tasks[rec.task_id] = rec
                 for oid in spec["return_ids"]:
@@ -1301,20 +1590,29 @@ class NodeService:
                 w.current_task = None
             self._schedule()
         if notify_owner is not None:
-            threading.Thread(target=self._notify_forward_done,
-                             args=(notify_owner, m["task_id"]),
-                             daemon=True, name="rtpu-fwd-done").start()
+            self._peer_notify(notify_owner,
+                              {"type": "forward_done",
+                               "task_id": m["task_id"]})
 
-    def _notify_forward_done(self, owner_node: bytes,
-                             task_id: bytes) -> None:
-        ninfo = self._node_info(owner_node)
-        if ninfo is None:
+    def _peer_notify(self, nid: bytes, msg: dict) -> None:
+        """One-way message to a peer, reusing that peer's FIFO sender
+        when one exists (no thread churn on the task-done hot path)."""
+        q = self._fwd_queues.get(nid)
+        if q is not None:
+            q.put(("notify", msg, None))
             return
-        try:
-            self._peer_conn_to(ninfo).notify(
-                {"type": "forward_done", "task_id": task_id})
-        except Exception:
-            pass
+
+        def _send():
+            ninfo = self._node_info(nid)
+            if ninfo is None:
+                return
+            try:
+                self._peer_conn_to(ninfo).notify(msg)
+            except Exception:
+                pass
+
+        threading.Thread(target=_send, daemon=True,
+                         name="rtpu-peer-notify").start()
 
     def _h_worker_blocked(self, ctx: _ConnCtx, m: dict) -> None:
         # A worker blocked in get(): return its CPU to the pool so nested
@@ -1323,7 +1621,7 @@ class NodeService:
             w = ctx.worker
             if w is not None and w.state == "busy":
                 w.state = "blocked"
-                self._give_back(w.resources_held)
+                self._release_held(w)
                 self._schedule()
 
     def _h_worker_unblocked(self, ctx: _ConnCtx, m: dict) -> None:
@@ -1331,7 +1629,12 @@ class NodeService:
             w = ctx.worker
             if w is not None and w.state == "blocked":
                 # Overcommit on purpose: the task must finish.
-                self._take(w.resources_held, allow_negative=True)
+                b = (self.bundles.get(w.bundle_key)
+                     if w.bundle_key else None)
+                if b is not None:
+                    _charge(b.free, w.resources_held)
+                else:
+                    self._take(w.resources_held, allow_negative=True)
                 w.state = "busy"
 
     def _h_add_ref(self, ctx: _ConnCtx, m: dict) -> None:
@@ -1422,7 +1725,19 @@ class NodeService:
     def _h_create_actor(self, ctx: _ConnCtx, m: dict) -> None:
         spec = m["spec"]
         actor_id = spec["actor_id"]
-        if self.multinode:
+        pgspec = spec.get("pg")
+        if pgspec is not None:
+            key = (pgspec["id"], pgspec["bundle"])
+            with self.lock:
+                bundle_here = key in self.bundles
+            if not bundle_here:
+                # Await PG readiness + route to the bundle's node on a
+                # side thread (never block this conn's dispatch loop).
+                threading.Thread(target=self._create_actor_with_pg,
+                                 args=(ctx, m), daemon=True,
+                                 name="rtpu-pg-actor").start()
+                return
+        if self.multinode and pgspec is None:
             # Placement: keep the actor local when this node's totals can
             # ever run it; otherwise forward the whole creation to a peer
             # that can (reference: GCS actor scheduling picks a node).
@@ -1460,8 +1775,8 @@ class NodeService:
         # Name reservation happens OUTSIDE the state lock: in multinode
         # mode this is a blocking RPC to the GCS process, and blocking
         # gcs.call() under self.lock can deadlock against GCS pushes.
-        if spec.get("name") and \
-                self._infeasible_reason(spec.get("resources")) is None:
+        if spec.get("name") and (spec.get("pg") is not None or
+                self._infeasible_reason(spec.get("resources")) is None):
             ok = self.gcs.register_named_actor(
                 spec.get("namespace", "default"), spec["name"], actor_id)
             if not ok:
@@ -1469,7 +1784,8 @@ class NodeService:
                     f"actor name {spec['name']!r} already taken")})
                 return
         with self.lock:
-            reason = self._infeasible_reason(spec.get("resources"))
+            reason = (None if spec.get("pg") is not None
+                      else self._infeasible_reason(spec.get("resources")))
             if reason is not None:
                 actor = ActorRecord(actor_id, spec)
                 actor.state = "dead"
@@ -1585,9 +1901,12 @@ class NodeService:
                 "type": "kill_actor", "actor_id": m["actor_id"],
                 "no_restart": m.get("no_restart", True)})
             if fwd is not None:
-                with self.lock:
-                    self._remote_actor_tombstones[m["actor_id"]] = \
-                        "killed via kill()"
+                if m.get("no_restart", True):
+                    # A restartable kill leaves the actor alive on its
+                    # home node — no tombstone.
+                    with self.lock:
+                        self._remote_actor_tombstones[m["actor_id"]] = \
+                            "killed via kill()"
                 ctx.reply(m, fwd)
                 return
         with self.lock:
@@ -1736,8 +2055,9 @@ class NodeService:
         if w.state == "dead":
             return
         w.state = "dead"
-        self._give_back(w.resources_held)
+        self._release_held(w)
         w.resources_held = {}
+        w.bundle_key = None
         if w.conn_send:
             try:
                 w.conn_send({"type": "exit"})
@@ -1749,11 +2069,19 @@ class NodeService:
         self._schedule_reap(w)
 
     def _release_worker(self, w: WorkerHandle) -> None:
-        self._give_back(w.resources_held)
+        self._release_held(w)
         w.resources_held = {}
+        w.bundle_key = None
         w.current_task = None
         w.state = "idle"
         w.last_idle_time = time.time()
+
+    def _cluster_node(self, nid: bytes) -> Optional[dict]:
+        """_cluster_view lookup WITHOUT any GCS round-trip (lock-safe)."""
+        for n in self._cluster_view:
+            if n["node_id"] == nid:
+                return n
+        return None
 
     def _schedule(self) -> None:
         """Dispatch every runnable pending task. Caller holds self.lock."""
@@ -1767,13 +2095,36 @@ class NodeService:
                     continue
                 res = dict(rec.spec.get("resources") or {})
                 needs_tpu = res.get("TPU", 0) > 0
-                if not self._take(res):
+                pg = rec.spec.get("pg")
+                bundle = None
+                key = None
+                if pg is not None:
+                    key = (pg["id"], pg["bundle"])
+                    bundle = self.bundles.get(key)
+                    if bundle is None:
+                        # Not our bundle: route to its home node (known
+                        # once the PG committed); wait while pending.
+                        target = self._pg_bundle_node(pg)
+                        if (self.multinode and target is not None
+                                and target != self.node_id):
+                            ninfo = self._cluster_node(target)
+                            if ninfo is not None:
+                                self._forward_task(rec, ninfo)
+                                progressed = True
+                        continue
+                    if not _fits(bundle.free, res):
+                        continue   # bundle busy: wait for a pg task end
+                    _charge(bundle.free, res)
+                elif not self._take(res):
                     if self.multinode and self._try_spill(rec, res):
                         progressed = True
                     continue
                 w = self._find_idle_worker(tpu=needs_tpu)
                 if w is None:
-                    self._give_back(res)
+                    if bundle is not None:
+                        _uncharge(bundle.free, res)
+                    else:
+                        self._give_back(res)
                     self._maybe_spawn(tpu=needs_tpu)
                     continue
                 self.pending_queue.remove(rec)
@@ -1782,8 +2133,19 @@ class NodeService:
                 w.state = "busy"
                 w.current_task = rec
                 w.resources_held = res
+                w.bundle_key = key if bundle is not None else None
                 w.conn_send({"type": "execute_task", "spec": rec.spec})
                 progressed = True
+
+    def _release_held(self, w: WorkerHandle) -> None:
+        """Return a worker's held resources to their source pool: the pg
+        bundle they came from if it still exists, else the node pool.
+        Caller holds self.lock."""
+        b = self.bundles.get(w.bundle_key) if w.bundle_key else None
+        if b is not None:
+            _uncharge(b.free, w.resources_held)
+        else:
+            self._give_back(w.resources_held)
 
     def _find_idle_worker(self, tpu: bool) -> Optional[WorkerHandle]:
         for w in self.workers.values():
@@ -1863,7 +2225,7 @@ class NodeService:
         if w.state == "busy":
             # ("blocked" workers already returned their resources when
             # they blocked — giving back again would double-credit.)
-            self._give_back(w.resources_held)
+            self._release_held(w)
         w.state = "dead"
         self.workers.pop(w.worker_id, None)
         self._schedule_reap(w)
@@ -1991,6 +2353,92 @@ class NodeService:
                     cb()
                 except Exception:
                     pass
+
+
+def _fits(pool: Dict[str, float], res: Dict[str, float]) -> bool:
+    return all(pool.get(k, 0.0) >= v - 1e-9 for k, v in res.items())
+
+
+def _charge(pool: Dict[str, float], res: Dict[str, float]) -> None:
+    for k, v in res.items():
+        pool[k] = pool.get(k, 0.0) - v
+
+
+def _uncharge(pool: Dict[str, float], res: Dict[str, float]) -> None:
+    for k, v in res.items():
+        pool[k] = pool.get(k, 0.0) + v
+
+
+def _place_bundles(bundles: List[Dict[str, float]], strategy: str,
+                   nodes: List[dict], use_avail: bool = True
+                   ) -> Optional[List[dict]]:
+    """Pick a node for every bundle under the given strategy, or None.
+
+    Strategies mirror the reference (python/ray/util/placement_group.py):
+    PACK (few nodes, soft), STRICT_PACK (one node), SPREAD (distinct
+    nodes, soft), STRICT_SPREAD (distinct nodes, hard)."""
+    pool_key = "resources_avail" if use_avail else "resources_total"
+    pools = [dict(n[pool_key]) for n in nodes]
+    assignment: List[Optional[dict]] = [None] * len(bundles)
+    if strategy in ("PACK", "STRICT_PACK"):
+        for i in range(len(nodes)):
+            trial = dict(pools[i])
+            ok = True
+            for b in bundles:
+                if not _fits(trial, b):
+                    ok = False
+                    break
+                _charge(trial, b)
+            if ok:
+                return [nodes[i]] * len(bundles)
+        if strategy == "STRICT_PACK":
+            return None
+        used: List[int] = []
+        for bi, b in enumerate(bundles):
+            placed = False
+            for i in used:
+                if _fits(pools[i], b):
+                    _charge(pools[i], b)
+                    assignment[bi] = nodes[i]
+                    placed = True
+                    break
+            if not placed:
+                for i in range(len(nodes)):
+                    if i not in used and _fits(pools[i], b):
+                        _charge(pools[i], b)
+                        used.append(i)
+                        assignment[bi] = nodes[i]
+                        placed = True
+                        break
+            if not placed:
+                return None
+        return assignment      # type: ignore[return-value]
+    if strategy in ("SPREAD", "STRICT_SPREAD"):
+        order = sorted(range(len(nodes)),
+                       key=lambda i: -sum(pools[i].values()))
+        used_set: set = set()
+        for bi, b in enumerate(bundles):
+            placed = False
+            for i in order:
+                if i not in used_set and _fits(pools[i], b):
+                    _charge(pools[i], b)
+                    used_set.add(i)
+                    assignment[bi] = nodes[i]
+                    placed = True
+                    break
+            if not placed:
+                if strategy == "STRICT_SPREAD":
+                    return None
+                for i in order:
+                    if _fits(pools[i], b):
+                        _charge(pools[i], b)
+                        assignment[bi] = nodes[i]
+                        placed = True
+                        break
+                if not placed:
+                    return None
+        return assignment      # type: ignore[return-value]
+    raise ValueError(f"unknown placement strategy {strategy!r}")
 
 
 def _unregister_waiter(entries: List[ObjectEntry], cb) -> None:

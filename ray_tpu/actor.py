@@ -14,11 +14,13 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 from ray_tpu._private.config import config
-from ray_tpu.remote_function import _resources_from_options
+from ray_tpu.remote_function import (_pg_spec_from_options,
+                                     _resources_from_options)
 
 _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "max_restarts", "max_concurrency",
     "name", "namespace", "lifetime", "max_task_retries",
+    "placement_group", "placement_group_bundle_index",
 }
 
 
@@ -70,7 +72,8 @@ class ActorClass:
             max_concurrency=self._options.get("max_concurrency", 1),
             name=self._options.get("name"),
             namespace=self._options.get("namespace", "default"),
-            detached=detached)
+            detached=detached,
+            pg=_pg_spec_from_options(self._options))
         method_meta = _method_meta(self._cls)
         return ActorHandle(actor_id, class_id, self._cls.__name__,
                            method_meta, creation_ref=ready_ref)

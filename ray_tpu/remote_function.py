@@ -17,8 +17,19 @@ from ray_tpu._private.config import config
 
 _VALID_OPTIONS = {
     "num_returns", "num_cpus", "num_tpus", "resources", "max_retries",
-    "name",
+    "name", "placement_group", "placement_group_bundle_index",
 }
+
+
+def _pg_spec_from_options(options: Dict[str, Any]) -> Optional[Dict]:
+    pg = options.get("placement_group")
+    if pg is None:
+        return None
+    index = options.get("placement_group_bundle_index", 0)
+    # Fail fast at submission: an out-of-range bundle would otherwise
+    # never match a reserved bundle and the task would pend forever.
+    pg._check_bundle_index(index)
+    return {"id": pg.id, "bundle": index}
 
 
 def _resources_from_options(options: Dict[str, Any],
@@ -73,7 +84,9 @@ class RemoteFunction:
             name=self._options.get("name") or self._fn.__qualname__,
             args=args, kwargs=kwargs, num_returns=num_returns,
             resources=resources,
-            retries=self._options.get("max_retries", config.max_task_retries))
+            retries=self._options.get("max_retries",
+                                      config.max_task_retries),
+            pg=_pg_spec_from_options(self._options))
         if num_returns == 1:
             return refs[0]
         return refs

@@ -1,0 +1,11 @@
+"""Cluster utilities (reference: python/ray/util)."""
+
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          placement_group_table,
+                                          remove_placement_group,
+                                          tpu_slice_bundles)
+
+__all__ = [
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "placement_group_table", "tpu_slice_bundles",
+]

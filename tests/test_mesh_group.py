@@ -1,0 +1,90 @@
+"""MeshGroup: multi-process global mesh via jax.distributed.
+
+The VERDICT's done-bar: 2 "hosts" x 4 virtual CPU devices form ONE
+8-device global mesh and run the compiled train step.  Reference analog:
+train/_internal/backend_executor.py:135 multi-node worker-group bring-up.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.parallel.mesh_group import MeshGroup
+
+
+@pytest.fixture
+def mesh_group(ray_start):
+    mg = MeshGroup(num_hosts=2, devices_per_host=4, platform="cpu")
+    yield mg
+    mg.shutdown()
+
+
+def _global_sum(rank):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    shard = np.arange(8.0)[rank * 4:(rank + 1) * 4]
+    g = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), shard)
+    out = jax.jit(lambda v: jnp.sum(v),
+                  out_shardings=NamedSharding(mesh, P()))(g)
+    return float(out)
+
+
+def _train_step_loss(rank):
+    """One CompiledTrainStep on the 2-host 8-device global mesh."""
+    import jax
+    import numpy as np
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.train.train_step import CompiledTrainStep
+
+    cfg = TransformerConfig(vocab_size=128, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_seq=64,
+                            remat=False)
+    mesh = make_mesh(MeshSpec(fsdp=4, tp=2), devices=jax.devices())
+    step = CompiledTrainStep(cfg, mesh)
+    state = step.init_state(seed=0)
+    rng = np.random.RandomState(0)           # same data on all hosts
+    tokens_global = rng.randint(0, cfg.vocab_size, (8, 65)).astype(
+        np.int32)
+    tokens = jax.make_array_from_process_local_data(
+        step.data_sharding, tokens_global[rank * 4:(rank + 1) * 4])
+    state, metrics = step(state, tokens)
+    return float(metrics["loss"])
+
+
+def test_global_device_counts(mesh_group):
+    counts = mesh_group.device_counts()
+    assert [c["global"] for c in counts] == [8, 8]
+    assert [c["local"] for c in counts] == [4, 4]
+
+
+def test_global_collective(mesh_group):
+    res = mesh_group.run(_global_sum, timeout=300)
+    assert res == [28.0, 28.0]
+
+
+def test_compiled_train_step_on_global_mesh(mesh_group, cpu_mesh_devices):
+    losses = mesh_group.run(_train_step_loss, timeout=600)
+    assert losses[0] == pytest.approx(losses[1], rel=1e-5)
+
+    # Single-process 8-device reference run must agree.
+    import jax
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.train.train_step import CompiledTrainStep
+    cfg = TransformerConfig(vocab_size=128, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_seq=64,
+                            remat=False)
+    mesh = make_mesh(MeshSpec(fsdp=4, tp=2),
+                     devices=cpu_mesh_devices[:8])
+    step = CompiledTrainStep(cfg, mesh)
+    state = step.init_state(seed=0)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (8, 65)).astype(np.int32)
+    _, metrics = step(state, step.shard_batch(tokens))
+    assert losses[0] == pytest.approx(float(metrics["loss"]), rel=1e-4)
