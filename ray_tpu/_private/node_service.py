@@ -229,6 +229,8 @@ class NodeService:
         self.resources_total = dict(resources)
         self.resources_avail = dict(resources)
         self._conns: List[_ConnCtx] = []
+        self._conn_threads: List[threading.Thread] = []
+        self._pull_threads: List[threading.Thread] = []
         self._shutdown = False
         self._listener: Optional[socket.socket] = None
         self._next_worker_seq = 0
@@ -258,8 +260,10 @@ class NodeService:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="rtpu-node-accept")
         self._accept_thread.start()
-        threading.Thread(target=self._monitor_loop, daemon=True,
-                         name="rtpu-node-monitor").start()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="rtpu-node-monitor")
+        self._monitor_thread.start()
         if self.multinode:
             self._start_multinode()
         for _ in range(config.worker_pool_prestart):
@@ -309,6 +313,26 @@ class NodeService:
                 self.gcs.close()
             except Exception:
                 pass
+        # Join every thread that can touch the shm store BEFORE the
+        # caller (ray_tpu.shutdown) closes/munmaps the store client: a
+        # straggler conn thread reaping a dead worker against an
+        # unmapped segment is a segfault, not an exception.
+        with self.lock:
+            conns = list(self._conns)
+            threads = list(self._conn_threads)
+            pulls = list(self._pull_threads)
+        for ctx in conns:
+            try:
+                ctx.sock.close()
+            except OSError:
+                pass
+        deadline = time.time() + 3.0
+        for t in threads + pulls + [
+                getattr(self, "_monitor_thread", None),
+                getattr(self, "_gcs_event_thread", None)]:
+            if t is None or not t.is_alive():
+                continue
+            t.join(timeout=max(0.05, deadline - time.time()))
         try:
             os.unlink(self.socket_path)
         except OSError:
@@ -343,10 +367,15 @@ class NodeService:
                     pass
                 return
             ctx = _ConnCtx(sock)
+            t = threading.Thread(target=self._conn_loop, args=(ctx,),
+                                 daemon=True, name="rtpu-node-conn")
             with self.lock:
                 self._conns.append(ctx)
-            threading.Thread(target=self._conn_loop, args=(ctx,),
-                             daemon=True, name="rtpu-node-conn").start()
+                self._conn_threads.append(t)
+                if len(self._conn_threads) > 64:
+                    self._conn_threads = [x for x in self._conn_threads
+                                          if x.is_alive()]
+            t.start()
 
     def _conn_loop(self, ctx: _ConnCtx) -> None:
         try:
@@ -401,8 +430,10 @@ class NodeService:
             target=self._peer_accept_loop, daemon=True,
             name="rtpu-peer-accept")
         self._peer_accept_thread.start()
-        threading.Thread(target=self._gcs_event_loop, daemon=True,
-                         name="rtpu-gcs-events").start()
+        self._gcs_event_thread = threading.Thread(
+            target=self._gcs_event_loop, daemon=True,
+            name="rtpu-gcs-events")
+        self._gcs_event_thread.start()
         self.gcs.register_node(self.node_id, host, self.control_port,
                                self.transfer_port, self.resources_total)
         self.gcs.sub_nodes(lambda ev, info:
@@ -426,10 +457,15 @@ class NodeService:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             ctx = _ConnCtx(sock)
             ctx.kind = "peer"
+            t = threading.Thread(target=self._conn_loop, args=(ctx,),
+                                 daemon=True, name="rtpu-peer-conn")
             with self.lock:
                 self._conns.append(ctx)
-            threading.Thread(target=self._conn_loop, args=(ctx,),
-                             daemon=True, name="rtpu-peer-conn").start()
+                self._conn_threads.append(t)
+                if len(self._conn_threads) > 64:
+                    self._conn_threads = [x for x in self._conn_threads
+                                          if x.is_alive()]
+            t.start()
 
     def _heartbeat_loop(self) -> None:
         interval = config.heartbeat_interval_s
@@ -625,8 +661,13 @@ class NodeService:
         if oid in self._pulls_inflight:
             return
         self._pulls_inflight.add(oid)
-        threading.Thread(target=self._pull_object, args=(oid,),
-                         daemon=True, name="rtpu-pull").start()
+        t = threading.Thread(target=self._pull_object, args=(oid,),
+                             daemon=True, name="rtpu-pull")
+        self._pull_threads.append(t)
+        if len(self._pull_threads) > 32:
+            self._pull_threads = [x for x in self._pull_threads
+                                  if x.is_alive()]
+        t.start()
 
     def _pull_object(self, oid: bytes) -> None:
         evt = threading.Event()

@@ -1,0 +1,315 @@
+"""KV-cache autoregressive decoding for serving.
+
+The reference serves LLMs by delegating to vLLM on GPU (e.g.
+doc/source/serve/doc_code/vllm_example.py); the TPU-native build owns
+the decode loop itself, shaped for XLA:
+
+* FIXED shapes everywhere: a slot-based cache [B, M, Hkv, Dh] per layer
+  with B decode slots and M max positions — prefill and decode_step
+  compile ONCE and are reused for the server's lifetime.
+* decode_step advances every active slot one token per call (the inner
+  loop of continuous batching): one [B,1,D] layer pass, scatter the new
+  k/v into the caches with static-shape advanced indexing, attend
+  against the full cache under a per-slot length mask.
+* prefill runs the prompt through the stacked layers once (causal
+  within the prompt), returning per-layer k/v to be inserted into a
+  free slot.
+
+Everything reuses transformer.py's parameter layout (init_params),
+norms and RoPE, so any trained checkpoint serves unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import (TransformerConfig, _norm, _rope,
+                                        _w_out)
+
+
+class DecodeCaches(NamedTuple):
+    """Per-layer KV caches + per-slot bookkeeping (all fixed-shape)."""
+
+    k: jax.Array          # [L, B, M, Hkv, Dh]
+    v: jax.Array          # [L, B, M, Hkv, Dh]
+    lengths: jax.Array    # [B] int32 — tokens currently cached per slot
+    last_token: jax.Array  # [B] int32 — input to the next decode step
+
+
+def init_caches(cfg: TransformerConfig, num_slots: int,
+                max_len: int) -> DecodeCaches:
+    shape = (cfg.n_layers, num_slots, max_len, cfg.kv_heads,
+             cfg.head_dim)
+    return DecodeCaches(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        lengths=jnp.zeros((num_slots,), jnp.int32),
+        last_token=jnp.zeros((num_slots,), jnp.int32))
+
+
+def _qkv(p, h, cfg: TransformerConfig, positions):
+    q = jnp.einsum("bsd,dhk->bshk", h, p["wq"].astype(h.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, p["wk"].astype(h.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, p["wv"].astype(h.dtype))
+    if cfg.arch == "llama":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(p, x, cfg: TransformerConfig):
+    rms = cfg.arch == "llama"
+    h = _norm(x, p["mlp_norm"], p.get("mlp_norm_b"), cfg.norm_eps, rms)
+    if cfg.arch == "llama":
+        gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"].astype(h.dtype))
+        up = jnp.einsum("bsd,df->bsf", h, p["w_up"].astype(h.dtype))
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up
+    else:
+        up = jnp.einsum("bsd,df->bsf", h, p["w_up"].astype(h.dtype))
+        up = up + p["b_up"].astype(h.dtype)
+        act = jax.nn.gelu(up.astype(jnp.float32)).astype(h.dtype)
+    down = jnp.einsum("bsf,fd->bsd", act, p["w_down"].astype(act.dtype))
+    if cfg.arch == "gpt2":
+        down = down + p["b_down"].astype(down.dtype)
+    return x + down
+
+
+def _gqa_scores(q, k_cache, cfg: TransformerConfig):
+    """q: [B,1,H,Dh], k_cache: [B,M,Hkv,Dh] -> scores [B,H,M] (f32)."""
+    groups = cfg.n_heads // cfg.kv_heads
+    B, M = k_cache.shape[0], k_cache.shape[1]
+    qg = q[:, 0].reshape(B, cfg.kv_heads, groups, cfg.head_dim)
+    s = jnp.einsum("bhgk,bmhk->bhgm", qg.astype(jnp.float32),
+                   k_cache.astype(jnp.float32))
+    return s.reshape(B, cfg.n_heads, M) / (cfg.head_dim ** 0.5)
+
+
+def _decode_core(params: Dict[str, Any], caches: DecodeCaches,
+                 active: jax.Array, cfg: TransformerConfig
+                 ) -> Tuple[DecodeCaches, jax.Array]:
+    """One decode step (traceable): greedy argmax and the last-token
+    feedback happen ON DEVICE, so the host costs one small [B]-int
+    transfer per read.  Safe to run extra steps on retired slots: every
+    cache position is overwritten by its owner BEFORE it is first
+    attended (scatter-at-pos precedes the mask reaching pos), so a
+    reused slot never reads a predecessor's leftovers."""
+    B = caches.lengths.shape[0]
+    tokens = caches.last_token[:, None]                      # [B,1]
+    pos = caches.lengths[:, None]                            # [B,1]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)        # [B,1,D]
+    if cfg.arch == "gpt2":
+        x = x + params["pos_embed"][
+            jnp.clip(pos, 0, cfg.max_seq - 1)].astype(cfg.dtype)
+    rms = cfg.arch == "llama"
+    batch_ix = jnp.arange(B)
+    M = caches.k.shape[2]
+    # j attends iff j <= current position (cache holds pos new entries
+    # after the scatter below, indices 0..pos inclusive of the new one).
+    mask = jnp.arange(M)[None, :] <= pos                     # [B,M]
+
+    def layer(x, inputs):
+        p, k_cache, v_cache = inputs
+        h = _norm(x, p["attn_norm"], p.get("attn_norm_b"),
+                  cfg.norm_eps, rms)
+        q, k_new, v_new = _qkv(p, h, cfg, pos)
+        # Inactive slots must keep their cache untouched: a later,
+        # shorter prompt reusing the slot would otherwise attend to the
+        # garbage written at its old length position.
+        gate = active[:, None, None]
+        k_cache = k_cache.at[batch_ix, caches.lengths].set(
+            jnp.where(gate, k_new[:, 0],
+                      k_cache[batch_ix, caches.lengths]))
+        v_cache = v_cache.at[batch_ix, caches.lengths].set(
+            jnp.where(gate, v_new[:, 0],
+                      v_cache[batch_ix, caches.lengths]))
+        s = _gqa_scores(q, k_cache, cfg)                     # [B,H,M]
+        s = jnp.where(mask[:, None, :], s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        groups = cfg.n_heads // cfg.kv_heads
+        wg = w.reshape(B, cfg.kv_heads, groups, M)
+        o = jnp.einsum("bhgm,bmhk->bhgk", wg,
+                       v_cache.astype(jnp.float32))
+        o = o.reshape(B, 1, cfg.n_heads, cfg.head_dim).astype(cfg.dtype)
+        attn = jnp.einsum("bshk,hkd->bsd", o,
+                          p["wo"].astype(cfg.dtype))
+        x = x + attn
+        x = _mlp(p, x, cfg)
+        return x, (k_cache, v_cache)
+
+    def scan_fn(x, inputs):
+        x, kv = layer(x, inputs)
+        return x, kv
+
+    x, (k_all, v_all) = jax.lax.scan(
+        scan_fn, x, (params["layers"], caches.k, caches.v))
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"),
+              cfg.norm_eps, rms)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x.astype(jnp.float32),
+        _w_out(params, cfg).astype(jnp.float32))[:, 0]       # [B,V]
+    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    new_last = jnp.where(active, next_tok, caches.last_token)
+    new_len = jnp.where(active, caches.lengths + 1, caches.lengths)
+    return DecodeCaches(k=k_all, v=v_all, lengths=new_len,
+                        last_token=new_last), next_tok
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def decode_step(params: Dict[str, Any], caches: DecodeCaches,
+                active: jax.Array, cfg: TransformerConfig
+                ) -> Tuple[DecodeCaches, jax.Array]:
+    """One token for every slot; returns (caches', next_tokens [B])."""
+    return _decode_core(params, caches, active, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_steps"),
+                   donate_argnums=(1,))
+def decode_steps(params: Dict[str, Any], caches: DecodeCaches,
+                 active: jax.Array, cfg: TransformerConfig,
+                 num_steps: int) -> Tuple[DecodeCaches, jax.Array]:
+    """num_steps tokens per slot in ONE dispatch (lax.scan): returns
+    (caches', tokens [num_steps, B]).  This is what makes serving fast
+    through a high-latency host<->chip link: the per-read round trip
+    (~60ms via a tunnel) amortizes over num_steps * B tokens instead of
+    B."""
+
+    def body(c, _):
+        c, tok = _decode_core(params, c, active, cfg)
+        return c, tok
+
+    caches, toks = jax.lax.scan(body, caches, None, length=num_steps)
+    return caches, toks
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill(params: Dict[str, Any], tokens: jax.Array, length: jax.Array,
+            cfg: TransformerConfig
+            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Prompt pass.  tokens: [1, P] int32 (padded), length: true length.
+    Returns (k [L,P,Hkv,Dh], v [L,P,Hkv,Dh], last_logits [vocab])."""
+    P = tokens.shape[1]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)        # [1,P,D]
+    positions = jnp.arange(P, dtype=jnp.int32)[None]
+    if cfg.arch == "gpt2":
+        x = x + params["pos_embed"][:P][None].astype(cfg.dtype)
+    rms = cfg.arch == "llama"
+    causal = (jnp.arange(P)[:, None] >= jnp.arange(P)[None, :])
+    padmask = jnp.arange(P)[None, :] < length                # [1,P]
+
+    def layer(x, p):
+        h = _norm(x, p["attn_norm"], p.get("attn_norm_b"),
+                  cfg.norm_eps, rms)
+        q, k, v = _qkv(p, h, cfg, positions)
+        groups = cfg.n_heads // cfg.kv_heads
+        qg = q.reshape(1, P, cfg.kv_heads, groups, cfg.head_dim)
+        s = jnp.einsum("bqhgk,bmhk->bhgqm", qg.astype(jnp.float32),
+                       k.astype(jnp.float32)) / (cfg.head_dim ** 0.5)
+        s = jnp.where(causal[None, None, None], s, -jnp.inf)
+        s = jnp.where(padmask[:, None, None, None, :], s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhgqm,bmhk->bqhgk", w, v.astype(jnp.float32))
+        o = o.reshape(1, P, cfg.n_heads, cfg.head_dim).astype(cfg.dtype)
+        attn = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
+        x = x + attn
+        x = _mlp(p, x, cfg)
+        return x, (k[0], v[0])
+
+    x, (k_all, v_all) = jax.lax.scan(layer, x, params["layers"])
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"),
+              cfg.norm_eps, rms)
+    last = x[0, jnp.clip(length - 1, 0, P - 1)]
+    logits = last.astype(jnp.float32) @ _w_out(params, cfg).astype(
+        jnp.float32)
+    return k_all, v_all, logits
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnums=(1,))
+def prefill_insert(params: Dict[str, Any], caches: DecodeCaches,
+                   tokens: jax.Array, lengths: jax.Array,
+                   slots: jax.Array, valid: jax.Array,
+                   cfg: TransformerConfig
+                   ) -> Tuple[DecodeCaches, jax.Array]:
+    """Batched prefill of up to N prompts + cache insertion in ONE
+    dispatch.  tokens: [N, P] int32 (padded), lengths/slots/valid: [N].
+    Invalid rows rewrite their target slot with its existing contents
+    (gather-then-scatter no-op).  Returns (caches', first_tokens [N]).
+
+    Serving admission is the other latency cliff besides decode reads:
+    one serial prefill+sync per request costs ~70ms each through a
+    tunnel; batching them makes 16 admissions cost the same as one."""
+    N, P = tokens.shape
+    x = params["tok_embed"][tokens].astype(cfg.dtype)        # [N,P,D]
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (N, P))
+    if cfg.arch == "gpt2":
+        x = x + params["pos_embed"][:P][None].astype(cfg.dtype)
+    rms = cfg.arch == "llama"
+    causal = (jnp.arange(P)[:, None] >= jnp.arange(P)[None, :])
+    padmask = jnp.arange(P)[None, :] < lengths[:, None]      # [N,P]
+
+    def layer(x, p):
+        h = _norm(x, p["attn_norm"], p.get("attn_norm_b"),
+                  cfg.norm_eps, rms)
+        q, k, v = _qkv(p, h, cfg, positions)
+        groups = cfg.n_heads // cfg.kv_heads
+        qg = q.reshape(N, P, cfg.kv_heads, groups, cfg.head_dim)
+        s = jnp.einsum("bqhgk,bmhk->bhgqm", qg.astype(jnp.float32),
+                       k.astype(jnp.float32)) / (cfg.head_dim ** 0.5)
+        s = jnp.where(causal[None, None, None], s, -jnp.inf)
+        s = jnp.where(padmask[:, None, None, None, :], s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhgqm,bmhk->bqhgk", w, v.astype(jnp.float32))
+        o = o.reshape(N, P, cfg.n_heads, cfg.head_dim).astype(cfg.dtype)
+        attn = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
+        x = x + attn
+        x = _mlp(p, x, cfg)
+        return x, (k, v)
+
+    x, (k_all, v_all) = jax.lax.scan(layer, x, params["layers"])
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"),
+              cfg.norm_eps, rms)
+    last_ix = jnp.clip(lengths - 1, 0, P - 1)
+    last = x[jnp.arange(N), last_ix]                         # [N,D]
+    logits = last.astype(jnp.float32) @ _w_out(params, cfg).astype(
+        jnp.float32)
+    first_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Scatter into the cache: [L,N,P,...] -> positions [:, slot, :P].
+    gate = valid[None, :, None, None, None]
+    old_k = caches.k[:, slots, :P]
+    old_v = caches.v[:, slots, :P]
+    ck = caches.k.at[:, slots, :P].set(
+        jnp.where(gate, k_all.astype(caches.k.dtype), old_k))
+    cv = caches.v.at[:, slots, :P].set(
+        jnp.where(gate, v_all.astype(caches.v.dtype), old_v))
+    new_len = caches.lengths.at[slots].set(
+        jnp.where(valid, lengths, caches.lengths[slots]))
+    new_last = caches.last_token.at[slots].set(
+        jnp.where(valid, first_tok, caches.last_token[slots]))
+    return DecodeCaches(k=ck, v=cv, lengths=new_len,
+                        last_token=new_last), first_tok
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def insert_slot(caches: DecodeCaches, slot: jax.Array, k: jax.Array,
+                v: jax.Array, length: jax.Array, first_token: jax.Array
+                ) -> DecodeCaches:
+    """Install a prefilled request into a decode slot.  k/v: [L,P,...];
+    P <= M (cache width) — padded positions beyond `length` are masked
+    by the per-slot length at attention time."""
+    P = k.shape[1]
+    ck = caches.k.at[:, slot, :P].set(k.astype(caches.k.dtype))
+    cv = caches.v.at[:, slot, :P].set(v.astype(caches.v.dtype))
+    return DecodeCaches(
+        k=ck, v=cv,
+        lengths=caches.lengths.at[slot].set(length),
+        last_token=caches.last_token.at[slot].set(first_token))
+
+
+def set_last_tokens(caches: DecodeCaches,
+                    tokens: jax.Array) -> DecodeCaches:
+    return caches._replace(last_token=tokens)

@@ -1,0 +1,213 @@
+"""ray_tpu.serve: model serving on actors, TPU-first.
+
+Analog of the reference's Ray Serve (python/ray/serve): a controller
+actor reconciles deployments (serve/_private/controller.py:84), replica
+actors run user code (replica.py:233), handles route requests with
+power-of-two-choices (pow_2_scheduler.py:52), and @serve.batch provides
+dynamic batching (batching.py:468).  The TPU twist lives in
+serve.llm: continuous-batched decoding keeps a fixed-shape jitted step
+fed, so XLA compiles once and every decode step rides the MXU.
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Model)
+    ray_tpu.get(handle.remote(21))    # -> 42
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve._controller import CONTROLLER_NAME, ServeController
+
+__all__ = ["deployment", "run", "delete", "shutdown", "status",
+           "get_deployment_handle", "batch", "Deployment",
+           "DeploymentHandle"]
+
+
+def _get_or_create_controller():
+    import ray_tpu
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    cls = ray_tpu.remote(ServeController)
+    try:
+        return cls.options(name=CONTROLLER_NAME, lifetime="detached",
+                           max_restarts=2).remote()
+    except ValueError:
+        # Lost the name race with a concurrent caller.
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+class Deployment:
+    """A deployable class + its serve options (reference:
+    serve/deployment.py Deployment)."""
+
+    def __init__(self, cls: type, options: Dict[str, Any]) -> None:
+        self._cls = cls
+        self._options = dict(options)
+        self._init_args: tuple = ()
+        self._init_kwargs: dict = {}
+
+    @property
+    def name(self) -> str:
+        return self._options.get("name") or self._cls.__name__
+
+    def options(self, **overrides) -> "Deployment":
+        d = Deployment(self._cls, {**self._options, **overrides})
+        d._init_args, d._init_kwargs = self._init_args, self._init_kwargs
+        return d
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        """Capture constructor args (reference: .bind() DAG API)."""
+        d = Deployment(self._cls, dict(self._options))
+        d._init_args, d._init_kwargs = args, kwargs
+        return d
+
+
+def deployment(_cls: Optional[type] = None, *,
+               name: Optional[str] = None,
+               num_replicas: int = 1,
+               max_concurrent_queries: int = 8,
+               ray_actor_options: Optional[Dict[str, Any]] = None):
+    """@serve.deployment decorator (reference: serve/api.py)."""
+
+    def deco(cls: type) -> Deployment:
+        return Deployment(cls, {
+            "name": name, "num_replicas": num_replicas,
+            "max_concurrent_queries": max_concurrent_queries,
+            "ray_actor_options": dict(ray_actor_options or {}),
+        })
+
+    if _cls is not None:
+        return deco(_cls)
+    return deco
+
+
+class DeploymentHandle:
+    """Client handle: routes requests to replicas with pow-2 choices
+    (reference: serve/handle.py:751)."""
+
+    def __init__(self, deployment_name: str) -> None:
+        self.deployment_name = deployment_name
+        self._router = None
+
+    def _get_router(self):
+        if self._router is None:
+            from ray_tpu.serve._router import Router
+            self._router = Router(self.deployment_name)
+        return self._router
+
+    def remote(self, *args, **kwargs):
+        return self.method("__call__").remote(*args, **kwargs)
+
+    def method(self, method_name: str) -> "_HandleMethod":
+        return _HandleMethod(self, method_name)
+
+    def __getattr__(self, name: str) -> "_HandleMethod":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _HandleMethod(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))
+
+
+class _HandleMethod:
+    def __init__(self, handle: DeploymentHandle, method: str) -> None:
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        import ray_tpu
+        router = self._handle._get_router()
+        ref, replica = router.assign(self._method, args, kwargs)
+        _attach_done_callback(router, ref, replica)
+        return ref
+
+
+def _attach_done_callback(router, ref, replica) -> None:
+    """Decrement the outstanding count when the reply lands, and report
+    dead replicas to the controller (drop from routing + backfill).
+    Piggybacks on a tiny waiter thread per request — cheap at serving
+    rates compared to an RPC; replaced by completion pushes if it shows
+    up in profiles."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import exceptions as exc
+
+    def waiter():
+        try:
+            ray_tpu.get(ref)
+        except (exc.ActorDiedError, exc.WorkerCrashedError):
+            router.report_failure(replica)
+        except Exception:
+            pass
+        finally:
+            router.done(replica)
+
+    threading.Thread(target=waiter, daemon=True,
+                     name="rtpu-serve-done").start()
+
+
+def run(target: Deployment, *, name: Optional[str] = None
+        ) -> DeploymentHandle:
+    """Deploy (or redeploy) and return a handle once replicas exist
+    (reference: serve.run, serve/api.py:494)."""
+    import ray_tpu
+    if not isinstance(target, Deployment):
+        raise TypeError("serve.run expects a Deployment "
+                        "(use @serve.deployment)")
+    controller = _get_or_create_controller()
+    opts = target._options
+    actor_opts = dict(opts.get("ray_actor_options") or {})
+    unsupported = set(actor_opts) - {"num_cpus", "num_tpus", "resources"}
+    if unsupported:
+        raise ValueError(
+            f"unsupported ray_actor_options {sorted(unsupported)}; "
+            f"supported: num_cpus, num_tpus, resources")
+    blob = cloudpickle.dumps(target._cls)
+    ray_tpu.get(controller.deploy.remote(
+        name or target.name, blob, target._init_args,
+        target._init_kwargs, opts.get("num_replicas", 1),
+        opts.get("max_concurrent_queries", 8),
+        actor_opts), timeout=120)
+    return DeploymentHandle(name or target.name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def delete(name: str) -> bool:
+    import ray_tpu
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.delete.remote(name), timeout=60)
+
+
+def status() -> Dict[str, dict]:
+    import ray_tpu
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.status.remote(), timeout=60)
+
+
+def shutdown() -> None:
+    import ray_tpu
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    ray_tpu.get(controller.shutdown_all.remote(), timeout=60)
+    ray_tpu.kill(controller)

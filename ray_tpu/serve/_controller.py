@@ -1,0 +1,147 @@
+"""Serve control plane: the controller actor.
+
+Analog of the reference's detached ServeController
+(serve/_private/controller.py:84) + deployment_state reconciler
+(deployment_state.py:1232): holds the target state for every deployment
+and reconciles actual replica actors toward it.  Reconciliation runs
+inside control calls and from the router's failure reports — no
+standing poll loop is needed at this scale (the reference's controller
+loops because it also drives autoscaling/long-poll broadcast).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class ServeController:
+    """Named actor owning deployment target state + replica registry."""
+
+    def __init__(self) -> None:
+        # name -> {"blob", "init_args", "init_kwargs", "num_replicas",
+        #          "max_concurrent_queries", "version",
+        #          "replicas": [ActorHandle]}
+        self._deployments: Dict[str, dict] = {}
+        self._version = 0
+
+    # -- control ----------------------------------------------------------
+    def deploy(self, name: str, cls_blob: bytes, init_args: tuple,
+               init_kwargs: dict, num_replicas: int,
+               max_concurrent_queries: int,
+               actor_options: Optional[Dict[str, Any]] = None) -> int:
+        """Create or update a deployment; reconciles synchronously and
+        returns the new version.  Changed code/args/options replace
+        every running replica (the reference's version-driven replica
+        rollout, deployment_state.py)."""
+        d = self._deployments.get(name)
+        if d is None:
+            d = {"replicas": [], "version": 0}
+            self._deployments[name] = d
+        new_state = dict(blob=cls_blob, init_args=init_args,
+                         init_kwargs=init_kwargs,
+                         max_concurrent_queries=max_concurrent_queries,
+                         actor_options=dict(actor_options or {}))
+        changed = any(d.get(k) != v for k, v in new_state.items())
+        d.update(new_state, num_replicas=num_replicas)
+        if changed and d["replicas"]:
+            old, d["replicas"] = d["replicas"], []
+            self._stop_replicas(old)
+        d["version"] += 1
+        self._version += 1
+        self._reconcile(name)
+        return d["version"]
+
+    def delete(self, name: str) -> bool:
+        d = self._deployments.pop(name, None)
+        if d is None:
+            return False
+        self._stop_replicas(d["replicas"])
+        self._version += 1
+        return True
+
+    def shutdown_all(self) -> None:
+        for name in list(self._deployments):
+            self.delete(name)
+
+    # -- data-plane queries ------------------------------------------------
+    def get_replicas(self, name: str) -> dict:
+        d = self._deployments.get(name)
+        if d is None:
+            return {"replicas": [], "version": -1,
+                    "max_concurrent_queries": 1}
+        return {"replicas": list(d["replicas"]),
+                "version": d["version"],
+                "max_concurrent_queries": d["max_concurrent_queries"]}
+
+    def version(self) -> int:
+        return self._version
+
+    def status(self) -> Dict[str, dict]:
+        import ray_tpu
+        out = {}
+        for name, d in self._deployments.items():
+            states = []
+            for r in d["replicas"]:
+                try:
+                    states.append(
+                        ray_tpu._ensure_connected().actor_state(
+                            r._actor_id)["state"])
+                except Exception:
+                    states.append("unknown")
+            out[name] = {"target_replicas": d["num_replicas"],
+                         "replica_states": states,
+                         "version": d["version"]}
+        return out
+
+    def report_replica_failure(self, name: str, actor_id: bytes) -> None:
+        """Router saw a replica die: drop it and backfill."""
+        d = self._deployments.get(name)
+        if d is None:
+            return
+        before = len(d["replicas"])
+        d["replicas"] = [r for r in d["replicas"]
+                         if r._actor_id != actor_id]
+        if len(d["replicas"]) != before:
+            d["version"] += 1
+            self._version += 1
+        self._reconcile(name)
+
+    # -- reconciliation ----------------------------------------------------
+    def _reconcile(self, name: str) -> None:
+        import ray_tpu
+        from ray_tpu.serve._replica import Replica
+        d = self._deployments.get(name)
+        if d is None:
+            return
+        want, have = d["num_replicas"], len(d["replicas"])
+        if have < want:
+            cls = ray_tpu.remote(Replica)
+            opts = {k: v for k, v in d["actor_options"].items()
+                    if k in ("num_cpus", "num_tpus", "resources")
+                    and v is not None}
+            for i in range(want - have):
+                h = cls.options(
+                    max_concurrency=max(d["max_concurrent_queries"], 1),
+                    max_restarts=2, **opts,
+                ).remote(name, d["blob"], d["init_args"],
+                         d["init_kwargs"])
+                d["replicas"].append(h)
+            d["version"] += 1
+            self._version += 1
+        elif have > want:
+            extra = d["replicas"][want:]
+            d["replicas"] = d["replicas"][:want]
+            self._stop_replicas(extra)
+            d["version"] += 1
+            self._version += 1
+
+    @staticmethod
+    def _stop_replicas(replicas: List[Any]) -> None:
+        import ray_tpu
+        for r in replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

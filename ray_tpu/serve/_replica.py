@@ -1,0 +1,48 @@
+"""Serve data plane: the replica actor.
+
+Analog of the reference's ReplicaActor (serve/_private/replica.py:233)
++ its user-code wrapper (:800): one actor per replica wrapping the user
+class; every request runs through handle_request, which tracks the
+in-flight count the pow-2 router probes.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+
+class Replica:
+    def __init__(self, deployment_name: str, cls_blob: bytes,
+                 init_args: tuple, init_kwargs: dict) -> None:
+        import cloudpickle
+        self._name = deployment_name
+        cls = cloudpickle.loads(cls_blob)
+        self._user = cls(*init_args, **(init_kwargs or {}))
+        self._inflight = 0
+        self._served = 0
+
+    async def handle_request(self, method: str, args: tuple,
+                             kwargs: dict) -> Any:
+        """Run one request on the user instance (async so batched /
+        concurrent user methods interleave on the actor's event loop)."""
+        self._inflight += 1
+        try:
+            target = (self._user if method == "__call__"
+                      and not hasattr(self._user, "__call__")
+                      else getattr(self._user, method))
+            out = target(*args, **(kwargs or {}))
+            if inspect.isawaitable(out):
+                out = await out
+            return out
+        finally:
+            self._inflight -= 1
+            self._served += 1
+
+    def queue_len(self) -> int:
+        """Probed by the pow-2 router (reference: replica queue-length
+        probing in pow_2_scheduler.py)."""
+        return self._inflight
+
+    def stats(self) -> dict:
+        return {"inflight": self._inflight, "served": self._served}

@@ -1,0 +1,100 @@
+"""Serve request router: power-of-two-choices replica scheduling.
+
+Analog of the reference's Router (serve/_private/router.py:311) +
+PowerOfTwoChoicesReplicaScheduler
+(_private/replica_scheduler/pow_2_scheduler.py:52): sample two
+replicas, send to the one with the smaller queue.  Queue depth is the
+caller-side outstanding count (cheap, no probe RPC on the hot path);
+the replica-side `queue_len` stays available for diagnostics, matching
+how the reference caches probed queue lengths rather than probing per
+request.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# Seconds between controller polls: existing handles pick up scale-ups /
+# redeploys within this window (reference uses LongPoll pushes).
+_REFRESH_INTERVAL_S = 2.0
+
+
+class Router:
+    def __init__(self, deployment_name: str) -> None:
+        self._name = deployment_name
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._outstanding: Dict[bytes, int] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    def _controller(self):
+        import ray_tpu
+        from ray_tpu.serve._controller import CONTROLLER_NAME
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False) -> None:
+        import ray_tpu
+        now = time.time()
+        with self._lock:
+            fresh = (self._replicas
+                     and now - self._last_refresh < _REFRESH_INTERVAL_S)
+        if fresh and not force:
+            return
+        info = ray_tpu.get(
+            self._controller().get_replicas.remote(self._name),
+            timeout=30)
+        with self._lock:
+            self._replicas = info["replicas"]
+            self._version = info["version"]
+            self._last_refresh = now
+            self._outstanding = {
+                r._actor_id: self._outstanding.get(r._actor_id, 0)
+                for r in self._replicas}
+
+    def pick(self):
+        """Pow-2 choice over the caller-side outstanding counts."""
+        self._refresh()
+        with self._lock:
+            reps = self._replicas
+            if not reps:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no replicas")
+            if len(reps) == 1:
+                choice = reps[0]
+            else:
+                a, b = random.sample(reps, 2)
+                choice = (a if self._outstanding.get(a._actor_id, 0)
+                          <= self._outstanding.get(b._actor_id, 0) else b)
+            self._outstanding[choice._actor_id] = \
+                self._outstanding.get(choice._actor_id, 0) + 1
+            return choice
+
+    def done(self, replica) -> None:
+        with self._lock:
+            k = replica._actor_id
+            if self._outstanding.get(k, 0) > 0:
+                self._outstanding[k] -= 1
+
+    def assign(self, method: str, args: tuple, kwargs: dict):
+        """Submit one request; returns (ObjectRef, replica)."""
+        replica = self.pick()
+        ref = replica.handle_request.remote(method, args, kwargs)
+        return ref, replica
+
+    def report_failure(self, replica) -> None:
+        """A request errored with a dead replica: tell the controller,
+        drop local state, force a refresh."""
+        import ray_tpu
+        try:
+            ray_tpu.get(self._controller().report_replica_failure.remote(
+                self._name, replica._actor_id), timeout=30)
+        except Exception:
+            pass
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if r._actor_id != replica._actor_id]
+        self._refresh(force=True)

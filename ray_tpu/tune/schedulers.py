@@ -1,0 +1,71 @@
+"""Trial schedulers: FIFO and ASHA early stopping.
+
+Analog of the reference's tune/schedulers/async_hyperband.py
+(ASHAScheduler / AsyncHyperBandScheduler): rungs at
+grace_period * reduction_factor^k; when a trial reports at (or past) a
+rung it joins that rung's score record and is stopped unless it sits in
+the top 1/reduction_factor of everything recorded there — the
+asynchronous successive-halving rule (no waiting for full brackets).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """No early stopping: every trial runs to completion."""
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3) -> None:
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.rf = reduction_factor
+        # Rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self.milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(t)
+            t *= reduction_factor
+        # milestone -> list of recorded scores (sign-normalized: higher
+        # is always better internally)
+        self._rungs: Dict[int, List[float]] = {m: []
+                                               for m in self.milestones}
+        # trial_id -> highest milestone already recorded
+        self._reached: Dict[str, int] = {}
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        if self.metric not in result:
+            return CONTINUE   # e.g. a final summary report — tolerate
+        t = int(result.get(self.time_attr, 0))
+        score = self._score(result)
+        decision = CONTINUE
+        for m in self.milestones:
+            if t < m or self._reached.get(trial_id, 0) >= m:
+                continue
+            self._reached[trial_id] = m
+            rung = self._rungs[m]
+            rung.append(score)
+            # Top 1/rf cutoff over everything recorded at this rung.
+            k = max(len(rung) // self.rf, 1)
+            cutoff = sorted(rung, reverse=True)[k - 1]
+            if score < cutoff:
+                decision = STOP
+        return decision

@@ -1,0 +1,116 @@
+"""Search spaces + basic variant generation.
+
+Analog of the reference's tune search-space API (tune/search/sample.py:
+uniform/loguniform/randint/choice, tune/search/variant_generator.py
+grid expansion): `grid_search` values cross-product; distribution
+objects are sampled per trial by the BasicVariantGenerator.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, List, Sequence
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float) -> None:
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float) -> None:
+        import math
+        self._lo, self._hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self._lo, self._hi))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int) -> None:
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, options: Sequence[Any]) -> None:
+        self.options = list(options)
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+class GridSearch:
+    def __init__(self, values: Sequence[Any]) -> None:
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(options: Sequence[Any]) -> Choice:
+    return Choice(options)
+
+
+def grid_search(values: Sequence[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int,
+                      seed: int = 0) -> List[Dict[str, Any]]:
+    """Cross-product of every grid_search axis, x num_samples, with
+    distribution leaves re-sampled per variant (reference:
+    BasicVariantGenerator semantics)."""
+    rng = random.Random(seed)
+    grid_paths: List[tuple] = []
+    grid_values: List[List[Any]] = []
+
+    def find_grids(node, path):
+        if isinstance(node, GridSearch):
+            grid_paths.append(path)
+            grid_values.append(node.values)
+        elif isinstance(node, dict):
+            for k, v in node.items():
+                find_grids(v, path + (k,))
+
+    find_grids(param_space, ())
+
+    def build(node, path, grid_assign):
+        if isinstance(node, GridSearch):
+            return grid_assign[path]
+        if isinstance(node, Domain):
+            return node.sample(rng)
+        if isinstance(node, dict):
+            return {k: build(v, path + (k,), grid_assign)
+                    for k, v in node.items()}
+        return node
+
+    combos = (list(itertools.product(*grid_values))
+              if grid_values else [()])
+    variants = []
+    for _ in range(max(num_samples, 1)):
+        for combo in combos:
+            assign = dict(zip(grid_paths, combo))
+            variants.append(build(param_space, (), assign))
+    return variants

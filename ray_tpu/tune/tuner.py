@@ -1,0 +1,256 @@
+"""Tuner + trial controller.
+
+Analog of the reference's Tuner (tune/tuner.py:44) driving the
+TuneController event loop (tune/execution/tune_controller.py:68): each
+trial is one actor running the trainable function with the same
+session.report KV write-through the Train workers use; the controller
+drains reports, feeds the scheduler, and kills trials it says to stop.
+
+Train-on-Tune parity (train/base_trainer.py:693-724 — the reference
+runs EVERY Train job as a Tune trial): pass a TpuTrainer as the
+trainable and each trial calls trainer.fit() with the variant's
+`train_loop_config` merged in.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train import session as session_mod
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: int = 0
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    status: str = "PENDING"   # RUNNING|TERMINATED|EARLY_STOPPED|ERROR
+    path: str = ""
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult]) -> None:
+        self._results = results
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i: int) -> TrialResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: str, mode: str = "max"
+                        ) -> TrialResult:
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]          # noqa: E731
+        return (max if mode == "max" else min)(scored, key=key)
+
+    def get_dataframe(self) -> List[Dict[str, Any]]:
+        return [dict(r.metrics, trial_id=r.trial_id,
+                     status=r.status) for r in self._results]
+
+
+@ray_tpu.remote
+class _TrialActor:
+    """One trial in its own worker process (reference: a Tune trial's
+    train-fn ray actor)."""
+
+    def __init__(self, trial_id: str, trial_dir: str,
+                 config: Dict[str, Any], report_ns: str,
+                 restore_checkpoint: Optional[str] = None) -> None:
+        ctx = session_mod.TrainContext(
+            world_size=1, world_rank=0, trial_dir=trial_dir,
+            restore_checkpoint=restore_checkpoint, config=config,
+            report_ns=report_ns)
+        session_mod.set_context(ctx)
+        self._config = config
+
+    def run(self, fn: Callable) -> Optional[str]:
+        try:
+            fn(self._config)
+            return None
+        except BaseException as e:   # noqa: BLE001
+            import traceback
+            return "".join(traceback.format_exception(
+                type(e), e, e.__traceback__))
+
+
+class Tuner:
+    def __init__(self, trainable: Union[Callable, Any],
+                 *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[Any] = None) -> None:
+        from ray_tpu.train.trainer import RunConfig, TpuTrainer
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._param_space = dict(param_space or {})
+        if isinstance(trainable, TpuTrainer):
+            self._fn = _trainer_trainable(trainable)
+        elif callable(trainable):
+            self._fn = trainable
+        else:
+            raise TypeError("trainable must be a function or TpuTrainer")
+
+    # ------------------------------------------------------------------
+    def fit(self) -> ResultGrid:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self._tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        run_name = self._run_config.name or f"tune_{int(time.time())}"
+        storage = self._run_config.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        exp_dir = os.path.join(storage, run_name)
+        os.makedirs(exp_dir, exist_ok=True)
+
+        variants = generate_variants(self._param_space, tc.num_samples,
+                                     seed=tc.seed)
+        trials = [TrialResult(trial_id=f"trial_{i:05d}", config=v,
+                              metrics={},
+                              path=os.path.join(exp_dir, f"trial_{i:05d}"))
+                  for i, v in enumerate(variants)]
+        pending = list(trials)
+        running: Dict[str, dict] = {}     # trial_id -> {actor, ref, ...}
+        client = ray_tpu._ensure_connected()
+
+        while pending or running:
+            while pending and len(running) < tc.max_concurrent_trials:
+                t = pending.pop(0)
+                os.makedirs(t.path, exist_ok=True)
+                ns = f"tune_reports/{exp_dir}/{t.trial_id}"
+                actor = _TrialActor.remote(t.trial_id, t.path, t.config,
+                                           ns)
+                ref = actor.run.remote(self._fn)
+                t.status = "RUNNING"
+                running[t.trial_id] = {"trial": t, "actor": actor,
+                                       "ref": ref, "ns": ns, "iter": 0}
+            refs = [info["ref"] for info in running.values()]
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=0.2)
+            # Drain reports + scheduler decisions for every live trial.
+            for tid in list(running):
+                info = running[tid]
+                t = info["trial"]
+                stop = False
+                for key in sorted(client.kv_keys(info["ns"])):
+                    blob = client.kv_get(info["ns"], key)
+                    client.kv_del(info["ns"], key)
+                    if blob is None or stop:
+                        continue   # post-stop reports don't count
+                    metrics, ckpt_path = pickle.loads(blob)
+                    info["iter"] += 1
+                    metrics.setdefault("training_iteration",
+                                       info["iter"])
+                    t.history.append(metrics)
+                    t.metrics = metrics
+                    if ckpt_path:
+                        t.checkpoint = Checkpoint(ckpt_path)
+                    if scheduler.on_result(tid, metrics) == STOP:
+                        stop = True
+                if stop:
+                    t.status = "EARLY_STOPPED"
+                    self._stop_trial(info)
+                    del running[tid]
+            # Reap finished trials.
+            done_refs = set(r.binary() for r in ready)
+            for tid in list(running):
+                info = running[tid]
+                if info["ref"].binary() not in done_refs:
+                    continue
+                t = info["trial"]
+                try:
+                    tb = ray_tpu.get(info["ref"])
+                    if tb is None:
+                        t.status = "TERMINATED"
+                    else:
+                        t.status = "ERROR"
+                        t.error = tb
+                except (exc.ActorDiedError,
+                        exc.WorkerCrashedError) as e:
+                    t.status = "ERROR"
+                    t.error = str(e)
+                self._drain_final(client, info, t, scheduler)
+                self._stop_trial(info)
+                del running[tid]
+        return ResultGrid(trials)
+
+    @staticmethod
+    def _drain_final(client, info, t: TrialResult, scheduler) -> None:
+        for key in sorted(client.kv_keys(info["ns"])):
+            blob = client.kv_get(info["ns"], key)
+            client.kv_del(info["ns"], key)
+            if blob is None:
+                continue
+            metrics, ckpt_path = pickle.loads(blob)
+            info["iter"] += 1
+            metrics.setdefault("training_iteration", info["iter"])
+            t.history.append(metrics)
+            t.metrics = metrics
+            if ckpt_path:
+                t.checkpoint = Checkpoint(ckpt_path)
+
+    @staticmethod
+    def _stop_trial(info: dict) -> None:
+        try:
+            ray_tpu.kill(info["actor"])
+        except Exception:
+            pass
+
+
+def _trainer_trainable(trainer) -> Callable:
+    """Wrap a TpuTrainer so each trial runs trainer.fit() with the
+    variant's train_loop_config merged (reference:
+    base_trainer.py:693-724)."""
+
+    def run_trainer(config: Dict[str, Any]) -> None:
+        import copy
+        from ray_tpu.train import session
+        t = copy.copy(trainer)
+        merged = dict(t._config or {})
+        merged.update(config.get("train_loop_config", config))
+        t._config = merged
+        ctx = session.get_context()
+        # Nest the inner run's outputs under this trial's directory.
+        from ray_tpu.train.trainer import RunConfig
+        rc = t._run_config
+        t._run_config = RunConfig(
+            name="train", storage_path=ctx.get_trial_dir(),
+            failure_config=rc.failure_config,
+            checkpoint_config=rc.checkpoint_config)
+        result = t.fit()
+        if result.error is not None:
+            raise result.error
+        session.report(dict(result.metrics, _train_done=1),
+                       checkpoint=result.checkpoint)
+
+    return run_trainer

@@ -1,0 +1,93 @@
+"""Serve benchmark: req/s + p50 TTFT for continuous-batched decoding.
+
+Analog of BASELINE.json config #5 ("Llama Ray Serve continuous
+batching") scaled to the attached single chip: a GPT-2-small-class
+model served through the ContinuousBatcher engine, closed-loop clients
+firing short prompts.  Writes SERVE_BENCH_r02.json and prints one JSON
+line.  The reference publishes no serving numbers (BASELINE.md
+"published": {}), so the recorded numbers ARE the baseline this repo
+must beat in later rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+
+def main() -> None:
+    import numpy as np
+    import jax
+    from ray_tpu.models import transformer
+    from ray_tpu.serve.llm import ContinuousBatcher
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    cfg = transformer.TransformerConfig(
+        vocab_size=50_304, d_model=768, n_layers=12, n_heads=12,
+        d_ff=3072, max_seq=1024, arch="gpt2",
+        dtype=jax.numpy.bfloat16, remat=False)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    num_slots = 16 if on_tpu else 4
+    max_new = 32 if on_tpu else 8
+    n_requests = 128 if on_tpu else 12
+    bat = ContinuousBatcher(params, cfg, num_slots=num_slots,
+                            max_len=256, prompt_pad=64)
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, size=(16,)).tolist()
+               for _ in range(n_requests)]
+
+    # Warmup: compile prefill + decode_step.
+    bat.generate(prompts[0], max_new=4)
+
+    # Closed loop at concurrency == num_slots: every slot stays busy but
+    # requests don't pile up in the admission queue (queue wait would
+    # dominate TTFT and measure the backlog, not the system).
+    results = []
+    lock = threading.Lock()
+    work = list(prompts)
+
+    def client():
+        while True:
+            with lock:
+                if not work:
+                    return
+                p = work.pop()
+            out = bat.generate(p, max_new=max_new, timeout=600)
+            with lock:
+                results.append(out)
+
+    t0 = time.time()
+    threads = [threading.Thread(target=client)
+               for _ in range(num_slots)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    bat.stop()
+
+    ttfts = sorted(r["ttft_s"] for r in results)
+    total_tokens = sum(len(r["tokens"]) for r in results)
+    out = {
+        "metric": "serve_continuous_batching",
+        "model": "gpt2-small (124M)",
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+        "num_slots": num_slots,
+        "requests": len(results),
+        "max_new_tokens": max_new,
+        "req_per_s": round(len(results) / wall, 2),
+        "decode_tokens_per_s": round(total_tokens / wall, 1),
+        "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
+        "ttft_p99_ms": round(ttfts[int(len(ttfts) * 0.99)] * 1e3, 1),
+        "wall_s": round(wall, 2),
+    }
+    with open("SERVE_BENCH_r02.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

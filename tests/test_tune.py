@@ -1,0 +1,141 @@
+"""Tune tests: grid/random search, ASHA early stopping, checkpoints,
+Train-on-Tune.
+
+Reference analogs: tune/tuner.py:44, execution/tune_controller.py:68,
+schedulers/async_hyperband.py, train/base_trainer.py:693 (every Train
+job runs as a Tune trial).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import session
+from ray_tpu.train.trainer import RunConfig, ScalingConfig, TpuTrainer
+
+
+def test_grid_search_runs_all_variants(ray_start, tmp_path):
+    def trainable(config):
+        session.report({"score": config["x"] * config["y"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3]),
+                     "y": tune.grid_search([10, 100])},
+        tune_config=tune.TuneConfig(max_concurrent_trials=3),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 6
+    assert not grid.errors
+    best = grid.get_best_result("score", "max")
+    assert best.metrics["score"] == 300
+    assert best.config == {"x": 3, "y": 100}
+
+
+def test_random_search_samples(ray_start, tmp_path):
+    def trainable(config):
+        session.report({"lr": config["lr"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+        tune_config=tune.TuneConfig(num_samples=4),
+        run_config=RunConfig(name="rand", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    lrs = [r.metrics["lr"] for r in grid]
+    assert len(set(lrs)) == 4
+    assert all(1e-5 <= v <= 1e-1 for v in lrs)
+
+
+def test_asha_stops_bad_trials_early(ray_start, tmp_path):
+    """Bad trials (low asymptote) must be stopped before max_t; the good
+    trial runs to completion.  The good trial goes first so its rung
+    scores set the bar (async successive halving needs recorded
+    competitors before it can cut)."""
+    def trainable(config):
+        import time as _t
+        for step in range(1, 28):
+            session.report({"acc": config["quality"] * step})
+            _t.sleep(0.03)      # let the controller drain incrementally
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search(
+            [1.0, 0.01, 0.02, 0.03])},
+        tune_config=tune.TuneConfig(
+            max_concurrent_trials=1,      # deterministic rung order
+            scheduler=tune.ASHAScheduler(
+                metric="acc", mode="max", max_t=27, grace_period=3,
+                reduction_factor=3)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    by_quality = {r.config["quality"]: r for r in grid}
+    assert by_quality[1.0].status == "TERMINATED"
+    assert len(by_quality[1.0].history) == 27
+    stopped = [r for r in grid if r.status == "EARLY_STOPPED"]
+    assert len(stopped) >= 2
+    for r in stopped:
+        assert len(r.history) < 27      # actually saved work
+
+
+def test_trial_checkpoint_registered(ray_start, tmp_path):
+    def trainable(config):
+        import json
+        from ray_tpu.train import Checkpoint
+        ctx = session.get_context()
+        d = os.path.join(ctx.get_trial_dir(), "ck")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "w.json"), "w") as f:
+            json.dump({"w": config["w"]}, f)
+        session.report({"loss": 1.0 / config["w"]},
+                       checkpoint=Checkpoint(d))
+
+    tuner = tune.Tuner(
+        trainable, param_space={"w": tune.grid_search([1, 2])},
+        run_config=RunConfig(name="ck", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    best = grid.get_best_result("loss", "min")
+    assert best.checkpoint is not None
+    assert os.path.exists(os.path.join(best.checkpoint.path, "w.json"))
+
+
+def test_trainer_on_tune(ray_start, tmp_path):
+    """A TpuTrainer as the trainable: each trial runs trainer.fit() with
+    the variant's train_loop_config (reference: base_trainer.py:693)."""
+    def loop(config):
+        ctx = session.get_context()
+        for step in range(2):
+            session.report({"loss": config["lr"] * (step + 1),
+                            "rank": ctx.get_world_rank()})
+
+    trainer = TpuTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2))
+    tuner = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {
+            "lr": tune.grid_search([0.1, 0.5])}},
+        run_config=RunConfig(name="tot", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 2
+    assert not grid.errors
+    best = grid.get_best_result("loss", "min")
+    assert best.metrics["loss"] == pytest.approx(0.2)
+
+
+def test_trial_error_reported(ray_start, tmp_path):
+    def trainable(config):
+        if config["boom"]:
+            raise RuntimeError("exploded")
+        session.report({"ok": 1})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"boom": tune.grid_search([False, True])},
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    statuses = sorted(r.status for r in grid)
+    assert statuses == ["ERROR", "TERMINATED"]
+    assert any("exploded" in e for e in grid.errors)
