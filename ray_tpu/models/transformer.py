@@ -53,6 +53,13 @@ class TransformerConfig:
     # Fused cross-entropy chunk (tokens per logits block). None => dense
     # [B,S,V] logits path (only sensible for tiny vocab/testing).
     xent_chunk: Optional[int] = 1024
+    # Mixture-of-Experts (expert-parallel over the `ep` mesh axis,
+    # SURVEY §2.3 TPU-build obligation; reference analog: Mixtral-style
+    # expert parallelism, BASELINE config #3).  0 => dense MLP.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def kv_heads(self) -> int:
@@ -118,6 +125,16 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
             "mlp_norm": jnp.ones((d,), pd),
             "w_down": normal(ks[5], (f, d), scale_out),
         }
+        if cfg.moe_experts > 0:
+            E = cfg.moe_experts
+            p["w_router"] = normal(ks[7], (d, E), scale_in)
+            p["w_gate"] = normal(ks[4], (E, d, f), scale_in)
+            p["w_up"] = normal(ks[6], (E, d, f), scale_in)
+            p["w_down"] = normal(ks[5], (E, f, d), scale_out)
+            if cfg.arch == "gpt2":
+                p["attn_norm_b"] = jnp.zeros((d,), pd)
+                p["mlp_norm_b"] = jnp.zeros((d,), pd)
+            return p
         if cfg.arch == "llama":
             p["w_gate"] = normal(ks[4], (d, f), scale_in)
             p["w_up"] = normal(ks[6], (d, f), scale_in)
@@ -156,7 +173,15 @@ def logical_axes(cfg: TransformerConfig) -> Dict[str, Any]:
         "mlp_norm": ("embed",),
         "w_down": ("mlp", "embed"),
     }
-    if cfg.arch == "llama":
+    if cfg.moe_experts > 0:
+        layer["w_router"] = ("embed", None)
+        layer["w_gate"] = ("expert", "embed", "mlp")
+        layer["w_up"] = ("expert", "embed", "mlp")
+        layer["w_down"] = ("expert", "mlp", "embed")
+        if cfg.arch == "gpt2":
+            layer["attn_norm_b"] = ("embed",)
+            layer["mlp_norm_b"] = ("embed",)
+    elif cfg.arch == "llama":
         layer["w_gate"] = ("embed", "mlp")
         layer["w_up"] = ("embed", "mlp")
     else:
@@ -212,6 +237,66 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
+def _moe_block(cfg: TransformerConfig, mesh, h, p):
+    """Expert-parallel MoE FFN (GShard-style dense dispatch).
+
+    h: [B, S, D] (already normed) -> ([B, S, D], aux_loss scalar).
+
+    TPU-first formulation: routing is expressed as dense einsums with a
+    fixed per-expert capacity; the expert dimension is sharded over the
+    `ep` mesh axis (rules: "expert" -> ep), so XLA inserts the
+    all-to-all between the token-sharded and expert-sharded layouts —
+    the collective the reference would run through NCCL alltoall, here
+    derived from sharding constraints and ridden over ICI.
+    """
+    B, S, D = h.shape
+    E, K = cfg.moe_experts, cfg.moe_top_k
+    T = B * S
+    ht = h.reshape(T, D)
+    logits = jnp.einsum("td,de->te", ht.astype(jnp.float32),
+                        p["w_router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)               # [T, E]
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)         # [T, K]
+    # Normalize the selected gates to sum 1 (Mixtral-style).
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    cap = int(math.ceil(T * K * cfg.moe_capacity_factor / E))
+    combine = jnp.zeros((T, E, cap), jnp.float32)
+    occupancy = jnp.zeros((T, E), jnp.float32)
+    for j in range(K):
+        onehot = jax.nn.one_hot(gate_idx[:, j], E)        # [T, E]
+        pos = jnp.cumsum(onehot, axis=0) - onehot + \
+            jnp.sum(occupancy, axis=0, keepdims=True)     # [T, E]
+        pos_t = jnp.sum(pos * onehot, axis=-1)            # [T]
+        keep = (pos_t < cap).astype(jnp.float32)
+        slot = jax.nn.one_hot(pos_t.astype(jnp.int32), cap)
+        combine = combine + (gate_vals[:, j] * keep)[:, None, None] \
+            * onehot[:, :, None] * slot[:, None, :]
+        occupancy = occupancy + onehot * keep[:, None]
+
+    dispatch = (combine > 0).astype(cfg.dtype)            # [T, E, cap]
+    xin = jnp.einsum("tec,td->ecd", dispatch, ht)         # [E, cap, D]
+    xin = constrain(xin, ("expert", None, "embed"), mesh=mesh)
+    wg = p["w_gate"].astype(cfg.dtype)
+    wu = p["w_up"].astype(cfg.dtype)
+    wd = p["w_down"].astype(cfg.dtype)
+    gate = jnp.einsum("ecd,edf->ecf", xin, wg)
+    up = jnp.einsum("ecd,edf->ecf", xin, wu)
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(cfg.dtype) * up
+    act = constrain(act, ("expert", None, "mlp"), mesh=mesh)
+    out_e = jnp.einsum("ecf,efd->ecd", act, wd)           # [E, cap, D]
+    out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), out_e)
+    out = out.reshape(B, S, D)
+
+    # Load-balancing auxiliary loss (Switch/GShard): fraction of tokens
+    # per expert x mean router prob per expert, scaled by E.
+    top1 = jax.nn.one_hot(gate_idx[:, 0], E)
+    frac_tokens = jnp.mean(top1, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return out, aux
+
+
 def _layer_body(cfg: TransformerConfig, mesh, x, p, positions):
     """One decoder layer. x: [B, S, D]."""
     rms = cfg.arch == "llama"
@@ -240,6 +325,10 @@ def _layer_body(cfg: TransformerConfig, mesh, x, p, positions):
     x = x + constrain(attn_out, ("batch", "seq", "embed"), mesh=mesh)
 
     h = _norm(x, p["mlp_norm"], p.get("mlp_norm_b"), cfg.norm_eps, rms)
+    if cfg.moe_experts > 0:
+        moe_out, aux = _moe_block(cfg, mesh, h, p)
+        x = x + constrain(moe_out, ("batch", "seq", "embed"), mesh=mesh)
+        return x, aux
     if cfg.arch == "llama":
         gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"].astype(h.dtype))
         up = jnp.einsum("bsd,df->bsf", h, p["w_up"].astype(h.dtype))
@@ -252,7 +341,8 @@ def _layer_body(cfg: TransformerConfig, mesh, x, p, positions):
     down = jnp.einsum("bsf,fd->bsd", act, p["w_down"].astype(act.dtype))
     if cfg.arch == "gpt2":
         down = down + p["b_down"].astype(down.dtype)
-    return x + constrain(down, ("batch", "seq", "embed"), mesh=mesh)
+    x = x + constrain(down, ("batch", "seq", "embed"), mesh=mesh)
+    return x, jnp.zeros((), jnp.float32)
 
 
 def _remat_policy(cfg: TransformerConfig):
@@ -266,9 +356,11 @@ def _remat_policy(cfg: TransformerConfig):
         jax.checkpoint_policies.save_only_these_names("attn_out"))
 
 
-def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
-                   cfg: TransformerConfig, mesh=None) -> jax.Array:
-    """tokens: [B, S] int32 -> final-norm hidden states [B, S, D]."""
+def forward_hidden_aux(params: Dict[str, Any], tokens: jax.Array,
+                       cfg: TransformerConfig, mesh=None
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """tokens: [B, S] int32 -> (final-norm hidden [B, S, D],
+    summed MoE aux loss — zero for dense models)."""
     B, S = tokens.shape
     x = params["tok_embed"][tokens].astype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -280,14 +372,23 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
     if cfg.remat:
         body = jax.checkpoint(body, policy=_remat_policy(cfg))
 
-    def scan_fn(x, layer_params):
-        return body(x, layer_params), None
+    def scan_fn(carry, layer_params):
+        x, aux = carry
+        x, a = body(x, layer_params)
+        return (x, aux + a), None
 
-    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    (x, aux), _ = jax.lax.scan(
+        scan_fn, (x, jnp.zeros((), jnp.float32)), params["layers"])
 
     rms = cfg.arch == "llama"
     return _norm(x, params["final_norm"], params.get("final_norm_b"),
-                 cfg.norm_eps, rms)
+                 cfg.norm_eps, rms), aux
+
+
+def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
+                   cfg: TransformerConfig, mesh=None) -> jax.Array:
+    """tokens: [B, S] int32 -> final-norm hidden states [B, S, D]."""
+    return forward_hidden_aux(params, tokens, cfg, mesh)[0]
 
 
 def _w_out(params, cfg: TransformerConfig):
@@ -345,17 +446,26 @@ def fused_cross_entropy(x: jax.Array, w_out: jax.Array, targets: jax.Array,
 
 def loss_fn(params, tokens, cfg: TransformerConfig, mesh=None
             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Next-token cross-entropy. tokens: [B, S]; predicts tokens[:,1:]."""
+    """Next-token cross-entropy (+ MoE load-balance aux when MoE).
+    tokens: [B, S]; predicts tokens[:,1:]."""
     targets = tokens[:, 1:]
     if cfg.xent_chunk is None:
-        logits = forward(params, tokens[:, :-1], cfg, mesh)
+        x, aux = forward_hidden_aux(params, tokens[:, :-1], cfg, mesh)
+        logits = jnp.einsum("bsd,dv->bsv", x.astype(cfg.dtype),
+                            _w_out(params, cfg).astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         loss = jnp.mean(nll)
     else:
-        x = forward_hidden(params, tokens[:, :-1], cfg, mesh)
+        x, aux = forward_hidden_aux(params, tokens[:, :-1], cfg, mesh)
         loss = fused_cross_entropy(x, _w_out(params, cfg), targets, cfg)
-    return loss, {"loss": loss, "ppl": jnp.exp(loss)}
+    metrics = {"loss": loss, "ppl": jnp.exp(loss)}
+    if cfg.moe_experts > 0:
+        metrics["moe_aux"] = aux
+        loss = loss + cfg.moe_aux_weight * aux
+        metrics["total_loss"] = loss
+    return loss, metrics
 
 
 def num_params(params) -> int:

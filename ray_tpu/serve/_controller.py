@@ -16,6 +16,16 @@ from typing import Any, Dict, List, Optional
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
+def _differs(old: Any, new: Any) -> bool:
+    """Inequality that tolerates array-valued init args (plain != on a
+    tuple holding numpy/jax arrays raises 'truth value is ambiguous');
+    any comparison failure counts as a change."""
+    try:
+        return bool(old != new)
+    except Exception:
+        return True
+
+
 class ServeController:
     """Named actor owning deployment target state + replica registry."""
 
@@ -43,7 +53,8 @@ class ServeController:
                          init_kwargs=init_kwargs,
                          max_concurrent_queries=max_concurrent_queries,
                          actor_options=dict(actor_options or {}))
-        changed = any(d.get(k) != v for k, v in new_state.items())
+        changed = any(_differs(d.get(k), v)
+                      for k, v in new_state.items())
         d.update(new_state, num_replicas=num_replicas)
         if changed and d["replicas"]:
             old, d["replicas"] = d["replicas"], []

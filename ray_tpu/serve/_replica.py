@@ -28,9 +28,7 @@ class Replica:
         concurrent user methods interleave on the actor's event loop)."""
         self._inflight += 1
         try:
-            target = (self._user if method == "__call__"
-                      and not hasattr(self._user, "__call__")
-                      else getattr(self._user, method))
+            target = getattr(self._user, method)
             out = target(*args, **(kwargs or {}))
             if inspect.isawaitable(out):
                 out = await out

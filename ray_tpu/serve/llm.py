@@ -38,6 +38,8 @@ class _Request:
     _t0: float = 0.0
     slot: int = -1
     error: Optional[Exception] = None
+    # "eos" | "length" (hit max_new) | "cache" (KV cache exhausted)
+    finish_reason: str = ""
 
 
 class ContinuousBatcher:
@@ -77,7 +79,7 @@ class ContinuousBatcher:
 
     # -- public ------------------------------------------------------------
     def submit(self, prompt: List[int], max_new: int = 32) -> _Request:
-        if len(prompt) >= self.prompt_pad:
+        if len(prompt) > self.prompt_pad:
             raise ValueError(f"prompt of {len(prompt)} tokens exceeds "
                              f"prompt budget {self.prompt_pad}")
         req = _Request(prompt=list(prompt), max_new=max_new)
@@ -93,7 +95,8 @@ class ContinuousBatcher:
             raise TimeoutError("generation timed out")
         if req.error is not None:
             raise req.error
-        return {"tokens": req.tokens, "ttft_s": req.ttft_s}
+        return {"tokens": req.tokens, "ttft_s": req.ttft_s,
+                "finish_reason": req.finish_reason}
 
     def stop(self) -> None:
         self._shutdown = True
@@ -162,8 +165,12 @@ class ContinuousBatcher:
 
     def _finished(self, req: _Request, tok: int) -> bool:
         if self.eos_id is not None and tok == self.eos_id:
+            req.finish_reason = "eos"
             return True
-        return len(req.tokens) >= req.max_new
+        if len(req.tokens) >= req.max_new:
+            req.finish_reason = "length"
+            return True
+        return False
 
     def _retire(self, slot: int, req: _Request) -> None:
         self._active[slot] = None
@@ -172,43 +179,65 @@ class ContinuousBatcher:
     def _engine_loop(self) -> None:
         import jax.numpy as jnp
         while not self._shutdown:
-            self._admit()
-            live = [(i, r) for i, r in enumerate(self._active)
-                    if r is not None]
-            if not live:
-                self._work.wait(timeout=0.05)
-                self._work.clear()
-                continue
-            active = np.zeros((self.num_slots,), bool)
-            for i, _ in live:
-                active[i] = True
-            # Chunked decode when every live slot has headroom; single
-            # step otherwise (close to max_len).
-            chunk = self.decode_chunk
-            if any(self._host_len[i] + chunk >= self.max_len - 1
-                   for i, _ in live):
-                chunk = 1
-            if chunk > 1:
-                self.caches, toks = self._dec.decode_steps(
-                    self.params, self.caches, jnp.asarray(active),
-                    self.cfg, chunk)
-                rows = np.asarray(toks)            # [chunk, B]
-            else:
-                self.caches, next_tok = self._dec.decode_step(
-                    self.params, self.caches, jnp.asarray(active),
-                    self.cfg)
-                rows = np.asarray(next_tok)[None]
-            self.steps += rows.shape[0]
-            for row in rows:
-                for i, req in live:
-                    if self._active[i] is not req:
-                        continue                    # retired mid-chunk
-                    tok = int(row[i])
-                    req.tokens.append(tok)
-                    self._host_len[i] += 1
-                    if self._finished(req, tok) or \
-                            self._host_len[i] >= self.max_len - 1:
+            try:
+                self._engine_tick(jnp)
+            except Exception as e:
+                # An engine failure (e.g. device error) must surface to
+                # every waiting caller, not die with the thread and
+                # zombify the replica.
+                for i, req in enumerate(self._active):
+                    if req is not None:
+                        req.error = e
                         self._retire(i, req)
+                while not self._pending.empty():
+                    try:
+                        req = self._pending.get_nowait()
+                    except queue.Empty:
+                        break
+                    req.error = e
+                    req.done.set()
+                time.sleep(0.1)
+
+    def _engine_tick(self, jnp) -> None:
+        self._admit()
+        live = [(i, r) for i, r in enumerate(self._active)
+                if r is not None]
+        if not live:
+            self._work.wait(timeout=0.05)
+            self._work.clear()
+            return
+        active = np.zeros((self.num_slots,), bool)
+        for i, _ in live:
+            active[i] = True
+        # Chunked decode when every live slot has headroom; single
+        # step otherwise (close to max_len).
+        chunk = self.decode_chunk
+        if any(self._host_len[i] + chunk >= self.max_len - 1
+               for i, _ in live):
+            chunk = 1
+        if chunk > 1:
+            self.caches, toks = self._dec.decode_steps(
+                self.params, self.caches, jnp.asarray(active),
+                self.cfg, chunk)
+            rows = np.asarray(toks)            # [chunk, B]
+        else:
+            self.caches, next_tok = self._dec.decode_step(
+                self.params, self.caches, jnp.asarray(active),
+                self.cfg)
+            rows = np.asarray(next_tok)[None]
+        self.steps += rows.shape[0]
+        for row in rows:
+            for i, req in live:
+                if self._active[i] is not req:
+                    continue                    # retired mid-chunk
+                tok = int(row[i])
+                req.tokens.append(tok)
+                self._host_len[i] += 1
+                if self._finished(req, tok):
+                    self._retire(i, req)
+                elif self._host_len[i] >= self.max_len - 1:
+                    req.finish_reason = "cache"
+                    self._retire(i, req)
 
 
 class LLMDeployment:

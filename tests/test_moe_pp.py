@@ -1,0 +1,158 @@
+"""Expert-parallel MoE + pipeline-parallel execution tests.
+
+SURVEY §2.3 TPU-build obligations (the reference orchestrates external
+engines for both; here they are native).  Done-bars from VERDICT #8:
+CPU-mesh loss equivalence vs the dense / non-pp model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import transformer
+from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.parallel import pipeline
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=97, d_model=32, n_heads=4, n_layers=2,
+                d_ff=64, max_seq=64, dtype=jnp.float32, remat=False,
+                xent_chunk=None)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _tokens(cfg, b=8, s=33, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, cfg.vocab_size, size=(b, s)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# MoE
+# ---------------------------------------------------------------------------
+def test_moe_identical_experts_match_dense():
+    """Top-1 MoE whose experts all equal the dense MLP == dense model
+    (gates normalize to 1; ample capacity => no drops)."""
+    dense_cfg = _cfg()
+    moe_cfg = _cfg(moe_experts=4, moe_top_k=1, moe_capacity_factor=8.0)
+    key = jax.random.PRNGKey(0)
+    dense = transformer.init_params(dense_cfg, key)
+    moe = transformer.init_params(moe_cfg, key)
+
+    def tile(dense_w):
+        return jnp.broadcast_to(dense_w[:, None],
+                                (dense_w.shape[0], 4,
+                                 *dense_w.shape[1:])).reshape(
+            dense_w.shape[0], 4, *dense_w.shape[1:])
+
+    for name in ("w_gate", "w_up", "w_down"):
+        moe["layers"][name] = tile(dense["layers"][name])
+    for name in ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"):
+        moe["layers"][name] = dense["layers"][name]
+    for name in ("tok_embed", "final_norm", "lm_head"):
+        moe[name] = dense[name]
+
+    toks = _tokens(dense_cfg)
+    h_dense = transformer.forward_hidden(dense, toks, dense_cfg)
+    h_moe = transformer.forward_hidden(moe, toks, moe_cfg)
+    np.testing.assert_allclose(np.asarray(h_moe), np.asarray(h_dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_loss_equal_across_ep_meshes(cpu_mesh_devices):
+    """Same MoE loss on an ep=4 mesh as on a single device (the
+    all-to-all dispatch must be numerically transparent)."""
+    cfg = _cfg(moe_experts=4, moe_top_k=2)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(1))
+    toks = _tokens(cfg)
+
+    loss_1, _ = jax.jit(
+        lambda p, t: transformer.loss_fn(p, t, cfg))(params, toks)
+
+    mesh = make_mesh(MeshSpec(dp=2, ep=4))
+    from ray_tpu.train.train_step import CompiledTrainStep
+    with mesh:
+        loss_m, _ = jax.jit(
+            lambda p, t: transformer.loss_fn(p, t, cfg, mesh))(
+                params, toks)
+    assert float(loss_1) == pytest.approx(float(loss_m), rel=1e-4)
+
+
+def test_moe_train_step_converges(cpu_mesh_devices):
+    """MoE end-to-end through the sharded train step on an ep mesh."""
+    from ray_tpu.train.train_step import CompiledTrainStep, make_optimizer
+    cfg = _cfg(moe_experts=4, moe_top_k=2, xent_chunk=64)
+    mesh = make_mesh(MeshSpec(dp=2, ep=4))
+    step = CompiledTrainStep(
+        cfg, mesh, optimizer=make_optimizer(learning_rate=1e-2,
+                                            warmup_steps=1,
+                                            total_steps=100))
+    state = step.init_state(seed=0)
+    batch = step.shard_batch(_tokens(cfg))
+    first = None
+    for _ in range(10):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < first * 0.9
+    assert "moe_aux" in metrics
+
+
+# ---------------------------------------------------------------------------
+# Pipeline
+# ---------------------------------------------------------------------------
+def test_pp_forward_matches_nonpp(cpu_mesh_devices):
+    cfg = _cfg(n_layers=4)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(2))
+    toks = _tokens(cfg, b=8, s=32)
+    mesh = make_mesh(MeshSpec(pp=4))
+
+    ref = transformer.forward_hidden(params, toks, cfg)
+    with mesh:
+        out = jax.jit(lambda p, t: pipeline.pipeline_forward_hidden(
+            p, t, cfg, mesh, num_microbatches=4))(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pp_loss_and_grads_match(cpu_mesh_devices):
+    """Autodiff THROUGH the ppermute schedule: pipelined loss + grads
+    equal the plain model's."""
+    cfg = _cfg(n_layers=4, xent_chunk=64)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(3))
+    toks = _tokens(cfg, b=8, s=33)
+    mesh = make_mesh(MeshSpec(pp=4))
+
+    def ref_loss(p):
+        return transformer.loss_fn(p, toks, cfg)[0]
+
+    def pp_loss(p):
+        return pipeline.pipeline_loss_fn(p, toks, cfg, mesh,
+                                         num_microbatches=4)
+
+    l_ref, g_ref = jax.value_and_grad(ref_loss)(params)
+    with mesh:
+        l_pp, g_pp = jax.jit(jax.value_and_grad(pp_loss))(params)
+    assert float(l_pp) == pytest.approx(float(l_ref), rel=1e-4)
+    flat_ref = jax.tree.leaves(g_ref)
+    flat_pp = jax.tree.leaves(g_pp)
+    for a, b in zip(flat_ref, flat_pp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-3, atol=5e-4)
+
+
+def test_pp_with_dp_mesh(cpu_mesh_devices):
+    """pp composes with dp on one mesh (2 stages x 4-way data)."""
+    cfg = _cfg(n_layers=4)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(4))
+    toks = _tokens(cfg, b=8, s=32)
+    mesh = make_mesh(MeshSpec(dp=2, pp=2))
+    ref = transformer.forward_hidden(params, toks, cfg)
+    with mesh:
+        out = jax.jit(lambda p, t: pipeline.pipeline_forward_hidden(
+            p, t, cfg, mesh, num_microbatches=2))(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
