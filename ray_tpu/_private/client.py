@@ -102,6 +102,19 @@ class CoreClient:
             self.add_ref_async(oid)
         return s, embedded
 
+    def _create_in_store(self, oid: ObjectID, size: int):
+        """store.create with spill-on-full: a full store asks the node
+        to spill sealed objects to disk, then retries (reference:
+        plasma create retries + local_object_manager spilling)."""
+        for attempt in range(3):
+            try:
+                return self.store.create(oid, size)
+            except exc.ObjectStoreFullError:
+                if attempt == 2:
+                    raise
+                self.conn.call({"type": "free_store_space",
+                                "bytes": size})
+
     # ------------------------------------------------------------------
     # put / get / wait
     # ------------------------------------------------------------------
@@ -123,7 +136,7 @@ class CoreClient:
                               "loc": "inline", "data": s.to_bytes(),
                               "size": s.total_size, "embedded": embedded})
         else:
-            buf = self.store.create(oid, s.total_size)
+            buf = self._create_in_store(oid, s.total_size)
             s.write_into(buf)
             self.store.seal(oid)
             # Creator pin intentionally NOT released: the directory owns
@@ -146,7 +159,7 @@ class CoreClient:
         out = []
         for oid in oids:
             loc, data, size = reply["results"][oid]
-            out.append(self._materialize(oid, loc, data))
+            out.append(self._materialize_recovering(oid, loc, data))
         return out
 
     def _materialize(self, oid: bytes, loc: str, data: Optional[bytes]) -> Any:
@@ -159,12 +172,39 @@ class CoreClient:
             # Zero-copy deserialize; the read pin auto-releases when the
             # last aliasing array is GC'd (see get_autoreleased_view).
             value = ser.deserialize(mv, copy_buffers=False)
+        elif loc == "spilled":
+            # Spilled to disk: read the file directly (data = path).
+            try:
+                with open(data.decode(), "rb") as f:
+                    blob = f.read()
+            except OSError as e:
+                raise exc.ObjectLostError(
+                    oid.hex(), f"spill file unreadable: {e}") from e
+            value = ser.deserialize(memoryview(blob), copy_buffers=True)
         elif loc == "error":
             err = ser.loads(data)
             raise err
         else:
             raise exc.ObjectLostError(oid.hex(), f"unexpected loc {loc}")
         return value
+
+    def _materialize_recovering(self, oid: bytes, loc: str,
+                                data: Optional[bytes]) -> Any:
+        """_materialize + one lineage-recovery round trip: a READY
+        directory entry whose payload vanished (evicted, spill file
+        lost) asks the node to recompute it from lineage, then re-gets
+        (reference: object_recovery_manager.h:41)."""
+        try:
+            return self._materialize(oid, loc, data)
+        except exc.ObjectLostError:
+            if not self.conn.call({"type": "reconstruct_object",
+                                   "object_id": oid}).get("ok"):
+                raise
+            reply = self._blocking_call(
+                {"type": "get_objects", "object_ids": [oid],
+                 "timeout": None})
+            loc2, data2, _ = reply["results"][oid]
+            return self._materialize(oid, loc2, data2)
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None
@@ -296,7 +336,7 @@ class CoreClient:
             packed.insert(0, ("inline", s.to_bytes()))
         else:
             oid = ObjectID.from_random()
-            buf = self.store.create(oid, s.total_size)
+            buf = self._create_in_store(oid, s.total_size)
             s.write_into(buf)
             self.store.seal(oid)  # creator pin kept — owned by directory
             self.conn.notify({"type": "put_object",
@@ -348,7 +388,7 @@ class CoreClient:
             return (oid, "inline", s.to_bytes(), s.total_size, embedded)
         obj = ObjectID(oid)
         try:
-            buf = self.store.create(obj, s.total_size)
+            buf = self._create_in_store(obj, s.total_size)
         except FileExistsError:
             # A prior attempt of this task died around create/seal
             # (ADVICE r1).  reset_stale frees the leftover (CREATING or
@@ -357,7 +397,7 @@ class CoreClient:
             # payload.  If the creator is somehow still alive (death
             # detection raced), fall back to reusing its sealed copy.
             if self.store.reset_stale(obj):
-                buf = self.store.create(obj, s.total_size)
+                buf = self._create_in_store(obj, s.total_size)
             else:
                 mv = self.store.get(obj)
                 if mv is None:

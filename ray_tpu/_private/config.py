@@ -127,12 +127,19 @@ _D("testing_rpc_failure", str, "",
 _D("testing_asio_delay_us", str, "",
    "Chaos: 'method:min:max' artificial delays in message dispatch "
    "(reference: RAY_testing_asio_delay_us).")
+_D("object_spilling_enabled", bool, True,
+   "Spill sealed objects to disk when the store fills (reference: "
+   "automatic_object_spilling_enabled).")
 _D("object_spilling_threshold", float, 0.8,
    "Fraction of the object store that may fill before spilling begins.")
 _D("object_spilling_dir", str, "",
    "Directory for spilled objects (default: <session_dir>/spill).")
 _D("min_spilling_size", int, 1024 * 1024,
    "Batch spills until at least this many bytes are queued.")
+_D("max_object_reconstructions", int, 3,
+   "Times a lost object may be recomputed from lineage before its "
+   "readers get ObjectLostError (reference: max_task_retries role in "
+   "object_recovery_manager).")
 _D("object_transfer_chunk_bytes", int, 4 * 1024 * 1024,
    "Chunk size for inter-node object transfer (reference: "
    "object_manager_default_chunk_size, 5 MiB).")

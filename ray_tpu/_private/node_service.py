@@ -41,11 +41,12 @@ FAILED = "error"
 
 class ObjectEntry:
     __slots__ = ("state", "loc", "data", "size", "refcount", "waiters",
-                 "producing_task", "deleted", "embedded", "foreign")
+                 "producing_task", "deleted", "embedded", "foreign",
+                 "lineage", "reconstructions", "spill_path", "spilling")
 
     def __init__(self) -> None:
         self.state = PENDING
-        self.loc = None          # "inline" | "shm"
+        self.loc = None          # "inline" | "shm" | "spilled" | "error"
         self.data: Optional[bytes] = None
         self.size = 0
         self.refcount = 1
@@ -57,6 +58,15 @@ class ObjectEntry:
         # (pulled replica / forwarded-task return).  Deleting a foreign
         # copy never removes the global GCS record.
         self.foreign = False
+        # Lineage: the completed producing task's spec, kept so a lost
+        # copy can be recomputed (reference:
+        # core_worker/object_recovery_manager.h:41).  Plain tasks only;
+        # actor results and put()s are not reconstructable (Ray parity).
+        self.lineage: Optional[dict] = None
+        self.reconstructions = 0
+        # Spilling (reference: raylet/local_object_manager.h:110)
+        self.spill_path: Optional[str] = None
+        self.spilling = False
 
 
 class TaskRecord:
@@ -720,9 +730,19 @@ class NodeService:
                 evt.wait(timeout=0.5)
                 le = last_event.get("evt")
                 if le is not None and le.get("kind") == "lost":
-                    blob = ser.dumps(exc.ObjectLostError(
-                        oid.hex(), "all copies lost (node died)"))
+                    last_event.pop("evt", None)
                     with self.lock:
+                        # Lineage first: recompute rather than fail
+                        # (reference: object_recovery_manager ladder).
+                        # KEEP PULLING afterwards: this thread is still
+                        # registered in _pulls_inflight, so exiting here
+                        # would block the re-arm and strand the waiters
+                        # (recomputation may land on a peer node and
+                        # come back through the location directory).
+                        if self._try_reconstruct(oid):
+                            continue
+                        blob = ser.dumps(exc.ObjectLostError(
+                            oid.hex(), "all copies lost (node died)"))
                         self._register_object(oid, "error", blob,
                                               len(blob), state=FAILED,
                                               foreign=True)
@@ -806,6 +826,220 @@ class NodeService:
             self._schedule()
         return True
 
+    # ------------------------------------------------------------------
+    # lineage reconstruction (reference: object_recovery_manager.h:41)
+    # ------------------------------------------------------------------
+    def _try_reconstruct(self, oid: bytes) -> bool:
+        """Recompute a lost object by resubmitting its producing task.
+        Caller holds self.lock.  Returns True if a reconstruction was
+        started (the entry is PENDING again; waiters stay registered)."""
+        e = self.objects.get(oid)
+        if e is None or e.lineage is None:
+            return False
+        if e.reconstructions >= config.max_object_reconstructions:
+            return False
+        spec = dict(e.lineage)
+        # Pass 1 (no mutation yet): every ref arg must be resolvable —
+        # READY locally, recoverable in turn via its own lineage, or
+        # findable cluster-wide (multinode pull).
+        need_recover: List[bytes] = []
+        need_pull: List[bytes] = []
+        for kind, val in spec["args"]:
+            if kind != "ref":
+                continue
+            dep = self.objects.get(val)
+            if dep is not None and dep.state == READY:
+                continue
+            if (dep is not None and dep.lineage is not None
+                    and dep.reconstructions
+                    < config.max_object_reconstructions):
+                need_recover.append(val)
+            elif self.multinode:
+                need_pull.append(val)
+            else:
+                return False
+        # Recursive recovery of lost deps FIRST: if a dep can't come
+        # back, abort before mutating this object's entries (a parent
+        # queued behind an unrecoverable dep would pend forever).
+        for d in need_recover:
+            dep = self.objects[d]
+            dep.state = PENDING
+            if not self._try_reconstruct(d):
+                dep.state = FAILED
+                return False
+        # Pass 2: mutate.
+        spec["task_id"] = os.urandom(16)
+        spec.pop("owner_node", None)
+        spec.pop("spilled", None)
+        rec = TaskRecord(spec)
+        for roid in spec["return_ids"]:
+            re_ = self.objects.get(roid)
+            if re_ is None:
+                re_ = ObjectEntry()
+                re_.refcount = 0
+                self.objects[roid] = re_
+            re_.state = PENDING
+            re_.loc = None
+            re_.data = None
+            re_.producing_task = rec.task_id
+            re_.reconstructions += 1
+        # Re-take the embedded holds this resubmission will release at
+        # completion (the original run already balanced the client's
+        # submit-time increfs — without this, _h_task_done would
+        # double-decref and free live objects).
+        for dep_oid in spec.get("embedded") or []:
+            de = self.objects.get(dep_oid)
+            if de is not None:
+                de.refcount += 1
+        self.tasks[rec.task_id] = rec
+        # Only READY deps are satisfied; FAILED tombstones must be
+        # recomputed, not treated as "ready" the way get() does.
+        rec.deps = {d for d in rec.deps
+                    if not (self.objects.get(d) is not None
+                            and self.objects[d].state == READY)}
+        for d in need_pull:
+            self._ensure_pull(d)
+        self.pending_queue.append(rec)
+        self._schedule()
+        return True
+
+    def _h_reconstruct_object(self, ctx: _ConnCtx, m: dict) -> None:
+        """Client found a READY directory entry whose shm payload is
+        gone: recover via lineage (or confirm a racing restore)."""
+        oid = m["object_id"]
+        with self.lock:
+            e = self.objects.get(oid)
+            if e is None:
+                ctx.reply(m, {"ok": False})
+                return
+            if e.loc == "inline":
+                ctx.reply(m, {"ok": True})
+                return
+            if e.loc == "spilled":
+                if e.spill_path and os.path.exists(e.spill_path):
+                    ctx.reply(m, {"ok": True})
+                    return
+                e.spill_path = None     # spill file destroyed
+            elif e.loc == "shm":
+                try:
+                    present = self._store().contains(_OID(oid))
+                except Exception:
+                    present = False
+                if present:
+                    ctx.reply(m, {"ok": True})
+                    return
+            ok = self._try_reconstruct(oid)
+        ctx.reply(m, {"ok": ok})
+
+    # ------------------------------------------------------------------
+    # object spilling (reference: local_object_manager.h:110 +
+    # _private/external_storage.py:246)
+    # ------------------------------------------------------------------
+    def _spill_dir(self) -> str:
+        d = config.object_spilling_dir or os.path.join(
+            self.session_dir, "spill")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _spill_objects(self, need_bytes: int) -> int:
+        """Move sealed shm objects to disk until `need_bytes` (at least
+        min_spilling_size) are freed.  IO runs OFF the state lock; the
+        store's deferred delete keeps live zero-copy readers valid."""
+        if not config.object_spilling_enabled:
+            return 0
+        try:
+            spill_dir = self._spill_dir()
+        except OSError:
+            return 0    # unwritable spill dir: no flags taken yet
+        target = max(need_bytes, config.min_spilling_size)
+        victims: List[Tuple[bytes, ObjectEntry]] = []
+        with self.lock:
+            acc = 0
+            for oid, e in self.objects.items():
+                if (e.state == READY and e.loc == "shm"
+                        and not e.spilling and e.size > 0):
+                    e.spilling = True
+                    victims.append((oid, e))
+                    acc += e.size
+                    if acc >= target:
+                        break
+        freed = 0
+        store = self._store()
+        for oid, e in victims:
+            path = os.path.join(spill_dir, oid.hex())
+            try:
+                mv = store.get(_OID(oid))
+                if mv is None:      # deleted/evicted since selection
+                    with self.lock:
+                        e.spilling = False
+                    continue
+                try:
+                    with open(path, "wb") as f:
+                        f.write(mv)
+                finally:
+                    store.release(_OID(oid))   # our read pin
+                with self.lock:
+                    if e.deleted:
+                        # _delete_object raced the file write: it
+                        # already released the directory pin + deleted
+                        # the store entry; ours must not double-release.
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                        e.spilling = False
+                        continue
+                    store.release(_OID(oid))   # the directory's pin
+                    store.delete(_OID(oid))
+                    e.loc = "spilled"
+                    e.spill_path = path
+                    # get_objects replies ship (loc, data, size): the
+                    # client reads the spill file directly from `data`.
+                    e.data = path.encode()
+                    e.spilling = False
+                freed += e.size
+            except Exception:
+                with self.lock:
+                    e.spilling = False
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        return freed
+
+    def _h_free_store_space(self, ctx: _ConnCtx, m: dict) -> None:
+        """A client's create hit ObjectStoreFullError: spill to disk."""
+        freed = self._spill_objects(int(m.get("bytes", 0)))
+        ctx.reply(m, {"freed": freed})
+
+    _proactive_spilling = False
+
+    def _maybe_proactive_spill(self) -> None:
+        """Keep usage under the spilling threshold.  The disk IO runs on
+        its own thread: seconds of serial file writes must not stall the
+        monitor loop's deadline firing / dead-process detection."""
+        if self._proactive_spilling:
+            return
+        try:
+            stats = self._store().stats()
+        except Exception:
+            return
+        cap = stats["capacity_bytes"] or 1
+        frac = stats["used_bytes"] / cap
+        if frac <= config.object_spilling_threshold:
+            return
+        over = int((frac - config.object_spilling_threshold) * cap)
+        self._proactive_spilling = True
+
+        def run():
+            try:
+                self._spill_objects(over)
+            finally:
+                self._proactive_spilling = False
+
+        threading.Thread(target=run, daemon=True,
+                         name="rtpu-spill").start()
+
     # -- peer handlers (ride the same _dispatch as local clients) ----------
     def _h_fetch_object_meta(self, ctx: _ConnCtx, m: dict) -> None:
         oid = m["object_id"]
@@ -822,6 +1056,21 @@ class NodeService:
                 ctx.reply(m, {"found": True, "kind": "inline",
                               "data": e.data, "size": e.size})
                 return
+            spill_path = e.spill_path if e.loc == "spilled" else None
+        if spill_path is not None:
+            # Serve the spilled copy from disk (still one fetchable
+            # location as far as peers are concerned).
+            try:
+                size = os.path.getsize(spill_path)
+            except OSError:
+                ctx.reply(m, {"found": False})
+                return
+            out = {"found": True, "kind": "shm", "size": size}
+            if size <= config.object_transfer_chunk_bytes:
+                with open(spill_path, "rb") as f:
+                    out["data"] = f.read()
+            ctx.reply(m, out)
+            return
         mv = self._store().get(_OID(oid))
         if mv is None:
             ctx.reply(m, {"found": False})
@@ -835,7 +1084,20 @@ class NodeService:
             self._store().release(_OID(oid))
 
     def _h_fetch_object_chunk(self, ctx: _ConnCtx, m: dict) -> None:
-        mv = self._store().get(_OID(m["object_id"]))
+        oid = m["object_id"]
+        with self.lock:
+            e = self.objects.get(oid)
+            spill_path = (e.spill_path if e is not None
+                          and e.loc == "spilled" else None)
+        if spill_path is not None:
+            try:
+                with open(spill_path, "rb") as f:
+                    f.seek(m["offset"])
+                    ctx.reply(m, {"data": f.read(m["length"])})
+            except OSError:
+                ctx.reply(m, {"data": None})
+            return
+        mv = self._store().get(_OID(oid))
         if mv is None:
             ctx.reply(m, {"data": None})
             return
@@ -843,7 +1105,7 @@ class NodeService:
             off = m["offset"]
             ctx.reply(m, {"data": bytes(mv[off:off + m["length"]])})
         finally:
-            self._store().release(_OID(m["object_id"]))
+            self._store().release(_OID(oid))
 
     def _complete_forwarded(self, task_id: bytes) -> None:
         """Release the owner-side embedded arg holds of a forwarded task
@@ -858,6 +1120,11 @@ class NodeService:
         if pair is None:
             return
         rec, _ = pair
+        if rec.actor_id is None:
+            for oid in rec.spec["return_ids"]:
+                e = self.objects.get(oid)
+                if e is not None:
+                    e.lineage = rec.spec
         for dep in rec.spec.get("embedded") or []:
             self._decref(dep)
 
@@ -1607,6 +1874,14 @@ class NodeService:
                     embedded=embedded, creator_pid=ctx.pid)
             if rec is not None:
                 rec.state = "done"
+                # Lineage for reconstruction: remember how each return
+                # was produced (plain tasks only — actor calls depend on
+                # actor state and are not replayable).
+                if rec.actor_id is None and not m.get("failed"):
+                    for oid in rec.spec["return_ids"]:
+                        e = self.objects.get(oid)
+                        if e is not None:
+                            e.lineage = rec.spec
                 # Release the holds the submitter took on arg/embedded
                 # refs — EXCEPT for actor creation tasks, whose spec may
                 # be replayed on restart (holds released at permanent
@@ -1694,6 +1969,11 @@ class NodeService:
         e.deleted = True
         e.data = None
         self.objects.pop(oid, None)
+        if e.spill_path:
+            try:
+                os.unlink(e.spill_path)
+            except OSError:
+                pass
         if oid in self._pulls_inflight:
             self._cancelled_pulls.add(oid)
         if self.multinode and e.foreign and e.loc == "shm":
@@ -2348,8 +2628,15 @@ class NodeService:
     # monitor: deadlines, dead procs, idle reaping
     # ------------------------------------------------------------------
     def _monitor_loop(self) -> None:
+        ticks = 0
         while not self._shutdown:
             time.sleep(0.05)
+            ticks += 1
+            if ticks % 20 == 0:       # ~1s: spill-threshold watchdog
+                try:
+                    self._maybe_proactive_spill()
+                except Exception:
+                    pass
             now = time.time()
             fire = []
             with self.lock:

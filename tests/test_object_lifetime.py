@@ -64,11 +64,24 @@ def test_unread_objects_survive_pressure(ray_start):
         assert ray_tpu.get(ref)[0] == i
 
 
-def test_store_full_raises_on_put(ray_start):
-    refs = []
-    with pytest.raises(exc.ObjectStoreFullError):
-        for i in range(80):  # 80 * 8 MiB >> 256 MiB store
-            refs.append(ray_tpu.put(np.zeros(8 << 20, dtype=np.uint8)))
+def test_store_full_raises_without_spilling():
+    """With spilling disabled, overcommitting the store surfaces
+    ObjectStoreFullError (spilling-on by default absorbs it — see
+    tests/test_recovery.py::test_spill_beyond_capacity)."""
+    ray_tpu.init(num_cpus=2,
+                 object_store_memory=64 << 20,
+                 _system_config={"object_spilling_enabled": False})
+    try:
+        refs = []
+        with pytest.raises(exc.ObjectStoreFullError):
+            for i in range(20):  # 20 * 8 MiB >> 64 MiB store
+                refs.append(
+                    ray_tpu.put(np.zeros(8 << 20, dtype=np.uint8)))
+    finally:
+        ray_tpu.shutdown()
+        # _system_config overrides outlive shutdown — undo ours.
+        from ray_tpu._private.config import config
+        config.set("object_spilling_enabled", True)
 
 
 def test_kill_actor_returns_resources(ray_start):
