@@ -389,6 +389,20 @@ class CoreClient:
         obj = ObjectID(oid)
         try:
             buf = self._create_in_store(obj, s.total_size)
+        except exc.ObjectStoreFullError:
+            # Even after spilling READY objects the store can stay full
+            # of OTHER in-flight tasks' sealed-but-unregistered returns
+            # (not yet spillable).  Write this return straight to a
+            # spill file instead of deadlocking the pipeline.
+            if not config.object_spilling_enabled:
+                raise
+            spill_dir = os.path.join(self.session_dir, "spill")
+            os.makedirs(spill_dir, exist_ok=True)
+            path = os.path.join(spill_dir, oid.hex())
+            with open(path, "wb") as f:
+                f.write(s.to_bytes())
+            return (oid, "spilled", path.encode(), s.total_size,
+                    embedded)
         except FileExistsError:
             # A prior attempt of this task died around create/seal
             # (ADVICE r1).  reset_stale frees the leftover (CREATING or

@@ -1717,6 +1717,11 @@ class NodeService:
         entry.loc = loc
         entry.data = data
         entry.size = size
+        if loc == "spilled" and data is not None:
+            # Born spilled (worker wrote the return to disk because the
+            # store was full of in-flight returns): track the file so
+            # delete unlinks it and peers can fetch it.
+            entry.spill_path = data.decode()
         if embedded:
             entry.embedded = list(embedded)
         if self.multinode:

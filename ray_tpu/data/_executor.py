@@ -1,0 +1,346 @@
+"""Streaming operator execution for Dataset.
+
+Analog of the reference's StreamingExecutor
+(data/_internal/execution/streaming_executor.py:48, scheduling loop
+:222): the logical plan is a chain of operators; each operator streams
+block refs from its upstream through a BOUNDED in-flight window
+(concurrency-cap backpressure,
+backpressure_policy/concurrency_cap_backpressure_policy.py) and yields
+completed refs downstream.  Because operators are chained lazily, a
+slow consumer stalls the whole pipeline — no unbounded buffering
+anywhere.  Shuffle-family operators (sort/groupby/random_shuffle/
+repartition) are stage breaks executed as distributed map-partition +
+reduce tasks (data/_internal/planner/exchange), not driver-side
+concats; actor-pool map runs UDFs on a pool of reusable actors
+(execution/operators/actor_pool_map_operator.py).
+"""
+
+from __future__ import annotations
+
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Tuple)
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+MAX_IN_FLIGHT = 8
+
+
+# ---------------------------------------------------------------------------
+# remote kernels
+# ---------------------------------------------------------------------------
+@ray_tpu.remote
+def _apply_stages(block: B.Block, stages: List[Callable]) -> B.Block:
+    for stage in stages:
+        outs = stage(block)
+        block = B.block_concat(outs) if len(outs) != 1 else outs[0]
+    return block
+
+
+@ray_tpu.remote
+def _read_source(read_fn) -> B.Block:
+    return read_fn()
+
+
+@ray_tpu.remote
+def _partition_block(block: B.Block, mode: str, P: int,
+                     key: Optional[str], bounds, seed) -> List[B.Block]:
+    """Map side of every shuffle: split one block into P partitions.
+    mode: 'hash' (groupby) | 'range' (sort) | 'random' (shuffle) |
+    'rr' (repartition round-robin)."""
+    n = B.block_num_rows(block)
+    if n == 0:
+        return [B.block_slice(block, 0, 0) for _ in range(P)]
+    if mode == "hash":
+        col = np.asarray(block[key])
+        if col.dtype.kind in "OUS":
+            # Deterministic across worker processes — Python's hash()
+            # is salted per interpreter and would scatter one key over
+            # several partitions (silently wrong groupbys).
+            import zlib
+            part = np.asarray(
+                [zlib.crc32(str(x).encode()) % P for x in col])
+        else:
+            part = (col.astype(np.int64, copy=False) % P + P) % P
+    elif mode == "range":
+        col = np.asarray(block[key])
+        part = np.searchsorted(bounds, col, side="right")
+    elif mode == "random":
+        part = np.random.RandomState(seed).randint(0, P, size=n)
+    elif mode == "rr":
+        part = np.arange(n) % P
+    else:
+        raise ValueError(mode)
+    return [B.block_take(block, np.nonzero(part == p)[0])
+            for p in range(P)]
+
+
+@ray_tpu.remote
+def _reduce_concat(*parts: B.Block) -> B.Block:
+    return B.block_concat(list(parts))
+
+
+@ray_tpu.remote
+def _reduce_sorted(key: str, descending: bool, *parts: B.Block
+                   ) -> B.Block:
+    whole = B.block_concat(list(parts))
+    if not whole:                 # every shard empty for this partition
+        return {}
+    col = np.asarray(whole[key])
+    order = np.argsort(col, kind="stable")
+    if descending:
+        order = order[::-1]
+    return B.block_take(whole, order)
+
+
+@ray_tpu.remote
+def _reduce_shuffled(seed, *parts: B.Block) -> B.Block:
+    whole = B.block_concat(list(parts))
+    n = B.block_num_rows(whole)
+    if n == 0:
+        return {}
+    return B.block_take(whole, np.random.RandomState(seed).permutation(n))
+
+
+@ray_tpu.remote
+def _reduce_grouped(key: str, aggs: List[Tuple[str, str, str]],
+                    *parts: B.Block) -> B.Block:
+    """Group one hash partition and compute aggregates.
+    aggs: [(agg_name, column, out_name)]; every key lands in exactly
+    one partition, so partition-local grouping is globally correct."""
+    whole = B.block_concat(list(parts))
+    if not whole:                 # every shard empty for this partition
+        return {}
+    col = np.asarray(whole[key])
+    uniq, inv = np.unique(col, return_inverse=True)
+    out: Dict[str, np.ndarray] = {key: uniq}
+    counts = np.bincount(inv, minlength=len(uniq))
+    for agg, c, out_name in aggs:
+        if agg == "count":
+            out[out_name] = counts
+            continue
+        vals = np.asarray(whole[c], dtype=np.float64)
+        if agg == "sum":
+            out[out_name] = np.bincount(inv, weights=vals,
+                                        minlength=len(uniq))
+        elif agg == "mean":
+            s = np.bincount(inv, weights=vals, minlength=len(uniq))
+            out[out_name] = s / np.maximum(counts, 1)
+        elif agg in ("min", "max"):
+            red = (np.minimum if agg == "min" else np.maximum)
+            acc = np.full(len(uniq),
+                          np.inf if agg == "min" else -np.inf)
+            red.at(acc, inv, vals)
+            out[out_name] = acc
+        elif agg == "std":
+            # Sample std (ddof=1), matching Ray Data / pandas defaults;
+            # singleton groups get 0.
+            s = np.bincount(inv, weights=vals, minlength=len(uniq))
+            s2 = np.bincount(inv, weights=vals * vals,
+                             minlength=len(uniq))
+            mean = s / np.maximum(counts, 1)
+            ss = np.maximum(s2 - counts * mean * mean, 0.0)
+            out[out_name] = np.where(
+                counts > 1, np.sqrt(ss / np.maximum(counts - 1, 1)),
+                0.0)
+        else:
+            raise ValueError(f"unknown aggregate {agg!r}")
+    return out
+
+
+@ray_tpu.remote
+def _sample_column(block: B.Block, key: str, k: int) -> np.ndarray:
+    col = np.asarray(block[key])
+    if len(col) <= k:
+        return col
+    ix = np.random.RandomState(0).choice(len(col), size=k,
+                                         replace=False)
+    return col[ix]
+
+
+class _MapActor:
+    """Reusable UDF worker for actor-pool map (reference:
+    actor_pool_map_operator; class UDFs construct once per actor)."""
+
+    def __init__(self, fn_or_cls, fn_args: tuple, fn_kwargs: dict):
+        if isinstance(fn_or_cls, type):
+            self._fn = fn_or_cls(*fn_args, **(fn_kwargs or {}))
+        else:
+            self._fn = fn_or_cls
+
+    def apply(self, block: B.Block, stages_before: List[Callable]
+              ) -> B.Block:
+        for stage in stages_before:
+            outs = stage(block)
+            block = (B.block_concat(outs) if len(outs) != 1
+                     else outs[0])
+        out = self._fn(block)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# operators
+# ---------------------------------------------------------------------------
+def _windowed(upstream: Iterator[ray_tpu.ObjectRef],
+              submit: Callable[[ray_tpu.ObjectRef], ray_tpu.ObjectRef],
+              cap: int, preserve_order: bool
+              ) -> Iterator[ray_tpu.ObjectRef]:
+    """Shared operator inner loop: keep up to `cap` submitted refs in
+    flight (concurrency-cap backpressure), yield in submission order or
+    whichever completes first."""
+    window: List[ray_tpu.ObjectRef] = []
+    up = iter(upstream)
+    exhausted = False
+    while not exhausted or window:
+        while not exhausted and len(window) < cap:
+            try:
+                ref = next(up)
+            except StopIteration:
+                exhausted = True
+                break
+            window.append(submit(ref))
+        if not window:
+            continue
+        if preserve_order:
+            yield window.pop(0)
+        else:
+            ready, _ = ray_tpu.wait(window, num_returns=1,
+                                    timeout=None)
+            window.remove(ready[0])
+            yield ready[0]
+
+
+class FusedMapOp:
+    """Chained per-block transforms fused into ONE task per block
+    (reference: operator fusion, logical/rules/operator_fusion.py)."""
+
+    def __init__(self, stages: Optional[List[Callable]] = None) -> None:
+        self.stages = list(stages or [])
+
+    def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
+               preserve_order: bool = True
+               ) -> Iterator[ray_tpu.ObjectRef]:
+        if not self.stages:
+            yield from upstream
+            return
+        yield from _windowed(
+            upstream,
+            lambda ref: _apply_stages.remote(ref, self.stages),
+            MAX_IN_FLIGHT, preserve_order)
+
+
+class ActorPoolMapOp:
+    """map_batches(compute='actors'): blocks run on a pool of N
+    reusable actors — stateful/expensive UDF setup happens once per
+    actor, not once per block."""
+
+    def __init__(self, fn_or_cls, size: int,
+                 fn_args: tuple = (), fn_kwargs: Optional[dict] = None,
+                 num_cpus: float = 1.0,
+                 stages_before: Optional[List[Callable]] = None) -> None:
+        self.fn_or_cls = fn_or_cls
+        self.size = max(size, 1)
+        self.fn_args = fn_args
+        self.fn_kwargs = fn_kwargs or {}
+        self.num_cpus = num_cpus
+        self.stages_before = list(stages_before or [])
+
+    def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
+               preserve_order: bool = True
+               ) -> Iterator[ray_tpu.ObjectRef]:
+        cls = ray_tpu.remote(_MapActor)
+        actors = [cls.options(num_cpus=self.num_cpus).remote(
+            self.fn_or_cls, self.fn_args, self.fn_kwargs)
+            for _ in range(self.size)]
+        counter = [0]
+
+        def submit(ref):
+            actor = actors[counter[0] % self.size]
+            counter[0] += 1
+            return actor.apply.remote(ref, self.stages_before)
+
+        try:
+            yield from _windowed(upstream, submit, 2 * self.size,
+                                 preserve_order)
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+
+class ShuffleOp:
+    """Stage break: all-to-all exchange as distributed map-partition +
+    reduce tasks (reference: planner/exchange push-based shuffle).
+    kind: 'random' | 'sort' | 'groupby' | 'repartition'."""
+
+    def __init__(self, kind: str, num_partitions: Optional[int] = None,
+                 key: Optional[str] = None, descending: bool = False,
+                 seed: Optional[int] = None,
+                 aggs: Optional[List[Tuple[str, str, str]]] = None
+                 ) -> None:
+        self.kind = kind
+        self.P = num_partitions
+        self.key = key
+        self.descending = descending
+        self.seed = seed          # None => fresh randomness per run
+        self.aggs = aggs or []
+
+    def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
+               preserve_order: bool = True
+               ) -> Iterator[ray_tpu.ObjectRef]:
+        inputs = list(upstream)          # stage break: need all blocks
+        if not inputs:
+            return
+        P = self.P or len(inputs)
+        # seed=None means random per EXECUTION (an unseeded shuffle must
+        # differ between epochs), drawn here so map+reduce agree.
+        import random as _random
+        seed = (self.seed if self.seed is not None
+                else _random.randrange(1 << 31))
+        bounds = None
+        if self.kind == "sort":
+            # Sample-based range boundaries (reference: sort sampling).
+            samples = ray_tpu.get(
+                [_sample_column.remote(r, self.key, 64) for r in inputs])
+            nonempty = [s for s in samples if len(s)]
+            if not nonempty:          # every block empty: one partition
+                bounds = np.array([])
+            else:
+                allv = np.sort(np.concatenate(nonempty))
+                ix = (np.arange(1, P) * len(allv)) // P
+                bounds = allv[np.minimum(ix, len(allv) - 1)]
+        mode = {"random": "random", "sort": "range",
+                "groupby": "hash", "repartition": "rr"}[self.kind]
+        if P == 1:
+            # Single output partition: no exchange needed — every input
+            # block IS that partition's shard.
+            parts = [[ref] for ref in inputs]
+        else:
+            parts = [
+                _partition_block.options(num_returns=P).remote(
+                    ref, mode, P, self.key, bounds,
+                    (seed + i) & 0x7FFFFFFF)
+                for i, ref in enumerate(inputs)
+            ]
+        # Range partitions are ascending; a descending sort must emit
+        # them in reverse so the concatenation is globally ordered.
+        order = (reversed(range(P))
+                 if self.kind == "sort" and self.descending
+                 else range(P))
+        for p in order:
+            shard = [m[p] for m in parts]
+            if self.kind == "sort":
+                yield _reduce_sorted.remote(self.key, self.descending,
+                                            *shard)
+            elif self.kind == "random":
+                yield _reduce_shuffled.remote(
+                    (seed + p) & 0x7FFFFFFF, *shard)
+            elif self.kind == "groupby":
+                yield _reduce_grouped.remote(self.key, self.aggs,
+                                             *shard)
+            else:
+                yield _reduce_concat.remote(*shard)

@@ -24,11 +24,12 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.data import block as B
+from ray_tpu.data import _executor as X
+from ray_tpu.data._executor import _read_source
 
 Batch = Dict[str, np.ndarray]
 
 _DEFAULT_BLOCK_ROWS = 4096
-_MAX_IN_FLIGHT = 8
 
 
 # Block-transform stages are plain functions Block -> List[Block]
@@ -36,28 +37,22 @@ _MAX_IN_FLIGHT = 8
 Stage = Callable[[B.Block], List[B.Block]]
 
 
-@ray_tpu.remote
-def _apply_stages(block: B.Block, stages: List[Stage]) -> B.Block:
-    for stage in stages:
-        outs = stage(block)
-        block = B.block_concat(outs) if len(outs) != 1 else outs[0]
-    return block
-
-
-@ray_tpu.remote
-def _read_source(read_fn) -> B.Block:
-    return read_fn()
-
-
 class Dataset:
-    """Lazy dataset = input block sources + fused transform stages."""
+    """Lazy dataset = input block sources + an operator plan.
 
-    def __init__(self, sources: List[Any], stages: List[Stage],
+    The plan is a chain of streaming operators (fused per-block maps,
+    actor-pool maps, shuffle stage breaks) executed by pull with
+    bounded per-operator in-flight windows — see data/_executor.py."""
+
+    def __init__(self, sources: List[Any], stages_or_plan=None,
                  materialized: Optional[List[ray_tpu.ObjectRef]] = None):
         # sources: list of either ObjectRef (ready block) or zero-arg
         # callables (deferred reads, executed as tasks).
         self._sources = sources
-        self._stages = stages
+        plan = list(stages_or_plan or [])
+        if plan and not hasattr(plan[0], "stream"):
+            plan = [X.FusedMapOp(plan)]      # legacy: raw stage list
+        self._plan: List[Any] = plan
         self._materialized = materialized
 
     # ------------------------------------------------------------------
@@ -134,9 +129,39 @@ class Dataset:
     # transforms (lazy, fused per block)
     # ------------------------------------------------------------------
     def _with_stage(self, stage: Stage) -> "Dataset":
-        return Dataset(self._sources, self._stages + [stage], None)
+        """Append a per-block transform, FUSING into the trailing map
+        operator when possible (one task per block regardless of chain
+        length — reference: operator fusion)."""
+        plan = list(self._plan)
+        if plan and isinstance(plan[-1], X.FusedMapOp):
+            plan[-1] = X.FusedMapOp(plan[-1].stages + [stage])
+        else:
+            plan.append(X.FusedMapOp([stage]))
+        return Dataset(self._sources, plan, self._materialized)
 
-    def map_batches(self, fn: Callable[[Batch], Batch]) -> "Dataset":
+    def _with_op(self, op) -> "Dataset":
+        return Dataset(self._sources, self._plan + [op],
+                       self._materialized)
+
+    def map_batches(self, fn, *, compute: str = "tasks",
+                    concurrency: int = 2, num_cpus: float = 1.0,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None
+                    ) -> "Dataset":
+        """Per-block batch transform.  compute='actors' (or a class fn)
+        runs on a reusable actor pool: stateful/expensive setup happens
+        once per actor (reference: actor_pool_map_operator.py)."""
+        if compute == "actors" or isinstance(fn, type):
+            # Fold any pending fused stages into the actor op so the
+            # pool applies them in the same task.
+            plan = list(self._plan)
+            before: List[Stage] = []
+            if plan and isinstance(plan[-1], X.FusedMapOp):
+                before = plan.pop().stages
+            plan.append(X.ActorPoolMapOp(
+                fn, concurrency, fn_constructor_args,
+                fn_constructor_kwargs, num_cpus, before))
+            return Dataset(self._sources, plan, self._materialized)
         return self._with_stage(lambda b: [fn(b)])
 
     def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]
@@ -170,64 +195,66 @@ class Dataset:
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
-    def _launch(self, src) -> ray_tpu.ObjectRef:
-        """Submit one source block through the fused stage pipeline."""
-        ref = _read_source.remote(src) if callable(src) else src
-        if self._stages:
-            ref = _apply_stages.remote(ref, self._stages)
-        return ref
+    def _source_ref_iter(self) -> Iterator[ray_tpu.ObjectRef]:
+        """Stream source blocks as refs (reads become tasks lazily,
+        bounded by the first operator's window)."""
+        if self._materialized is not None:
+            yield from self._materialized
+            return
+        for src in self._sources:
+            yield _read_source.remote(src) if callable(src) else src
+
+    def _iter_block_refs(self, preserve_order: bool = True
+                         ) -> Iterator[ray_tpu.ObjectRef]:
+        """Chain every operator's streaming window over the sources —
+        the whole pipeline advances by downstream pull (backpressure by
+        laziness + per-op in-flight caps)."""
+        it: Iterator[ray_tpu.ObjectRef] = self._source_ref_iter()
+        for op in self._plan:
+            it = op.stream(it, preserve_order=preserve_order)
+        return it
 
     def _block_refs(self) -> List[ray_tpu.ObjectRef]:
-        """Launch the fused pipeline; returns refs for all output blocks
-        (submission is eager; completion streams)."""
-        if self._materialized is not None:
-            return list(self._materialized)
-        return [self._launch(src) for src in self._sources]
+        return list(self._iter_block_refs())
 
-    def _iter_blocks(self) -> Iterator[B.Block]:
-        """Streaming pull: bounded in-flight tasks, in-order yield."""
-        if self._materialized is not None:
-            for ref in self._materialized:
-                yield ray_tpu.get(ref)
-            return
-        pending: List[ray_tpu.ObjectRef] = []
-        srcs = list(self._sources)
-        while srcs or pending:
-            while srcs and len(pending) < _MAX_IN_FLIGHT:
-                pending.append(self._launch(srcs.pop(0)))
-            yield ray_tpu.get(pending.pop(0))
+    def _iter_blocks(self, preserve_order: bool = True
+                     ) -> Iterator[B.Block]:
+        """Streaming pull.  preserve_order=False yields whichever block
+        finishes first (no head-of-line blocking on a slow block)."""
+        for ref in self._iter_block_refs(preserve_order):
+            yield ray_tpu.get(ref)
 
     def materialize(self) -> "Dataset":
         refs = self._block_refs()
-        ray_tpu.wait(refs, num_returns=len(refs))
+        if refs:
+            ray_tpu.wait(refs, num_returns=len(refs))
         return Dataset([], [], materialized=refs)
 
     # ------------------------------------------------------------------
-    # global ops (stage breaks)
+    # global ops (distributed shuffles — stage breaks in the plan)
     # ------------------------------------------------------------------
-    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        """Full shuffle: materialize, permute rows across blocks."""
-        blocks = list(self._iter_blocks())
-        if not blocks:
-            return Dataset([], [])
-        whole = B.block_concat(blocks)
-        n = B.block_num_rows(whole)
-        rng = np.random.RandomState(seed)
-        perm = rng.permutation(n)
-        shuffled = B.block_take(whole, perm)
-        rows = max(1, (n + len(blocks) - 1) // len(blocks))
-        refs = [ray_tpu.put(B.block_slice(shuffled, i, min(i + rows, n)))
-                for i in range(0, n, rows)]
-        return Dataset([], [], materialized=refs)
+    def random_shuffle(self, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        """Distributed shuffle: map tasks scatter each block into random
+        partitions, reduce tasks permute each partition — no block ever
+        lands in the driver (reference: push-based shuffle exchange)."""
+        return self._with_op(X.ShuffleOp(
+            "random", num_partitions=num_blocks, seed=seed))
+
+    def sort(self, key: str, descending: bool = False,
+             num_blocks: Optional[int] = None) -> "Dataset":
+        """Distributed sample-partition sort (reference:
+        data/grouped_data.py sort exchange)."""
+        return self._with_op(X.ShuffleOp(
+            "sort", num_partitions=num_blocks, key=key,
+            descending=descending))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        blocks = list(self._iter_blocks())
-        whole = B.block_concat(blocks)
-        n = B.block_num_rows(whole)
-        rows = max(1, (n + num_blocks - 1) // num_blocks)
-        refs = [ray_tpu.put(B.block_slice(whole, i, min(i + rows, n)))
-                for i in range(0, n, rows)]
-        return Dataset([], [], materialized=refs)
+        return self._with_op(X.ShuffleOp("repartition",
+                                         num_partitions=num_blocks))
 
     def split(self, n: int) -> List["Dataset"]:
         """Split into n sub-datasets by block round-robin (reference:
@@ -246,7 +273,7 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         out: List[ray_tpu.ObjectRef] = []
         taken = 0
-        for ref in self._block_refs():
+        for ref in self._iter_block_refs():   # lazy: stop pulling early
             blk = ray_tpu.get(ref)
             rows = B.block_num_rows(blk)
             if taken + rows > n:
@@ -341,11 +368,53 @@ class Dataset:
     def num_blocks(self) -> int:
         if self._materialized is not None:
             return len(self._materialized)
-        return len(self._sources)
+        n = len(self._sources)
+        for op in self._plan:
+            if isinstance(op, X.ShuffleOp):
+                n = op.P or n
+        return n
 
     def __repr__(self) -> str:
         return (f"Dataset(blocks={self.num_blocks()}, "
-                f"stages={len(self._stages)})")
+                f"ops={len(self._plan)})")
+
+
+class GroupedData:
+    """ds.groupby(key) -> aggregations as a distributed hash-shuffle
+    (reference: data/grouped_data.py; aggregate fns data/aggregate.py).
+    Each key hashes to exactly one partition, so the reduce side groups
+    partition-locally with global correctness."""
+
+    def __init__(self, ds: Dataset, key: str) -> None:
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, aggs: List[Tuple[str, str, str]]) -> Dataset:
+        return self._ds._with_op(X.ShuffleOp(
+            "groupby", key=self._key, aggs=aggs))
+
+    def count(self) -> Dataset:
+        return self._agg([("count", self._key, "count()")])
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg([("sum", col, f"sum({col})")])
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg([("mean", col, f"mean({col})")])
+
+    def min(self, col: str) -> Dataset:
+        return self._agg([("min", col, f"min({col})")])
+
+    def max(self, col: str) -> Dataset:
+        return self._agg([("max", col, f"max({col})")])
+
+    def std(self, col: str) -> Dataset:
+        return self._agg([("std", col, f"std({col})")])
+
+    def aggregate(self, **aggs: Tuple[str, str]) -> Dataset:
+        """aggregate(out_name=("sum", "col"), ...)"""
+        return self._agg([(agg, col, out)
+                          for out, (agg, col) in aggs.items()])
 
 
 def _expand_paths(paths: Union[str, List[str]],

@@ -1,0 +1,161 @@
+"""Data executor v2: distributed shuffles (groupby/sort/random),
+actor-pool map, out-of-order streaming, bigger-than-store shuffle.
+
+Reference analogs: streaming_executor.py:48 (+ scheduling loop :222),
+actor_pool_map_operator.py, grouped_data.py/aggregate.py, push-based
+shuffle exchange.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+
+def test_groupby_aggregates(ray_start):
+    n = 1000
+    keys = np.arange(n) % 7
+    vals = np.arange(n, dtype=np.float64)
+    ds = rd.from_numpy({"k": keys, "v": vals}, block_rows=128)
+    out = ds.groupby("k").sum("v")
+    rows = {int(r["k"]): r["sum(v)"] for r in out.iter_rows()}
+    for k in range(7):
+        assert rows[k] == vals[keys == k].sum()
+
+    mean = ds.groupby("k").mean("v")
+    rows = {int(r["k"]): r["mean(v)"] for r in mean.iter_rows()}
+    for k in range(7):
+        assert rows[k] == pytest.approx(vals[keys == k].mean())
+
+    cnt = ds.groupby("k").count()
+    rows = {int(r["k"]): int(r["count()"]) for r in cnt.iter_rows()}
+    assert all(rows[k] == (keys == k).sum() for k in range(7))
+
+
+def test_groupby_multi_aggregate(ray_start):
+    ds = rd.from_numpy({"k": np.array([0, 0, 1, 1, 1]),
+                        "v": np.array([1.0, 3.0, 2.0, 4.0, 6.0])})
+    out = ds.groupby("k").aggregate(lo=("min", "v"), hi=("max", "v"))
+    rows = {int(r["k"]): (r["lo"], r["hi"]) for r in out.iter_rows()}
+    assert rows[0] == (1.0, 3.0)
+    assert rows[1] == (2.0, 6.0)
+
+
+def test_groupby_string_keys(ray_start):
+    """String keys must hash deterministically ACROSS worker processes
+    (Python's salted hash() would split one key over partitions)."""
+    n = 1000
+    keys = np.asarray([f"key{i % 4}" for i in range(n)])
+    ds = rd.from_numpy({"k": keys,
+                        "v": np.ones(n)}, block_rows=100)
+    out = list(ds.groupby("k").count().iter_rows())
+    assert len(out) == 4, out
+    assert {int(r["count()"]) for r in out} == {250}
+
+
+def test_unseeded_shuffle_varies(ray_start):
+    ds = rd.range(500, block_rows=100)
+    a = np.concatenate([b["id"] for b in ds.random_shuffle()._iter_blocks()])
+    b = np.concatenate([b["id"] for b in ds.random_shuffle()._iter_blocks()])
+    assert not np.array_equal(a, b)
+
+
+def test_sort_all_empty_blocks(ray_start):
+    ds = rd.range(100, block_rows=25).filter(lambda r: False).sort("id")
+    assert ds.count() == 0
+
+
+def test_sort_distributed(ray_start):
+    rng = np.random.RandomState(0)
+    vals = rng.permutation(2000).astype(np.int64)
+    ds = rd.from_numpy({"x": vals}, block_rows=256).sort("x")
+    out = np.concatenate([b["x"] for b in ds._iter_blocks()])
+    np.testing.assert_array_equal(out, np.sort(vals))
+
+    desc = rd.from_numpy({"x": vals}, block_rows=256).sort(
+        "x", descending=True)
+    out = np.concatenate([b["x"] for b in desc._iter_blocks()])
+    np.testing.assert_array_equal(out, np.sort(vals)[::-1])
+
+
+def test_random_shuffle_distributed(ray_start):
+    ds = rd.range(2000, block_rows=250).random_shuffle(seed=7)
+    out = np.concatenate([b["id"] for b in ds._iter_blocks()])
+    assert len(out) == 2000
+    np.testing.assert_array_equal(np.sort(out), np.arange(2000))
+    assert not np.array_equal(out, np.arange(2000))   # actually moved
+
+
+def test_actor_pool_map_batches(ray_start):
+    """Class UDF on an actor pool: constructed once per actor, reused
+    across blocks."""
+
+    class AddPid:
+        def __init__(self, offset):
+            self.offset = offset
+            self.pid = os.getpid()
+
+        def __call__(self, batch):
+            out = dict(batch)
+            out["y"] = batch["id"] + self.offset
+            out["pid"] = np.full(len(batch["id"]), self.pid)
+            return out
+
+    ds = rd.range(1000, block_rows=100).map_batches(
+        AddPid, compute="actors", concurrency=2,
+        fn_constructor_args=(5,))
+    blocks = list(ds._iter_blocks())
+    assert sum(len(b["id"]) for b in blocks) == 1000
+    for b in blocks:
+        np.testing.assert_array_equal(b["y"], b["id"] + 5)
+    pids = {int(p) for b in blocks for p in np.unique(b["pid"])}
+    assert 1 <= len(pids) <= 2          # pool of 2 actors, reused
+
+
+def test_out_of_order_iteration(ray_start):
+    """A slow first block must not head-of-line-block the rest when
+    preserve_order=False."""
+    def slow_first(batch):
+        if int(batch["id"][0]) == 0:
+            time.sleep(1.5)
+        return batch
+
+    ds = rd.range(800, block_rows=100).map_batches(slow_first)
+    first = next(iter(ds._iter_blocks(preserve_order=False)))
+    assert int(first["id"][0]) != 0     # a fast block arrived first
+
+
+def test_shuffle_larger_than_store():
+    """Shuffle a dataset ~2x the object store: distributed exchange +
+    spilling keep it working."""
+    ray_tpu.init(num_cpus=4, object_store_memory=16 << 20)
+    try:
+        n = 4_000_000                    # 32MB of float64
+        ds = rd.from_numpy(
+            {"v": np.arange(n, dtype=np.float64)},
+            block_rows=500_000).random_shuffle(seed=3)
+        total = 0.0
+        count = 0
+        for b in ds._iter_blocks():
+            total += float(b["v"].sum())
+            count += len(b["v"])
+        assert count == n
+        assert total == pytest.approx(n * (n - 1) / 2)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_fusion_still_one_task(ray_start):
+    """Chained maps fuse into a single FusedMapOp."""
+    ds = (rd.range(100, block_rows=50)
+          .map_batches(lambda b: {"id": b["id"] + 1})
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .filter(lambda r: r["id"] % 2 == 0))
+    assert len(ds._plan) == 1
+    out = np.concatenate([b["id"] for b in ds._iter_blocks()])
+    np.testing.assert_array_equal(np.sort(out),
+                                  np.sort((np.arange(100) + 1) * 2))
