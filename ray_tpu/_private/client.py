@@ -396,7 +396,8 @@ class CoreClient:
             # spill file instead of deadlocking the pipeline.
             if not config.object_spilling_enabled:
                 raise
-            spill_dir = os.path.join(self.session_dir, "spill")
+            spill_dir = (config.object_spilling_dir
+                         or os.path.join(self.session_dir, "spill"))
             os.makedirs(spill_dir, exist_ok=True)
             path = os.path.join(spill_dir, oid.hex())
             with open(path, "wb") as f:

@@ -210,6 +210,12 @@ class Dataset:
         the whole pipeline advances by downstream pull (backpressure by
         laziness + per-op in-flight caps)."""
         it: Iterator[ray_tpu.ObjectRef] = self._source_ref_iter()
+        if not self._plan:
+            # No operator window pulls ahead of the consumer — wrap the
+            # sources in a pass-through window so read tasks stay
+            # submitted MAX_IN_FLIGHT deep instead of one at a time.
+            return X._windowed(it, lambda ref: ref, X.MAX_IN_FLIGHT,
+                               preserve_order)
         for op in self._plan:
             it = op.stream(it, preserve_order=preserve_order)
         return it
@@ -362,7 +368,10 @@ class Dataset:
 
     def schema(self) -> Dict[str, str]:
         for b in self._iter_blocks():
-            return {k: str(v.dtype) for k, v in b.items()}
+            # Skip empty blocks: shuffle reducers legitimately emit {}
+            # for partitions no rows hashed into.
+            if b:
+                return {k: str(v.dtype) for k, v in b.items()}
         return {}
 
     def num_blocks(self) -> int:

@@ -27,7 +27,7 @@ import jax
 import jax.ad_checkpoint
 import jax.numpy as jnp
 
-from ray_tpu.ops.attention import attention
+from ray_tpu.ops.attention import attention, attention_with_lse
 from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.parallel.sharding import constrain
 
@@ -50,6 +50,8 @@ class TransformerConfig:
     remat: bool = True
     remat_policy: str = "dots"            # dots | nothing
     attn_impl: str = "auto"               # auto | flash | reference
+    attn_block_q: int = 512               # flash kernel tile sizes
+    attn_block_k: int = 512
     # Fused cross-entropy chunk (tokens per logits block). None => dense
     # [B,S,V] logits path (only sensible for tiny vocab/testing).
     xent_chunk: Optional[int] = 1024
@@ -315,11 +317,16 @@ def _layer_body(cfg: TransformerConfig, mesh, x, p, positions):
     v = constrain(v, ("batch", "kv_heads", "seq", None), mesh=mesh)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         o = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+        o = jax.ad_checkpoint.checkpoint_name(o, "attn_out")
     else:
-        o = attention(q, k, v, causal=True, impl=cfg.attn_impl)
-    # Named for the remat policy: saving the attention output avoids
-    # re-running the flash kernel in the backward pass.
-    o = jax.ad_checkpoint.checkpoint_name(o, "attn_out")
+        # Both outputs arrive tagged remat-saveable ("attn_out"/
+        # "attn_lse") by the dispatcher/custom-vjp, so the dots policy
+        # never re-runs the forward kernel in the backward pass; lse is
+        # consumed only as a bwd residual.
+        o, _ = attention_with_lse(q, k, v, causal=True,
+                                  impl=cfg.attn_impl,
+                                  block_q=cfg.attn_block_q,
+                                  block_k=cfg.attn_block_k)
     o = o.transpose(0, 2, 1, 3)   # [B, S, H, Dh]
     attn_out = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(o.dtype))
     x = x + constrain(attn_out, ("batch", "seq", "embed"), mesh=mesh)
@@ -353,7 +360,8 @@ def _remat_policy(cfg: TransformerConfig):
     # pass recomputes only cheap elementwise/norm work.
     return jax.checkpoint_policies.save_from_both_policies(
         jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        jax.checkpoint_policies.save_only_these_names("attn_out"))
+        jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse"))
 
 
 def forward_hidden_aux(params: Dict[str, Any], tokens: jax.Array,

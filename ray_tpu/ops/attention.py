@@ -27,9 +27,14 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512-tile blocks: measured on v5e (B=8,H=12,S=1024,D=64, causal), the
+# 12-layer fwd+bwd attention stack drops from 111ms (128x128 grid of 6144
+# tiny programs, overhead-bound) to 52ms — identical to the stock
+# jax.experimental pallas flash kernel at the same block sizes.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -373,6 +378,13 @@ def _flash_flat(q, k, v, scale, causal, block_q, block_k):
 def _flash_flat_fwd(q, k, v, scale, causal, block_q, block_k):
     group = q.shape[0] // k.shape[0]
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, group)
+    # Tag the kernel outputs as remat-saveable where the residuals are
+    # actually built: under jax.checkpoint with a save_only_these_names
+    # policy, tagging AFTER the custom-vjp call would save a copy while
+    # the bwd still consumed the untagged residual — re-running the whole
+    # forward kernel in the backward pass.
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, o, lse)
 
 
@@ -386,19 +398,25 @@ def _flash_flat_bwd(scale, causal, block_q, block_k, res, do):
 _flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_flat_with_lse(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_flat_with_lse(q, k, v, scale, causal, block_q, block_k, tag):
     group = q.shape[0] // k.shape[0]
     return _flash_fwd(q, k, v, scale, causal, block_q, block_k, group)
 
 
-def _flash_wl_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_wl_fwd(q, k, v, scale, causal, block_q, block_k, tag):
     group = q.shape[0] // k.shape[0]
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, group)
+    if tag:
+        # `tag=False` for per-step ring-attention partials: tagging those
+        # would make the dots remat policy save every ring step's partial
+        # o/lse (xR memory) instead of only the final combined output.
+        o = checkpoint_name(o, "attn_out")
+        lse = checkpoint_name(lse, "attn_lse")
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_wl_bwd(scale, causal, block_q, block_k, res, cts):
+def _flash_wl_bwd(scale, causal, block_q, block_k, tag, res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
     group = q.shape[0] // k.shape[0]
@@ -409,13 +427,25 @@ def _flash_wl_bwd(scale, causal, block_q, block_k, res, cts):
 _flash_flat_with_lse.defvjp(_flash_wl_fwd, _flash_wl_bwd)
 
 
+def _pick_block(s: int, b: int) -> int:
+    """Largest block <= b that divides s (halving); s<=128 is one block."""
+    b0, b = b, min(b, s)
+    while s % b and b > 128:
+        b //= 2
+    if s % b:
+        raise ValueError(
+            f"flash_attention block size {b0} is incompatible with seq "
+            f"length {s}: no halving of it >= 128 divides the length")
+    return b
+
+
 def _validate_flash(q, k, causal, block_q, block_k):
     b, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
-    if sq % block_q or sk % block_k:
+    if (sq > 128 and sq % 128) or (sk > 128 and sk % 128):
         raise ValueError(
-            f"flash_attention requires seq lengths divisible by block "
-            f"sizes: sq={sq} %% {block_q}, sk={sk} %% {block_k} "
+            f"flash_attention requires seq lengths divisible by the "
+            f"128-lane tile: sq={sq}, sk={sk} "
             f"(pad inputs or use attention_reference)")
     if d % 64:
         raise ValueError(f"head_dim {d} must be a multiple of 64")
@@ -435,9 +465,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
     _validate_flash(q, k, causal, block_q, block_k)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
     qf = q.reshape(b * hq, sq, d)
     kf = k.reshape(b * hkv, sk, d)
     vf = v.reshape(b * hkv, sk, d)
@@ -448,20 +478,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def flash_attention_with_lse(q, k, v, causal: bool = True,
                              scale: Optional[float] = None,
                              block_q: int = DEFAULT_BLOCK_Q,
-                             block_k: int = DEFAULT_BLOCK_K):
+                             block_k: int = DEFAULT_BLOCK_K,
+                             save_residuals: bool = True):
     """Like flash_attention but also returns logsumexp [B,Hq,Sq] —
-    differentiable in both outputs (the ring-attention building block)."""
+    differentiable in both outputs (the ring-attention building block).
+    `save_residuals=False` skips remat-saveable tagging (ring partials)."""
     b, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
     _validate_flash(q, k, causal, block_q, block_k)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
     qf = q.reshape(b * hq, sq, d)
     kf = k.reshape(b * hkv, sk, d)
     vf = v.reshape(b * hkv, sk, d)
     o, lse = _flash_flat_with_lse(qf, kf, vf, scale, causal,
-                                  block_q, block_k)
+                                  block_q, block_k, save_residuals)
     return (o.reshape(b, hq, sq, d),
             lse.reshape(b, hq, sq))
 
@@ -489,19 +521,55 @@ def attention_reference_with_lse(q, k, v, causal: bool = True,
             lse.reshape(b, hq, sq))
 
 
+def _flash_ok(q, k, causal: bool) -> bool:
+    sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
+    return (sq % 128 == 0 and sk % 128 == 0 and d % 64 == 0
+            and q.shape[1] % k.shape[1] == 0
+            and not (causal and sq > sk))
+
+
 def attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
-              impl: str = "auto") -> jax.Array:
+              impl: str = "auto",
+              block_q: int = DEFAULT_BLOCK_Q,
+              block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
     """Dispatcher: pallas flash on TPU when shapes tile cleanly, else the
     reference path (CPU meshes, ragged shapes, causal sq > sk)."""
     if impl == "reference":
         return attention_reference(q, k, v, causal, scale)
     if impl == "flash":
-        return flash_attention(q, k, v, causal, scale)
-    sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
-    tileable = (sq % 128 == 0 and sk % 128 == 0 and d % 64 == 0
-                and q.shape[1] % k.shape[1] == 0
-                and not (causal and sq > sk))
+        return flash_attention(q, k, v, causal, scale, block_q, block_k)
     on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
-    if tileable and on_tpu:
-        return flash_attention(q, k, v, causal, scale)
+    if _flash_ok(q, k, causal) and on_tpu:
+        return flash_attention(q, k, v, causal, scale, block_q, block_k)
     return attention_reference(q, k, v, causal, scale)
+
+
+def _tag_saveable(o, lse):
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
+    return o, lse
+
+
+def attention_with_lse(q, k, v, causal: bool = True,
+                       scale: Optional[float] = None, impl: str = "auto",
+                       block_q: int = DEFAULT_BLOCK_Q,
+                       block_k: int = DEFAULT_BLOCK_K):
+    """(o, lse) dispatcher; outputs are tagged remat-saveable.
+
+    The flash path tags INSIDE the custom-vjp fwd rule: under a
+    save_only_these_names policy, tagging after the call would save a
+    copy while the bwd still consumed the untagged residual — re-running
+    the whole forward kernel in the backward pass just to regenerate lse.
+    The reference path has no custom vjp, so tagging here suffices."""
+    if impl == "reference":
+        return _tag_saveable(*attention_reference_with_lse(
+            q, k, v, causal, scale))
+    if impl == "flash":
+        return flash_attention_with_lse(q, k, v, causal, scale,
+                                        block_q, block_k)
+    on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
+    if _flash_ok(q, k, causal) and on_tpu:
+        return flash_attention_with_lse(q, k, v, causal, scale,
+                                        block_q, block_k)
+    return _tag_saveable(*attention_reference_with_lse(
+        q, k, v, causal, scale))

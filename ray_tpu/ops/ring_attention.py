@@ -43,8 +43,11 @@ def _partial_attn(q, k, v, scale, causal):
     tileable = (sq % 128 == 0 and sk % 128 == 0 and d % 64 == 0
                 and q.shape[1] % k.shape[1] == 0)
     if tileable and jax.default_backend() == "tpu":
+        # save_residuals=False: per-step partials must NOT be tagged
+        # remat-saveable — the dots policy would save all R ring steps'
+        # partial o/lse instead of only the final combined output.
         return flash_attention_with_lse(q, k, v, causal=causal,
-                                        scale=scale)
+                                        scale=scale, save_residuals=False)
     return attention_reference_with_lse(q, k, v, causal=causal,
                                         scale=scale)
 
