@@ -93,6 +93,12 @@ def init(num_cpus: Optional[float] = None,
                                "(pass ignore_reinit_error=True to allow)")
         if _system_config:
             config.update(_system_config)
+        if gcs_address is None and os.environ.get("RAY_TPU_GCS_ADDRESS"):
+            # Injected by job submission (reference: RAY_ADDRESS) so a
+            # plain init() inside a job script joins the cluster.
+            host, _, port = os.environ["RAY_TPU_GCS_ADDRESS"].rpartition(
+                ":")
+            gcs_address = (host or "127.0.0.1", int(port))
         from ray_tpu._private.client import CoreClient, set_global_client
         from ray_tpu._private.node_service import NodeService
 

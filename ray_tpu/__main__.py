@@ -1,0 +1,7 @@
+"""`python -m ray_tpu` → the cluster CLI (scripts/cli.py)."""
+
+import sys
+
+from ray_tpu.scripts.cli import main
+
+sys.exit(main())

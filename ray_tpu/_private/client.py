@@ -276,9 +276,11 @@ class CoreClient:
                     is_actor_creation: bool = False,
                     actor_spec_extra: Optional[dict] = None,
                     pg: Optional[dict] = None,
+                    runtime_env: Optional[dict] = None,
                     ) -> List[ObjectRef]:
         spec_args, embedded = self._pack_args(args, kwargs)
         return_ids = [os.urandom(16) for _ in range(num_returns)]
+        embedded = self._pin_runtime_env_archives(runtime_env, embedded)
         spec = {
             "task_id": os.urandom(16),
             "name": name,
@@ -294,6 +296,7 @@ class CoreClient:
             "is_actor_creation": is_actor_creation,
             "owner": self.client_id,
             "pg": pg,
+            "runtime_env": runtime_env,
         }
         if actor_spec_extra:
             spec.update(actor_spec_extra)
@@ -303,6 +306,22 @@ class CoreClient:
         # submission pipeline (reference: lease reuse + PushTask stream).
         self.conn.notify({"type": "submit_task", "spec": spec})
         return [ObjectRef(oid, owned=True) for oid in return_ids]
+
+    def _pin_runtime_env_archives(self, runtime_env: Optional[dict],
+                                  embedded: List[bytes]) -> List[bytes]:
+        """Archive refs must survive until the task runs: count them
+        like embedded arg refs (+1 here, released by the node when the
+        task completes) so the store keeps them pinned."""
+        if not runtime_env:
+            return embedded
+        archives = ([runtime_env["working_dir"]]
+                    if runtime_env.get("working_dir") else [])
+        archives += runtime_env.get("py_modules") or []
+        embedded = list(embedded)
+        for a in archives:
+            self.add_ref_async(a["ref"])
+            embedded.append(a["ref"])
+        return embedded
 
     def _pack_args(self, args: tuple, kwargs: dict
                    ) -> Tuple[List[tuple], List[bytes]]:
@@ -430,9 +449,12 @@ class CoreClient:
                      max_restarts: int, max_concurrency: int,
                      name: Optional[str], namespace: str,
                      detached: bool,
-                     pg: Optional[dict] = None) -> Tuple[bytes, ObjectRef]:
+                     pg: Optional[dict] = None,
+                     runtime_env: Optional[dict] = None
+                     ) -> Tuple[bytes, ObjectRef]:
         actor_id = os.urandom(16)
         spec_args, embedded = self._pack_args(args, kwargs)
+        embedded = self._pin_runtime_env_archives(runtime_env, embedded)
         creation_task = {
             "task_id": os.urandom(16),
             "name": f"{name_repr}.__init__",
@@ -449,10 +471,12 @@ class CoreClient:
             "max_concurrency": max_concurrency,
             "owner": self.client_id,
             "pg": pg,
+            "runtime_env": runtime_env,
         }
         spec = {
             "actor_id": actor_id,
             "name": name,
+            "class_name": name_repr,
             "namespace": namespace,
             "detached": detached,
             "max_restarts": max_restarts,
@@ -508,6 +532,20 @@ class CoreClient:
     def kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
         return self.conn.call({"type": "kv_keys", "ns": ns,
                                "prefix": prefix})["keys"]
+
+    def node_info(self) -> dict:
+        return self.conn.call({"type": "node_info"})
+
+    # -- observability -----------------------------------------------------
+    def state_dump(self, cluster: bool = True) -> dict:
+        return self.conn.call({"type": "state_dump",
+                               "cluster": cluster}, timeout=30.0)["dump"]
+
+    def metrics_push(self, series: List[dict]) -> None:
+        self.conn.call({"type": "metrics_push", "series": series})
+
+    def metrics_scrape(self) -> List[dict]:
+        return self.conn.call({"type": "metrics_scrape"})["series"]
 
     # -- placement groups --------------------------------------------------
     def create_pg(self, pg_id: bytes, bundles: List[Dict[str, float]],

@@ -25,7 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 class NodeInfo:
     __slots__ = ("node_id", "host", "control_port", "transfer_port",
                  "resources_total", "resources_avail", "last_heartbeat",
-                 "state")
+                 "state", "load")
 
     def __init__(self, node_id: bytes, host: str, control_port: int,
                  transfer_port: int, resources_total: Dict[str, float]
@@ -38,6 +38,10 @@ class NodeInfo:
         self.resources_avail = dict(resources_total)
         self.last_heartbeat = time.time()
         self.state = "alive"        # alive | dead
+        # Scheduling load from the node's last heartbeat (autoscaler
+        # demand signal): {"pending": N, "shapes": [resource dicts],
+        # "idle_since": ts | None}.
+        self.load: Dict[str, object] = {}
 
     def to_dict(self) -> dict:
         return {"node_id": self.node_id, "host": self.host,
@@ -45,7 +49,7 @@ class NodeInfo:
                 "transfer_port": self.transfer_port,
                 "resources_total": dict(self.resources_total),
                 "resources_avail": dict(self.resources_avail),
-                "state": self.state}
+                "state": self.state, "load": dict(self.load)}
 
 
 class GlobalControlState:
@@ -134,13 +138,16 @@ class GlobalControlState:
         self._publish_node("node_added", self._nodes[node_id].to_dict())
 
     def heartbeat(self, node_id: bytes,
-                  resources_avail: Dict[str, float]) -> None:
+                  resources_avail: Dict[str, float],
+                  load: Optional[dict] = None) -> None:
         with self._lock:
             n = self._nodes.get(node_id)
             if n is None or n.state == "dead":
                 return
             n.last_heartbeat = time.time()
             n.resources_avail = dict(resources_avail)
+            if load is not None:
+                n.load = dict(load)
 
     def mark_node_dead(self, node_id: bytes, reason: str = "") -> None:
         lost_notifies = []

@@ -151,11 +151,16 @@ class GcsServer:
         conn.reply(m, {"ok": True})
 
     def _h_heartbeat(self, conn, m):
-        self.state.heartbeat(m["node_id"], m["resources_avail"])
+        self.state.heartbeat(m["node_id"], m["resources_avail"],
+                             m.get("load"))
 
     def _h_nodes(self, conn, m):
         conn.reply(m, {"nodes": self.state.nodes(
             alive_only=m.get("alive_only", True))})
+
+    def _h_mark_node_dead(self, conn, m):
+        self.state.mark_node_dead(m["node_id"], m.get("reason", ""))
+        conn.reply(m, {"ok": True})
 
     def _h_kv_put(self, conn, m):
         conn.reply(m, {"ok": self.state.kv_put(
@@ -293,13 +298,18 @@ class GcsClient:
                         "transfer_port": transfer_port,
                         "resources_total": resources_total})
 
-    def heartbeat(self, node_id, resources_avail):
+    def heartbeat(self, node_id, resources_avail, load=None):
         self.conn.notify({"type": "heartbeat", "node_id": node_id,
-                          "resources_avail": resources_avail})
+                          "resources_avail": resources_avail,
+                          "load": load})
 
     def nodes(self, alive_only: bool = True):
         return self.conn.call({"type": "nodes",
                                "alive_only": alive_only})["nodes"]
+
+    def mark_node_dead(self, node_id, reason=""):
+        self.conn.call({"type": "mark_node_dead", "node_id": node_id,
+                        "reason": reason})
 
     def kv_put(self, ns, key, value, overwrite=True):
         return self.conn.call({"type": "kv_put", "ns": ns, "key": key,

@@ -256,6 +256,15 @@ class NodeService:
         # are reaped once the process is observed gone (escalating to
         # SIGKILL past the deadline).
         self._pending_reaps: List[Tuple[subprocess.Popen, int, float]] = []
+        # Aggregated application metrics pushed by workers/driver
+        # (reference: _private/metrics_agent.py aggregation role).
+        # key = (name, kind, frozenset(tag items)) -> series dict.
+        self._metrics: Dict[tuple, dict] = {}
+        # Worker stdout/stderr capture: per-file read offsets for the
+        # log tailer that forwards new lines to the driver console
+        # (reference: log_monitor.py `log_to_driver`).
+        self._log_dir = os.path.join(session_dir, "logs")
+        self._log_offsets: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -274,6 +283,12 @@ class NodeService:
             target=self._monitor_loop, daemon=True,
             name="rtpu-node-monitor")
         self._monitor_thread.start()
+        os.makedirs(self._log_dir, exist_ok=True)
+        if config.log_to_driver:
+            self._log_tail_thread = threading.Thread(
+                target=self._log_tail_loop, daemon=True,
+                name="rtpu-log-tailer")
+            self._log_tail_thread.start()
         if self.multinode:
             self._start_multinode()
         for _ in range(config.worker_pool_prestart):
@@ -483,7 +498,28 @@ class NodeService:
             try:
                 with self.lock:
                     avail = dict(self.resources_avail)
-                self.gcs.heartbeat(self.node_id, avail)
+                    # Demand/idleness signal for the autoscaler
+                    # (reference: resource_demand in raylet heartbeats →
+                    # autoscaler/_private/monitor.py).
+                    shapes = [dict(r.spec.get("resources") or {})
+                              for r in list(self.pending_queue)[:20]]
+                    busy = any(w.state in ("busy", "blocked")
+                               for w in self.workers.values())
+                    if shapes or busy:
+                        self._idle_since = None
+                    elif getattr(self, "_idle_since", None) is None:
+                        self._idle_since = time.time()
+                    load = {"pending": len(self.pending_queue),
+                            "shapes": shapes,
+                            "idle_since": self._idle_since}
+                self.gcs.heartbeat(self.node_id, avail, load)
+                # Autoscaler presence flag (written by StandardAutoscaler
+                # into GCS KV): gates infeasible fail-fast vs wait.
+                try:
+                    self._autoscaler_active = bool(
+                        self.gcs.kv_get("cluster", b"autoscaler"))
+                except Exception:
+                    pass
                 self._cluster_view = self.gcs.nodes()
                 with self.lock:
                     self._schedule()   # peer capacity may have freed up
@@ -1622,7 +1658,14 @@ class NodeService:
                     ctx.reply(m, {"ok": True})
                     return
             rec = TaskRecord(spec)
+            # When an autoscaler is live (it announces itself in GCS KV,
+            # mirrored into _autoscaler_active by the heartbeat loop), a
+            # currently unsatisfiable shape stays PENDING as demand — a
+            # node with the resource may be provisioned (reference:
+            # infeasible tasks wait and feed the autoscaler).  Otherwise
+            # fail fast, cluster-wide totals considered.
             reason = (None if spec.get("pg") is not None
+                      or getattr(self, "_autoscaler_active", False)
                       else self._infeasible_reason(spec.get("resources")))
             if reason is not None and spec.get("actor_id") is None:
                 self.tasks[rec.task_id] = rec
@@ -2339,6 +2382,177 @@ class NodeService:
     def _h_store_stats(self, ctx: _ConnCtx, m: dict) -> None:
         ctx.reply(m, {"stats": self._store().stats()})
 
+    def _h_node_info(self, ctx: _ConnCtx, m: dict) -> None:
+        ctx.reply(m, {"node_id": self.node_id,
+                      "session_dir": self.session_dir,
+                      "multinode": self.multinode,
+                      "gcs_address": self.gcs_address})
+
+    # ------------------------------------------------------------------
+    # observability: state dump + metrics (reference: util/state/api.py,
+    # _private/metrics_agent.py)
+    # ------------------------------------------------------------------
+    def _local_state_dump(self) -> dict:
+        """Snapshot of this node's runtime state.  Caller must NOT hold
+        the lock."""
+        with self.lock:
+            tasks = []
+            for rec in self.tasks.values():
+                tasks.append({
+                    "task_id": rec.task_id.hex(),
+                    "name": rec.spec.get("name", ""),
+                    "state": rec.state,
+                    "actor_id": (rec.actor_id.hex()
+                                 if rec.actor_id else None),
+                    "is_actor_creation": rec.is_actor_creation,
+                    "retries_left": rec.retries_left,
+                    "pid": rec.worker.pid if rec.worker else None,
+                    "node_id": self.node_id.hex(),
+                })
+            actors = []
+            for a in self.actors.values():
+                actors.append({
+                    "actor_id": a.actor_id.hex(),
+                    "name": a.name,
+                    "namespace": a.namespace,
+                    "class_name": (a.spec.get("class_name")
+                                   or a.spec.get("creation_task", {})
+                                   .get("name", "").removesuffix(
+                                       ".__init__")),
+                    "state": a.state,
+                    "pid": a.worker.pid if a.worker else None,
+                    "restarts_left": a.restarts_left,
+                    "detached": a.detached,
+                    "queued": len(a.queue),
+                    "in_flight": len(a.in_flight),
+                    "death_reason": a.death_reason,
+                    "node_id": self.node_id.hex(),
+                })
+            workers = []
+            for w in self.workers.values():
+                workers.append({
+                    "worker_id": w.worker_id.hex(),
+                    "pid": w.pid,
+                    "state": w.state,
+                    "tpu": w.tpu,
+                    "task": (w.current_task.spec.get("name")
+                             if w.current_task else None),
+                    "actor_id": (w.actor_id.hex()
+                                 if w.actor_id else None),
+                    "node_id": self.node_id.hex(),
+                })
+            objects = []
+            for oid, e in self.objects.items():
+                objects.append({
+                    "object_id": oid.hex(),
+                    "state": ("failed" if e.state == FAILED else
+                              "ready" if e.state == READY else "pending"),
+                    "loc": e.loc,
+                    "size": e.size,
+                    "refcount": e.refcount,
+                    "foreign": e.foreign,
+                    "has_lineage": e.lineage is not None,
+                    "node_id": self.node_id.hex(),
+                })
+            pgs = []
+            for pgid, pg in self.pgs.items():
+                pgs.append({
+                    "pg_id": pgid.hex(),
+                    "name": pg.get("name"),
+                    "strategy": pg.get("strategy"),
+                    "state": pg.get("state"),
+                    "bundles": pg.get("bundles"),
+                    "node_id": self.node_id.hex(),
+                })
+            pending = len(self.pending_queue)
+        return {"tasks": tasks, "actors": actors, "workers": workers,
+                "objects": objects, "placement_groups": pgs,
+                "node_id": self.node_id.hex(),
+                "pending_tasks": pending,
+                "store": self._store().stats()}
+
+    def _h_state_dump(self, ctx: _ConnCtx, m: dict) -> None:
+        dump = self._local_state_dump()
+        if m.get("cluster") and self.multinode:
+            merged = {k: list(dump[k]) for k in
+                      ("tasks", "actors", "workers", "objects",
+                       "placement_groups")}
+            nodes = []
+            for n in self._cluster_view:
+                nodes.append(n)
+                if n["node_id"] == self.node_id:
+                    continue
+                if n.get("state") != "alive":
+                    continue
+                try:
+                    conn = self._peer_conn_to(n)
+                    peer = conn.call({"type": "state_dump",
+                                      "cluster": False}, timeout=2.0)
+                    for k in merged:
+                        merged[k].extend(peer["dump"].get(k, []))
+                except Exception:
+                    pass
+            merged["nodes"] = nodes
+            merged["node_id"] = dump["node_id"]
+            merged["pending_tasks"] = dump["pending_tasks"]
+            merged["store"] = dump["store"]
+            ctx.reply(m, {"dump": merged})
+            return
+        ctx.reply(m, {"dump": dump})
+
+    def _h_metrics_push(self, ctx: _ConnCtx, m: dict) -> None:
+        """Merge a batch of metric series from a worker/driver process.
+        Counters accumulate deltas, gauges keep the latest value,
+        histograms merge bucket counts."""
+        with self.lock:
+            for s in m["series"]:
+                key = (s["name"], s["kind"],
+                       tuple(sorted(s.get("tags", {}).items())))
+                cur = self._metrics.get(key)
+                if cur is None:
+                    cur = {"name": s["name"], "kind": s["kind"],
+                           "tags": dict(s.get("tags", {})),
+                           "value": 0.0, "buckets": {}, "sum": 0.0,
+                           "count": 0.0,
+                           "description": s.get("description", "")}
+                    self._metrics[key] = cur
+                if s["kind"] == "counter":
+                    cur["value"] += s["value"]
+                elif s["kind"] == "gauge":
+                    cur["value"] = s["value"]
+                else:  # histogram
+                    for b, c in s.get("buckets", {}).items():
+                        cur["buckets"][b] = cur["buckets"].get(b, 0) + c
+                    cur["sum"] += s.get("sum", 0.0)
+                    cur["count"] += s.get("count", 0.0)
+        ctx.reply(m, {"ok": True})
+
+    def _h_metrics_scrape(self, ctx: _ConnCtx, m: dict) -> None:
+        """All aggregated series + built-in runtime gauges."""
+        with self.lock:
+            series = [dict(v, buckets=dict(v["buckets"]))
+                      for v in self._metrics.values()]
+            builtin = {
+                "ray_tpu_tasks_pending": float(len(self.pending_queue)),
+                "ray_tpu_tasks_total": float(len(self.tasks)),
+                "ray_tpu_actors_alive": float(
+                    sum(1 for a in self.actors.values()
+                        if a.state == "alive")),
+                "ray_tpu_workers": float(len(self.workers)),
+                "ray_tpu_objects_local": float(len(self.objects)),
+            }
+        stats = self._store().stats()
+        builtin["ray_tpu_object_store_bytes_used"] = float(
+            stats.get("used_bytes", 0))
+        builtin["ray_tpu_object_store_capacity_bytes"] = float(
+            stats.get("capacity_bytes", 0))
+        for name, val in builtin.items():
+            series.append({"name": name, "kind": "gauge", "tags": {},
+                           "value": val, "buckets": {}, "sum": 0.0,
+                           "count": 0.0,
+                           "description": "ray_tpu runtime built-in"})
+        ctx.reply(m, {"series": series})
+
     def _h_shutdown(self, ctx: _ConnCtx, m: dict) -> None:
         ctx.reply(m, {"ok": True})
         threading.Thread(target=self.shutdown, daemon=True).start()
@@ -2527,12 +2741,59 @@ class NodeService:
             # sitecustomize imports jax in every interpreter): CPU workers
             # must start in ~0.3s, not seconds.
             env.pop("PALLAS_AXON_POOL_IPS", None)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, cwd=os.getcwd())
+        # Capture worker output into a per-worker log file; the tailer
+        # thread forwards appended lines to the driver console when
+        # config.log_to_driver (reference: worker logs under
+        # session/logs/worker-*.out + log monitor tailing).
+        log_path = os.path.join(
+            self._log_dir,
+            f"worker-{self._next_worker_seq:04d}-{worker_id.hex()[:8]}.log")
+        log_f = open(log_path, "ab", buffering=0)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=env, cwd=os.getcwd(),
+                stdout=log_f, stderr=subprocess.STDOUT)
+        finally:
+            log_f.close()
         w = WorkerHandle(worker_id, proc, tpu)
         self.workers[worker_id] = w
         return w
+
+    def _log_tail_loop(self) -> None:
+        """Forward new worker-log lines to this process's stderr with a
+        `(worker pid=N)` prefix — the driver console on a head node."""
+        import glob as _glob
+        while not self._shutdown:
+            time.sleep(0.25)
+            try:
+                for path in _glob.glob(os.path.join(self._log_dir,
+                                                    "worker-*.log")):
+                    off = self._log_offsets.get(path, 0)
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue
+                    if size <= off:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        chunk = f.read(size - off)
+                    # Only forward complete lines; carry the remainder.
+                    cut = chunk.rfind(b"\n")
+                    if cut < 0:
+                        continue
+                    self._log_offsets[path] = off + cut + 1
+                    tag = os.path.basename(path)[:-4]
+                    for line in chunk[:cut].splitlines():
+                        try:
+                            sys.stderr.write(
+                                f"({tag}) "
+                                f"{line.decode(errors='replace')}\n")
+                        except Exception:
+                            pass
+            except Exception:
+                pass
 
     def _handle_worker_death(self, w: WorkerHandle, reason: str,
                              actor_already_handled: bool = False) -> None:

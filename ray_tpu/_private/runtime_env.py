@@ -1,0 +1,153 @@
+"""Runtime environments: per-task/actor env vars + code shipping.
+
+Reference surface: python/ray/runtime_env/runtime_env.py (RuntimeEnv
+kwargs) + _private/runtime_env/working_dir.py (working_dir upload to
+GCS, download + sys.path injection on workers).  Supported keys:
+
+  env_vars:    {str: str} applied for the task's duration (actors keep
+               them for life — a worker hosting an actor is dedicated).
+  working_dir: local directory, zipped and shipped THROUGH THE OBJECT
+               STORE (the same plane as task args; the reference uploads
+               to its GCS packages table), extracted once per node into
+               <session>/runtime_envs/<hash>/ and prepended to sys.path
+               + made the cwd.
+  py_modules:  list of directories shipped the same way, sys.path only.
+
+`pip`/`conda` are rejected: this deployment model forbids installs;
+bake dependencies into the image instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import os
+import sys
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional
+
+_ALLOWED = {"env_vars", "working_dir", "py_modules"}
+# content hash -> pinned ObjectRef, scoped to ONE session: refs from a
+# previous init() point into a dead object store.
+_upload_cache: Dict[str, Any] = {}
+_upload_cache_session: str = ""
+_extract_lock = threading.Lock()
+
+
+def _zip_dir(path: str) -> bytes:
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory {path!r} does not exist")
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git")]
+            for f in files:
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, path))
+    return buf.getvalue()
+
+
+def pack(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Driver-side: validate + upload archives; returns the wire spec."""
+    if not runtime_env:
+        return None
+    bad = set(runtime_env) - _ALLOWED
+    if bad:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(bad)} (supported: "
+            f"{sorted(_ALLOWED)}; pip/conda are rejected — this "
+            f"deployment bakes dependencies into the image)")
+    import ray_tpu
+    from ray_tpu._private.client import get_global_client
+
+    global _upload_cache_session
+    sess = getattr(get_global_client(), "session_dir", "") or ""
+    if sess != _upload_cache_session:
+        _upload_cache.clear()
+        _upload_cache_session = sess
+
+    out: dict = {}
+    env_vars = runtime_env.get("env_vars")
+    if env_vars:
+        out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+
+    def upload(path: str) -> dict:
+        blob = _zip_dir(path)
+        digest = hashlib.sha256(blob).hexdigest()[:16]
+        ref = _upload_cache.get(digest)
+        if ref is None:
+            ref = ray_tpu.put(blob)
+            _upload_cache[digest] = ref     # pin for the session
+        return {"hash": digest, "ref": ref.binary(),
+                "basename": os.path.basename(os.path.abspath(path))}
+
+    if runtime_env.get("working_dir"):
+        out["working_dir"] = upload(runtime_env["working_dir"])
+    if runtime_env.get("py_modules"):
+        out["py_modules"] = [upload(p)
+                             for p in runtime_env["py_modules"]]
+    return out or None
+
+
+def _ensure_extracted(archive: dict, session_dir: str) -> str:
+    """Worker-side: materialize one shipped archive; idempotent."""
+    import ray_tpu
+    from ray_tpu.object_ref import ObjectRef
+
+    dest = os.path.join(session_dir, "runtime_envs", archive["hash"])
+    with _extract_lock:
+        if os.path.isdir(dest):
+            return dest
+        blob = ray_tpu.get(ObjectRef._from_wire(archive["ref"]))
+        tmp = dest + f".tmp.{os.getpid()}"
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            z.extractall(tmp)
+        try:
+            os.rename(tmp, dest)
+        except OSError:         # lost a cross-process race: theirs wins
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+@contextlib.contextmanager
+def applied(spec: Optional[dict], session_dir: str, permanent: bool):
+    """Apply a runtime env around task execution.  `permanent=True`
+    (actor creation) skips restoration — the worker is dedicated."""
+    if not spec:
+        yield
+        return
+    saved_env: Dict[str, Optional[str]] = {}
+    saved_cwd = os.getcwd()
+    added_paths: List[str] = []
+    try:
+        for k, v in (spec.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        for mod in (spec.get("py_modules") or []):
+            p = _ensure_extracted(mod, session_dir)
+            sys.path.insert(0, p)
+            added_paths.append(p)
+        wd = spec.get("working_dir")
+        if wd:
+            p = _ensure_extracted(wd, session_dir)
+            sys.path.insert(0, p)
+            added_paths.append(p)
+            os.chdir(p)
+        yield
+    finally:
+        if not permanent:
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            for p in added_paths:
+                with contextlib.suppress(ValueError):
+                    sys.path.remove(p)
+            with contextlib.suppress(OSError):
+                os.chdir(saved_cwd)

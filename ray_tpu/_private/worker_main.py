@@ -71,12 +71,26 @@ class WorkerRuntime:
             self._execute_and_report(spec, self._run_function, spec)
 
     def _run_function(self, spec: dict) -> Any:
-        fn = self.client.fetch_function(spec["function_id"])
-        args, kwargs = self.client.unpack_args(spec["args"])
-        return fn(*args, **kwargs)
+        from ray_tpu._private import runtime_env as rte
+        # The env must be live BEFORE unpickling: cloudpickle refers to
+        # driver-side modules by name, and py_modules/working_dir exist
+        # precisely to make those imports resolve here.
+        with rte.applied(spec.get("runtime_env"),
+                         self.client.session_dir, permanent=False):
+            fn = self.client.fetch_function(spec["function_id"])
+            args, kwargs = self.client.unpack_args(spec["args"])
+            return fn(*args, **kwargs)
 
     def _execute_actor_creation(self, spec: dict) -> None:
         def create(spec: dict) -> Any:
+            from ray_tpu._private import runtime_env as rte
+            # permanent=True: this worker is dedicated to the actor, so
+            # its runtime env applies for the worker's whole life
+            # (reference: per-runtime-env dedicated workers).  Applied
+            # before class unpickling — see _run_function.
+            ctx = rte.applied(spec.get("runtime_env"),
+                              self.client.session_dir, permanent=True)
+            ctx.__enter__()
             cls = self.client.fetch_function(spec["function_id"])
             args, kwargs = self.client.unpack_args(spec["args"])
             instance = cls(*args, **kwargs)
@@ -116,6 +130,17 @@ class WorkerRuntime:
         if instance is None:
             self._report_error(spec, exc.ActorDiedError(
                 spec["actor_id"].hex(), "actor instance missing in worker"))
+            return
+        if spec["method_name"] == "__rtpu_dag_loop__":
+            # Compiled-graph execution loop (ray_tpu.dag): runs until
+            # channel teardown; this worker is dedicated to the DAG for
+            # that duration (reference: aDAG loops pin the actor).
+            def loop(spec: dict) -> int:
+                from ray_tpu.experimental.dag_executor import run_dag_loop
+                (ops,), _ = self.client.unpack_args(spec["args"])
+                return run_dag_loop(instance, ops)
+
+            self._execute_and_report(spec, loop, spec)
             return
         method = getattr(instance, spec["method_name"], None)
         if method is None:

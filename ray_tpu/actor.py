@@ -20,7 +20,7 @@ from ray_tpu.remote_function import (_pg_spec_from_options,
 _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "max_restarts", "max_concurrency",
     "name", "namespace", "lifetime", "max_task_retries",
-    "placement_group", "placement_group_bundle_index",
+    "placement_group", "placement_group_bundle_index", "runtime_env",
 }
 
 
@@ -56,6 +56,7 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> "ActorHandle":
         import ray_tpu
+        from ray_tpu._private import runtime_env as rte
         client = ray_tpu._ensure_connected()
         if self._blob is None:
             self._blob = cloudpickle.dumps(self._cls)
@@ -73,7 +74,8 @@ class ActorClass:
             name=self._options.get("name"),
             namespace=self._options.get("namespace", "default"),
             detached=detached,
-            pg=_pg_spec_from_options(self._options))
+            pg=_pg_spec_from_options(self._options),
+            runtime_env=rte.pack(self._options.get("runtime_env")))
         method_meta = _method_meta(self._cls)
         return ActorHandle(actor_id, class_id, self._cls.__name__,
                            method_meta, creation_ref=ready_ref)

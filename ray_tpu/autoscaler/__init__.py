@@ -1,0 +1,12 @@
+"""Autoscaler: demand-driven cluster resizing.
+
+Reference surface: python/ray/autoscaler/_private/autoscaler.py
+(StandardAutoscaler), node_provider.py (NodeProvider interface),
+monitor.py (the reconcile loop fed by raylet load reports).
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (LocalNodeProvider,
+                                              NodeProvider)
+
+__all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider"]

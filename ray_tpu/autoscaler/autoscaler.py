@@ -1,0 +1,138 @@
+"""StandardAutoscaler: reconcile cluster size against reported demand.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py (update() at
+:333 — launch on unfulfilled demand, terminate on idle timeout) fed by
+the load reports raylets attach to heartbeats (monitor.py).  Our demand
+signal is the `load` field each node service attaches to its GCS
+heartbeat: pending task resource shapes + an idle-since timestamp.
+
+Scale-up: any pending shape that fits NO alive node's available
+resources (and would fit a fresh worker) triggers a launch, up to
+max_workers.  Scale-down: provider-owned nodes idle past
+idle_timeout_s are terminated, down to min_workers.  The head node is
+never touched (the provider only owns workers it launched).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+def _fits(avail: Dict[str, float], shape: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v - 1e-9
+               for k, v in (shape or {}).items())
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, gcs_address: tuple,
+                 worker_resources: Dict[str, float],
+                 min_workers: int = 0, max_workers: int = 4,
+                 idle_timeout_s: float = 30.0,
+                 poll_interval_s: float = 1.0) -> None:
+        from ray_tpu._private.gcs_service import GcsClient
+        self.provider = provider
+        self.worker_resources = dict(worker_resources)
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self._gcs = GcsClient(gcs_address[0], gcs_address[1])
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # launch cooldown: a freshly launched node needs a heartbeat or
+        # two before its capacity shows up; don't double-launch for the
+        # same demand in the meantime.
+        self._last_launch = 0.0
+        self.launch_cooldown_s = 3.0
+        # Announce to the cluster that an autoscaler is live: node
+        # services mirror this flag and keep infeasible shapes PENDING
+        # (demand) instead of failing them fast.
+        try:
+            self._gcs.kv_put("cluster", b"autoscaler", b"1")
+        except Exception:
+            pass
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "StandardAutoscaler":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rtpu-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        try:
+            self._gcs.kv_del("cluster", b"autoscaler")
+        except Exception:
+            pass
+        self._gcs.close()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:
+                pass
+            self._stop.wait(self.poll_interval_s)
+
+    # -- one reconcile step (unit-testable) ----------------------------
+    def update(self) -> dict:
+        nodes = self._gcs.nodes(alive_only=True)
+        workers = self.provider.non_terminated_nodes()
+        actions = {"launched": 0, "terminated": 0}
+
+        # min_workers floor
+        while len(workers) < self.min_workers:
+            self.provider.create_node(self.worker_resources)
+            workers = self.provider.non_terminated_nodes()
+            actions["launched"] += 1
+
+        # Scale-up on unfulfilled demand.
+        unfulfilled = []
+        for n in nodes:
+            for shape in (n.get("load", {}).get("shapes") or []):
+                if not any(_fits(m["resources_avail"], shape)
+                           for m in nodes):
+                    unfulfilled.append(shape)
+        if unfulfilled and len(workers) < self.max_workers \
+                and time.time() - self._last_launch \
+                >= self.launch_cooldown_s:
+            # Launch only if a fresh worker would actually help.
+            if any(_fits(self.worker_resources, s) for s in unfulfilled):
+                self.provider.create_node(self.worker_resources)
+                self._last_launch = time.time()
+                actions["launched"] += 1
+
+        # Scale-down idle provider workers past the timeout.
+        if len(workers) > self.min_workers:
+            by_id = {}
+            for n in nodes:
+                by_id[bytes(n["node_id"])] = n
+            now = time.time()
+            for name in list(workers):
+                if len(self.provider.non_terminated_nodes()) \
+                        <= self.min_workers:
+                    break
+                nid = self.provider.node_cluster_id(name)
+                info = by_id.get(nid)
+                if info is None:
+                    continue            # not registered yet: young node
+                idle_since = info.get("load", {}).get("idle_since")
+                fully_free = (info["resources_avail"]
+                              == info["resources_total"])
+                if (idle_since and fully_free
+                        and now - idle_since > self.idle_timeout_s):
+                    self.provider.terminate_node(name)
+                    try:
+                        self._gcs.mark_node_dead(nid, "autoscaler "
+                                                 "idle termination")
+                    except Exception:
+                        pass
+                    actions["terminated"] += 1
+        return actions

@@ -1,0 +1,117 @@
+"""NodeProvider: how the autoscaler actually acquires machines.
+
+Reference: python/ray/autoscaler/node_provider.py (NodeProvider ABC;
+cloud impls live per provider).  Here the in-tree implementation is
+LocalNodeProvider, which "provisions" worker nodes as OS processes on
+this machine (`python -m ray_tpu._private.node_service`) — the same
+mechanics as a cloud provider modulo the machine actually being remote.
+A TPU-pod provider would subclass NodeProvider and create/delete
+GKE/QueuedResources slices instead; the autoscaler above is unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider contract the autoscaler needs."""
+
+    def create_node(self, resources: Dict[str, float]) -> str:
+        """Start one worker node; returns a provider-scoped node name."""
+        raise NotImplementedError
+
+    def terminate_node(self, name: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_cluster_id(self, name: str) -> Optional[bytes]:
+        """GCS node_id of a provider node once registered, else None."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        for name in list(self.non_terminated_nodes()):
+            self.terminate_node(name)
+
+
+def _drain(pipe) -> None:
+    try:
+        for _ in pipe:
+            pass
+    except (OSError, ValueError):
+        pass
+
+
+class LocalNodeProvider(NodeProvider):
+    """Worker nodes as local node-service subprocesses."""
+
+    def __init__(self, gcs_address: tuple,
+                 env: Optional[Dict[str, str]] = None) -> None:
+        self.gcs_address = gcs_address
+        self._env = dict(env or {})
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._node_ids: Dict[str, bytes] = {}
+        self._seq = 0
+
+    def create_node(self, resources: Dict[str, float]) -> str:
+        env = dict(os.environ)
+        env.update(self._env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        import ray_tpu
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        parts = [pkg_parent] + [p for p in sys.path
+                                if p and os.path.isdir(p)]
+        env["PYTHONPATH"] = os.pathsep.join(
+            dict.fromkeys(parts + env.get("PYTHONPATH", "").split(
+                os.pathsep)))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_service",
+             "--gcs-host", self.gcs_address[0],
+             "--gcs-port", str(self.gcs_address[1]),
+             "--resources", json.dumps(resources)],
+            env=env, stdout=subprocess.PIPE, text=True)
+        deadline = time.time() + 60.0
+        node_id = b""
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"provider node exited rc={proc.poll()}")
+            if line.startswith("NODE_READY="):
+                node_id = bytes.fromhex(line.strip().split("=", 1)[1])
+                break
+        threading.Thread(target=_drain, args=(proc.stdout,),
+                         daemon=True).start()
+        self._seq += 1
+        name = f"local-{self._seq}"
+        self._procs[name] = proc
+        self._node_ids[name] = node_id
+        return name
+
+    def terminate_node(self, name: str) -> None:
+        proc = self._procs.pop(name, None)
+        self._node_ids.pop(name, None)
+        if proc is None:
+            return
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [n for n, p in self._procs.items() if p.poll() is None]
+
+    def node_cluster_id(self, name: str) -> Optional[bytes]:
+        return self._node_ids.get(name)

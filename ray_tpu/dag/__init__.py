@@ -1,0 +1,255 @@
+"""Compiled graphs (aDAG): pre-wired actor pipelines over shm channels.
+
+Reference surface: python/ray/dag/ — InputNode/MultiOutputNode
+(input_node.py, output_node.py), `.bind` on actor methods
+(class_node.py), `experimental_compile` → CompiledDAG
+(compiled_dag_node.py:549) executing via shared-memory channels instead
+of per-call task RPCs.
+
+Why it matters on TPU: a decode step or pipeline stage dispatched
+through the normal task path pays ms-scale scheduling; a compiled DAG
+pays one shm ring-buffer hop (µs).  Usage:
+
+    with InputNode() as inp:
+        x = preproc.step.bind(inp)
+        y = model.infer.bind(x)
+    dag = y.experimental_compile()
+    out = dag.execute(batch).get()
+    dag.teardown()
+
+Compilation groups nodes by actor (one long-lived loop task per actor,
+ops in topological order; same-actor edges stay in-process), allocates
+one SPSC channel per cross-process edge, and returns a CompiledDAG whose
+`execute` writes the driver→graph channels and returns a ref that reads
+the graph→driver channels.  Pipelined: up to `capacity` executes may be
+in flight before the first `get`."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.experimental.channel import Channel
+
+__all__ = ["InputNode", "MultiOutputNode", "CompiledDAG",
+           "CompiledDAGRef", "DAGNode"]
+
+
+class DAGNode:
+    def experimental_compile(self, capacity: int = 8,
+                             buffer_size_bytes: int = 1 << 20
+                             ) -> "CompiledDAG":
+        return CompiledDAG(self, capacity, buffer_size_bytes)
+
+
+class InputNode(DAGNode):
+    """The placeholder for `execute()`'s argument (input_node.py)."""
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *a) -> None:
+        pass
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, handle, method_name: str, args: tuple,
+                 kwargs: dict) -> None:
+        self.handle = handle
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+
+    def __repr__(self) -> str:
+        return (f"{self.handle._class_name}.{self.method_name}"
+                f".bind(...)")
+
+
+class MultiOutputNode(DAGNode):
+    """Terminal fan-in: execute() refs resolve to a list
+    (output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]) -> None:
+        self.outputs = list(outputs)
+
+
+def _topo(root: DAGNode) -> List[ClassMethodNode]:
+    order: List[ClassMethodNode] = []
+    seen: set = set()
+
+    def visit(n) -> None:
+        if id(n) in seen or not isinstance(n, ClassMethodNode):
+            return
+        seen.add(id(n))
+        for a in list(n.args) + list(n.kwargs.values()):
+            visit(a)
+        order.append(n)
+
+    if isinstance(root, MultiOutputNode):
+        for o in root.outputs:
+            visit(o)
+    else:
+        visit(root)
+    return order
+
+
+class CompiledDAGRef:
+    def __init__(self, dag: "CompiledDAG", seq: int) -> None:
+        self._dag = dag
+        self._seq = seq
+
+    def get(self, timeout: Optional[float] = None):
+        return self._dag._read_result(self._seq, timeout)
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, capacity: int,
+                 slot_size: int) -> None:
+        nodes = _topo(root)
+        if not nodes:
+            raise ValueError("compiled DAG needs at least one "
+                             "actor-method node")
+        self._root = root
+        self._chan_dir = os.path.join(
+            ray_tpu._ensure_connected().session_dir, "channels")
+        os.makedirs(self._chan_dir, exist_ok=True)
+        self._dag_id = os.urandom(4).hex()
+        self._edge_n = 0
+        self._channels: List[Channel] = []
+        self._input_chans: List[Channel] = []
+        self._torn_down = False
+
+        # node -> where its output lives, per consumer kind
+        out_slots: Dict[int, List[tuple]] = {id(n): [] for n in nodes}
+        in_slot_of: Dict[int, tuple] = {}
+
+        def new_chan() -> Tuple[str, Channel]:
+            self._edge_n += 1
+            path = os.path.join(
+                self._chan_dir,
+                f"dag-{self._dag_id}-e{self._edge_n}")
+            ch = Channel(path, capacity=capacity, slot_size=slot_size,
+                         create=True)
+            self._channels.append(ch)
+            return path, ch
+
+        actor_of = {id(n): n.handle._actor_id for n in nodes}
+        local_n = 0
+
+        def slot_for_arg(consumer: ClassMethodNode, arg) -> tuple:
+            nonlocal local_n
+            if isinstance(arg, InputNode):
+                path, ch = new_chan()
+                self._input_chans.append(ch)
+                return ("chan", path)
+            if isinstance(arg, ClassMethodNode):
+                if actor_of[id(arg)] == actor_of[id(consumer)]:
+                    # same actor: pass through the loop-local dict
+                    for kind, v in out_slots[id(arg)]:
+                        if kind == "local":
+                            return ("local", v)
+                    local_n += 1
+                    key = f"v{local_n}"
+                    out_slots[id(arg)].append(("local", key))
+                    return ("local", key)
+                path, _ = new_chan()
+                out_slots[id(arg)].append(("chan", path))
+                return ("chan", path)
+            if isinstance(arg, MultiOutputNode):
+                raise TypeError("MultiOutputNode can only be the root")
+            return ("const", arg)
+
+        ops_by_actor: Dict[bytes, List[dict]] = {}
+        handles: Dict[bytes, Any] = {}
+        for n in nodes:
+            ins = [slot_for_arg(n, a) for a in n.args]
+            kw = {k: slot_for_arg(n, v) for k, v in n.kwargs.items()}
+            aid = n.handle._actor_id
+            handles[aid] = n.handle
+            ops_by_actor.setdefault(aid, []).append(
+                {"method": n.method_name, "ins": ins, "kwargs": kw,
+                 "outs": out_slots[id(n)], "_node": id(n)})
+
+        # terminal outputs -> driver channels
+        terminals = (root.outputs if isinstance(root, MultiOutputNode)
+                     else [root])
+        self._out_chans: List[Channel] = []
+        for t in terminals:
+            if not isinstance(t, ClassMethodNode):
+                raise TypeError(f"DAG output must be an actor-method "
+                                f"node, got {t!r}")
+            path, ch = new_chan()
+            out_slots[id(t)].append(("chan", path))
+            self._out_chans.append(ch)
+
+        # launch one loop per actor (ops in topo order)
+        client = ray_tpu._ensure_connected()
+        self._loop_refs = []
+        for aid, ops in ops_by_actor.items():
+            for op in ops:
+                op.pop("_node", None)
+            h = handles[aid]
+            refs = client.submit_actor_task(
+                aid, h._class_id, "__rtpu_dag_loop__", (ops,), {}, 1)
+            self._loop_refs.append(refs[0])
+
+        self._exec_seq = 0
+        self._read_seq = 0
+        self._buffer: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+
+    # -- execution -----------------------------------------------------
+    def execute(self, *args) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        value = args[0] if len(args) == 1 else tuple(args)
+        for ch in self._input_chans:
+            ch.write(value)
+        with self._lock:
+            seq = self._exec_seq
+            self._exec_seq += 1
+        return CompiledDAGRef(self, seq)
+
+    def _read_result(self, seq: int, timeout: Optional[float]):
+        with self._lock:
+            while self._read_seq <= seq:
+                out = [ch.read(timeout) for ch in self._out_chans]
+                self._buffer[self._read_seq] = (
+                    out if isinstance(self._root, MultiOutputNode)
+                    else out[0])
+                self._read_seq += 1
+            return self._buffer.pop(seq)
+
+    # -- teardown ------------------------------------------------------
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self._channels:
+            ch.close(unlink=True)
+        # loops exit via ChannelClosed; their return is the tick count
+        try:
+            ray_tpu.get(self._loop_refs, timeout=10)
+        except Exception:
+            pass
+
+    def __del__(self) -> None:
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+def _bind(self, *args, **kwargs) -> ClassMethodNode:
+    """`actor.method.bind(...)` — dag/class_node.py."""
+    return ClassMethodNode(self._handle, self._name, args, kwargs)
+
+
+# Attach to ActorMethod (kept here so the core actor module stays free
+# of DAG concerns; importing ray_tpu.dag activates .bind).
+from ray_tpu.actor import ActorMethod  # noqa: E402
+
+ActorMethod.bind = _bind

@@ -1,0 +1,92 @@
+"""Dashboard: HTTP introspection endpoints over the state/metrics plane.
+
+Reference analog: the dashboard head's API server
+(python/ray/dashboard/) — re-scoped to the data endpoints (the
+reference's React frontend is out of scope; every panel's data source
+exists here as JSON):
+
+    GET /               tiny HTML overview (auto-refreshing)
+    GET /api/state      full cluster state dump (tasks/actors/workers/
+                        objects/placement groups/nodes)
+    GET /api/nodes      node table
+    GET /api/summary    task/actor/object rollups
+    GET /metrics        Prometheus exposition (scrape endpoint)
+
+Runs as a daemon thread inside whichever process calls `serve()` — the
+CLI head process by default."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>body{font-family:monospace;margin:2em}table{border-collapse:
+collapse}td,th{border:1px solid #999;padding:4px 8px;text-align:left}
+</style></head><body><h2>ray_tpu cluster</h2><div id=c>loading…</div>
+<script>
+fetch('/api/summary').then(r=>r.json()).then(s=>{
+  let h = '<h3>nodes</h3><table><tr><th>node</th><th>state</th></tr>';
+  for (const n of s.nodes) h += `<tr><td>${n.node_id.slice(0,12)}</td>
+    <td>${n.state||'alive'}</td></tr>`;
+  h += '</table><h3>actors by class/state</h3><pre>' +
+       JSON.stringify(s.actors, null, 1) + '</pre>' +
+       '<h3>tasks by name/state</h3><pre>' +
+       JSON.stringify(s.tasks, null, 1) + '</pre>' +
+       '<h3>objects</h3><pre>' +
+       JSON.stringify(s.objects, null, 1) + '</pre>';
+  document.getElementById('c').innerHTML = h;});
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):     # silence per-request stderr lines
+        pass
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        from ray_tpu.util import metrics, state
+        try:
+            if self.path == "/" or self.path == "/index.html":
+                self._send(200, _PAGE.encode(), "text/html")
+            elif self.path == "/api/state":
+                dump = state._dump()
+                self._send(200, json.dumps(dump, default=str).encode())
+            elif self.path == "/api/nodes":
+                self._send(200, json.dumps(state.list_nodes(),
+                                           default=str).encode())
+            elif self.path == "/api/summary":
+                body = {
+                    "nodes": state.list_nodes(),
+                    "tasks": state.summarize_tasks(),
+                    "actors": state.summarize_actors(),
+                    "objects": state.summarize_objects(),
+                }
+                self._send(200, json.dumps(body, default=str).encode())
+            elif self.path == "/metrics":
+                self._send(200, metrics.prometheus_text().encode(),
+                           "text/plain; version=0.0.4")
+            else:
+                self._send(404, b'{"error": "not found"}')
+        except Exception as e:   # introspection must never crash serving
+            self._send(500, json.dumps({"error": repr(e)}).encode())
+
+
+def serve(port: int = 8265, host: str = "127.0.0.1"
+          ) -> ThreadingHTTPServer:
+    """Start the dashboard server on a daemon thread; returns the server
+    (call .shutdown() to stop).  Port 8265 mirrors the reference."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True,
+                     name="rtpu-dashboard").start()
+    return httpd

@@ -1,0 +1,1 @@
+"""Experimental subsystems (channel, compiled DAG plumbing)."""

@@ -1,0 +1,141 @@
+"""Shared-memory SPSC channels: the compiled-graph transport.
+
+Reference: python/ray/experimental/channel/shared_memory_channel.py
+(Channel over mutable plasma buffers).  Our object store seals objects
+immutably, so channels get their own primitive: an mmap'd /dev/shm ring
+of fixed slots with single-producer/single-consumer semantics.
+
+Layout (all 8-byte little-endian fields, 64-byte aligned header):
+
+    [0]  capacity  (slots)
+    [8]  slot_size (payload bytes per slot)
+    [16] write_seq — published AFTER the slot payload is written
+    [24] read_seq  — published AFTER the slot payload is consumed
+
+A slot holds [8B length][payload].  On x86/ARM64 an aligned 8-byte
+store is atomic and Python's mmap writes go straight to the shared
+page, so publishing the sequence number AFTER the payload write is the
+entire synchronization protocol (same design as the reference's
+mutable-plasma seqlock).  Blocking uses adaptive spin→sleep polling:
+µs-scale latency when hot, no burned core when cold."""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+from typing import Any, Optional
+
+from ray_tpu._private import serialization as ser
+
+_HEADER = 64
+_Q = struct.Struct("<Q")
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    """One direction, one producer process, one consumer process."""
+
+    def __init__(self, path: str, capacity: int = 8,
+                 slot_size: int = 1 << 20, create: bool = False) -> None:
+        self.path = path
+        if create:
+            size = _HEADER + capacity * (8 + slot_size)
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            self._mm[0:8] = _Q.pack(capacity)
+            self._mm[8:16] = _Q.pack(slot_size)
+            self._mm[16:24] = _Q.pack(0)
+            self._mm[24:32] = _Q.pack(0)
+        else:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                size = os.fstat(fd).st_size
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+        self.capacity = _Q.unpack(self._mm[0:8])[0]
+        self.slot_size = _Q.unpack(self._mm[8:16])[0]
+        self._closed = False
+
+    # -- seq accessors (aligned 8-byte torn-free reads/writes) ---------
+    def _wseq(self) -> int:
+        return _Q.unpack(self._mm[16:24])[0]
+
+    def _rseq(self) -> int:
+        return _Q.unpack(self._mm[24:32])[0]
+
+    _CLOSED_SENTINEL = (1 << 64) - 1
+
+    def _slot_off(self, seq: int) -> int:
+        return _HEADER + (seq % self.capacity) * (8 + self.slot_size)
+
+    @staticmethod
+    def _wait(poll, timeout: Optional[float]) -> None:
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        spins = 0
+        while not poll():
+            spins += 1
+            if spins < 200:          # hot path: pure spin, ~µs latency
+                continue
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel wait timed out")
+            time.sleep(0.0001 if spins < 2000 else 0.001)
+
+    # -- API -----------------------------------------------------------
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        blob = ser.dumps(value)
+        if len(blob) > self.slot_size:
+            raise ValueError(
+                f"value of {len(blob)}B exceeds channel slot_size "
+                f"{self.slot_size}B (pass a larger slot_size at "
+                f"compile/creation time)")
+        self._wait(lambda: (self._rseq() == self._CLOSED_SENTINEL
+                            or self._wseq() - self._rseq()
+                            < self.capacity), timeout)
+        if self._rseq() == self._CLOSED_SENTINEL:
+            raise ChannelClosed(self.path)
+        seq = self._wseq()
+        off = self._slot_off(seq)
+        self._mm[off:off + 8] = _Q.pack(len(blob))
+        self._mm[off + 8:off + 8 + len(blob)] = blob
+        self._mm[16:24] = _Q.pack(seq + 1)      # publish
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        self._wait(lambda: (self._wseq() == self._CLOSED_SENTINEL
+                            or self._wseq() > self._rseq()), timeout)
+        if self._wseq() == self._CLOSED_SENTINEL:
+            raise ChannelClosed(self.path)
+        seq = self._rseq()
+        off = self._slot_off(seq)
+        n = _Q.unpack(self._mm[off:off + 8])[0]
+        blob = bytes(self._mm[off + 8:off + 8 + n])
+        self._mm[24:32] = _Q.pack(seq + 1)      # release slot
+        return ser.loads(blob)
+
+    def close(self, unlink: bool = False) -> None:
+        """Mark closed for the peer (poison both seqs), then unmap."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mm[16:24] = _Q.pack(self._CLOSED_SENTINEL)
+            self._mm[24:32] = _Q.pack(self._CLOSED_SENTINEL)
+            self._mm.flush()
+            self._mm.close()
+        except (ValueError, OSError):
+            pass
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass

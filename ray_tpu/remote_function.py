@@ -18,6 +18,7 @@ from ray_tpu._private.config import config
 _VALID_OPTIONS = {
     "num_returns", "num_cpus", "num_tpus", "resources", "max_retries",
     "name", "placement_group", "placement_group_bundle_index",
+    "runtime_env",
 }
 
 
@@ -36,7 +37,10 @@ def _resources_from_options(options: Dict[str, Any],
                             default_cpus: float) -> Dict[str, float]:
     res = dict(options.get("resources") or {})
     num_cpus = options.get("num_cpus")
-    res["CPU"] = float(default_cpus if num_cpus is None else num_cpus)
+    if num_cpus is not None:
+        res["CPU"] = float(num_cpus)
+    elif "CPU" not in res:   # resources={"CPU": x} must not be clobbered
+        res["CPU"] = float(default_cpus)
     num_tpus = options.get("num_tpus")
     if num_tpus:
         res["TPU"] = float(num_tpus)
@@ -79,6 +83,7 @@ class RemoteFunction:
         num_returns = self._options.get("num_returns", 1)
         resources = _resources_from_options(
             self._options, config.task_default_num_cpus)
+        from ray_tpu._private import runtime_env as rte
         refs = client.submit_task(
             function_id=fid,
             name=self._options.get("name") or self._fn.__qualname__,
@@ -86,7 +91,8 @@ class RemoteFunction:
             resources=resources,
             retries=self._options.get("max_retries",
                                       config.max_task_retries),
-            pg=_pg_spec_from_options(self._options))
+            pg=_pg_spec_from_options(self._options),
+            runtime_env=rte.pack(self._options.get("runtime_env")))
         if num_returns == 1:
             return refs[0]
         return refs

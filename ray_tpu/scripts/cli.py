@@ -1,0 +1,398 @@
+"""`python -m ray_tpu <command>`: the cluster CLI.
+
+Reference surface: python/ray/scripts/scripts.py (`ray start/stop/
+status`) + `ray list/summary` (util/state CLI) + `ray job` (job CLI).
+
+    start --head [...]        start GCS + head node + dashboard, detached
+    start --address H:P       join an existing cluster as a worker node
+    stop                      stop every process this CLI started
+    status [--address H:P]    cluster nodes + resources
+    list {tasks,actors,workers,objects,nodes,pgs}
+    summary                   task/actor/object rollups
+    memory                    object-store usage
+    metrics                   Prometheus text from the head
+    job {submit,status,logs,list,stop}
+    microbench                core-runtime perf harness
+
+State (started pids, head address) persists in ~/.ray_tpu_cli.json so
+`stop`/`status` work from a fresh shell."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+STATE_PATH = os.path.expanduser("~/.ray_tpu_cli.json")
+
+
+# ---------------------------------------------------------------------------
+# CLI state file
+# ---------------------------------------------------------------------------
+def _load_state() -> dict:
+    try:
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"procs": []}
+
+
+def _save_state(st: dict) -> None:
+    with open(STATE_PATH, "w") as f:
+        json.dump(st, f, indent=1)
+
+
+def _daemon_log(role: str) -> str:
+    d = os.path.expanduser("~/.ray_tpu_logs")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"{role}-{int(time.time())}.err")
+
+
+def _parse_addr(addr: str) -> tuple:
+    host, _, port = addr.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def _head_address(args) -> Optional[str]:
+    if getattr(args, "address", None):
+        return args.address
+    st = _load_state()
+    return st.get("gcs_address")
+
+
+# ---------------------------------------------------------------------------
+# start / stop / status
+# ---------------------------------------------------------------------------
+def cmd_start(args) -> int:
+    st = _load_state()
+    env = dict(os.environ)
+    if args.head:
+        cmd = [sys.executable, "-m", "ray_tpu.scripts.head",
+               "--host", args.host, "--port", str(args.port),
+               "--dashboard-port", str(args.dashboard_port),
+               "--resources", args.resources]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            cmd += ["--num-tpus", str(args.num_tpus)]
+        if args.object_store_memory:
+            cmd += ["--object-store-memory",
+                    str(args.object_store_memory)]
+        err_f = open(_daemon_log("head"), "ab")
+        try:
+            # stderr to a log file, NOT inherited: a detached daemon
+            # holding the caller's pipe would hang any capture of this
+            # CLI's own output.
+            proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                    stderr=err_f, text=True,
+                                    start_new_session=True)
+        finally:
+            err_f.close()
+        info = _await_line(proc, "HEAD_READY=", args.timeout)
+        head = json.loads(info)
+        st["gcs_address"] = head["gcs_address"]
+        st["dashboard_url"] = head["dashboard_url"]
+        st["procs"].append({"pid": proc.pid, "role": "head"})
+        _save_state(st)
+        print(f"head started: gcs={head['gcs_address']} "
+              f"dashboard={head['dashboard_url']} pid={proc.pid}")
+        print(f"join with: python -m ray_tpu start "
+              f"--address {head['gcs_address']}")
+        return 0
+    addr = args.address or st.get("gcs_address")
+    if not addr:
+        print("error: --address required (no head on record)",
+              file=sys.stderr)
+        return 1
+    host, port = _parse_addr(addr)
+    cmd = [sys.executable, "-m", "ray_tpu._private.node_service",
+           "--gcs-host", host, "--gcs-port", str(port),
+           "--resources", args.resources]
+    if args.object_store_memory:
+        cmd += ["--store-capacity", str(args.object_store_memory)]
+    err_f = open(_daemon_log("node"), "ab")
+    try:
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=err_f, text=True,
+                                start_new_session=True)
+    finally:
+        err_f.close()
+    node_id = _await_line(proc, "NODE_READY=", args.timeout)
+    st["procs"].append({"pid": proc.pid, "role": "node"})
+    _save_state(st)
+    print(f"node {node_id[:12]} joined {addr} (pid={proc.pid})")
+    return 0
+
+
+def _await_line(proc, prefix: str, timeout_s: float) -> str:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"process exited early (rc={proc.poll()})")
+        if line.startswith(prefix):
+            # Leave the pipe to the OS; the daemon keeps running.
+            import threading
+
+            def drain(p=proc.stdout):
+                try:
+                    for _ in p:
+                        pass
+                except (OSError, ValueError):
+                    pass
+            threading.Thread(target=drain, daemon=True).start()
+            return line.strip()[len(prefix):]
+    proc.kill()
+    raise TimeoutError(f"no {prefix} within {timeout_s}s")
+
+
+def cmd_stop(args) -> int:
+    st = _load_state()
+    stopped = 0
+    for rec in st.get("procs", []):
+        try:
+            os.killpg(os.getpgid(rec["pid"]), signal.SIGTERM)
+            stopped += 1
+        except (ProcessLookupError, PermissionError):
+            pass
+    _save_state({"procs": []})
+    print(f"stopped {stopped} process group(s)")
+    return 0
+
+
+def cmd_status(args) -> int:
+    addr = _head_address(args)
+    if not addr:
+        print("no cluster on record (start one with: "
+              "python -m ray_tpu start --head)", file=sys.stderr)
+        return 1
+    from ray_tpu._private.gcs_service import GcsClient
+    host, port = _parse_addr(addr)
+    gcs = GcsClient(host, port)
+    try:
+        nodes = gcs.nodes()
+    finally:
+        gcs.close()
+    print(f"cluster at {addr}: {len(nodes)} node(s)")
+    total: Dict[str, float] = {}
+    avail: Dict[str, float] = {}
+    for n in nodes:
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0.0) + v
+        for k, v in n["resources_avail"].items():
+            avail[k] = avail.get(k, 0.0) + v
+        print(f"  node {n['node_id'].hex()[:12]} {n['host']} "
+              f"state={n.get('state', 'alive')}")
+    for k in sorted(total):
+        print(f"  {avail.get(k, 0.0):g}/{total[k]:g} {k}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# state queries (served by the head's dashboard HTTP endpoints)
+# ---------------------------------------------------------------------------
+def _fetch_json(path: str, args) -> Any:
+    st = _load_state()
+    url = getattr(args, "dashboard_url", None) or st.get("dashboard_url")
+    if not url:
+        raise SystemExit("no dashboard on record; pass --dashboard-url")
+    with urllib.request.urlopen(f"{url}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _print_table(rows: List[dict], cols: List[str]) -> None:
+    if not rows:
+        print("(empty)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c])
+                        for c in cols))
+
+
+def cmd_list(args) -> int:
+    dump = _fetch_json("/api/state", args)
+    kind = args.kind
+    key = {"tasks": "tasks", "actors": "actors", "workers": "workers",
+           "objects": "objects", "pgs": "placement_groups",
+           "nodes": "nodes"}[kind]
+    rows = dump.get(key) or []
+    cols = {
+        "tasks": ["task_id", "name", "state", "pid", "retries_left"],
+        "actors": ["actor_id", "class_name", "name", "state", "pid"],
+        "workers": ["worker_id", "pid", "state", "tpu", "task"],
+        "objects": ["object_id", "state", "loc", "size", "refcount"],
+        "pgs": ["pg_id", "name", "strategy", "state"],
+        "nodes": ["node_id", "host", "state"],
+    }[kind]
+    for r in rows:
+        for c in cols:
+            if isinstance(r.get(c), bytes):
+                r[c] = r[c].hex()
+        for c in ("task_id", "actor_id", "worker_id", "object_id",
+                  "pg_id", "node_id"):
+            if isinstance(r.get(c), str) and len(r[c]) > 16:
+                r[c] = r[c][:16]
+    _print_table(rows, cols)
+    return 0
+
+
+def cmd_summary(args) -> int:
+    print(json.dumps(_fetch_json("/api/summary", args), indent=1,
+                     default=str))
+    return 0
+
+
+def cmd_memory(args) -> int:
+    dump = _fetch_json("/api/state", args)
+    store = dump.get("store", {})
+    objs = dump.get("objects", [])
+    print(f"store: {store.get('used_bytes', 0)}/"
+          f"{store.get('capacity_bytes', 0)} bytes, "
+          f"{store.get('num_objects', 0)} objects, "
+          f"{store.get('num_evictions', 0)} evictions")
+    by_loc: Dict[str, int] = {}
+    for o in objs:
+        by_loc[str(o["loc"])] = by_loc.get(str(o["loc"]), 0) + 1
+    for loc, n in sorted(by_loc.items()):
+        print(f"  {loc}: {n}")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    st = _load_state()
+    url = getattr(args, "dashboard_url", None) or st.get("dashboard_url")
+    if not url:
+        raise SystemExit("no dashboard on record")
+    with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+        sys.stdout.write(r.read().decode())
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# jobs
+# ---------------------------------------------------------------------------
+def _job_client(args):
+    from ray_tpu.util.job import JobSubmissionClient
+    addr = _head_address(args)
+    if not addr:
+        raise SystemExit("no cluster on record")
+    return JobSubmissionClient(addr)
+
+
+def cmd_job(args) -> int:
+    jc = _job_client(args)
+    try:
+        if args.job_cmd == "submit":
+            import shlex
+            argv = args.entrypoint
+            if argv and argv[0] == "--":
+                argv = argv[1:]
+            entrypoint = shlex.join(argv)
+            job_id = jc.submit_job(
+                entrypoint=entrypoint,
+                runtime_env=({"working_dir": args.working_dir}
+                             if args.working_dir else None))
+            print(f"submitted {job_id}")
+            if args.wait:
+                status = jc.wait(job_id)
+                print(f"{job_id}: {status}")
+                sys.stdout.write(jc.get_job_logs(job_id))
+                return 0 if status == "SUCCEEDED" else 1
+        elif args.job_cmd == "status":
+            print(jc.get_job_status(args.job_id))
+        elif args.job_cmd == "logs":
+            sys.stdout.write(jc.get_job_logs(args.job_id))
+        elif args.job_cmd == "list":
+            _print_table(jc.list_jobs(),
+                         ["job_id", "status", "entrypoint"])
+        elif args.job_cmd == "stop":
+            jc.stop_job(args.job_id)
+            print(f"stopped {args.job_id}")
+        return 0
+    finally:
+        jc.close()
+
+
+def cmd_microbench(args) -> int:
+    from ray_tpu.util.microbench import main as mb
+    mb()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="ray_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start head or join a cluster")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None, help="H:P of existing GCS")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--object-store-memory", type=int, default=0)
+    p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument("--timeout", type=float, default=60.0)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop CLI-started processes")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster nodes + resources")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list runtime entities")
+    p.add_argument("kind", choices=["tasks", "actors", "workers",
+                                    "objects", "nodes", "pgs"])
+    p.add_argument("--dashboard-url", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="state rollups")
+    p.add_argument("--dashboard-url", default=None)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("memory", help="object store usage")
+    p.add_argument("--dashboard-url", default=None)
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("metrics", help="Prometheus metrics dump")
+    p.add_argument("--dashboard-url", default=None)
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("job", help="job submission")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address", default=None)
+    j.add_argument("--working-dir", default=None)
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+        j.add_argument("--address", default=None)
+    j = jsub.add_parser("list")
+    j.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("microbench", help="core perf harness")
+    p.set_defaults(fn=cmd_microbench)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

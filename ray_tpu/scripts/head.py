@@ -1,0 +1,77 @@
+"""Head-node daemon: GCS server + head node service + dashboard.
+
+Spawned detached by `python -m ray_tpu start --head` (reference analog:
+`ray start --head` bringing up gcs_server + raylet + dashboard;
+python/ray/scripts/scripts.py + node.py start_head_processes).
+
+Prints one line `HEAD_READY=<json>` once serving, then runs until
+SIGTERM/SIGINT."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import threading
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="GCS port (0 = pick a free one)")
+    ap.add_argument("--num-cpus", type=float, default=None)
+    ap.add_argument("--num-tpus", type=float, default=None)
+    ap.add_argument("--resources", default="{}")
+    ap.add_argument("--object-store-memory", type=int, default=0)
+    ap.add_argument("--dashboard-port", type=int, default=8265)
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu._private.gcs_service import GcsServer
+    from ray_tpu import dashboard
+
+    gcs = GcsServer(host=args.host, port=args.port)
+    gcs.start()
+
+    ray_tpu.init(
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        resources={k: float(v)
+                   for k, v in json.loads(args.resources).items()},
+        object_store_memory=args.object_store_memory or None,
+        gcs_address=(args.host, gcs.port))
+
+    dash_url = None
+    if args.dashboard_port >= 0:
+        try:
+            httpd = dashboard.serve(port=args.dashboard_port,
+                                    host=args.host)
+            dash_url = f"http://{args.host}:{httpd.server_address[1]}"
+        except OSError as e:
+            print(f"dashboard disabled: {e}", flush=True)
+
+    info = {
+        "pid": os.getpid(),
+        "gcs_address": f"{args.host}:{gcs.port}",
+        "dashboard_url": dash_url,
+        "session_dir": ray_tpu._session.session_dir,
+    }
+    print(f"HEAD_READY={json.dumps(info)}", flush=True)
+    # The launcher closes its end of our stdout pipe once it has the
+    # READY line; route later prints to stderr (the daemon log file)
+    # instead of dying on SIGPIPE.
+    import sys
+    sys.stdout = sys.stderr
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    while not stop.is_set():
+        stop.wait(0.5)
+    ray_tpu.shutdown()
+    gcs.shutdown()
+
+
+if __name__ == "__main__":
+    main()

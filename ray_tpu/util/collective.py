@@ -1,0 +1,351 @@
+"""Process-level collectives: the `ray.util.collective` analog.
+
+Reference surface: python/ray/util/collective/collective.py:258-594
+(init_collective_group, declare_collective_group, allreduce, barrier,
+reducescatter, allgather, broadcast, send, recv, get_rank,
+get_collective_group_size, destroy_collective_group).
+
+TPU-first split of responsibilities:
+
+* INSIDE a compiled program, collectives are XLA's job — `psum` /
+  `all_gather` / `ppermute` over `jax.sharding.Mesh` axes ride the ICI
+  and fuse with compute.  Nothing here is for that path.
+* BETWEEN processes (actors coordinating outside jit — parameter
+  exchange in Tune/PBT, rollout aggregation, eval fan-in), the
+  reference stands up NCCL/gloo rings.  Here the transport IS the
+  runtime's native object plane: each rank `put`s its shard into the
+  zero-copy shm store and peers `get` it (cross-node gets ride the
+  object-transfer plane), with GCS KV as the rendezvous/sequencing
+  board.  No second networking stack to configure, and payloads move
+  through the same spill/transfer machinery as everything else.
+
+Semantics notes vs the reference:
+* Arrays (numpy or jax) are reduced with f-order-preserving numpy ops;
+  numpy inputs are ALSO updated in place (reference mutates tensors in
+  place); the reduced array is always returned.
+* Every rank must call the same collectives in the same order (standard
+  collective contract) — a per-group operation counter sequences keys.
+* Garbage: a rank entering op N deletes its op N-2 keys — any rank at
+  N has finished N-1, so nobody can still be reading N-2.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private.client import get_global_client
+
+_NS = "collective"
+_POLL_S = 0.002
+
+_lock = threading.RLock()
+_groups: Dict[str, "_Group"] = {}
+
+
+class _Group:
+    def __init__(self, name: str, world_size: int, rank: int) -> None:
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.seq = 0           # collective op counter
+        self.p2p_seq: Dict[tuple, int] = {}   # (src, dst) -> counter
+        self._refs: List[tuple] = []          # (seq, ObjectRef) pins
+        # p2p pins live on their own ledger: p2p sequencing is per-pair
+        # and independent of the collective counter, so the seq-horizon
+        # GC must not touch them.  Released on receiver ack or destroy.
+        self._p2p_refs: Dict[tuple, Any] = {}   # (dst, seq) -> ObjectRef
+
+
+def _client():
+    c = get_global_client()
+    if c is None:
+        raise RuntimeError("ray_tpu is not initialized in this process")
+    return c
+
+
+def _key(group: str, seq: int, tag: str) -> bytes:
+    return f"{group}/{seq:09d}/{tag}".encode()
+
+
+def _put_blob(group: _Group, seq: int, tag: str, value: Any,
+              p2p_dst: Optional[int] = None) -> None:
+    """Publish a value on the op board.  Small values inline into KV;
+    big arrays go through the object store and only the ref id lands in
+    KV (zero-copy within a node, transfer plane across nodes)."""
+    blob = pickle.dumps(value, protocol=5)
+    if len(blob) > 64 * 1024:
+        ref = ray_tpu.put(value)
+        if p2p_dst is not None:
+            group._p2p_refs[(p2p_dst, seq)] = ref
+        else:
+            group._refs.append((seq, ref))    # pin until GC horizon
+        payload = b"R" + ref.binary()
+    else:
+        payload = b"I" + blob
+    _client().kv_put(_NS, _key(group.name, seq, tag), payload)
+
+
+def _get_blob(group: _Group, seq: int, tag: str,
+              timeout: Optional[float] = None) -> Any:
+    key = _key(group.name, seq, tag)
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        raw = _client().kv_get(_NS, key)
+        if raw is not None:
+            break
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(
+                f"collective {tag} (group={group.name!r} seq={seq}) "
+                f"timed out after {timeout}s")
+        time.sleep(_POLL_S)
+    if raw[:1] == b"R":
+        from ray_tpu.object_ref import ObjectRef
+        return ray_tpu.get(ObjectRef._from_wire(raw[1:]))
+    return pickle.loads(raw[1:])
+
+
+def _gc_horizon(group: _Group, seq: int) -> None:
+    """Delete this rank's keys from op seq-2 (provably unread by now)."""
+    old = seq - 2
+    if old < 0:
+        return
+    c = _client()
+    prefix = f"{group.name}/{old:09d}/r{group.rank}".encode()
+    for key in c.kv_keys(_NS, prefix):
+        c.kv_del(_NS, key)
+    if group.rank == 0:
+        c.kv_del(_NS, _key(group.name, old, "result"))
+    group._refs = [(s, r) for (s, r) in group._refs if s > old]
+
+
+# ---------------------------------------------------------------------------
+# group management
+# ---------------------------------------------------------------------------
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> None:
+    """Join `group_name` as `rank` of `world_size`.  Called inside each
+    participating actor/task (reference: collective.py:258)."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside [0, {world_size})")
+    with _lock:
+        if group_name in _groups:
+            raise RuntimeError(f"group {group_name!r} already initialized "
+                               f"in this process")
+        _groups[group_name] = _Group(group_name, world_size, rank)
+    # Rendezvous: every rank registers, all wait for a full roster.
+    _client().kv_put(_NS, f"{group_name}/roster/{rank}".encode(),
+                     str(world_size).encode())
+    g = _groups[group_name]
+    deadline = time.monotonic() + 120.0
+    while True:
+        n = len(_client().kv_keys(_NS, f"{group_name}/roster/".encode()))
+        if n >= world_size:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"collective group {group_name!r}: only {n}/{world_size} "
+                f"ranks joined within 120s")
+        time.sleep(_POLL_S)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    with _lock:
+        return group_name in _groups
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        g = _groups.pop(group_name, None)
+    if g is None:
+        return
+    c = _client()
+    c.kv_del(_NS, f"{group_name}/roster/{g.rank}".encode())
+    for key in c.kv_keys(_NS, f"{group_name}/".encode()):
+        c.kv_del(_NS, key)
+
+
+def _group(name: str) -> _Group:
+    with _lock:
+        g = _groups.get(name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {name!r} is not initialized in this "
+            f"process (call init_collective_group first)")
+    return g
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+_REDUCERS = {
+    "sum": lambda stack: np.sum(stack, axis=0),
+    "prod": lambda stack: np.prod(stack, axis=0),
+    "max": lambda stack: np.max(stack, axis=0),
+    "min": lambda stack: np.min(stack, axis=0),
+    "mean": lambda stack: np.mean(stack, axis=0),
+}
+
+
+def _finish(arr, out):
+    """In-place update for numpy inputs + always return the result."""
+    if isinstance(arr, np.ndarray):
+        arr[...] = out
+        return arr
+    try:
+        import jax.numpy as jnp
+        return jnp.asarray(out)
+    except ImportError:           # pragma: no cover
+        return out
+
+
+def allreduce(arr, op: str = "sum", group_name: str = "default"):
+    """Reduce across ranks (rank-0 root reduce + broadcast over the
+    object plane).  Reference: collective.py:327."""
+    g = _group(group_name)
+    seq = g.seq
+    g.seq += 1
+    _gc_horizon(g, seq)
+    reducer = _REDUCERS.get(op)
+    if reducer is None:
+        raise ValueError(f"unknown reduce op {op!r} "
+                         f"(have {sorted(_REDUCERS)})")
+    local = np.asarray(arr)
+    if g.world_size == 1:
+        return _finish(arr, local)
+    _put_blob(g, seq, f"r{g.rank}", local)
+    if g.rank == 0:
+        parts = [_get_blob(g, seq, f"r{r}") for r in range(g.world_size)]
+        out = reducer(np.stack([np.asarray(p) for p in parts]))
+        out = out.astype(local.dtype) if op != "mean" else out
+        _put_blob(g, seq, "result", out)
+    else:
+        out = np.asarray(_get_blob(g, seq, "result"))
+    return _finish(arr, out)
+
+
+def barrier(group_name: str = "default") -> None:
+    """All ranks wait until every rank arrives (collective.py:367)."""
+    g = _group(group_name)
+    seq = g.seq
+    g.seq += 1
+    _gc_horizon(g, seq)
+    if g.world_size == 1:
+        return
+    _put_blob(g, seq, f"r{g.rank}", True)
+    for r in range(g.world_size):
+        _get_blob(g, seq, f"r{r}")
+
+
+def broadcast(arr, src_rank: int = 0, group_name: str = "default"):
+    """Copy src_rank's array to every rank (collective.py:389)."""
+    g = _group(group_name)
+    seq = g.seq
+    g.seq += 1
+    _gc_horizon(g, seq)
+    if g.world_size == 1:
+        return _finish(arr, np.asarray(arr))
+    if g.rank == src_rank:
+        _put_blob(g, seq, "result", np.asarray(arr))
+        out = np.asarray(arr)
+    else:
+        out = np.asarray(_get_blob(g, seq, "result"))
+    return _finish(arr, out)
+
+
+def allgather(arr, group_name: str = "default") -> List[np.ndarray]:
+    """Every rank receives [arr_0, ..., arr_{n-1}] (collective.py:433)."""
+    g = _group(group_name)
+    seq = g.seq
+    g.seq += 1
+    _gc_horizon(g, seq)
+    local = np.asarray(arr)
+    if g.world_size == 1:
+        return [local]
+    _put_blob(g, seq, f"r{g.rank}", local)
+    return [np.asarray(_get_blob(g, seq, f"r{r}"))
+            for r in range(g.world_size)]
+
+
+def reducescatter(arr, op: str = "sum",
+                  group_name: str = "default") -> np.ndarray:
+    """Reduce then scatter row-shards: rank i gets the i-th 1/n slice
+    along axis 0 of the reduction (collective.py:469)."""
+    g = _group(group_name)
+    reducer = _REDUCERS.get(op)
+    if reducer is None:
+        raise ValueError(f"unknown reduce op {op!r}")
+    local = np.asarray(arr)
+    if local.shape[0] % g.world_size:
+        raise ValueError(
+            f"reducescatter needs dim0 ({local.shape[0]}) divisible by "
+            f"world_size ({g.world_size})")
+    seq = g.seq
+    g.seq += 1
+    _gc_horizon(g, seq)
+    if g.world_size == 1:
+        return reducer(np.stack([local]))
+    # Scatter-then-reduce: each rank publishes only the slice destined
+    # for each peer, so no rank ever holds the full stacked array.
+    shards = np.split(local, g.world_size, axis=0)
+    for r, shard in enumerate(shards):
+        if r != g.rank:
+            _put_blob(g, seq, f"r{g.rank}:{r}", shard)
+    parts = [shards[g.rank] if r == g.rank
+             else np.asarray(_get_blob(g, seq, f"r{r}:{g.rank}"))
+             for r in range(g.world_size)]
+    out = reducer(np.stack(parts))
+    return out if op == "mean" else out.astype(local.dtype)
+
+
+def send(arr, dst_rank: int, group_name: str = "default") -> None:
+    """Point-to-point send (collective.py:551).  Pairwise FIFO.
+    Large-payload pins are released when the receiver acks (or at
+    destroy_collective_group)."""
+    g = _group(group_name)
+    if dst_rank == g.rank:
+        raise ValueError("send to self")
+    pair = (g.rank, dst_rank)
+    seq = g.p2p_seq.get(pair, 0)
+    g.p2p_seq[pair] = seq + 1
+    # Release pins the receiver has acked.
+    c = _client()
+    for (dst, s) in list(g._p2p_refs):
+        if dst != dst_rank:
+            continue
+        ack = _key(g.name, s, f"p2pack/{g.rank}->{dst}")
+        if c.kv_get(_NS, ack) is not None:
+            del g._p2p_refs[(dst, s)]
+            c.kv_del(_NS, ack)
+    _put_blob(g, seq, f"p2p/{g.rank}->{dst_rank}", np.asarray(arr),
+              p2p_dst=dst_rank)
+
+
+def recv(arr, src_rank: int, group_name: str = "default"):
+    """Point-to-point receive matching `send` (collective.py:571)."""
+    g = _group(group_name)
+    if src_rank == g.rank:
+        raise ValueError("recv from self")
+    pair = (src_rank, g.rank)
+    seq = g.p2p_seq.get(pair, 0)
+    g.p2p_seq[pair] = seq + 1
+    out = np.asarray(_get_blob(g, seq, f"p2p/{src_rank}->{g.rank}"))
+    c = _client()
+    c.kv_del(_NS, _key(g.name, seq, f"p2p/{src_rank}->{g.rank}"))
+    # Ack so the sender can release its object-store pin.
+    c.kv_put(_NS, _key(g.name, seq, f"p2pack/{src_rank}->{g.rank}"),
+             b"1")
+    return _finish(arr, out)

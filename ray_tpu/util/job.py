@@ -1,0 +1,212 @@
+"""Job submission: run an entrypoint command on the cluster.
+
+Reference surface: python/ray/dashboard/modules/job/sdk.py
+(JobSubmissionClient.submit_job/stop_job/get_job_status/get_job_logs)
+backed by the JobSupervisor actor pattern
+(modules/job/job_supervisor.py): a detached, zero-CPU supervisor actor
+runs the entrypoint as a child process on some cluster node, streams its
+combined output and status transitions into GCS KV, and survives the
+submitting client.
+
+The child process inherits `RAY_TPU_GCS_ADDRESS`, so a plain
+`ray_tpu.init()` inside the job script joins the same cluster
+(reference: RAY_ADDRESS injection)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+_NS = "jobs"
+TERMINAL = ("SUCCEEDED", "FAILED", "STOPPED")
+
+
+@ray_tpu.remote
+class _JobSupervisor:
+    """Runs ONE job entrypoint; lives on whichever node scheduled it."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 gcs_address: Optional[str],
+                 packed_env: Optional[dict]) -> None:
+        import subprocess
+        import threading
+
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self._log_chunks: List[bytes] = []
+        self._stopped = False
+
+        env = dict(os.environ)
+        if gcs_address:
+            env["RAY_TPU_GCS_ADDRESS"] = gcs_address
+        cwd = None
+        if packed_env:
+            # packed by runtime_env.pack on the submitting side:
+            # working_dir arrives as an object-store archive, so jobs
+            # run with their code on ANY node, like task runtime envs.
+            from ray_tpu._private import runtime_env as rte
+            from ray_tpu._private.client import get_global_client
+            for k, v in (packed_env.get("env_vars") or {}).items():
+                env[str(k)] = str(v)
+            wd = packed_env.get("working_dir")
+            if wd:
+                cwd = rte._ensure_extracted(
+                    wd, get_global_client().session_dir)
+                env["PYTHONPATH"] = (cwd + os.pathsep
+                                     + env.get("PYTHONPATH", ""))
+        try:
+            self.proc = subprocess.Popen(
+                entrypoint, shell=True, env=env, cwd=cwd,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        except OSError as e:
+            self._log_chunks.append(
+                f"job spawn failed: {e!r}\n".encode())
+            self._flush_logs()
+            self._set_status("FAILED", rc=None)
+            raise
+        # Status flips to RUNNING only once the process exists — a
+        # failed spawn must never leave a phantom RUNNING record.
+        self._set_status("RUNNING")
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+        self._pump.start()
+
+    # -- state in GCS KV (survives this actor) -------------------------
+    def _kv(self):
+        from ray_tpu._private.client import get_global_client
+        return get_global_client()
+
+    def _set_status(self, status: str, rc: Optional[int] = None) -> None:
+        meta = {"job_id": self.job_id, "status": status,
+                "entrypoint": getattr(self, "entrypoint", ""),
+                "return_code": rc, "update_time": time.time()}
+        self._kv().kv_put(_NS, f"{self.job_id}/meta".encode(),
+                          json.dumps(meta).encode())
+
+    def _flush_logs(self) -> None:
+        self._kv().kv_put(_NS, f"{self.job_id}/logs".encode(),
+                          b"".join(self._log_chunks))
+
+    def _pump_loop(self) -> None:
+        for line in self.proc.stdout:
+            self._log_chunks.append(line)
+            if len(self._log_chunks) % 20 == 0:
+                self._flush_logs()
+        rc = self.proc.wait()
+        self._flush_logs()
+        if self._stopped:
+            self._set_status("STOPPED", rc)
+        elif rc == 0:
+            self._set_status("SUCCEEDED", rc)
+        else:
+            self._set_status("FAILED", rc)
+
+    # -- control -------------------------------------------------------
+    def stop(self) -> bool:
+        self._stopped = True
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:
+                self.proc.kill()
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+
+class JobSubmissionClient:
+    """Submit/inspect jobs on a cluster (sdk.py:109)."""
+
+    def __init__(self, address: Optional[str] = None) -> None:
+        self._owns_session = False
+        if not ray_tpu.is_initialized():
+            gcs = None
+            if address:
+                host, _, port = address.rpartition(":")
+                gcs = (host or "127.0.0.1", int(port))
+            ray_tpu.init(num_cpus=0, gcs_address=gcs)
+            self._owns_session = True
+        if address is None:
+            # Already-initialized driver: recover the cluster address so
+            # job scripts join THIS cluster instead of silently starting
+            # their own (node_info carries the node's gcs_address).
+            from ray_tpu._private.client import get_global_client
+            ga = get_global_client().node_info().get("gcs_address")
+            if ga:
+                address = f"{ga[0]}:{ga[1]}"
+        self.address = address
+
+    # -- API -----------------------------------------------------------
+    def submit_job(self, entrypoint: str,
+                   runtime_env: Optional[dict] = None,
+                   submission_id: Optional[str] = None) -> str:
+        job_id = submission_id or f"job-{uuid.uuid4().hex[:10]}"
+        from ray_tpu._private import runtime_env as rte
+        packed = rte.pack(runtime_env)
+        # An epsilon of CPU keeps the supervisor off resourceless
+        # transient client nodes (it must outlive this client).
+        sup = _JobSupervisor.options(
+            resources={"CPU": 0.001}, lifetime="detached",
+            name=f"_job_supervisor:{job_id}",
+        ).remote(job_id, entrypoint, self.address, packed)
+        # Surface immediate spawn failures (bad cwd etc.) synchronously.
+        ray_tpu.get(sup.ping.remote(), timeout=60)
+        return job_id
+
+    def _kv(self):
+        from ray_tpu._private.client import get_global_client
+        return get_global_client()
+
+    def get_job_status(self, job_id: str) -> str:
+        raw = self._kv().kv_get(_NS, f"{job_id}/meta".encode())
+        if raw is None:
+            raise ValueError(f"no such job {job_id!r}")
+        return json.loads(raw)["status"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        raw = self._kv().kv_get(_NS, f"{job_id}/meta".encode())
+        if raw is None:
+            raise ValueError(f"no such job {job_id!r}")
+        return json.loads(raw)
+
+    def get_job_logs(self, job_id: str) -> str:
+        raw = self._kv().kv_get(_NS, f"{job_id}/logs".encode())
+        return (raw or b"").decode(errors="replace")
+
+    def list_jobs(self) -> List[dict]:
+        out = []
+        for key in self._kv().kv_keys(_NS):
+            if key.endswith(b"/meta"):
+                raw = self._kv().kv_get(_NS, key)
+                if raw:
+                    out.append(json.loads(raw))
+        return sorted(out, key=lambda j: j.get("update_time", 0))
+
+    def wait(self, job_id: str, timeout: float = 300.0,
+             poll_s: float = 0.2) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get_job_status(job_id)
+            if status in TERMINAL:
+                return status
+            time.sleep(poll_s)
+        raise TimeoutError(f"job {job_id} still "
+                           f"{self.get_job_status(job_id)} "
+                           f"after {timeout}s")
+
+    def stop_job(self, job_id: str) -> bool:
+        try:
+            sup = ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+        except ValueError:
+            return False
+        return ray_tpu.get(sup.stop.remote(), timeout=30)
+
+    def close(self) -> None:
+        if self._owns_session:
+            ray_tpu.shutdown()

@@ -1,0 +1,259 @@
+"""Application metrics: Counter / Gauge / Histogram + Prometheus export.
+
+Reference surface: python/ray/util/metrics.py (Counter :115, Gauge :188,
+Histogram :263, tag_keys/default_tags semantics) backed by
+_private/metrics_agent.py aggregation.
+
+Here every process (driver or worker) keeps a local registry; a daemon
+flusher batches deltas to the node service over the existing UDS
+connection every `flush_interval_s`, where they aggregate across
+processes.  `scrape()` reads the merged series; `prometheus_text()`
+renders the standard exposition format (what the reference's agent
+serves on its metrics port)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private.client import get_global_client
+
+FLUSH_INTERVAL_S = 1.0
+
+_lock = threading.RLock()
+_registry: List["_Metric"] = []
+_flusher_started = False
+
+# Default histogram bucket upper bounds (seconds-ish scale).
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   60.0)
+
+
+class _Metric:
+    kind = "none"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None) -> None:
+        if not name:
+            raise ValueError("metric name must be non-empty")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        # per-tagset state; subclasses define the value layout
+        self._cells: Dict[Tuple[Tuple[str, str], ...], dict] = {}
+        with _lock:
+            _registry.append(self)
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "_Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tagset(self, tags: Optional[Dict[str, str]]
+                ) -> Tuple[Tuple[str, str], ...]:
+        merged = dict(self._default_tags)
+        if tags:
+            unknown = set(tags) - set(self.tag_keys)
+            if unknown:
+                raise ValueError(
+                    f"tags {sorted(unknown)} not declared in tag_keys "
+                    f"{self.tag_keys} of metric {self.name!r}")
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+    def _cell(self, tags) -> dict:
+        ts = self._tagset(tags)
+        cell = self._cells.get(ts)
+        if cell is None:
+            cell = self._new_cell()
+            self._cells[ts] = cell
+        return cell
+
+    def _new_cell(self) -> dict:
+        raise NotImplementedError
+
+    def _drain(self) -> List[dict]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic counter (reference: util/metrics.py:115)."""
+
+    kind = "counter"
+
+    def _new_cell(self) -> dict:
+        return {"delta": 0.0}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("Counter.inc() requires value >= 0")
+        with self._lock:
+            self._cell(tags)["delta"] += value
+
+    def _drain(self) -> List[dict]:
+        out = []
+        with self._lock:
+            for ts, cell in self._cells.items():
+                if cell["delta"]:
+                    out.append({"name": self.name, "kind": "counter",
+                                "tags": dict(ts),
+                                "value": cell["delta"],
+                                "description": self.description})
+                    cell["delta"] = 0.0
+        return out
+
+
+class Gauge(_Metric):
+    """Last-write-wins value (reference: util/metrics.py:188)."""
+
+    kind = "gauge"
+
+    def _new_cell(self) -> dict:
+        return {"value": 0.0, "dirty": False}
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            cell = self._cell(tags)
+            cell["value"] = float(value)
+            cell["dirty"] = True
+
+    def _drain(self) -> List[dict]:
+        out = []
+        with self._lock:
+            for ts, cell in self._cells.items():
+                if cell["dirty"]:
+                    out.append({"name": self.name, "kind": "gauge",
+                                "tags": dict(ts),
+                                "value": cell["value"],
+                                "description": self.description})
+                    cell["dirty"] = False
+        return out
+
+
+class Histogram(_Metric):
+    """Bucketed distribution (reference: util/metrics.py:263)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None) -> None:
+        self.boundaries = tuple(sorted(boundaries or DEFAULT_BUCKETS))
+        super().__init__(name, description, tag_keys)
+
+    def _new_cell(self) -> dict:
+        return {"buckets": {str(b): 0 for b in self.boundaries},
+                "sum": 0.0, "count": 0}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            cell = self._cell(tags)
+            for b in self.boundaries:
+                if value <= b:
+                    cell["buckets"][str(b)] += 1
+                    break
+            cell["sum"] += value
+            cell["count"] += 1
+
+    def _drain(self) -> List[dict]:
+        out = []
+        with self._lock:
+            for ts, cell in self._cells.items():
+                if cell["count"]:
+                    out.append({"name": self.name, "kind": "histogram",
+                                "tags": dict(ts),
+                                "value": 0.0,
+                                "buckets": dict(cell["buckets"]),
+                                "sum": cell["sum"],
+                                "count": cell["count"],
+                                "description": self.description})
+                    self._cells[ts] = self._new_cell()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# flush + scrape
+# ---------------------------------------------------------------------------
+def flush() -> None:
+    """Push pending deltas to the node service now (also called by the
+    daemon flusher)."""
+    client = get_global_client()
+    if client is None:
+        return
+    batch: List[dict] = []
+    with _lock:
+        metrics = list(_registry)
+    for m in metrics:
+        batch.extend(m._drain())
+    if batch:
+        try:
+            client.metrics_push(batch)
+        except Exception:
+            pass
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    with _lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(FLUSH_INTERVAL_S)
+            flush()
+
+    threading.Thread(target=loop, daemon=True,
+                     name="rtpu-metrics-flusher").start()
+
+
+def scrape() -> List[dict]:
+    """Merged series from the node service (includes runtime built-ins
+    like ray_tpu_tasks_pending and object-store usage)."""
+    flush()
+    client = get_global_client()
+    if client is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return client.metrics_scrape()
+
+
+def prometheus_text() -> str:
+    """Render `scrape()` in the Prometheus exposition format the
+    reference's metrics agent serves."""
+    lines: List[str] = []
+    seen_help = set()
+    for s in sorted(scrape(), key=lambda s: s["name"]):
+        name = s["name"]
+        if name not in seen_help:
+            seen_help.add(name)
+            if s.get("description"):
+                lines.append(f"# HELP {name} {s['description']}")
+            lines.append(f"# TYPE {name} {s['kind']}")
+        tags = s.get("tags") or {}
+        label = ("{" + ",".join(f'{k}="{v}"'
+                                for k, v in sorted(tags.items())) + "}"
+                 if tags else "")
+        if s["kind"] == "histogram":
+            acc = 0
+            for b in sorted(s["buckets"], key=float):
+                acc += s["buckets"][b]
+                ltags = dict(tags, le=b)
+                lab = "{" + ",".join(
+                    f'{k}="{v}"' for k, v in sorted(ltags.items())) + "}"
+                lines.append(f"{name}_bucket{lab} {acc}")
+            inf = dict(tags, le="+Inf")
+            lab = "{" + ",".join(f'{k}="{v}"'
+                                 for k, v in sorted(inf.items())) + "}"
+            lines.append(f"{name}_bucket{lab} {int(s['count'])}")
+            lines.append(f"{name}_sum{label} {s['sum']}")
+            lines.append(f"{name}_count{label} {int(s['count'])}")
+        else:
+            lines.append(f"{name}{label} {s['value']}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,116 @@
+"""State API: live introspection of the running cluster.
+
+Reference surface: python/ray/util/state/api.py (list_actors :429,
+list_tasks :576, list_objects :629, list_nodes :502, list_workers :523,
+list_placement_groups :475, summarize_tasks :793).
+
+Implementation: one `state_dump` RPC to the local node service, which
+snapshots its own tables and — in multinode mode — fans out to every
+alive peer over the control plane and merges.  Filters run driver-side
+(the reference pushes predicates to the dashboard head; at our scale a
+post-filter over the merged snapshot is the same observable behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private.client import get_global_client
+
+
+def _dump() -> dict:
+    client = get_global_client()
+    if client is None:
+        import ray_tpu
+        ray_tpu.init()
+        client = get_global_client()
+    return client.state_dump(cluster=True)
+
+
+def _apply_filters(rows: List[dict],
+                   filters: Optional[Sequence[Tuple[str, str, Any]]],
+                   limit: int) -> List[dict]:
+    """Filters are (key, "=" | "!=", value) triples, per the reference's
+    list API predicate form."""
+    out = []
+    for row in rows:
+        ok = True
+        for key, pred, val in (filters or []):
+            have = row.get(key)
+            if pred == "=":
+                ok = have == val
+            elif pred == "!=":
+                ok = have != val
+            else:
+                raise ValueError(f"unsupported predicate {pred!r} "
+                                 "(use '=' or '!=')")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+            if len(out) >= limit:
+                break
+    return out
+
+
+def list_tasks(filters=None, limit: int = 10_000) -> List[dict]:
+    return _apply_filters(_dump()["tasks"], filters, limit)
+
+
+def list_actors(filters=None, limit: int = 10_000) -> List[dict]:
+    return _apply_filters(_dump()["actors"], filters, limit)
+
+
+def list_workers(filters=None, limit: int = 10_000) -> List[dict]:
+    return _apply_filters(_dump()["workers"], filters, limit)
+
+
+def list_objects(filters=None, limit: int = 10_000) -> List[dict]:
+    return _apply_filters(_dump()["objects"], filters, limit)
+
+
+def list_placement_groups(filters=None, limit: int = 10_000) -> List[dict]:
+    return _apply_filters(_dump()["placement_groups"], filters, limit)
+
+
+def list_nodes(filters=None, limit: int = 10_000) -> List[dict]:
+    dump = _dump()
+    nodes = dump.get("nodes")
+    if nodes is None:   # single-node mode: synthesize the head entry
+        nodes = [{"node_id": dump["node_id"], "state": "alive",
+                  "pending_tasks": dump["pending_tasks"]}]
+    rows = []
+    for n in nodes:
+        row = dict(n)
+        nid = row.get("node_id")
+        if isinstance(nid, bytes):
+            row["node_id"] = nid.hex()
+        rows.append(row)
+    return _apply_filters(rows, filters, limit)
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """Task counts grouped by name then state (api.py:793)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for t in _dump()["tasks"]:
+        per = out.setdefault(t["name"] or "<anonymous>", {})
+        per[t["state"]] = per.get(t["state"], 0) + 1
+    return out
+
+
+def summarize_actors() -> Dict[str, Dict[str, int]]:
+    out: Dict[str, Dict[str, int]] = {}
+    for a in _dump()["actors"]:
+        per = out.setdefault(a["class_name"] or "<anonymous>", {})
+        per[a["state"]] = per.get(a["state"], 0) + 1
+    return out
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = _dump()["objects"]
+    by_loc: Dict[str, int] = {}
+    total = 0
+    for o in objs:
+        by_loc[str(o["loc"])] = by_loc.get(str(o["loc"]), 0) + 1
+        total += o["size"] or 0
+    return {"count": len(objs), "total_bytes": total, "by_loc": by_loc}

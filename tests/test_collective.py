@@ -1,0 +1,108 @@
+"""ray_tpu.util.collective: process-level collectives over the object
+plane (reference surface: python/ray/util/collective/collective.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=False)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self, rank: int, world: int, group: str) -> None:
+        from ray_tpu.util import collective as col
+        self.col = col
+        self.rank = rank
+        self.world = world
+        col.init_collective_group(world, rank, group_name=group)
+        self.group = group
+
+    def run_suite(self):
+        col, g = self.col, self.group
+        out = {}
+        out["rank"] = col.get_rank(g)
+        out["size"] = col.get_collective_group_size(g)
+
+        a = np.full((4,), float(self.rank + 1), np.float64)
+        out["allreduce_sum"] = col.allreduce(a, "sum", g).tolist()
+        # numpy input mutated in place as well
+        out["inplace"] = a.tolist()
+
+        b = np.arange(3, dtype=np.int64) * (self.rank + 1)
+        out["bcast"] = col.broadcast(b, src_rank=1, group_name=g).tolist()
+
+        gathered = col.allgather(
+            np.array([self.rank], np.int32), g)
+        out["allgather"] = [x.tolist() for x in gathered]
+
+        rs = col.reducescatter(
+            np.arange(self.world * 2, dtype=np.float32) + self.rank, "sum", g)
+        out["reducescatter"] = rs.tolist()
+
+        col.barrier(g)
+
+        # big-array path (> 64 KB inline cap -> object store)
+        big = np.full((50_000,), float(self.rank), np.float64)
+        out["big_sum0"] = float(col.allreduce(big, "sum", g)[0])
+
+        # p2p ring: rank r sends to (r+1) % world, receives from r-1
+        msg = np.array([10 * self.rank], np.int64)
+        nxt = (self.rank + 1) % self.world
+        prv = (self.rank - 1) % self.world
+        if self.rank % 2 == 0:
+            col.send(msg, nxt, g)
+            got = col.recv(np.zeros(1, np.int64), prv, g)
+        else:
+            got = col.recv(np.zeros(1, np.int64), prv, g)
+            col.send(msg, nxt, g)
+        out["p2p"] = got.tolist()
+        return out
+
+
+def test_collective_suite(rt):
+    world = 3
+    workers = [Worker.remote(r, world, "g1") for r in range(world)]
+    results = ray_tpu.get([w.run_suite.remote() for w in workers],
+                          timeout=120)
+    by_rank = {r["rank"]: r for r in results}
+    assert sorted(by_rank) == [0, 1, 2]
+    for r, res in by_rank.items():
+        assert res["size"] == world
+        # sum over ranks of (rank+1) = 6, per element
+        assert res["allreduce_sum"] == [6.0] * 4
+        assert res["inplace"] == [6.0] * 4
+        # broadcast from rank 1: arange(3) * 2
+        assert res["bcast"] == [0, 2, 4]
+        assert res["allgather"] == [[0], [1], [2]]
+        # reducescatter: sum_r (arange(6)+r) = 3*arange(6)+3; rank slice
+        full = (3 * np.arange(6) + 3).astype(np.float32)
+        assert res["reducescatter"] == full[2 * r:2 * r + 2].tolist()
+        assert res["big_sum0"] == 3.0   # 0+1+2
+        assert res["p2p"] == [10 * ((r - 1) % world)]
+
+
+def test_single_rank_group(rt):
+    from ray_tpu.util import collective as col
+    col.init_collective_group(1, 0, group_name="solo")
+    try:
+        assert col.allreduce(np.ones(2), "sum", "solo").tolist() == [1, 1]
+        col.barrier("solo")
+        assert col.allgather(np.ones(1), "solo")[0].tolist() == [1.0]
+    finally:
+        col.destroy_collective_group("solo")
+    assert not col.is_group_initialized("solo")
+
+
+def test_errors(rt):
+    from ray_tpu.util import collective as col
+    with pytest.raises(RuntimeError, match="not initialized"):
+        col.allreduce(np.ones(1))
+    with pytest.raises(ValueError):
+        col.init_collective_group(2, 5, group_name="bad")

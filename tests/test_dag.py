@@ -1,0 +1,161 @@
+"""Compiled graphs (ray_tpu.dag): channels + actor pipeline loops
+(reference: python/ray/dag/compiled_dag_node.py, experimental/channel)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.experimental.channel import Channel, ChannelClosed
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# channel primitive
+# ---------------------------------------------------------------------------
+def test_channel_spsc_roundtrip(tmp_path):
+    path = str(tmp_path / "ch")
+    w = Channel(path, capacity=2, slot_size=4096, create=True)
+    r = Channel(path)
+    w.write({"x": 1})
+    w.write([1, 2, 3])
+    assert r.read() == {"x": 1}
+    assert r.read() == [1, 2, 3]
+
+    # capacity backpressure: 3rd write blocks until a read frees a slot
+    w.write("a")
+    w.write("b")
+    got = []
+
+    def delayed_read():
+        time.sleep(0.2)
+        got.append(r.read())
+
+    t = threading.Thread(target=delayed_read)
+    t.start()
+    t0 = time.time()
+    w.write("c")                      # must wait for the read
+    assert time.time() - t0 > 0.1
+    t.join()
+    assert got == ["a"]
+
+    # closing poisons the peer
+    w.close(unlink=True)
+    with pytest.raises(ChannelClosed):
+        r.read()
+    r.close()
+
+
+def test_channel_oversize_rejected(tmp_path):
+    w = Channel(str(tmp_path / "ch2"), capacity=1, slot_size=128,
+                create=True)
+    with pytest.raises(ValueError, match="slot_size"):
+        w.write(b"x" * 4096)
+    w.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# compiled DAGs
+# ---------------------------------------------------------------------------
+@ray_tpu.remote
+class Stage:
+    def __init__(self, k):
+        self.k = k
+        self.calls = 0
+
+    def mul(self, x):
+        self.calls += 1
+        return x * self.k
+
+    def add(self, x, y):
+        return x + y
+
+    def get_calls(self):
+        return self.calls
+
+
+def test_linear_chain_two_actors(rt):
+    a = Stage.remote(2)
+    b = Stage.remote(10)
+    with InputNode() as inp:
+        x = a.mul.bind(inp)
+        y = b.mul.bind(x)
+    dag = y.experimental_compile()
+    try:
+        assert dag.execute(3).get(timeout=30) == 60
+        assert dag.execute(5).get(timeout=30) == 100
+    finally:
+        dag.teardown()
+    # actor serves normal calls again after teardown
+    assert ray_tpu.get(a.get_calls.remote(), timeout=30) == 2
+
+
+def test_pipelined_executes(rt):
+    a = Stage.remote(3)
+    with InputNode() as inp:
+        out = a.mul.bind(inp)
+    dag = out.experimental_compile()
+    try:
+        refs = [dag.execute(i) for i in range(5)]
+        # out-of-order get: later ref first
+        assert refs[3].get(timeout=30) == 9
+        assert [refs[i].get(timeout=30) for i in (0, 1, 2, 4)] \
+            == [0, 3, 6, 12]
+    finally:
+        dag.teardown()
+
+
+def test_fan_out_fan_in(rt):
+    a = Stage.remote(2)
+    b = Stage.remote(5)
+    c = Stage.remote(1)
+    with InputNode() as inp:
+        xa = a.mul.bind(inp)
+        xb = b.mul.bind(inp)
+        s = c.add.bind(xa, xb)
+    dag = s.experimental_compile()
+    try:
+        assert dag.execute(4).get(timeout=30) == 8 + 20
+    finally:
+        dag.teardown()
+
+
+def test_same_actor_local_edge_and_multi_output(rt):
+    a = Stage.remote(2)
+    b = Stage.remote(7)
+    with InputNode() as inp:
+        x1 = a.mul.bind(inp)          # a: 2x
+        x2 = a.mul.bind(x1)           # a again: local edge, 4x
+        x3 = b.mul.bind(x1)           # cross edge 14x
+    dag = MultiOutputNode([x2, x3]).experimental_compile()
+    try:
+        assert dag.execute(1).get(timeout=30) == [4, 14]
+        # only one channel dir entry per cross-process edge: the a->a
+        # edge must not have a channel file
+        sess = ray_tpu._session.session_dir
+        files = os.listdir(os.path.join(sess, "channels"))
+        # edges: input->a, a->b, a->driver, b->driver = 4
+        assert len([f for f in files
+                    if f.startswith(f"dag-{dag._dag_id}")]) == 4
+    finally:
+        dag.teardown()
+
+
+def test_const_args(rt):
+    a = Stage.remote(1)
+    with InputNode() as inp:
+        out = a.add.bind(inp, 100)
+    dag = out.experimental_compile()
+    try:
+        assert dag.execute(1).get(timeout=30) == 101
+    finally:
+        dag.teardown()

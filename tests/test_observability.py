@@ -1,0 +1,145 @@
+"""State API + metrics + log_to_driver (reference: util/state/api.py,
+util/metrics.py, log monitor `log_to_driver`)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Holder:
+    def __init__(self):
+        self.x = 1
+
+    def bump(self):
+        self.x += 1
+        return self.x
+
+    def record_metrics(self):
+        from ray_tpu.util.metrics import Counter
+        c = Counter("test_requests_total", "requests",
+                    tag_keys=("route",))
+        c.inc(2.0, tags={"route": "a"})
+        from ray_tpu.util import metrics
+        metrics.flush()
+        return True
+
+
+def test_state_lists(rt):
+    from ray_tpu.util import state
+
+    h = Holder.options(name="holder").remote()
+    assert ray_tpu.get(h.bump.remote()) == 2
+    ref = ray_tpu.put(np.zeros(200_000))          # a big shm object
+
+    actors = state.list_actors()
+    assert any(a["name"] == "holder" and a["state"] == "alive"
+               for a in actors)
+    assert all("actor_id" in a and "node_id" in a for a in actors)
+
+    workers = state.list_workers()
+    assert len(workers) >= 1
+    assert all(w["state"] in ("starting", "idle", "busy", "blocked")
+               for w in workers)
+
+    objs = state.list_objects()
+    assert any(o["loc"] == "shm" and o["size"] >= 1_600_000
+               for o in objs)
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1
+
+    # filters
+    alive = state.list_actors(filters=[("state", "=", "alive")])
+    assert alive and all(a["state"] == "alive" for a in alive)
+    none = state.list_actors(filters=[("state", "=", "no_such")])
+    assert none == []
+    with pytest.raises(ValueError):
+        state.list_actors(filters=[("state", ">", "alive")])
+
+    summary = state.summarize_actors()
+    assert any("Holder" in k for k in summary)
+    del ref
+
+
+def test_metrics_aggregate_across_processes(rt):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests_total", "requests",
+                        tag_keys=("route",))
+    c.inc(1.0, tags={"route": "a"})
+    c.inc(5.0, tags={"route": "b"})
+    g = metrics.Gauge("test_queue_depth", "depth")
+    g.set(7.0)
+    h = metrics.Histogram("test_latency_s", "latency",
+                          boundaries=[0.01, 0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+
+    # worker-side increments merge with driver-side ones
+    w = Holder.remote()
+    assert ray_tpu.get(w.record_metrics.remote())
+
+    series = metrics.scrape()
+    by = {(s["name"], tuple(sorted(s["tags"].items()))): s
+          for s in series}
+    assert by[("test_requests_total", (("route", "a"),))]["value"] == 3.0
+    assert by[("test_requests_total", (("route", "b"),))]["value"] == 5.0
+    assert by[("test_queue_depth", ())]["value"] == 7.0
+    hist = by[("test_latency_s", ())]
+    assert hist["count"] == 2 and hist["buckets"]["0.1"] == 1
+
+    # runtime built-ins present
+    assert ("ray_tpu_workers", ()) in by
+    assert by[("ray_tpu_object_store_capacity_bytes", ())]["value"] > 0
+
+    text = metrics.prometheus_text()
+    assert '# TYPE test_requests_total counter' in text
+    assert 'test_requests_total{route="a"} 3.0' in text
+    assert 'test_latency_s_bucket{le="+Inf"} 2' in text
+
+
+def test_metric_tag_validation(rt):
+    from ray_tpu.util.metrics import Counter
+    c = Counter("test_tagged", tag_keys=("k",))
+    with pytest.raises(ValueError):
+        c.inc(1.0, tags={"other": "x"})
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+
+
+@ray_tpu.remote
+def chatty():
+    print("hello-from-worker-stdout")
+    return 1
+
+
+def test_log_to_driver(rt, capfd):
+    assert ray_tpu.get(chatty.remote()) == 1
+    # worker wrote into session logs; tailer forwards within ~0.5s
+    deadline = time.time() + 5.0
+    seen = ""
+    while time.time() < deadline:
+        time.sleep(0.3)
+        seen += capfd.readouterr().err
+        if "hello-from-worker-stdout" in seen:
+            break
+    assert "hello-from-worker-stdout" in seen
+    assert "(worker-" in seen
+
+    import glob, os
+    sess = ray_tpu._session.session_dir
+    logs = glob.glob(os.path.join(sess, "logs", "worker-*.log"))
+    assert logs
+    assert any("hello-from-worker-stdout" in open(p).read()
+               for p in logs)

@@ -1,0 +1,70 @@
+"""runtime_env: env_vars + working_dir/py_modules code shipping
+(reference: runtime_env/runtime_env.py, runtime_env/working_dir.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def read_env(key):
+    return os.environ.get(key)
+
+
+@ray_tpu.remote
+def use_shipped_module():
+    import shipped_mod
+    return shipped_mod.VALUE, os.path.basename(os.getcwd())
+
+
+def test_env_vars_scoped_to_task(rt):
+    opt = read_env.options(
+        runtime_env={"env_vars": {"MY_RTE_FLAG": "on"}})
+    assert ray_tpu.get(opt.remote("MY_RTE_FLAG")) == "on"
+    # a later plain task in (possibly) the same worker must NOT see it
+    assert ray_tpu.get(read_env.remote("MY_RTE_FLAG")) is None
+
+
+def test_working_dir_shipped(rt, tmp_path):
+    wd = tmp_path / "app"
+    wd.mkdir()
+    (wd / "shipped_mod.py").write_text("VALUE = 41 + 1\n")
+    opt = use_shipped_module.options(
+        runtime_env={"working_dir": str(wd)})
+    value, cwd_base = ray_tpu.get(opt.remote())
+    assert value == 42
+    # cwd is the extracted archive dir (content-hash name)
+    assert cwd_base != "app" and len(cwd_base) == 16
+
+
+def test_py_modules_on_actor(rt, tmp_path):
+    mod = tmp_path / "libs"
+    mod.mkdir()
+    (mod / "shipped_mod.py").write_text("VALUE = 'actor-sees-me'\n")
+
+    @ray_tpu.remote
+    class A:
+        def probe(self):
+            import shipped_mod
+            return shipped_mod.VALUE, os.environ.get("ACTOR_FLAG")
+
+    h = A.options(runtime_env={"py_modules": [str(mod)],
+                               "env_vars": {"ACTOR_FLAG": "yes"}}).remote()
+    assert ray_tpu.get(h.probe.remote()) == ("actor-sees-me", "yes")
+
+
+def test_rejected_keys(rt):
+    with pytest.raises(ValueError, match="pip/conda"):
+        read_env.options(runtime_env={"pip": ["numpy"]}).remote("X")
+    with pytest.raises(ValueError, match="does not exist"):
+        read_env.options(
+            runtime_env={"working_dir": "/no/such/dir"}).remote("X")
