@@ -547,6 +547,14 @@ class CoreClient:
     def metrics_scrape(self) -> List[dict]:
         return self.conn.call({"type": "metrics_scrape"})["series"]
 
+    def timeline_events(self, cluster: bool = True) -> List[dict]:
+        return self.conn.call({"type": "timeline",
+                               "cluster": cluster},
+                              timeout=30.0)["events"]
+
+    def profile_event(self, event: dict) -> None:
+        self.conn.notify({"type": "profile_event", "event": event})
+
     # -- placement groups --------------------------------------------------
     def create_pg(self, pg_id: bytes, bundles: List[Dict[str, float]],
                   strategy: str, name: Optional[str],

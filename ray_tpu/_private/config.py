@@ -153,3 +153,9 @@ _D("mesh_default_axes", str, "dp,fsdp,tp",
 _D("train_report_queue_size", int, 64, "Buffered train.report() messages.")
 _D("prefetch_buffer_size", int, 2,
    "Device prefetch depth for host->HBM input pipelines.")
+_D("profile_events_max", int, 10_000,
+   "Per-node ring capacity for profile/trace events (ray.timeline "
+   "analog; reference: RAY_PROFILING event table).")
+_D("workflow_storage_dir", str, "",
+   "Durable workflow storage root (default: ~/.ray_tpu/workflows). "
+   "Deliberately outside the session dir so resume survives shutdown.")

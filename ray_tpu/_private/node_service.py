@@ -265,6 +265,10 @@ class NodeService:
         # (reference: log_monitor.py `log_to_driver`).
         self._log_dir = os.path.join(session_dir, "logs")
         self._log_offsets: Dict[str, int] = {}
+        # Profile/trace event ring (reference: profile events table
+        # behind ray.timeline); workers attach execution spans to
+        # task_done and push custom spans via profile_event.
+        self._events: deque = deque(maxlen=config.profile_events_max)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -513,11 +517,13 @@ class NodeService:
                             "shapes": shapes,
                             "idle_since": self._idle_since}
                 self.gcs.heartbeat(self.node_id, avail, load)
-                # Autoscaler presence flag (written by StandardAutoscaler
-                # into GCS KV): gates infeasible fail-fast vs wait.
+                # Autoscaler lease (StandardAutoscaler refreshes a
+                # timestamp in GCS KV every reconcile): gates infeasible
+                # fail-fast vs wait.  A stale lease (dead autoscaler)
+                # must NOT leave infeasible work pending forever.
                 try:
-                    self._autoscaler_active = bool(
-                        self.gcs.kv_get("cluster", b"autoscaler"))
+                    raw = self.gcs.kv_get("cluster", b"autoscaler")
+                    self._autoscaler_lease = (float(raw) if raw else 0.0)
                 except Exception:
                     pass
                 self._cluster_view = self.gcs.nodes()
@@ -1199,6 +1205,11 @@ class NodeService:
         ctx.reply(m, {"spec": spec})
 
     # -- spillback scheduling (reference: cluster_task_manager spillback) --
+    def _autoscaler_live(self) -> bool:
+        """True while an autoscaler's KV lease is fresh (<15s old)."""
+        lease = getattr(self, "_autoscaler_lease", 0.0)
+        return bool(lease) and time.time() - lease < 15.0
+
     def _local_totals_satisfy(self, res: Dict[str, float]) -> bool:
         return all(v <= self.resources_total.get(k, 0.0) + 1e-9
                    for k, v in (res or {}).items())
@@ -1665,7 +1676,7 @@ class NodeService:
             # infeasible tasks wait and feed the autoscaler).  Otherwise
             # fail fast, cluster-wide totals considered.
             reason = (None if spec.get("pg") is not None
-                      or getattr(self, "_autoscaler_active", False)
+                      or self._autoscaler_live()
                       else self._infeasible_reason(spec.get("resources")))
             if reason is not None and spec.get("actor_id") is None:
                 self.tasks[rec.task_id] = rec
@@ -1905,6 +1916,10 @@ class NodeService:
 
     def _h_task_done(self, ctx: _ConnCtx, m: dict) -> None:
         notify_owner: Optional[bytes] = None
+        prof = m.get("profile")
+        if prof is not None:
+            prof["node_id"] = self.node_id.hex()
+            self._events.append(prof)
         with self.lock:
             rec = self.tasks.pop(m["task_id"], None)
             if (rec is not None and self.multinode
@@ -2144,8 +2159,9 @@ class NodeService:
         # Name reservation happens OUTSIDE the state lock: in multinode
         # mode this is a blocking RPC to the GCS process, and blocking
         # gcs.call() under self.lock can deadlock against GCS pushes.
-        if spec.get("name") and (spec.get("pg") is not None or
-                self._infeasible_reason(spec.get("resources")) is None):
+        if spec.get("name") and (spec.get("pg") is not None
+                or self._autoscaler_live()
+                or self._infeasible_reason(spec.get("resources")) is None):
             ok = self.gcs.register_named_actor(
                 spec.get("namespace", "default"), spec["name"], actor_id)
             if not ok:
@@ -2153,7 +2169,10 @@ class NodeService:
                     f"actor name {spec['name']!r} already taken")})
                 return
         with self.lock:
+            # Same autoscaler gating as the task path: a live autoscaler
+            # may provision the resource, so the actor waits as demand.
             reason = (None if spec.get("pg") is not None
+                      or self._autoscaler_live()
                       else self._infeasible_reason(spec.get("resources")))
             if reason is not None:
                 actor = ActorRecord(actor_id, spec)
@@ -2471,34 +2490,73 @@ class NodeService:
                 "pending_tasks": pending,
                 "store": self._store().stats()}
 
+    def _fanout_peers(self, request: dict, timeout: float = 2.0
+                      ) -> Tuple[List[Tuple[dict, dict]], List[str]]:
+        """Issue one RPC to every alive peer IN PARALLEL; returns
+        ([(node_info, reply)...], [unreachable node id hexes]).  Serial
+        per-peer timeouts would stack past the caller's deadline on big
+        clusters."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        peers = [n for n in self._cluster_view
+                 if n["node_id"] != self.node_id
+                 and n.get("state") == "alive"]
+        if not peers:
+            return [], []
+        results: List[Tuple[dict, dict]] = []
+        unreachable: List[str] = []
+
+        def one(n):
+            try:
+                conn = self._peer_conn_to(n)
+                return n, conn.call(dict(request), timeout=timeout)
+            except Exception:
+                return n, None
+
+        with ThreadPoolExecutor(max_workers=min(8, len(peers))) as ex:
+            for n, reply in ex.map(one, peers):
+                if reply is None:
+                    unreachable.append(n["node_id"].hex())
+                else:
+                    results.append((n, reply))
+        return results, unreachable
+
     def _h_state_dump(self, ctx: _ConnCtx, m: dict) -> None:
         dump = self._local_state_dump()
         if m.get("cluster") and self.multinode:
             merged = {k: list(dump[k]) for k in
                       ("tasks", "actors", "workers", "objects",
                        "placement_groups")}
-            nodes = []
-            for n in self._cluster_view:
-                nodes.append(n)
-                if n["node_id"] == self.node_id:
-                    continue
-                if n.get("state") != "alive":
-                    continue
-                try:
-                    conn = self._peer_conn_to(n)
-                    peer = conn.call({"type": "state_dump",
-                                      "cluster": False}, timeout=2.0)
-                    for k in merged:
-                        merged[k].extend(peer["dump"].get(k, []))
-                except Exception:
-                    pass
-            merged["nodes"] = nodes
+            replies, unreachable = self._fanout_peers(
+                {"type": "state_dump", "cluster": False})
+            for _, peer in replies:
+                for k in merged:
+                    merged[k].extend(peer["dump"].get(k, []))
+            merged["nodes"] = list(self._cluster_view)
+            # Partial snapshots must say so — silently missing nodes
+            # send operators debugging the wrong thing.
+            merged["unreachable_nodes"] = unreachable
             merged["node_id"] = dump["node_id"]
             merged["pending_tasks"] = dump["pending_tasks"]
             merged["store"] = dump["store"]
             ctx.reply(m, {"dump": merged})
             return
         ctx.reply(m, {"dump": dump})
+
+    def _h_profile_event(self, ctx: _ConnCtx, m: dict) -> None:
+        """Custom user span from ray_tpu.util.profiling.span()."""
+        ev = dict(m["event"])
+        ev["node_id"] = self.node_id.hex()
+        self._events.append(ev)
+
+    def _h_timeline(self, ctx: _ConnCtx, m: dict) -> None:
+        events = list(self._events)
+        if m.get("cluster") and self.multinode:
+            replies, _ = self._fanout_peers({"type": "timeline",
+                                             "cluster": False})
+            for _, peer in replies:
+                events.extend(peer["events"])
+        ctx.reply(m, {"events": events})
 
     def _h_metrics_push(self, ctx: _ConnCtx, m: dict) -> None:
         """Merge a batch of metric series from a worker/driver process.

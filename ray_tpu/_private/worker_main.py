@@ -182,14 +182,30 @@ class WorkerRuntime:
 
     # ------------------------------------------------------------------
     def _execute_and_report(self, spec: dict, fn, *args) -> None:
+        import time
+        t0 = time.time()
         try:
             value = fn(*args)
         except BaseException as e:  # noqa: BLE001
-            self._report_error(spec, e)
+            self._report_error(spec, e, start=t0)
             return
-        self._report_value(spec, value)
+        self._report_value(spec, value, start=t0)
 
-    def _report_value(self, spec: dict, value: Any) -> None:
+    def _profile(self, spec: dict, start: Optional[float],
+                 failed: bool) -> Optional[dict]:
+        """Execution-span record shipped with task_done (reference:
+        profile events feeding ray.timeline)."""
+        if start is None:
+            return None
+        import time
+        return {"start": start, "end": time.time(),
+                "name": spec.get("name") or "<task>",
+                "pid": os.getpid(),
+                "actor": spec.get("actor_id") is not None,
+                "failed": failed}
+
+    def _report_value(self, spec: dict, value: Any,
+                      start: Optional[float] = None) -> None:
         n = spec["num_returns"]
         return_ids = spec["return_ids"]
         try:
@@ -204,13 +220,16 @@ class WorkerRuntime:
             returns = [self.client.build_return_meta(oid, v)
                        for oid, v in zip(return_ids, values)]
         except BaseException as e:  # noqa: BLE001
-            self._report_error(spec, e)
+            self._report_error(spec, e, start=start)
             return
         self.client.conn.notify({"type": "task_done",
                                  "task_id": spec["task_id"],
-                                 "returns": returns, "failed": False})
+                                 "returns": returns, "failed": False,
+                                 "profile": self._profile(spec, start,
+                                                          False)})
 
-    def _report_error(self, spec: dict, error: BaseException) -> None:
+    def _report_error(self, spec: dict, error: BaseException,
+                      start: Optional[float] = None) -> None:
         name = spec.get("name", "<task>")
         if isinstance(error, exc.TaskError):
             task_err: Exception = error  # propagate nested task errors as-is
@@ -229,7 +248,9 @@ class WorkerRuntime:
                    for oid in spec["return_ids"]]
         self.client.conn.notify({"type": "task_done",
                                  "task_id": spec["task_id"],
-                                 "returns": returns, "failed": True})
+                                 "returns": returns, "failed": True,
+                                 "profile": self._profile(spec, start,
+                                                          True)})
 
 
 def main() -> None:

@@ -48,11 +48,17 @@ class StandardAutoscaler:
         # same demand in the meantime.
         self._last_launch = 0.0
         self.launch_cooldown_s = 3.0
-        # Announce to the cluster that an autoscaler is live: node
-        # services mirror this flag and keep infeasible shapes PENDING
-        # (demand) instead of failing them fast.
+        # Announce to the cluster that an autoscaler is live.  The
+        # value is a LEASE timestamp, refreshed by every update(): node
+        # services keep infeasible shapes PENDING (demand) only while
+        # the lease is fresh, so a killed autoscaler doesn't leave
+        # infeasible work hanging forever.
+        self._refresh_lease()
+
+    def _refresh_lease(self) -> None:
         try:
-            self._gcs.kv_put("cluster", b"autoscaler", b"1")
+            self._gcs.kv_put("cluster", b"autoscaler",
+                             str(time.time()).encode())
         except Exception:
             pass
 
@@ -83,6 +89,7 @@ class StandardAutoscaler:
 
     # -- one reconcile step (unit-testable) ----------------------------
     def update(self) -> dict:
+        self._refresh_lease()
         nodes = self._gcs.nodes(alive_only=True)
         workers = self.provider.non_terminated_nodes()
         actions = {"launched": 0, "terminated": 0}

@@ -79,17 +79,40 @@ class LocalNodeProvider(NodeProvider):
              "--gcs-host", self.gcs_address[0],
              "--gcs-port", str(self.gcs_address[1]),
              "--resources", json.dumps(resources)],
-            env=env, stdout=subprocess.PIPE, text=True)
+            env=env, stdout=subprocess.PIPE)
+        # select-based deadline: readline() could block past any wall
+        # clock check if the node prints nothing.  On timeout/exit the
+        # process is killed and NOT registered — a half-launched node
+        # must never count toward max_workers.
+        import select
         deadline = time.time() + 60.0
+        buf = b""
         node_id = b""
-        while time.time() < deadline:
-            line = proc.stdout.readline()
-            if not line:
+        fd = proc.stdout.fileno()
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                proc.kill()
+                raise TimeoutError(
+                    "provider node did not print NODE_READY in 60s")
+            ready, _, _ = select.select([fd], [], [], remaining)
+            if not ready:
+                continue
+            chunk = os.read(fd, 4096)
+            if not chunk:
+                proc.kill()
                 raise RuntimeError(
                     f"provider node exited rc={proc.poll()}")
-            if line.startswith("NODE_READY="):
-                node_id = bytes.fromhex(line.strip().split("=", 1)[1])
+            buf += chunk
+            for line in buf.split(b"\n"):
+                if line.startswith(b"NODE_READY="):
+                    node_id = bytes.fromhex(
+                        line.split(b"=", 1)[1].decode())
+                    break
+            if node_id:
                 break
+            if b"\n" in buf:
+                buf = buf.rsplit(b"\n", 1)[1]   # keep partial tail
         threading.Thread(target=_drain, args=(proc.stdout,),
                          daemon=True).start()
         self._seq += 1

@@ -97,6 +97,14 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Build a task-DAG node (reference: dag/function_node.py) —
+        executed durably by ray_tpu.workflow.run.  Defined here (not
+        monkey-patched at workflow import) so continuations returned
+        from inside workers can bind too."""
+        from ray_tpu.workflow import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def __reduce__(self):
         # Ship the underlying function + options.  The function is handed
         # to the OUTER pickler (not dumped eagerly) so its memo table can

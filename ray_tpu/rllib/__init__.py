@@ -1,0 +1,12 @@
+"""RL library: PPO on actor-parallel rollouts, jit'd learner.
+
+Reference surface: ray/rllib (algorithms/ppo, evaluation/
+rollout_worker.py, env vectorization).  See ppo.py for the TPU-first
+design notes.
+"""
+
+from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker
+
+__all__ = ["PPO", "PPOConfig", "RolloutWorker", "CartPoleEnv",
+           "VectorEnv"]

@@ -1,0 +1,104 @@
+"""RL environments: gym-style API + a vectorized CartPole in numpy.
+
+Reference analog: RLlib's env layer (rllib/env/) consumes external gym
+envs; this tree ships a self-contained classic-control benchmark so the
+algorithm stack runs with zero external dependencies (the image has no
+gym).  The VectorEnv steps N instances batched — rollout workers always
+operate on the vector form.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class CartPoleEnv:
+    """Classic cart-pole balancing (Barto-Sutton-Anderson dynamics, the
+    same constants as the canonical benchmark).  Observation
+    [x, x_dot, theta, theta_dot]; actions {0: left, 1: right}; +1 reward
+    per step; episode ends on |x|>2.4, |theta|>12deg, or step limit."""
+
+    GRAVITY = 9.8
+    CART_M = 1.0
+    POLE_M = 0.1
+    POLE_HALF_L = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * 2 * math.pi / 360
+
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 200,
+                 seed: Optional[int] = None) -> None:
+        self.max_steps = max_steps
+        self.rng = np.random.RandomState(seed)
+        self.state = np.zeros(4, np.float64)
+        self.steps = 0
+
+    def reset(self) -> np.ndarray:
+        self.state = self.rng.uniform(-0.05, 0.05, size=4)
+        self.steps = 0
+        return self.state.astype(np.float32)
+
+    def step(self, action: int
+             ) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        x, x_dot, th, th_dot = self.state
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_m = self.CART_M + self.POLE_M
+        pm_l = self.POLE_M * self.POLE_HALF_L
+        cos, sin = math.cos(th), math.sin(th)
+        tmp = (force + pm_l * th_dot ** 2 * sin) / total_m
+        th_acc = (self.GRAVITY * sin - cos * tmp) / (
+            self.POLE_HALF_L * (4.0 / 3.0
+                                - self.POLE_M * cos ** 2 / total_m))
+        x_acc = tmp - pm_l * th_acc * cos / total_m
+        self.state = np.array([x + self.DT * x_dot,
+                               x_dot + self.DT * x_acc,
+                               th + self.DT * th_dot,
+                               th_dot + self.DT * th_acc])
+        self.steps += 1
+        done = (abs(self.state[0]) > self.X_LIMIT
+                or abs(self.state[2]) > self.THETA_LIMIT
+                or self.steps >= self.max_steps)
+        return self.state.astype(np.float32), 1.0, done, {}
+
+
+class VectorEnv:
+    """N independent env instances, stepped as a batch; auto-resets
+    finished episodes (rllib vector_env semantics)."""
+
+    def __init__(self, make_env, num_envs: int,
+                 seed: int = 0) -> None:
+        self.envs = [make_env(seed + i) for i in range(num_envs)]
+        self.num_envs = num_envs
+        self.episode_returns = np.zeros(num_envs)
+        self.completed_returns: list = []
+
+    def reset(self) -> np.ndarray:
+        self.episode_returns[:] = 0.0
+        return np.stack([e.reset() for e in self.envs])
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        obs, rews, dones = [], [], []
+        for i, (env, a) in enumerate(zip(self.envs, actions)):
+            o, r, d, _ = env.step(int(a))
+            self.episode_returns[i] += r
+            if d:
+                self.completed_returns.append(self.episode_returns[i])
+                self.episode_returns[i] = 0.0
+                o = env.reset()
+            obs.append(o)
+            rews.append(r)
+            dones.append(d)
+        return (np.stack(obs), np.asarray(rews, np.float32),
+                np.asarray(dones, np.bool_))
+
+    def drain_episode_returns(self) -> list:
+        out, self.completed_returns = self.completed_returns, []
+        return out

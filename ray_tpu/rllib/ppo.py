@@ -1,0 +1,343 @@
+"""PPO: clipped-surrogate policy gradient, TPU-first.
+
+Reference surface: rllib/algorithms/ppo/ (PPOConfig, PPO.train()
+returning result dicts with episode_reward_mean) + rollout workers
+(rllib/evaluation/rollout_worker.py) collecting sample batches in
+parallel actors.
+
+TPU-first split:
+* sampling is HOST work — N `RolloutWorker` actors step vectorized envs
+  and run jit'd CPU/TPU policy inference on their own batch;
+* learning is ONE jit'd update: GAE is computed with `lax.scan`
+  (reverse), the clipped-surrogate + value + entropy loss runs
+  minibatched SGD epochs inside a single compiled function; with a mesh
+  the batch shards over `dp` and XLA inserts the gradient psum (this is
+  where multi-chip PPO scales, NOT in the python loop).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+
+
+# ---------------------------------------------------------------------------
+# policy: plain-jax MLP (actor + critic heads)
+# ---------------------------------------------------------------------------
+def init_policy(rng, obs_size: int, num_actions: int,
+                hidden: int = 64):
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.split(rng, 4)
+
+    def dense(key, n_in, n_out):
+        scale = jnp.sqrt(2.0 / n_in)
+        return {"w": jax.random.normal(key, (n_in, n_out)) * scale,
+                "b": jnp.zeros((n_out,))}
+
+    return {"l1": dense(k[0], obs_size, hidden),
+            "l2": dense(k[1], hidden, hidden),
+            "pi": dense(k[2], hidden, num_actions),
+            "vf": dense(k[3], hidden, 1)}
+
+
+def policy_forward(params, obs):
+    import jax.numpy as jnp
+
+    x = jnp.tanh(obs @ params["l1"]["w"] + params["l1"]["b"])
+    x = jnp.tanh(x @ params["l2"]["w"] + params["l2"]["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+# ---------------------------------------------------------------------------
+# rollout worker actor
+# ---------------------------------------------------------------------------
+@ray_tpu.remote
+class RolloutWorker:
+    """Collects `rollout_len` vector-env steps per sample() call
+    (reference: evaluation/rollout_worker.py sample())."""
+
+    def __init__(self, worker_index: int, num_envs: int,
+                 rollout_len: int, env_maker=None,
+                 max_steps: int = 200) -> None:
+        import jax
+
+        maker = env_maker or (
+            lambda seed: CartPoleEnv(max_steps=max_steps, seed=seed))
+        self.vec = VectorEnv(maker, num_envs,
+                             seed=1000 * (worker_index + 1))
+        self.rollout_len = rollout_len
+        self.obs = self.vec.reset()
+        self.rng = jax.random.PRNGKey(worker_index)
+        self._infer = jax.jit(policy_forward)
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        T, N = self.rollout_len, self.vec.num_envs
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T + 1, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+
+        for t in range(T):
+            logits, value = self._infer(params, jnp.asarray(self.obs))
+            self.rng, key = jax.random.split(self.rng)
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(N), action]
+            obs_buf[t] = self.obs
+            act_buf[t] = np.asarray(action)
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            self.obs, rew_buf[t], done_buf[t] = self.vec.step(
+                np.asarray(action))
+        _, last_val = self._infer(params, jnp.asarray(self.obs))
+        val_buf[T] = np.asarray(last_val)
+        return {"obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+                "values": val_buf, "rewards": rew_buf,
+                "dones": done_buf,
+                "episode_returns": self.vec.drain_episode_returns()}
+
+
+# ---------------------------------------------------------------------------
+# jit'd learner
+# ---------------------------------------------------------------------------
+def make_update_fn(optimizer, clip: float, vf_coef: float,
+                   ent_coef: float, gamma: float, lam: float,
+                   num_minibatches: int, num_epochs: int):
+    import jax
+    import jax.numpy as jnp
+
+    def gae(rewards, values, dones):
+        """Reverse-scan GAE over the time axis (lax.scan — no python
+        loop in the compiled program)."""
+        def step(carry, inp):
+            r, v, v_next, d = inp
+            nonterm = 1.0 - d
+            delta = r + gamma * v_next * nonterm - v
+            adv = delta + gamma * lam * nonterm * carry
+            return adv, adv
+
+        _, advs = jax.lax.scan(
+            step, jnp.zeros_like(rewards[0]),
+            (rewards, values[:-1], values[1:],
+             dones.astype(jnp.float32)),
+            reverse=True)
+        return advs
+
+    def loss_fn(params, batch):
+        logits, value = policy_forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["adv"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg = -jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
+        vf = 0.5 * ((value - batch["returns"]) ** 2).mean()
+        ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pg + vf_coef * vf - ent_coef * ent
+        return total, {"pg_loss": pg, "vf_loss": vf, "entropy": ent}
+
+    @jax.jit
+    def update(params, opt_state, rollout, rng):
+        rewards = rollout["rewards"]
+        advs = gae(rewards, rollout["values"], rollout["dones"])
+        returns = advs + rollout["values"][:-1]
+        T, N = rewards.shape
+        flat = {
+            "obs": rollout["obs"].reshape(T * N, -1),
+            "actions": rollout["actions"].reshape(T * N),
+            "logp": rollout["logp"].reshape(T * N),
+            "adv": advs.reshape(T * N),
+            "returns": returns.reshape(T * N),
+        }
+        B = T * N
+        mb = B // num_minibatches
+
+        def epoch(carry, key):
+            params, opt_state = carry
+            perm = jax.random.permutation(key, B)
+
+            def minibatch(carry, idx):
+                params, opt_state = carry
+                sl = jax.lax.dynamic_slice_in_dim(perm, idx * mb, mb)
+                batch = {k: v[sl] for k, v in flat.items()}
+                (_, metrics), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, batch)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                import optax
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), metrics
+
+            (params, opt_state), metrics = jax.lax.scan(
+                minibatch, (params, opt_state),
+                jnp.arange(num_minibatches))
+            return (params, opt_state), metrics
+
+        keys = jax.random.split(rng, num_epochs)
+        (params, opt_state), metrics = jax.lax.scan(
+            epoch, (params, opt_state), keys)
+        return params, opt_state, {
+            k: v.mean() for k, v in metrics.items()}
+
+    return update
+
+
+# ---------------------------------------------------------------------------
+# algorithm + config (builder style, rllib/algorithms/ppo/ppo.py)
+# ---------------------------------------------------------------------------
+class PPOConfig:
+    def __init__(self) -> None:
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 4
+        self.rollout_len = 128
+        self.env_maker: Optional[Callable] = None
+        self.env_max_steps = 200
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.clip = 0.2
+        self.vf_coef = 0.5
+        self.ent_coef = 0.01
+        self.num_minibatches = 4
+        self.num_epochs = 4
+        self.hidden = 64
+        self.seed = 0
+
+    def rollouts(self, *, num_rollout_workers=None,
+                 num_envs_per_worker=None,
+                 rollout_len=None) -> "PPOConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_len is not None:
+            self.rollout_len = rollout_len
+        return self
+
+    def environment(self, env_maker=None, *,
+                    max_steps=None) -> "PPOConfig":
+        if env_maker is not None:
+            self.env_maker = env_maker
+        if max_steps is not None:
+            self.env_max_steps = max_steps
+        return self
+
+    def training(self, *, lr=None, gamma=None, lam=None, clip=None,
+                 vf_coef=None, ent_coef=None, num_minibatches=None,
+                 num_epochs=None, hidden=None) -> "PPOConfig":
+        for k, v in dict(lr=lr, gamma=gamma, lam=lam, clip=clip,
+                         vf_coef=vf_coef, ent_coef=ent_coef,
+                         num_minibatches=num_minibatches,
+                         num_epochs=num_epochs, hidden=hidden).items():
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    """Trainer: parallel actor sampling + one jit'd learner update per
+    train() (reference: Algorithm.train result dict)."""
+
+    def __init__(self, config: PPOConfig) -> None:
+        import jax
+        import optax
+
+        self.config = config
+        rng = jax.random.PRNGKey(config.seed)
+        self._rng, init_rng = jax.random.split(rng)
+        self.params = init_policy(init_rng, CartPoleEnv.observation_size,
+                                  CartPoleEnv.num_actions,
+                                  hidden=config.hidden)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_update_fn(
+            self.optimizer, config.clip, config.vf_coef,
+            config.ent_coef, config.gamma, config.lam,
+            config.num_minibatches, config.num_epochs)
+        self.workers = [
+            RolloutWorker.remote(i, config.num_envs_per_worker,
+                                 config.rollout_len,
+                                 config.env_maker,
+                                 config.env_max_steps)
+            for i in range(config.num_rollout_workers)]
+        self.iteration = 0
+        self._reward_window: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        params_ref = ray_tpu.put(jax.device_get(self.params))
+        samples = ray_tpu.get(
+            [w.sample.remote(params_ref) for w in self.workers])
+        # Concat workers along the env axis -> [T, N_total, ...]
+        rollout = {
+            k: np.concatenate([s[k] for s in samples], axis=1)
+            for k in ("obs", "actions", "logp", "values", "rewards",
+                      "dones")}
+        episode_returns = [r for s in samples
+                           for r in s["episode_returns"]]
+        self._reward_window.extend(episode_returns)
+        self._reward_window = self._reward_window[-100:]
+
+        self._rng, key = jax.random.split(self._rng)
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in rollout.items()}, key)
+        self.iteration += 1
+        steps = rollout["rewards"].size
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(self._reward_window))
+                                    if self._reward_window else 0.0),
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_this_iter": steps,
+            "time_this_iter_s": time.time() - t0,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
+        """Greedy-policy evaluation on a fresh env."""
+        import jax
+        import jax.numpy as jnp
+
+        maker = self.config.env_maker or (
+            lambda seed: CartPoleEnv(max_steps=self.config.env_max_steps,
+                                     seed=seed))
+        infer = jax.jit(policy_forward)
+        returns = []
+        for ep in range(num_episodes):
+            env = maker(10_000 + ep)
+            obs, total, done = env.reset(), 0.0, False
+            while not done:
+                logits, _ = infer(self.params, jnp.asarray(obs[None]))
+                obs, r, done, _ = env.step(int(jnp.argmax(logits[0])))
+                total += r
+            returns.append(total)
+        return {"evaluation_reward_mean": float(np.mean(returns))}
+
+    def stop(self) -> None:
+        for w in self.workers:
+            ray_tpu.kill(w)

@@ -168,14 +168,26 @@ def get_collective_group_size(group_name: str = "default") -> int:
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    """Leave the group.  Deletes only THIS rank's keys — peers may not
+    have read the last op's data yet — except the final leaver, who
+    sweeps the whole prefix once the roster is empty."""
     with _lock:
         g = _groups.pop(group_name, None)
     if g is None:
         return
     c = _client()
     c.kv_del(_NS, f"{group_name}/roster/{g.rank}".encode())
+    own_prefixes = [f"/r{g.rank}".encode(),
+                    f"/p2p/{g.rank}->".encode(),
+                    f"/p2pack/".encode()]
+    if g.rank == 0:
+        own_prefixes.append(b"/result")
     for key in c.kv_keys(_NS, f"{group_name}/".encode()):
-        c.kv_del(_NS, key)
+        if any(p in key for p in own_prefixes):
+            c.kv_del(_NS, key)
+    if not c.kv_keys(_NS, f"{group_name}/roster/".encode()):
+        for key in c.kv_keys(_NS, f"{group_name}/".encode()):
+            c.kv_del(_NS, key)
 
 
 def _group(name: str) -> _Group:

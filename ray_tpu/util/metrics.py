@@ -24,6 +24,11 @@ FLUSH_INTERVAL_S = 1.0
 _lock = threading.RLock()
 _registry: List["_Metric"] = []
 _flusher_started = False
+# Drained-but-unpushed series retried on the next flush: a transient
+# push failure must not lose counter increments.  Bounded so a dead
+# node service doesn't grow memory forever.
+_pending: List[dict] = []
+_PENDING_MAX = 10_000
 
 # Default histogram bucket upper bounds (seconds-ish scale).
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
@@ -182,20 +187,23 @@ class Histogram(_Metric):
 # ---------------------------------------------------------------------------
 def flush() -> None:
     """Push pending deltas to the node service now (also called by the
-    daemon flusher)."""
+    daemon flusher).  Failed pushes requeue the drained batch."""
+    global _pending
     client = get_global_client()
     if client is None:
         return
-    batch: List[dict] = []
     with _lock:
         metrics = list(_registry)
+        batch, _pending = list(_pending), []
     for m in metrics:
         batch.extend(m._drain())
-    if batch:
-        try:
-            client.metrics_push(batch)
-        except Exception:
-            pass
+    if not batch:
+        return
+    try:
+        client.metrics_push(batch)
+    except Exception:
+        with _lock:
+            _pending = (batch + _pending)[:_PENDING_MAX]
 
 
 def _ensure_flusher() -> None:

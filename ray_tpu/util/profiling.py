@@ -1,0 +1,106 @@
+"""Tracing/profiling: runtime timeline + user spans + TPU profiler.
+
+Reference surface:
+* `ray.timeline(filename)` (python/ray/_private/state.py chrome-trace
+  export of profile events),
+* `ray.util.tracing` span instrumentation — here `span()` /
+  `@profiled`, recorded into the same per-node event ring workers feed
+  with task execution spans,
+* TPU side: `tpu_trace()` wraps `jax.profiler.trace`, producing the
+  XLA/TensorBoard profile (the tool that actually explains device time
+  — the runtime timeline explains scheduling time).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.client import get_global_client
+
+
+def _client():
+    c = get_global_client()
+    if c is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return c
+
+
+def timeline_events(cluster: bool = True) -> List[dict]:
+    """Raw profile events: task execution spans (name/start/end/pid/
+    node) + custom `span()` records."""
+    return _client().timeline_events(cluster=cluster)
+
+
+def timeline(filename: Optional[str] = None) -> Any:
+    """Chrome-trace export (open in chrome://tracing or Perfetto).
+    Returns the event list; writes JSON when `filename` is given.
+    Reference: ray.timeline."""
+    traced = []
+    for ev in timeline_events():
+        traced.append({
+            "name": ev.get("name", "<span>"),
+            "cat": ("actor" if ev.get("actor") else
+                    "user" if ev.get("user") else "task"),
+            "ph": "X",
+            "ts": ev["start"] * 1e6,
+            "dur": max(ev["end"] - ev["start"], 0.0) * 1e6,
+            "pid": ev.get("node_id", "node")[:8],
+            "tid": ev.get("pid", 0),
+            "args": {k: v for k, v in ev.items()
+                     if k in ("failed", "extra")},
+        })
+    traced.sort(key=lambda e: e["ts"])
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(traced, f)
+    return traced
+
+
+@contextlib.contextmanager
+def span(name: str, **extra):
+    """Record a custom span from driver or task code into the runtime
+    timeline (reference: ray.util.tracing spans / ray.profile)."""
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        try:
+            _client().profile_event({
+                "name": name, "start": t0, "end": time.time(),
+                "pid": os.getpid(), "user": True,
+                "extra": extra or None})
+        except Exception:
+            pass
+
+
+def profiled(fn=None, *, name: Optional[str] = None):
+    """Decorator form of `span()`."""
+    def deco(f):
+        @functools.wraps(f)
+        def wrapper(*a, **kw):
+            with span(name or f.__qualname__):
+                return f(*a, **kw)
+        return wrapper
+    return deco(fn) if fn is not None else deco
+
+
+@contextlib.contextmanager
+def tpu_trace(logdir: str):
+    """XLA device profile via jax.profiler (view in TensorBoard /
+    xprof).  This captures MXU utilization, HBM traffic, and fusion
+    timing — the device-side complement to the runtime timeline."""
+    import jax
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def annotate(name: str):
+    """Device-side named region (jax.profiler.TraceAnnotation) so jit
+    regions show under `name` in the xprof timeline."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,69 @@
+"""Runtime timeline + spans (reference: ray.timeline, util.tracing)."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import profiling
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def slow(ms):
+    time.sleep(ms / 1000)
+    with profiling.span("inner-work", phase="demo"):
+        time.sleep(0.01)
+    return ms
+
+
+@ray_tpu.remote
+class Act:
+    def ping(self):
+        return 1
+
+
+def test_timeline_records_tasks_actors_spans(rt, tmp_path):
+    ray_tpu.get([slow.remote(30), slow.remote(10)])
+    a = Act.remote()
+    ray_tpu.get(a.ping.remote())
+
+    events = profiling.timeline_events()
+    names = [e["name"] for e in events]
+    assert names.count("slow") == 2
+    assert any(e["name"] == "inner-work" and e.get("user")
+               for e in events)
+    assert any("Act" in n for n in names)   # creation + ping spans
+    for e in events:
+        assert e["end"] >= e["start"]
+        assert "node_id" in e
+    s = next(e for e in events if e["name"] == "slow"
+             and e["end"] - e["start"] > 0.035)
+    assert s["end"] - s["start"] < 5.0
+
+    # chrome trace export
+    out = tmp_path / "trace.json"
+    traced = profiling.timeline(str(out))
+    assert traced and json.load(open(out))
+    assert all(ev["ph"] == "X" and ev["dur"] >= 0 for ev in traced)
+    cats = {ev["cat"] for ev in traced}
+    assert {"task", "actor", "user"} <= cats
+
+
+def test_failed_task_span_flagged(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    events = profiling.timeline_events()
+    assert any(e["name"].endswith("boom") and e.get("failed")
+               for e in events)

@@ -1,0 +1,96 @@
+"""Durable workflows (reference: python/ray/workflow/api.py)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu._private.config import config
+
+
+@pytest.fixture
+def rt(tmp_path):
+    config.set("workflow_storage_dir", str(tmp_path / "wf"))
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    config.reset()
+
+
+COUNTER_FILE = None
+
+
+@ray_tpu.remote
+def add(x, y):
+    return x + y
+
+
+@ray_tpu.remote
+def times2_logged(x, log_path):
+    with open(log_path, "a") as f:
+        f.write("ran\n")
+    return x * 2
+
+
+@ray_tpu.remote
+def flaky(log_path):
+    with open(log_path, "a") as f:
+        f.write("attempt\n")
+    if open(log_path).read().count("attempt") < 2:
+        raise RuntimeError("first attempt fails")
+    return 5
+
+
+def test_run_dag(rt):
+    dag = add.bind(times2_logged.bind(5, "/dev/null"), 3)
+    assert workflow.run(dag, workflow_id="w1") == 13
+    assert workflow.get_status("w1") == "SUCCEEDED"
+    assert workflow.get_output("w1") == 13
+    assert any(m["workflow_id"] == "w1" for m in workflow.list_all())
+
+
+def test_resume_skips_completed_steps(rt, tmp_path):
+    log = str(tmp_path / "log.txt")
+    open(log, "w").close()
+    dag = add.bind(times2_logged.bind(10, log), flaky.bind(log))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == "FAILED"
+    # times2 completed and checkpointed before flaky failed
+    assert open(log).read().count("ran") == 1
+
+    assert workflow.resume("w2") == 25      # 10*2 + 5
+    assert workflow.get_status("w2") == "SUCCEEDED"
+    # resume did NOT re-run the checkpointed times2 step
+    assert open(log).read().count("ran") == 1
+    # flaky ran exactly twice (once per run attempt)
+    assert open(log).read().count("attempt") == 2
+
+
+def test_dynamic_continuation(rt):
+    @ray_tpu.remote
+    def fib(n):
+        if n <= 1:
+            return n
+        return add.bind(fib.bind(n - 1), fib.bind(n - 2))
+
+    assert workflow.run(fib.bind(6), workflow_id="w3") == 8
+    assert workflow.get_status("w3") == "SUCCEEDED"
+
+
+def test_shared_node_executes_once(rt, tmp_path):
+    log = str(tmp_path / "shared.txt")
+    open(log, "w").close()
+    a = times2_logged.bind(3, log)
+    dag = add.bind(a, a)           # diamond: same node, two consumers
+    assert workflow.run(dag, workflow_id="w5") == 12
+    assert open(log).read().count("ran") == 1
+
+
+def test_delete_and_missing(rt):
+    workflow.run(add.bind(1, 2), workflow_id="w4")
+    workflow.delete("w4")
+    with pytest.raises(ValueError):
+        workflow.get_status("w4")
