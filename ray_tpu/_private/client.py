@@ -277,6 +277,7 @@ class CoreClient:
                     actor_spec_extra: Optional[dict] = None,
                     pg: Optional[dict] = None,
                     runtime_env: Optional[dict] = None,
+                    affinity: Optional[dict] = None,
                     ) -> List[ObjectRef]:
         spec_args, embedded = self._pack_args(args, kwargs)
         return_ids = [os.urandom(16) for _ in range(num_returns)]
@@ -297,6 +298,7 @@ class CoreClient:
             "owner": self.client_id,
             "pg": pg,
             "runtime_env": runtime_env,
+            "affinity": affinity,
         }
         if actor_spec_extra:
             spec.update(actor_spec_extra)
@@ -450,7 +452,8 @@ class CoreClient:
                      name: Optional[str], namespace: str,
                      detached: bool,
                      pg: Optional[dict] = None,
-                     runtime_env: Optional[dict] = None
+                     runtime_env: Optional[dict] = None,
+                     affinity: Optional[dict] = None
                      ) -> Tuple[bytes, ObjectRef]:
         actor_id = os.urandom(16)
         spec_args, embedded = self._pack_args(args, kwargs)
@@ -485,6 +488,7 @@ class CoreClient:
             "resources": resources,
             "creation_task": creation_task,
             "pg": pg,
+            "affinity": affinity,
         }
         self.conn.call({"type": "create_actor", "spec": spec})
         return actor_id, ObjectRef(creation_task["return_ids"][0],

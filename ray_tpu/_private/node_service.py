@@ -2121,16 +2121,40 @@ class NodeService:
                                  args=(ctx, m), daemon=True,
                                  name="rtpu-pg-actor").start()
                 return
+        aff = spec.get("affinity")
+        if (self.multinode and pgspec is None and aff is not None
+                and aff["node_id"] != self.node_id):
+            ninfo = self._cluster_node(aff["node_id"])
+            if ninfo is None and not aff.get("soft"):
+                ctx.reply(m, {"__error__": exc.NodeAffinityError(
+                    f"affinity node {aff['node_id'].hex()[:12]} is not "
+                    f"alive (soft=False)")})
+                return
         if self.multinode and pgspec is None:
             # Placement: keep the actor local when this node's totals can
             # ever run it; otherwise forward the whole creation to a peer
             # that can (reference: GCS actor scheduling picks a node).
             res = spec.get("resources") or {}
             with self.lock:
-                local_ok = self._local_totals_satisfy(res)
+                local_ok = (self._local_totals_satisfy(res)
+                            if aff is None
+                            or aff["node_id"] == self.node_id
+                            or self._cluster_node(aff["node_id"]) is None
+                            else False)
             if not local_ok:
-                target = (self._pick_spill_target(res, need_avail=True)
-                          or self._pick_spill_target(res, need_avail=False))
+                if aff is not None:
+                    target = self._cluster_node(aff["node_id"])
+                    if (target is not None
+                            and target["node_id"] == self.node_id):
+                        # Pinned HERE but can't run yet: wait as pending
+                        # like the task path — self-forwarding would
+                        # recurse into our own create_actor forever.
+                        target = None
+                else:
+                    target = (self._pick_spill_target(res,
+                                                      need_avail=True)
+                              or self._pick_spill_target(
+                                  res, need_avail=False))
                 if target is not None:
                     self._actor_homes[actor_id] = target["node_id"]
                     # Track the creation like any forwarded task so this
@@ -2693,6 +2717,29 @@ class NodeService:
                     continue
                 res = dict(rec.spec.get("resources") or {})
                 needs_tpu = res.get("TPU", 0) > 0
+                aff = rec.spec.get("affinity")
+                if aff is not None and aff["node_id"] != self.node_id:
+                    # Node affinity: route to the pinned node; hard
+                    # affinity to a dead node fails, soft falls back
+                    # (reference: NodeAffinitySchedulingStrategy).
+                    ninfo = (self._cluster_node(aff["node_id"])
+                             if self.multinode else None)
+                    if ninfo is not None:
+                        self._forward_task(rec, ninfo)
+                        progressed = True
+                        continue
+                    if aff.get("soft"):
+                        rec.spec["affinity"] = None
+                    else:
+                        self.pending_queue.remove(rec)
+                        self.tasks.pop(rec.task_id, None)
+                        self._fail_task_returns(
+                            rec, exc.NodeAffinityError(
+                                f"affinity node "
+                                f"{aff['node_id'].hex()[:12]} is not "
+                                f"alive (soft=False)"))
+                        progressed = True
+                        continue
                 pg = rec.spec.get("pg")
                 bundle = None
                 key = None
@@ -2714,7 +2761,10 @@ class NodeService:
                         continue   # bundle busy: wait for a pg task end
                     _charge(bundle.free, res)
                 elif not self._take(res):
-                    if self.multinode and self._try_spill(rec, res):
+                    # Affinity-pinned work must wait here, not spill.
+                    if (self.multinode
+                            and rec.spec.get("affinity") is None
+                            and self._try_spill(rec, res)):
                         progressed = True
                     continue
                 w = self._find_idle_worker(tpu=needs_tpu)

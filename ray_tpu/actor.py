@@ -21,6 +21,7 @@ _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "max_restarts", "max_concurrency",
     "name", "namespace", "lifetime", "max_task_retries",
     "placement_group", "placement_group_bundle_index", "runtime_env",
+    "scheduling_strategy", "_affinity",
 }
 
 
@@ -57,7 +58,9 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> "ActorHandle":
         import ray_tpu
         from ray_tpu._private import runtime_env as rte
+        from ray_tpu.util.scheduling_strategies import apply_to_options
         client = ray_tpu._ensure_connected()
+        apply_to_options(self._options)
         if self._blob is None:
             self._blob = cloudpickle.dumps(self._cls)
         class_id = client.register_function(self._blob)
@@ -75,7 +78,8 @@ class ActorClass:
             namespace=self._options.get("namespace", "default"),
             detached=detached,
             pg=_pg_spec_from_options(self._options),
-            runtime_env=rte.pack(self._options.get("runtime_env")))
+            runtime_env=rte.pack(self._options.get("runtime_env")),
+            affinity=self._options.get("_affinity"))
         method_meta = _method_meta(self._cls)
         return ActorHandle(actor_id, class_id, self._cls.__name__,
                            method_meta, creation_ref=ready_ref)

@@ -104,15 +104,14 @@ class LocalNodeProvider(NodeProvider):
                 raise RuntimeError(
                     f"provider node exited rc={proc.poll()}")
             buf += chunk
-            for line in buf.split(b"\n"):
+            *complete, buf = buf.split(b"\n")   # keep partial tail
+            for line in complete:
                 if line.startswith(b"NODE_READY="):
                     node_id = bytes.fromhex(
                         line.split(b"=", 1)[1].decode())
                     break
             if node_id:
                 break
-            if b"\n" in buf:
-                buf = buf.rsplit(b"\n", 1)[1]   # keep partial tail
         threading.Thread(target=_drain, args=(proc.stdout,),
                          daemon=True).start()
         self._seq += 1

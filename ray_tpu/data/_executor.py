@@ -151,6 +151,27 @@ def _reduce_grouped(key: str, aggs: List[Tuple[str, str, str]],
 
 
 @ray_tpu.remote
+def _block_rows_of(block: B.Block) -> int:
+    return B.block_num_rows(block)
+
+
+@ray_tpu.remote
+def _zip_blocks(left_refs, right_refs) -> B.Block:
+    """Row-aligned column merge of two block lists (Dataset.zip).
+    Duplicate right-side column names get a `_1` suffix."""
+    left = B.block_concat([ray_tpu.get(r) for r in left_refs])
+    right = B.block_concat([ray_tpu.get(r) for r in right_refs])
+    ln, rn = B.block_num_rows(left), B.block_num_rows(right)
+    if ln != rn:
+        raise ValueError(f"zip() requires equal row counts "
+                         f"({ln} vs {rn})")
+    out = dict(left)
+    for k, v in right.items():
+        out[f"{k}_1" if k in out else k] = v
+    return out
+
+
+@ray_tpu.remote
 def _sample_column(block: B.Block, key: str, k: int) -> np.ndarray:
     col = np.asarray(block[key])
     if len(col) <= k:

@@ -226,9 +226,36 @@ class Dataset:
     def _iter_blocks(self, preserve_order: bool = True
                      ) -> Iterator[B.Block]:
         """Streaming pull.  preserve_order=False yields whichever block
-        finishes first (no head-of-line blocking on a slow block)."""
+        finishes first (no head-of-line blocking on a slow block).
+        Records execution stats for `stats()`."""
+        import time as _time
+        t0 = _time.perf_counter()
+        st = {"blocks": 0, "rows": 0, "bytes": 0, "wall_s": 0.0,
+              "plan": " -> ".join(type(op).__name__
+                                  for op in self._plan) or "<read>"}
+        self._last_stats = st
         for ref in self._iter_block_refs(preserve_order):
-            yield ray_tpu.get(ref)
+            blk = ray_tpu.get(ref)
+            st["blocks"] += 1
+            st["rows"] += B.block_num_rows(blk)
+            st["bytes"] += sum(v.nbytes for v in blk.values()
+                               if hasattr(v, "nbytes"))
+            st["wall_s"] = _time.perf_counter() - t0
+            yield blk
+
+    def stats(self) -> str:
+        """Execution summary of the most recent full/partial iteration
+        (reference: Dataset.stats / _internal/stats.py)."""
+        st = getattr(self, "_last_stats", None)
+        if st is None:
+            return "Dataset has not been executed yet"
+        mb = st["bytes"] / 1e6
+        thru = st["rows"] / st["wall_s"] if st["wall_s"] > 0 else 0.0
+        return (f"plan: {st['plan']}\n"
+                f"blocks: {st['blocks']}, rows: {st['rows']}, "
+                f"bytes: {mb:.1f} MB\n"
+                f"wall: {st['wall_s']:.3f}s, throughput: "
+                f"{thru:,.0f} rows/s")
 
     def materialize(self) -> "Dataset":
         refs = self._block_refs()
@@ -275,6 +302,37 @@ class Dataset:
         a = self._block_refs()
         b = other._block_refs()
         return Dataset([], [], materialized=a + b)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two same-length datasets (reference:
+        Dataset.zip; duplicate column names get a _1 suffix).
+
+        When the two sides have identical per-block row counts (e.g.
+        same block_rows), blocks zip pairwise as parallel tasks; ragged
+        block boundaries fall back to one realignment task."""
+        left = self._block_refs()
+        right = other._block_refs()
+        lrows = ray_tpu.get([X._block_rows_of.remote(r) for r in left])
+        rrows = ray_tpu.get([X._block_rows_of.remote(r) for r in right])
+        if lrows == rrows:
+            return Dataset([], [], materialized=[
+                X._zip_blocks.remote([lr], [rr])
+                for lr, rr in zip(left, right)])
+        if sum(lrows) != sum(rrows):
+            raise ValueError(f"zip() requires equal row counts "
+                             f"({sum(lrows)} vs {sum(rrows)})")
+        return Dataset([], [], materialized=[
+            X._zip_blocks.remote(left, right)])
+
+    def streaming_split(self, n: int, equal: bool = False
+                        ) -> List["DataIterator"]:
+        """n iterators fed from ONE streaming execution via a
+        coordinator actor — per-worker shards for Train without
+        materializing (reference: Dataset.streaming_split ->
+        SplitCoordinator, stream_split_iterator.py:124)."""
+        coord = _SplitCoordinator.options(max_concurrency=n + 1).remote(
+            self, n, equal)
+        return [DataIterator(coord, i) for i in range(n)]
 
     def limit(self, n: int) -> "Dataset":
         out: List[ray_tpu.ObjectRef] = []
@@ -386,6 +444,93 @@ class Dataset:
     def __repr__(self) -> str:
         return (f"Dataset(blocks={self.num_blocks()}, "
                 f"ops={len(self._plan)})")
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """One streaming execution, n consumers (reference:
+    SplitCoordinator actor, stream_split_iterator.py:124).
+
+    equal=False: work-stealing — any next_block() claims the next block
+    (fast consumers get more).  equal=True: deterministic round-robin
+    BLOCK assignment — every split sees the same number of blocks
+    (row-exact equality, which the reference achieves by splitting
+    blocks, is approximated at block granularity)."""
+
+    def __init__(self, ds: "Dataset", n: int, equal: bool) -> None:
+        import threading
+        from collections import deque
+        self._it = ds._iter_block_refs(preserve_order=True)
+        self._lock = threading.Lock()
+        self._n = n
+        self._equal = equal
+        self._done = False
+        # equal mode: per-split ready queues + a global RR cursor
+        self._queues = [deque() for _ in range(n)]
+        self._rr = 0
+
+    def _pull(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._done = True
+            return None
+
+    def next_block(self, split_index: int):
+        if not 0 <= split_index < self._n:
+            raise ValueError(f"split index {split_index} out of range "
+                             f"[0, {self._n})")
+        with self._lock:
+            if not self._equal:
+                return None if self._done else self._pull()
+            q = self._queues[split_index]
+            while not q and not self._done:
+                ref = self._pull()
+                if ref is None:
+                    break
+                self._queues[self._rr].append(ref)
+                self._rr = (self._rr + 1) % self._n
+            return q.popleft() if q else None
+
+
+class DataIterator:
+    """Per-consumer handle from `streaming_split` (reference:
+    DataIterator / stream_split_iterator)."""
+
+    def __init__(self, coord, index: int) -> None:
+        self._coord = coord
+        self._index = index
+
+    def _iter_blocks(self) -> Iterator[B.Block]:
+        while True:
+            ref = ray_tpu.get(
+                self._coord.next_block.remote(self._index))
+            if ref is None:
+                return
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Batch]:
+        carry: Optional[B.Block] = None
+        for blk in self._iter_blocks():
+            if carry is not None:
+                blk = B.block_concat([carry, blk])
+                carry = None
+            n = B.block_num_rows(blk)
+            i = 0
+            while n - i >= batch_size:
+                yield Dataset._format(
+                    B.block_slice(blk, i, i + batch_size), batch_format)
+                i += batch_size
+            if i < n:
+                carry = B.block_slice(blk, i, n)
+        if carry is not None and not drop_last:
+            yield Dataset._format(carry, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for blk in self._iter_blocks():
+            yield from B.block_rows(blk)
 
 
 class GroupedData:

@@ -93,3 +93,8 @@ class PlacementGroupUnschedulableError(RayTpuError):
 
 class NodeDiedError(RayTpuError):
     """A node was declared dead by health checking."""
+
+
+class NodeAffinityError(RayTpuError):
+    """Hard node-affinity target is gone (reference:
+    NodeAffinitySchedulingStrategy with soft=False)."""

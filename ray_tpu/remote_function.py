@@ -18,7 +18,7 @@ from ray_tpu._private.config import config
 _VALID_OPTIONS = {
     "num_returns", "num_cpus", "num_tpus", "resources", "max_retries",
     "name", "placement_group", "placement_group_bundle_index",
-    "runtime_env",
+    "runtime_env", "scheduling_strategy", "_affinity",
 }
 
 
@@ -78,7 +78,9 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         import ray_tpu
+        from ray_tpu.util.scheduling_strategies import apply_to_options
         client = ray_tpu._ensure_connected()
+        apply_to_options(self._options)
         fid = self._ensure_registered(client)
         num_returns = self._options.get("num_returns", 1)
         resources = _resources_from_options(
@@ -92,7 +94,8 @@ class RemoteFunction:
             retries=self._options.get("max_retries",
                                       config.max_task_retries),
             pg=_pg_spec_from_options(self._options),
-            runtime_env=rte.pack(self._options.get("runtime_env")))
+            runtime_env=rte.pack(self._options.get("runtime_env")),
+            affinity=self._options.get("_affinity"))
         if num_returns == 1:
             return refs[0]
         return refs

@@ -80,14 +80,22 @@ def deployment(_cls: Optional[type] = None, *,
                name: Optional[str] = None,
                num_replicas: int = 1,
                max_concurrent_queries: int = 8,
-               ray_actor_options: Optional[Dict[str, Any]] = None):
-    """@serve.deployment decorator (reference: serve/api.py)."""
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               autoscaling_config: Optional[Dict[str, Any]] = None):
+    """@serve.deployment decorator (reference: serve/api.py).
+
+    `autoscaling_config` (reference: serve/config.py AutoscalingConfig)
+    keys: min_replicas, max_replicas, target_ongoing_requests,
+    upscale_delay_s, downscale_delay_s — the controller then owns
+    num_replicas, scaling on replica-reported ongoing requests."""
 
     def deco(cls: type) -> Deployment:
         return Deployment(cls, {
             "name": name, "num_replicas": num_replicas,
             "max_concurrent_queries": max_concurrent_queries,
             "ray_actor_options": dict(ray_actor_options or {}),
+            "autoscaling_config": (dict(autoscaling_config)
+                                   if autoscaling_config else None),
         })
 
     if _cls is not None:
@@ -183,7 +191,7 @@ def run(target: Deployment, *, name: Optional[str] = None
         name or target.name, blob, target._init_args,
         target._init_kwargs, opts.get("num_replicas", 1),
         opts.get("max_concurrent_queries", 8),
-        actor_opts), timeout=120)
+        actor_opts, opts.get("autoscaling_config")), timeout=120)
     return DeploymentHandle(name or target.name)
 
 

@@ -30,21 +30,40 @@ class ServeController:
     """Named actor owning deployment target state + replica registry."""
 
     def __init__(self) -> None:
+        import threading
         # name -> {"blob", "init_args", "init_kwargs", "num_replicas",
         #          "max_concurrent_queries", "version",
-        #          "replicas": [ActorHandle]}
+        #          "replicas": [ActorHandle], "autoscaling": dict|None}
         self._deployments: Dict[str, dict] = {}
         self._version = 0
+        self._autoscale_thread = None
+        # Guards deployment state: the autoscale daemon thread mutates
+        # it concurrently with actor-method execution.
+        self._state_lock = threading.RLock()
 
     # -- control ----------------------------------------------------------
     def deploy(self, name: str, cls_blob: bytes, init_args: tuple,
                init_kwargs: dict, num_replicas: int,
                max_concurrent_queries: int,
-               actor_options: Optional[Dict[str, Any]] = None) -> int:
+               actor_options: Optional[Dict[str, Any]] = None,
+               autoscaling_config: Optional[Dict[str, Any]] = None
+               ) -> int:
         """Create or update a deployment; reconciles synchronously and
         returns the new version.  Changed code/args/options replace
         every running replica (the reference's version-driven replica
         rollout, deployment_state.py)."""
+        self._state_lock.acquire()
+        try:
+            return self._deploy_locked(
+                name, cls_blob, init_args, init_kwargs, num_replicas,
+                max_concurrent_queries, actor_options,
+                autoscaling_config)
+        finally:
+            self._state_lock.release()
+
+    def _deploy_locked(self, name, cls_blob, init_args, init_kwargs,
+                       num_replicas, max_concurrent_queries,
+                       actor_options, autoscaling_config) -> int:
         d = self._deployments.get(name)
         if d is None:
             d = {"replicas": [], "version": 0}
@@ -55,7 +74,22 @@ class ServeController:
                          actor_options=dict(actor_options or {}))
         changed = any(_differs(d.get(k), v)
                       for k, v in new_state.items())
-        d.update(new_state, num_replicas=num_replicas)
+        asc = None
+        if autoscaling_config:
+            asc = {"min_replicas": 1, "max_replicas": 8,
+                   "target_ongoing_requests": 2.0,
+                   "upscale_delay_s": 0.5, "downscale_delay_s": 5.0,
+                   "interval_s": 0.5}
+            asc.update(autoscaling_config)
+            num_replicas = max(asc["min_replicas"],
+                               min(d.get("num_replicas",
+                                         asc["min_replicas"]),
+                                   asc["max_replicas"]))
+        d.update(new_state, num_replicas=num_replicas,
+                 autoscaling=asc,
+                 _scale_pressure_since=None)
+        if asc is not None:
+            self._ensure_autoscale_loop()
         if changed and d["replicas"]:
             old, d["replicas"] = d["replicas"], []
             self._stop_replicas(old)
@@ -65,6 +99,10 @@ class ServeController:
         return d["version"]
 
     def delete(self, name: str) -> bool:
+        with self._state_lock:
+            return self._delete_locked(name)
+
+    def _delete_locked(self, name: str) -> bool:
         d = self._deployments.pop(name, None)
         if d is None:
             return False
@@ -108,6 +146,11 @@ class ServeController:
 
     def report_replica_failure(self, name: str, actor_id: bytes) -> None:
         """Router saw a replica die: drop it and backfill."""
+        with self._state_lock:
+            self._report_replica_failure_locked(name, actor_id)
+
+    def _report_replica_failure_locked(self, name: str,
+                                       actor_id: bytes) -> None:
         d = self._deployments.get(name)
         if d is None:
             return
@@ -147,6 +190,80 @@ class ServeController:
             self._stop_replicas(extra)
             d["version"] += 1
             self._version += 1
+
+    # -- replica autoscaling ----------------------------------------------
+    # Reference: replicas report ongoing-request metrics, the controller
+    # runs the autoscaling policy (serve/_private/autoscaling_state.py,
+    # serve/autoscaling_policy.py): desired = total_ongoing / target,
+    # clamped to [min, max], with upscale/downscale smoothing delays.
+    def _ensure_autoscale_loop(self) -> None:
+        import threading
+        if self._autoscale_thread is not None:
+            return
+
+        def loop() -> None:
+            import time
+            while True:
+                intervals = []
+                try:
+                    for name in list(self._deployments):
+                        d = self._deployments.get(name)
+                        if d is None or not d.get("autoscaling"):
+                            continue
+                        intervals.append(d["autoscaling"]["interval_s"])
+                        self._autoscale_tick(name, d)
+                except Exception:
+                    pass
+                time.sleep(min(intervals) if intervals else 0.5)
+
+        self._autoscale_thread = threading.Thread(
+            target=loop, daemon=True, name="rtpu-serve-autoscale")
+        self._autoscale_thread.start()
+
+    def _autoscale_tick(self, name: str, d: dict) -> None:
+        import math
+        import time
+
+        import ray_tpu
+        asc = d["autoscaling"]
+        with self._state_lock:
+            replicas = list(d["replicas"])
+        if not replicas:
+            return
+        # Metric poll OUTSIDE the lock (it blocks on replica RPCs).  An
+        # unreachable replica is counted at the per-replica target — a
+        # saturated replica whose probe times out must read as "busy",
+        # not zero, or peak load would trigger a downscale.
+        total = 0.0
+        for r in replicas:
+            try:
+                total += ray_tpu.get(r.queue_len.remote(), timeout=5)
+            except Exception:
+                total += asc["target_ongoing_requests"]
+        with self._state_lock:
+            if self._deployments.get(name) is not d:
+                return          # deleted/replaced while polling
+            desired = max(asc["min_replicas"],
+                          min(int(math.ceil(
+                              total / asc["target_ongoing_requests"]))
+                              or asc["min_replicas"],
+                              asc["max_replicas"]))
+            current = d["num_replicas"]
+            if desired == current:
+                d["_scale_pressure_since"] = None
+                return
+            now = time.time()
+            since = d.get("_scale_pressure_since")
+            if since is None or since[0] != (desired > current):
+                d["_scale_pressure_since"] = (desired > current, now)
+                return
+            delay = (asc["upscale_delay_s"] if desired > current
+                     else asc["downscale_delay_s"])
+            if now - since[1] < delay:
+                return
+            d["num_replicas"] = desired
+            d["_scale_pressure_since"] = None
+            self._reconcile(name)
 
     @staticmethod
     def _stop_replicas(replicas: List[Any]) -> None:

@@ -177,16 +177,23 @@ def destroy_collective_group(group_name: str = "default") -> None:
         return
     c = _client()
     c.kv_del(_NS, f"{group_name}/roster/{g.rank}".encode())
-    own_prefixes = [f"/r{g.rank}".encode(),
-                    f"/p2p/{g.rank}->".encode(),
-                    f"/p2pack/".encode()]
-    if g.rank == 0:
-        own_prefixes.append(b"/result")
-    for key in c.kv_keys(_NS, f"{group_name}/".encode()):
-        if any(p in key for p in own_prefixes):
+    prefix = f"{group_name}/".encode()
+    for key in c.kv_keys(_NS, prefix):
+        # key = "{group}/{seq:09d}/{tag}"; parse the tag exactly —
+        # substring matching would let rank 1 delete rank 12's data.
+        parts = key[len(prefix):].split(b"/", 1)
+        if len(parts) != 2:
+            continue
+        tag = parts[1].decode(errors="replace")
+        mine = (tag == f"r{g.rank}"
+                or tag.startswith(f"r{g.rank}:")
+                or tag.startswith(f"p2p/{g.rank}->")
+                or tag.startswith(f"p2pack/{g.rank}->")
+                or (g.rank == 0 and tag == "result"))
+        if mine:
             c.kv_del(_NS, key)
     if not c.kv_keys(_NS, f"{group_name}/roster/".encode()):
-        for key in c.kv_keys(_NS, f"{group_name}/".encode()):
+        for key in c.kv_keys(_NS, prefix):
             c.kv_del(_NS, key)
 
 

@@ -1,0 +1,56 @@
+"""joblib backend: scikit-learn `Parallel` jobs on the cluster.
+
+Reference surface: python/ray/util/joblib/ (register_ray +
+RayBackend over the multiprocessing-pool shim).  Usage:
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=8)(delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+
+
+def register_ray() -> None:
+    from joblib._parallel_backends import MultiprocessingBackend
+    from joblib.parallel import register_parallel_backend
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    class RayTpuBackend(MultiprocessingBackend):
+        """joblib backend whose pool is the cluster-wide task Pool."""
+
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs):
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            if n_jobs is None or n_jobs == -1:
+                return max(cpus, 1)
+            return min(max(n_jobs, 1), max(cpus, 1))
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **kwargs):
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self.parallel = parallel
+            self._pool = Pool(processes=n_jobs)
+            return n_jobs
+
+        def _get_pool(self):
+            return self._pool
+
+        def terminate(self):
+            pool = getattr(self, "_pool", None)
+            if pool is not None:
+                pool.terminate()
+            super_term = getattr(MultiprocessingBackend, "terminate",
+                                 None)
+            # MultiprocessingBackend.terminate touches its own _pool
+            # attrs; ours is already closed, so skip it.
+            del super_term
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,161 @@
+"""`multiprocessing.Pool` drop-in over the task runtime.
+
+Reference surface: python/ray/util/multiprocessing/pool.py (Pool with
+map/starmap/imap/imap_unordered/apply(_async), chunking, context
+manager).  Each chunk is one remote task, so pools span the whole
+cluster instead of one machine."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _run_chunk(fn: Callable, chunk: List[tuple], star: bool) -> List[Any]:
+    if star:
+        return [fn(*args) for args in chunk]
+    return [fn(arg) for (arg,) in chunk]
+
+
+@ray_tpu.remote
+def _apply_one(fn: Callable, args: tuple, kwds: dict) -> Any:
+    return fn(*args, **kwds)
+
+
+class AsyncResult:
+    def __init__(self, refs: List, chunked: bool = True,
+                 single: bool = False,
+                 callback: Optional[Callable] = None) -> None:
+        self._refs = refs
+        self._chunked = chunked
+        self._single = single
+        if callback is not None:
+            threading.Thread(
+                target=lambda: callback(self.get()),
+                daemon=True, name="rtpu-pool-callback").start()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        parts = ray_tpu.get(self._refs, timeout=timeout)
+        if self._single:
+            return parts[0]
+        if not self._chunked:
+            return parts
+        return [x for part in parts for x in part]
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+
+class Pool:
+    """Cluster-wide process pool (reference: util/multiprocessing)."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()) -> None:
+        if initializer is not None:
+            raise NotImplementedError(
+                "Pool(initializer=...) is not supported: tasks are "
+                "stateless; use an actor for per-worker state")
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cpus = ray_tpu.cluster_resources().get("CPU", 1)
+        self._processes = processes or max(int(cpus), 1)
+        self._closed = False
+
+    # -- helpers -------------------------------------------------------
+    def _chunks(self, iterables: Sequence[Iterable],
+                chunksize: Optional[int]) -> List[List[tuple]]:
+        items = list(zip(*iterables)) if len(iterables) > 1 \
+            else [(x,) for x in iterables[0]]
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    # -- API -----------------------------------------------------------
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        self._check_open()
+        refs = [_run_chunk.remote(fn, chunk, False)
+                for chunk in self._chunks([iterable], chunksize)]
+        return AsyncResult(refs)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check_open()
+        items = list(iterable)
+        if not items:
+            return []
+        chunks = self._chunks([items], chunksize)
+        star_chunks = [[args for (args,) in chunk] for chunk in chunks]
+        refs = [_run_chunk.remote(fn, [tuple(a) for a in chunk], True)
+                for chunk in star_chunks]
+        return AsyncResult(refs).get()
+
+    def apply(self, fn: Callable, args: tuple = (),
+              kwds: Optional[dict] = None) -> Any:
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: Optional[dict] = None,
+                    callback: Optional[Callable] = None,
+                    error_callback: Optional[Callable] = None
+                    ) -> AsyncResult:
+        """`callback` support matches stdlib/joblib expectations."""
+        self._check_open()
+        kwds = kwds or {}
+        ref = _apply_one.remote(fn, args, kwds)
+        return AsyncResult([ref], single=True, callback=callback)
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        self._check_open()
+        refs = [_run_chunk.remote(fn, chunk, False)
+                for chunk in self._chunks([iterable], chunksize)]
+        for ref in refs:                       # submission order
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        self._check_open()
+        refs = [_run_chunk.remote(fn, chunk, False)
+                for chunk in self._chunks([iterable], chunksize)]
+        pending = list(refs)
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            yield from ray_tpu.get(done[0])
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *a) -> None:
+        self.close()

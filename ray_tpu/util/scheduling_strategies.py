@@ -1,0 +1,54 @@
+"""Scheduling strategies (reference: util/scheduling_strategies.py).
+
+* PlacementGroupSchedulingStrategy (:15) — run in a PG bundle.
+* NodeAffinitySchedulingStrategy (:41) — pin to a node id; `soft=True`
+  falls back to normal scheduling if the node is gone, hard affinity
+  fails the task/actor with NodeAffinityError.
+* "SPREAD" / "DEFAULT" string strategies — accepted for parity
+  ("SPREAD" is best-effort here: the hybrid scheduler's spill logic
+  already distributes load).
+
+Pass via options:  f.options(scheduling_strategy=...).remote()
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group,
+                 placement_group_bundle_index: int = -1) -> None:
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: Union[str, bytes],
+                 soft: bool = False) -> None:
+        self.node_id = (bytes.fromhex(node_id)
+                        if isinstance(node_id, str) else node_id)
+        self.soft = soft
+
+
+SchedulingStrategyT = Union[None, str, PlacementGroupSchedulingStrategy,
+                            NodeAffinitySchedulingStrategy]
+
+
+def apply_to_options(options: dict) -> dict:
+    """Fold a `scheduling_strategy` option into the primitive option
+    keys the submission path understands.  Returns the same dict."""
+    strat = options.pop("scheduling_strategy", None)
+    if strat is None or strat in ("DEFAULT", "SPREAD"):
+        return options
+    if isinstance(strat, PlacementGroupSchedulingStrategy):
+        options.setdefault("placement_group", strat.placement_group)
+        if strat.placement_group_bundle_index >= 0:
+            options.setdefault("placement_group_bundle_index",
+                               strat.placement_group_bundle_index)
+        return options
+    if isinstance(strat, NodeAffinitySchedulingStrategy):
+        options["_affinity"] = {"node_id": strat.node_id,
+                                "soft": strat.soft}
+        return options
+    raise TypeError(f"unsupported scheduling_strategy: {strat!r}")

@@ -1,0 +1,76 @@
+"""Dataset zip / streaming_split / stats (reference: data/dataset.py
+zip :2190, streaming_split :1363, stats; _internal/stats.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data import Dataset
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_zip(rt):
+    a = Dataset.from_numpy({"x": np.arange(10)}, block_rows=4)
+    b = Dataset.from_numpy({"x": np.arange(10) * 2,
+                            "y": np.arange(10) * 3}, block_rows=3)
+    z = a.zip(b)
+    rows = list(z.iter_rows())
+    assert len(rows) == 10
+    assert rows[4] == {"x": 4, "x_1": 8, "y": 12}
+
+    short = Dataset.from_numpy({"q": np.arange(3)})
+    with pytest.raises(Exception, match="equal row counts"):
+        list(a.zip(short).iter_rows())
+
+
+def test_streaming_split_covers_all_rows(rt):
+    ds = Dataset.range(1000, block_rows=50)   # 20 blocks
+    its = ds.streaming_split(3)
+    seen: list = []
+    for it in its:
+        seen.extend(r["id"] for r in it.iter_rows())
+    assert sorted(seen) == list(range(1000))
+
+
+def test_streaming_split_equal_blocks(rt):
+    ds = Dataset.range(900, block_rows=100)   # 9 blocks
+    its = ds.streaming_split(3, equal=True)
+    counts = []
+    seen: list = []
+    for it in its:
+        rows = [r["id"] for r in it.iter_rows()]
+        counts.append(len(rows))
+        seen.extend(rows)
+    assert counts == [300, 300, 300]          # 3 blocks each
+    assert sorted(seen) == list(range(900))
+
+
+def test_zip_aligned_blocks_stay_parallel(rt):
+    a = Dataset.from_numpy({"x": np.arange(12)}, block_rows=4)
+    b = Dataset.from_numpy({"y": np.arange(12) * 2}, block_rows=4)
+    z = a.zip(b)
+    assert z.num_blocks() == 3                # pairwise, not one blob
+    assert [r for r in z.iter_rows()][5] == {"x": 5, "y": 10}
+
+
+def test_streaming_split_batches(rt):
+    ds = Dataset.from_numpy({"v": np.arange(100)}, block_rows=10)
+    (it,) = ds.streaming_split(1)
+    batches = list(it.iter_batches(batch_size=30))
+    assert sum(len(b["v"]) for b in batches) == 100
+
+
+def test_stats(rt):
+    ds = Dataset.range(500, block_rows=100).map(
+        lambda r: {"id": r["id"] * 2})
+    assert "not been executed" in ds.stats()
+    assert ds.count() == 500
+    s = ds.stats()
+    assert "rows: 500" in s and "blocks: 5" in s
+    assert "FusedMapOp" in s

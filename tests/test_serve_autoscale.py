@@ -1,0 +1,65 @@
+"""Serve replica autoscaling (reference: serve/_private/
+autoscaling_state.py + serve/autoscaling_policy.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+@serve.deployment(max_concurrent_queries=16,
+                  autoscaling_config={"min_replicas": 1,
+                                      "max_replicas": 3,
+                                      "target_ongoing_requests": 2.0,
+                                      "upscale_delay_s": 0.2,
+                                      "downscale_delay_s": 0.6,
+                                      "interval_s": 0.2})
+class Slow:
+    async def __call__(self, x):
+        import asyncio
+        await asyncio.sleep(0.4)
+        return x
+
+
+def _replica_count(name: str) -> int:
+    return len(serve.status()[name]["replica_states"])
+
+
+def test_scales_up_under_load_and_back_down(rt):
+    handle = serve.run(Slow.bind())
+    assert _replica_count("Slow") == 1
+
+    # Sustained burst: ~12 concurrent requests against target 2/replica.
+    refs = []
+    deadline = time.time() + 12
+    scaled_up = False
+    while time.time() < deadline:
+        refs.extend(handle.remote(i) for i in range(12))
+        ray_tpu.wait(refs, num_returns=max(len(refs) - 12, 1),
+                     timeout=5)
+        if _replica_count("Slow") >= 2:
+            scaled_up = True
+            break
+    assert scaled_up, "no scale-up under sustained load"
+    ray_tpu.get(refs, timeout=60)
+
+    # Idle: scales back to min_replicas.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if _replica_count("Slow") == 1:
+            break
+        time.sleep(0.3)
+    assert _replica_count("Slow") == 1, "no scale-down when idle"

@@ -1,0 +1,114 @@
+"""multiprocessing.Pool / joblib shims + scheduling strategies
+(reference: util/multiprocessing, util/joblib,
+util/scheduling_strategies.py)."""
+
+import operator
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def test_pool_map_variants(rt):
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert p.starmap(operator.add, [(1, 2), (3, 4)]) == [3, 7]
+        assert p.apply(operator.mul, (6, 7)) == 42
+        r = p.apply_async(operator.sub, (10, 3))
+        assert r.get(timeout=30) == 7
+        assert list(p.imap(_sq, range(5))) == [0, 1, 4, 9, 16]
+        assert sorted(p.imap_unordered(_sq, range(5))) == [0, 1, 4, 9, 16]
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+
+
+def test_pool_callback(rt):
+    hits = []
+    with Pool(processes=2) as p:
+        r = p.apply_async(_sq, (7,), callback=hits.append)
+        assert r.get(timeout=30) == 49
+    deadline = time.time() + 10
+    while not hits and time.time() < deadline:
+        time.sleep(0.05)
+    assert hits == [49]
+
+
+def test_joblib_backend(rt):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+
+def test_node_affinity_cross_node():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    cluster = Cluster()
+    try:
+        remote_node = cluster.add_node(resources={"CPU": 2})
+        ray_tpu.init(num_cpus=2, gcs_address=cluster.gcs_address)
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote
+        def my_node():
+            import ray_tpu as rt
+            from ray_tpu._private.client import get_global_client
+            return get_global_client().node_info()["node_id"].hex()
+
+        target = remote_node.node_id.hex()
+        got = ray_tpu.get(my_node.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                target)).remote(), timeout=60)
+        assert got == target
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_node_affinity_single_node(rt):
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    node_id = ray_tpu.nodes()[0]["node_id"]
+    if isinstance(node_id, bytes):
+        node_hex = node_id.hex() if node_id != b"local" else None
+    else:
+        node_hex = node_id
+    my_node = ray_tpu._session.node_service.node_id.hex()
+
+    @ray_tpu.remote
+    def where():
+        return 1
+
+    # affinity to self: runs
+    ref = where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        my_node)).remote()
+    assert ray_tpu.get(ref, timeout=30) == 1
+
+    # hard affinity to a nonexistent node: fails
+    with pytest.raises(ray_tpu.exceptions.NodeAffinityError):
+        ray_tpu.get(where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                "ab" * 16, soft=False)).remote(), timeout=30)
+
+    # soft affinity to a nonexistent node: falls back and runs
+    ref = where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        "cd" * 16, soft=True)).remote()
+    assert ray_tpu.get(ref, timeout=30) == 1
