@@ -53,7 +53,19 @@ class NodeInfo:
 
 
 class GlobalControlState:
-    def __init__(self) -> None:
+    """In-memory control-plane tables, optionally durable.
+
+    `persist_dir` enables the reference's GCS-FT role
+    (gcs/store_client/redis_store_client.h:106, swapped for a local
+    write-ahead log): every DURABLE mutation (KV, function table, named
+    actors) appends one pickled op to `gcs.wal`, replayed by the next
+    GlobalControlState pointed at the same directory — so detached-actor
+    names, job records, and workflow/meta KV survive a GCS restart.
+    Node membership and object locations are deliberately ephemeral:
+    nodes re-register and re-report on reconnect, exactly like the
+    reference's restarted GCS rebuilding from raylet resubscription."""
+
+    def __init__(self, persist_dir: Optional[str] = None) -> None:
         self._lock = threading.RLock()
         self._kv: Dict[str, Dict[bytes, bytes]] = {}
         self._functions: Dict[bytes, bytes] = {}
@@ -69,6 +81,56 @@ class GlobalControlState:
         # subscriptions (server wires these to connection pushes)
         self._loc_subs: Dict[bytes, List[Callable[[bytes, dict], None]]] = {}
         self._node_subs: List[Callable[[str, dict], None]] = []
+        self._wal = None
+        if persist_dir:
+            import os
+            import pickle
+            os.makedirs(persist_dir, exist_ok=True)
+            path = os.path.join(persist_dir, "gcs.wal")
+            good_end = 0
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    while True:
+                        try:
+                            op, args = pickle.load(f)
+                        except EOFError:
+                            good_end = f.tell()
+                            break
+                        except Exception:
+                            # Torn tail write (crash mid-append): keep
+                            # the good prefix only.  Appending AFTER the
+                            # garbage would make every later record
+                            # unreachable to the next replay.
+                            break
+                        good_end = f.tell()
+                        self._replay(op, args)
+                size = os.path.getsize(path)
+                if good_end < size:
+                    with open(path, "r+b") as f:
+                        f.truncate(good_end)
+            self._wal = open(path, "ab")
+
+    def _replay(self, op: str, args: tuple) -> None:
+        if op == "kv_put":
+            ns, key, value = args
+            self._kv.setdefault(ns, {})[key] = value
+        elif op == "kv_del":
+            ns, key = args
+            self._kv.get(ns, {}).pop(key, None)
+        elif op == "fn":
+            self._functions[args[0]] = args[1]
+        elif op == "actor_put":
+            self._named_actors[args[0]] = args[1]
+        elif op == "actor_del":
+            self._named_actors.pop(args[0], None)
+
+    def _log(self, op: str, *args) -> None:
+        """Append one durable op.  Caller holds the lock."""
+        if self._wal is None:
+            return
+        import pickle
+        pickle.dump((op, args), self._wal)
+        self._wal.flush()
 
     # -- internal KV -------------------------------------------------------
     def kv_put(self, ns: str, key: bytes, value: bytes,
@@ -78,6 +140,7 @@ class GlobalControlState:
             if not overwrite and key in table:
                 return False
             table[key] = value
+            self._log("kv_put", ns, key, value)
             return True
 
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
@@ -86,7 +149,10 @@ class GlobalControlState:
 
     def kv_del(self, ns: str, key: bytes) -> bool:
         with self._lock:
-            return self._kv.get(ns, {}).pop(key, None) is not None
+            hit = self._kv.get(ns, {}).pop(key, None) is not None
+            if hit:
+                self._log("kv_del", ns, key)
+            return hit
 
     def kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
         with self._lock:
@@ -96,6 +162,7 @@ class GlobalControlState:
     def register_function(self, function_id: bytes, blob: bytes) -> None:
         with self._lock:
             self._functions[function_id] = blob
+            self._log("fn", function_id, blob)
 
     def fetch_function(self, function_id: bytes) -> Optional[bytes]:
         with self._lock:
@@ -109,6 +176,7 @@ class GlobalControlState:
             if key in self._named_actors:
                 return False
             self._named_actors[key] = actor_id
+            self._log("actor_put", key, actor_id)
             return True
 
     def lookup_named_actor(self, ns: str, name: str) -> Optional[bytes]:
@@ -120,6 +188,7 @@ class GlobalControlState:
             dead = [k for k, v in self._named_actors.items() if v == actor_id]
             for k in dead:
                 del self._named_actors[k]
+                self._log("actor_del", k)
 
     def list_named_actors(self, ns: Optional[str] = None) -> List[str]:
         with self._lock:

@@ -54,8 +54,11 @@ class GcsServer:
     (reference: gcs_health_check_manager.h:39)."""
 
     def __init__(self, state: Optional[GlobalControlState] = None,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
-        self.state = state or GlobalControlState()
+                 host: str = "127.0.0.1", port: int = 0,
+                 persist_dir: Optional[str] = None) -> None:
+        # persist_dir: durable KV/function/named-actor tables via a WAL
+        # (GCS fault tolerance — see GlobalControlState docstring).
+        self.state = state or GlobalControlState(persist_dir=persist_dir)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))

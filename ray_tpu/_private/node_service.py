@@ -2122,14 +2122,22 @@ class NodeService:
                                  name="rtpu-pg-actor").start()
                 return
         aff = spec.get("affinity")
-        if (self.multinode and pgspec is None and aff is not None
+        if (pgspec is None and aff is not None
                 and aff["node_id"] != self.node_id):
-            ninfo = self._cluster_node(aff["node_id"])
-            if ninfo is None and not aff.get("soft"):
-                ctx.reply(m, {"__error__": exc.NodeAffinityError(
-                    f"affinity node {aff['node_id'].hex()[:12]} is not "
-                    f"alive (soft=False)")})
-                return
+            ninfo = (self._cluster_node(aff["node_id"])
+                     if self.multinode else None)
+            if ninfo is None:
+                if not aff.get("soft"):
+                    ctx.reply(m, {"__error__": exc.NodeAffinityError(
+                        f"affinity node {aff['node_id'].hex()[:12]} is "
+                        f"not alive (soft=False)")})
+                    return
+                # Soft affinity to a dead/unknown node: fall back to
+                # normal placement (spill targets included) — same
+                # semantics as the task path clearing rec affinity.
+                spec = dict(spec)
+                spec["affinity"] = None
+                aff = None
         if self.multinode and pgspec is None:
             # Placement: keep the actor local when this node's totals can
             # ever run it; otherwise forward the whole creation to a peer

@@ -55,9 +55,10 @@ class Cluster:
     """One GCS (in-process) + N worker-node subprocesses."""
 
     def __init__(self, host: str = "127.0.0.1",
-                 env: Optional[Dict[str, str]] = None) -> None:
+                 env: Optional[Dict[str, str]] = None,
+                 persist_dir: Optional[str] = None) -> None:
         from ray_tpu._private.gcs_service import GcsServer
-        self._server = GcsServer(host=host)
+        self._server = GcsServer(host=host, persist_dir=persist_dir)
         self._server.start()
         self.host = host
         self.gcs_address = (host, self._server.port)

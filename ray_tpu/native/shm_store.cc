@@ -18,10 +18,13 @@
 //
 // Exported as a plain C ABI for ctypes.
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
+#include <utility>
+#include <vector>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -376,23 +379,34 @@ uint64_t block_alloc(Store& s, uint64_t payload) {
 
 // Evict sealed refcnt==0 objects in LRU order until at least `bytes` of
 // payload could plausibly be allocated. Returns evicted byte count.
+// ONE table scan collects candidates sorted by lru_tick (an insertion
+// into a bounded min-heap-ish array) instead of the previous
+// O(table * victims) rescan-per-victim, which cliffed at 10k+ objects.
 uint64_t evict_lru(Store& s, uint64_t bytes) {
   Header* h = H(s);
-  uint64_t freed = 0;
-  while (freed < bytes + kBlockHdr) {
-    Entry* victim = nullptr;
-    for (uint32_t i = 0; i < kTableCapacity; ++i) {
-      Entry& e = h->table[i];
-      if (e.state == kSealed && e.refcnt == 0 &&
-          (!victim || e.lru_tick < victim->lru_tick)) {
-        victim = &e;
-      }
+  // (lru_tick, index) pairs; sorted ascending so victims pop oldest
+  // first.  Heap allocation is fine here: eviction is already the
+  // slow path (it only runs when an alloc failed).
+  std::vector<std::pair<uint64_t, uint32_t>> cand;
+  cand.reserve(256);
+  for (uint32_t i = 0; i < kTableCapacity; ++i) {
+    Entry& e = h->table[i];
+    if (e.state == kSealed && e.refcnt == 0) {
+      cand.emplace_back(e.lru_tick, i);
     }
-    if (!victim) break;
-    freed += victim->size + kBlockHdr;
+  }
+  std::sort(cand.begin(), cand.end());
+  uint64_t freed = 0;
+  for (size_t j = 0; j < cand.size() && freed < bytes + kBlockHdr;
+       ++j) {
+    Entry& e = h->table[cand[j].second];
+    // Re-check defensively (entry_free of earlier victims cannot
+    // change later candidates, but cheap insurance beats corruption).
+    if (e.state != kSealed || e.refcnt != 0) continue;
+    freed += e.size + kBlockHdr;
     h->num_evictions++;
-    h->bytes_evicted += victim->size;
-    entry_free(s, victim);
+    h->bytes_evicted += e.size;
+    entry_free(s, &e);
   }
   return freed;
 }

@@ -84,6 +84,8 @@ def cmd_start(args) -> int:
         if args.object_store_memory:
             cmd += ["--object-store-memory",
                     str(args.object_store_memory)]
+        if args.persist_dir:
+            cmd += ["--persist-dir", args.persist_dir]
         err_f = open(_daemon_log("head"), "ab")
         try:
             # stderr to a log file, NOT inherited: a detached daemon
@@ -324,8 +326,8 @@ def cmd_job(args) -> int:
 
 
 def cmd_microbench(args) -> int:
-    from ray_tpu.util.microbench import main as mb
-    mb()
+    from ray_tpu.util.microbench import run_all
+    run_all()
     return 0
 
 
@@ -344,6 +346,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--resources", default="{}")
     p.add_argument("--object-store-memory", type=int, default=0)
     p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument("--persist-dir", default="",
+                   help="durable GCS state dir (survives head restarts)")
     p.add_argument("--timeout", type=float, default=60.0)
     p.set_defaults(fn=cmd_start)
 

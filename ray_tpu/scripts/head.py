@@ -26,13 +26,16 @@ def main() -> None:
     ap.add_argument("--resources", default="{}")
     ap.add_argument("--object-store-memory", type=int, default=0)
     ap.add_argument("--dashboard-port", type=int, default=8265)
+    ap.add_argument("--persist-dir", default="",
+                    help="durable GCS state dir (WAL); empty = in-memory")
     args = ap.parse_args()
 
     import ray_tpu
     from ray_tpu._private.gcs_service import GcsServer
     from ray_tpu import dashboard
 
-    gcs = GcsServer(host=args.host, port=args.port)
+    gcs = GcsServer(host=args.host, port=args.port,
+                    persist_dir=args.persist_dir or None)
     gcs.start()
 
     ray_tpu.init(

@@ -44,13 +44,10 @@ def register_ray() -> None:
             return self._pool
 
         def terminate(self):
+            # Deliberately NOT calling MultiprocessingBackend.terminate:
+            # it manipulates stdlib-pool internals ours doesn't have.
             pool = getattr(self, "_pool", None)
             if pool is not None:
                 pool.terminate()
-            super_term = getattr(MultiprocessingBackend, "terminate",
-                                 None)
-            # MultiprocessingBackend.terminate touches its own _pool
-            # attrs; ours is already closed, so skip it.
-            del super_term
 
     register_parallel_backend("ray_tpu", RayTpuBackend)

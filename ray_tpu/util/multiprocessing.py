@@ -29,14 +29,24 @@ def _apply_one(fn: Callable, args: tuple, kwds: dict) -> Any:
 class AsyncResult:
     def __init__(self, refs: List, chunked: bool = True,
                  single: bool = False,
-                 callback: Optional[Callable] = None) -> None:
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None) -> None:
         self._refs = refs
         self._chunked = chunked
         self._single = single
-        if callback is not None:
-            threading.Thread(
-                target=lambda: callback(self.get()),
-                daemon=True, name="rtpu-pool-callback").start()
+        if callback is not None or error_callback is not None:
+            def waiter():
+                try:
+                    value = self.get()
+                except BaseException as e:  # noqa: BLE001
+                    if error_callback is not None:
+                        error_callback(e)   # stdlib Pool semantics
+                    return
+                if callback is not None:
+                    callback(value)
+
+            threading.Thread(target=waiter, daemon=True,
+                             name="rtpu-pool-callback").start()
 
     def get(self, timeout: Optional[float] = None) -> Any:
         parts = ray_tpu.get(self._refs, timeout=timeout)
@@ -123,25 +133,34 @@ class Pool:
         self._check_open()
         kwds = kwds or {}
         ref = _apply_one.remote(fn, args, kwds)
-        return AsyncResult([ref], single=True, callback=callback)
+        return AsyncResult([ref], single=True, callback=callback,
+                           error_callback=error_callback)
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: Optional[int] = None):
+        # Submit EAGERLY (stdlib semantics: work starts at call time
+        # and creating the iterator before close() is legal).
         self._check_open()
         refs = [_run_chunk.remote(fn, chunk, False)
                 for chunk in self._chunks([iterable], chunksize)]
-        for ref in refs:                       # submission order
-            yield from ray_tpu.get(ref)
+
+        def gen():
+            for ref in refs:                   # submission order
+                yield from ray_tpu.get(ref)
+        return gen()
 
     def imap_unordered(self, fn: Callable, iterable: Iterable,
                        chunksize: Optional[int] = None):
         self._check_open()
         refs = [_run_chunk.remote(fn, chunk, False)
                 for chunk in self._chunks([iterable], chunksize)]
-        pending = list(refs)
-        while pending:
-            done, pending = ray_tpu.wait(pending, num_returns=1)
-            yield from ray_tpu.get(done[0])
+
+        def gen():
+            pending = list(refs)
+            while pending:
+                done, pending = ray_tpu.wait(pending, num_returns=1)
+                yield from ray_tpu.get(done[0])
+        return gen()
 
     # -- lifecycle -----------------------------------------------------
     def close(self) -> None:

@@ -1,0 +1,74 @@
+"""GCS durable state: WAL persistence across server restarts
+(reference: gcs/store_client/redis_store_client.h GCS-FT role)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.gcs import GlobalControlState
+from ray_tpu._private.gcs_service import GcsClient, GcsServer
+
+
+def test_state_survives_restart(tmp_path):
+    d = str(tmp_path / "gcs")
+    s1 = GlobalControlState(persist_dir=d)
+    s1.kv_put("jobs", b"j1/meta", b'{"status": "RUNNING"}')
+    s1.kv_put("jobs", b"j2/meta", b"x")
+    s1.kv_del("jobs", b"j2/meta")
+    s1.register_function(b"f" * 16, b"blob-bytes")
+    assert s1.register_named_actor("default", "svc", b"a" * 16)
+    assert not s1.register_named_actor("default", "svc", b"b" * 16)
+    s1.register_named_actor("default", "gone", b"c" * 16)
+    s1.drop_named_actor(b"c" * 16)
+    # ephemeral tables must NOT persist
+    s1.register_node(b"n" * 16, "127.0.0.1", 1, 1, {"CPU": 4})
+
+    s2 = GlobalControlState(persist_dir=d)
+    assert s2.kv_get("jobs", b"j1/meta") == b'{"status": "RUNNING"}'
+    assert s2.kv_get("jobs", b"j2/meta") is None
+    assert s2.fetch_function(b"f" * 16) == b"blob-bytes"
+    assert s2.lookup_named_actor("default", "svc") == b"a" * 16
+    assert s2.lookup_named_actor("default", "gone") is None
+    assert s2.nodes() == []
+
+
+def test_torn_tail_write_tolerated(tmp_path):
+    d = str(tmp_path / "gcs")
+    s1 = GlobalControlState(persist_dir=d)
+    s1.kv_put("ns", b"k1", b"v1")
+    s1.kv_put("ns", b"k2", b"v2")
+    # simulate a crash mid-append: truncate the last few bytes
+    wal = tmp_path / "gcs" / "gcs.wal"
+    data = wal.read_bytes()
+    wal.write_bytes(data[:-3])
+
+    s2 = GlobalControlState(persist_dir=d)
+    assert s2.kv_get("ns", b"k1") == b"v1"     # good prefix replayed
+    # k2's record was torn; replay stops cleanly instead of crashing
+    s2.kv_put("ns", b"k3", b"v3")
+    s3 = GlobalControlState(persist_dir=d)
+    assert s3.kv_get("ns", b"k3") == b"v3"
+
+
+def test_server_restart_preserves_named_actor_record(tmp_path):
+    """End-to-end: GCS process restart; a detached actor's name record
+    survives (the cluster's nodes re-register on reconnect)."""
+    d = str(tmp_path / "gcs")
+    server = GcsServer(persist_dir=d)
+    server.start()
+    client = GcsClient(server.host, server.port)
+    client.kv_put("jobs", b"job-x/meta", b"done")
+    assert client.register_named_actor("default", "persistent",
+                                       b"p" * 16)
+    client.close()
+    server.shutdown()
+
+    server2 = GcsServer(persist_dir=d)
+    server2.start()
+    try:
+        client2 = GcsClient(server2.host, server2.port)
+        assert client2.kv_get("jobs", b"job-x/meta") == b"done"
+        assert client2.lookup_named_actor(
+            "default", "persistent") == b"p" * 16
+        client2.close()
+    finally:
+        server2.shutdown()
