@@ -28,7 +28,8 @@ from ray_tpu import exceptions as exc
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import ObjectID
-from ray_tpu._private.protocol import Connection, connect_uds
+from ray_tpu._private.protocol import (Connection, connect_tcp,
+                                       connect_uds)
 from ray_tpu._private.shm_store import ShmObjectStore
 from ray_tpu.object_ref import ObjectRef
 
@@ -357,16 +358,21 @@ class CoreClient:
             packed.insert(0, ("inline", s.to_bytes()))
         else:
             oid = ObjectID.from_random()
-            buf = self._create_in_store(oid, s.total_size)
-            s.write_into(buf)
-            self.store.seal(oid)  # creator pin kept — owned by directory
-            self.conn.notify({"type": "put_object",
-                              "object_id": oid.binary(),
-                              "loc": "shm", "data": None,
-                              "size": s.total_size, "embedded": []})
+            self._store_arg_blob(oid, s)
             packed.insert(0, ("blob", oid.binary()))
             all_embedded.append(oid.binary())
         return packed, all_embedded
+
+    def _store_arg_blob(self, oid: ObjectID, s) -> None:
+        """Publish an oversized arg blob (overridden by the thin client,
+        which has no shared-memory segment)."""
+        buf = self._create_in_store(oid, s.total_size)
+        s.write_into(buf)
+        self.store.seal(oid)  # creator pin kept — owned by directory
+        self.conn.notify({"type": "put_object",
+                          "object_id": oid.binary(),
+                          "loc": "shm", "data": None,
+                          "size": s.total_size, "embedded": []})
 
     def unpack_args(self, packed: List[tuple]) -> Tuple[tuple, dict]:
         """Worker side of _pack_args."""
@@ -584,3 +590,94 @@ def _reply_incomplete(msg: dict, reply: dict) -> bool:
     if msg["type"] == "wait":
         return len(reply.get("ready", [])) < msg["num_returns"]
     return False
+
+
+class RemoteCoreClient(CoreClient):
+    """Thin-client variant: same control protocol over TCP, NO local
+    shared-memory segment (reference: ray.util.client's proxied
+    CoreWorker surface).  Differences from the in-node client:
+
+    * `put` always ships the serialized value in the put_object RPC
+      (the node holds it in its directory); there is no zero-copy path
+      from a remote machine.
+    * "shm"/"spilled" results are pulled through the node's
+      object-transfer endpoints (fetch_object_meta/chunk) — the same
+      plane peers use — then deserialized with copies.
+    """
+
+    def __init__(self, host: str, port: int,
+                 client_id: Optional[bytes] = None,
+                 push_handler: Optional[Callable[[dict], None]] = None,
+                 ) -> None:
+        self.kind = "driver"
+        self.client_id = client_id or os.urandom(16)
+        sock = connect_tcp(host, port)
+        self.conn = Connection(sock, push_handler=push_handler)
+        reply = self.conn.call({"type": "register_client",
+                                "kind": "driver",
+                                "client_id": self.client_id,
+                                "pid": os.getpid()})
+        self.store = None
+        self.session_dir = reply["session_dir"]
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._registered_fns: set = set()
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        self.conn.close()
+
+    # -- object plane over RPC ------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed "
+                            "(matches the reference's behavior)")
+        s, embedded = self.serialize_with_refs(value)
+        oid = ObjectID.from_random()
+        self.conn.notify({"type": "put_object",
+                          "object_id": oid.binary(),
+                          "loc": "inline", "data": s.to_bytes(),
+                          "size": s.total_size, "embedded": embedded})
+        return ObjectRef(oid.binary(), owned=True)
+
+    def _store_arg_blob(self, oid: ObjectID, s) -> None:
+        # No local segment: oversized args ship inline in the RPC and
+        # live in the node's directory like thin-client put()s.
+        self.conn.notify({"type": "put_object",
+                          "object_id": oid.binary(),
+                          "loc": "inline", "data": s.to_bytes(),
+                          "size": s.total_size, "embedded": []})
+
+    def _materialize(self, oid: bytes, loc: str,
+                     data: Optional[bytes]) -> Any:
+        if loc in ("shm", "spilled"):
+            blob = self._fetch_remote(oid)
+            return ser.deserialize(memoryview(blob), copy_buffers=True)
+        return super()._materialize(oid, loc, data)
+
+    def _fetch_remote(self, oid: bytes) -> bytes:
+        meta = self.conn.call({"type": "fetch_object_meta",
+                               "object_id": oid}, timeout=60.0)
+        if not meta.get("found"):
+            raise exc.ObjectLostError(oid.hex(),
+                                      "not fetchable from node")
+        if meta["kind"] == "error":
+            raise ser.loads(meta["data"])
+        if meta.get("data") is not None:
+            return meta["data"]
+        total = meta["size"]
+        chunk = config.object_transfer_chunk_bytes
+        parts = []
+        off = 0
+        while off < total:
+            r = self.conn.call({"type": "fetch_object_chunk",
+                                "object_id": oid, "offset": off,
+                                "length": min(chunk, total - off)},
+                               timeout=60.0)
+            # Chunk replies carry "data" (no "found" key) — mirror the
+            # node's own peer-pull loop.
+            if r.get("data") is None:
+                raise exc.ObjectLostError(oid.hex(),
+                                          "evicted during fetch")
+            parts.append(r["data"])
+            off += len(r["data"])
+        return b"".join(parts)

@@ -65,7 +65,17 @@ class GlobalControlState:
     nodes re-register and re-report on reconnect, exactly like the
     reference's restarted GCS rebuilding from raylet resubscription."""
 
-    def __init__(self, persist_dir: Optional[str] = None) -> None:
+    # KV namespaces worth durability.  High-frequency transient channels
+    # (tune/train report queues, collective rendezvous boards) would
+    # otherwise grow the WAL without bound — a put+del pair per report,
+    # never compacted.
+    DURABLE_KV_NS = ("jobs", "default", "serve")
+
+    def __init__(self, persist_dir: Optional[str] = None,
+                 durable_kv_namespaces: Optional[Tuple[str, ...]] = None
+                 ) -> None:
+        self._durable_ns = tuple(durable_kv_namespaces
+                                 or self.DURABLE_KV_NS)
         self._lock = threading.RLock()
         self._kv: Dict[str, Dict[bytes, bytes]] = {}
         self._functions: Dict[bytes, bytes] = {}
@@ -140,7 +150,8 @@ class GlobalControlState:
             if not overwrite and key in table:
                 return False
             table[key] = value
-            self._log("kv_put", ns, key, value)
+            if ns in self._durable_ns:
+                self._log("kv_put", ns, key, value)
             return True
 
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
@@ -150,7 +161,7 @@ class GlobalControlState:
     def kv_del(self, ns: str, key: bytes) -> bool:
         with self._lock:
             hit = self._kv.get(ns, {}).pop(key, None) is not None
-            if hit:
+            if hit and ns in self._durable_ns:
                 self._log("kv_del", ns, key)
             return hit
 

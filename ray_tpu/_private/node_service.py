@@ -2437,7 +2437,9 @@ class NodeService:
         ctx.reply(m, {"node_id": self.node_id,
                       "session_dir": self.session_dir,
                       "multinode": self.multinode,
-                      "gcs_address": self.gcs_address})
+                      "gcs_address": self.gcs_address,
+                      "host": getattr(self, "host", "127.0.0.1"),
+                      "control_port": self.control_port})
 
     # ------------------------------------------------------------------
     # observability: state dump + metrics (reference: util/state/api.py,

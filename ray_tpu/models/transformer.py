@@ -95,6 +95,14 @@ PRESETS: Dict[str, TransformerConfig] = {
     "llama-8b": TransformerConfig(vocab_size=128_256, d_model=4096,
                                   n_layers=32, n_heads=32, n_kv_heads=8,
                                   d_ff=14_336, max_seq=8192),
+    # BASELINE.json config #3 ("Mixtral 8x7B MoE expert-parallel"):
+    # Mixtral-shaped MoE — 8 experts, top-2 routing, expert-parallel
+    # over the `ep` mesh axis.
+    "mixtral-8x7b": TransformerConfig(vocab_size=32_000, d_model=4096,
+                                      n_layers=32, n_heads=32,
+                                      n_kv_heads=8, d_ff=14_336,
+                                      max_seq=8192, moe_experts=8,
+                                      moe_top_k=2),
 }
 
 

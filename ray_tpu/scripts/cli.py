@@ -100,9 +100,11 @@ def cmd_start(args) -> int:
         head = json.loads(info)
         st["gcs_address"] = head["gcs_address"]
         st["dashboard_url"] = head["dashboard_url"]
+        st["client_address"] = head.get("client_address")
         st["procs"].append({"pid": proc.pid, "role": "head"})
         _save_state(st)
         print(f"head started: gcs={head['gcs_address']} "
+              f"client={head.get('client_address')} "
               f"dashboard={head['dashboard_url']} pid={proc.pid}")
         print(f"join with: python -m ray_tpu start "
               f"--address {head['gcs_address']}")

@@ -54,9 +54,11 @@ def main() -> None:
         except OSError as e:
             print(f"dashboard disabled: {e}", flush=True)
 
+    node = ray_tpu._session.node_service
     info = {
         "pid": os.getpid(),
         "gcs_address": f"{args.host}:{gcs.port}",
+        "client_address": f"{args.host}:{node.control_port}",
         "dashboard_url": dash_url,
         "session_dir": ray_tpu._session.session_dir,
     }

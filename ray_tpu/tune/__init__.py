@@ -3,19 +3,21 @@
 Analog of the reference's Ray Tune (python/ray/tune): Tuner
 (tune/tuner.py:44) + trial controller (execution/tune_controller.py:68)
 + search spaces (search/sample.py) + ASHA early stopping
-(schedulers/async_hyperband.py).  Trials are actors reporting through
+(schedulers/async_hyperband.py) + PBT (schedulers/pbt.py).  Trials are actors reporting through
 the same crash-surviving KV channel as Train workers, and a TpuTrainer
 can be passed as the trainable (Train-on-Tune,
 train/base_trainer.py:693).
 """
 
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PopulationBasedTraining)
 from ray_tpu.tune.search import (choice, grid_search, loguniform,
                                  randint, uniform)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "ASHAScheduler",
+    "PopulationBasedTraining",
     "FIFOScheduler", "grid_search", "uniform", "loguniform", "randint",
     "choice",
 ]

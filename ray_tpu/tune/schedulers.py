@@ -69,3 +69,96 @@ class ASHAScheduler:
             if score < cutoff:
                 decision = STOP
         return decision
+
+
+EXPLOIT = "EXPLOIT"
+
+
+class PopulationBasedTraining:
+    """PBT (reference: tune/schedulers/pbt.py): at every
+    `perturbation_interval` iterations, trials in the bottom quantile
+    EXPLOIT a top-quantile peer — clone its checkpoint and config —
+    then EXPLORE by mutating hyperparameters (resample with
+    `resample_probability`, else perturb x1.2 / x0.8, or step through
+    explicit choice lists).
+
+    Decisions are either CONTINUE/STOP strings or an exploit dict
+    {"decision": "EXPLOIT", "source": trial_id, "config": new_config}
+    the controller acts on (restart from source's checkpoint)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 2,
+                 hyperparam_mutations: Dict[str, Any] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: int = 0) -> None:
+        import random
+        if not hyperparam_mutations:
+            raise ValueError("hyperparam_mutations must be non-empty")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = dict(hyperparam_mutations)
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = random.Random(seed)
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, int] = {}
+
+    # controller hook: called at trial start and after exploit restarts
+    def register_trial(self, trial_id: str,
+                       config: Dict[str, Any]) -> None:
+        self._configs[trial_id] = dict(config)
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        for key, domain in self.mutations.items():
+            if isinstance(domain, list):
+                if self._rng.random() < self.resample_p \
+                        or key not in out:
+                    out[key] = self._rng.choice(domain)
+                else:  # step to a neighboring choice
+                    try:
+                        i = domain.index(out[key])
+                    except ValueError:
+                        i = 0
+                    i = max(0, min(len(domain) - 1,
+                                   i + self._rng.choice((-1, 1))))
+                    out[key] = domain[i]
+            elif callable(domain):
+                if self._rng.random() < self.resample_p \
+                        or key not in out:
+                    out[key] = domain()
+                else:
+                    out[key] = out[key] * self._rng.choice((0.8, 1.2))
+            else:
+                raise TypeError(
+                    f"mutation for {key!r} must be a list of choices "
+                    f"or a zero-arg sampler")
+        return out
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]):
+        if self.metric not in result:
+            return CONTINUE
+        v = float(result[self.metric])
+        self._scores[trial_id] = v if self.mode == "max" else -v
+        t = int(result.get(self.time_attr, 0))
+        if t - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        if len(self._scores) < 2:
+            return CONTINUE
+        ranked = sorted(self._scores, key=self._scores.get)
+        k = max(int(len(ranked) * self.quantile), 1)
+        bottom, top = ranked[:k], ranked[-k:]
+        if trial_id not in bottom or trial_id in top:
+            return CONTINUE
+        source = self._rng.choice(top)
+        src_cfg = self._configs.get(source)
+        if src_cfg is None:
+            return CONTINUE
+        return {"decision": EXPLOIT, "source": source,
+                "config": self._mutate(src_cfg)}

@@ -143,6 +143,7 @@ class Tuner:
         running: Dict[str, dict] = {}     # trial_id -> {actor, ref, ...}
         client = ray_tpu._ensure_connected()
 
+        trials_by_id = {t.trial_id: t for t in trials}
         while pending or running:
             while pending and len(running) < tc.max_concurrent_trials:
                 t = pending.pop(0)
@@ -153,7 +154,10 @@ class Tuner:
                 ref = actor.run.remote(self._fn)
                 t.status = "RUNNING"
                 running[t.trial_id] = {"trial": t, "actor": actor,
-                                       "ref": ref, "ns": ns, "iter": 0}
+                                       "ref": ref, "ns": ns, "iter": 0,
+                                       "epoch": 0}
+                if hasattr(scheduler, "register_trial"):
+                    scheduler.register_trial(t.trial_id, t.config)
             refs = [info["ref"] for info in running.values()]
             ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
                                     timeout=0.2)
@@ -162,11 +166,12 @@ class Tuner:
                 info = running[tid]
                 t = info["trial"]
                 stop = False
+                exploit = None
                 for key in sorted(client.kv_keys(info["ns"])):
                     blob = client.kv_get(info["ns"], key)
                     client.kv_del(info["ns"], key)
-                    if blob is None or stop:
-                        continue   # post-stop reports don't count
+                    if blob is None or stop or exploit:
+                        continue   # post-decision reports don't count
                     metrics, ckpt_path = pickle.loads(blob)
                     info["iter"] += 1
                     metrics.setdefault("training_iteration",
@@ -175,12 +180,22 @@ class Tuner:
                     t.metrics = metrics
                     if ckpt_path:
                         t.checkpoint = Checkpoint(ckpt_path)
-                    if scheduler.on_result(tid, metrics) == STOP:
+                    decision = scheduler.on_result(tid, metrics)
+                    if decision == STOP:
                         stop = True
+                    elif isinstance(decision, dict):
+                        exploit = decision
                 if stop:
                     t.status = "EARLY_STOPPED"
                     self._stop_trial(info)
                     del running[tid]
+                elif exploit is not None:
+                    src = trials_by_id.get(exploit["source"])
+                    if src is None or src.checkpoint is None:
+                        continue      # nothing to clone yet; skip
+                    self._exploit_restart(info, t, src,
+                                          exploit["config"], scheduler,
+                                          exp_dir)
             # Reap finished trials.
             done_refs = set(r.binary() for r in ready)
             for tid in list(running):
@@ -218,6 +233,26 @@ class Tuner:
             t.metrics = metrics
             if ckpt_path:
                 t.checkpoint = Checkpoint(ckpt_path)
+
+    def _exploit_restart(self, info: dict, t: TrialResult,
+                         src: TrialResult, new_config: Dict[str, Any],
+                         scheduler, exp_dir: str) -> None:
+        """PBT exploit: kill the trial's actor and restart it from the
+        source trial's checkpoint with the mutated config (reference:
+        pbt.py _exploit — checkpoint clone + explore)."""
+        self._stop_trial(info)
+        t.config = dict(new_config)
+        info["epoch"] += 1
+        ns = (f"tune_reports/{exp_dir}/{t.trial_id}"
+              f"/e{info['epoch']}")
+        actor = _TrialActor.remote(
+            t.trial_id, t.path, t.config, ns,
+            restore_checkpoint=src.checkpoint.path)
+        info["actor"] = actor
+        info["ref"] = actor.run.remote(self._fn)
+        info["ns"] = ns
+        if hasattr(scheduler, "register_trial"):
+            scheduler.register_trial(t.trial_id, t.config)
 
     @staticmethod
     def _stop_trial(info: dict) -> None:

@@ -1,0 +1,72 @@
+"""Thin client: drive a remote cluster without joining it as a node.
+
+Reference surface: python/ray/util/client/ (ray.init("ray://host:port")
+proxying the full API through a gRPC server).  Here the transport is
+the node's existing TCP control endpoint — the thin client speaks the
+SAME protocol as in-node drivers, minus the shared-memory fast path
+(see RemoteCoreClient): puts ship inline, big results pull through the
+object-transfer endpoints.
+
+    from ray_tpu.util import client
+    client.connect("10.0.0.5:41234")     # node client_address
+    # ... the whole ray_tpu.* API now routes to the remote cluster ...
+    client.disconnect()
+
+The head's client address is printed by `python -m ray_tpu start
+--head` (and available from any node via CoreClient.node_info()).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import ray_tpu
+from ray_tpu._private.client import (RemoteCoreClient, get_global_client,
+                                     set_global_client)
+
+
+class ClientContext:
+    def __init__(self, client: RemoteCoreClient, address: str) -> None:
+        self.client = client
+        self.address = address
+
+    def disconnect(self) -> None:
+        disconnect()
+
+    def __enter__(self) -> "ClientContext":
+        return self
+
+    def __exit__(self, *a) -> None:
+        self.disconnect()
+
+
+def connect(address: str) -> ClientContext:
+    """Attach this process to a remote cluster node's control endpoint;
+    the global ray_tpu API then routes through it."""
+    if ray_tpu.is_initialized():
+        raise RuntimeError(
+            "ray_tpu is already initialized in this process; "
+            "thin-client connect() requires a fresh process "
+            "(or call ray_tpu.shutdown() first)")
+    host, _, port = address.rpartition(":")
+    client = RemoteCoreClient(host or "127.0.0.1", int(port))
+    set_global_client(client)
+    ray_tpu._mark_worker_connected(client)   # adopt as the session
+    ray_tpu._session.is_worker = False
+    return ClientContext(client, address)
+
+
+def disconnect() -> None:
+    client = get_global_client()
+    if client is None:
+        return
+    set_global_client(None)
+    ray_tpu._session = None
+    try:
+        client.close()
+    except Exception:
+        pass
+
+
+def is_connected() -> bool:
+    return isinstance(get_global_client(), RemoteCoreClient)

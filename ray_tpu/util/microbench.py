@@ -107,8 +107,62 @@ def bench_get_latency_us(ray_tpu, n: int = 1000) -> float:
     return round(lats[n // 2] * 1e6, 1)
 
 
+def bench_thin_client_sync(n: int = 500) -> float:
+    """1:1 sync actor calls THROUGH the thin client (reference:
+    client__1_1_actor_calls_sync, 515/s on m5.16xlarge) — run in a
+    subprocess so the client is a genuinely separate process speaking
+    TCP to the cluster node."""
+    import subprocess
+    import sys
+    import textwrap
+
+    import ray_tpu
+    node = ray_tpu._session.node_service
+    if not node.multinode:
+        return 0.0
+    addr = f"127.0.0.1:{node.control_port}"
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.x = 0
+
+        def inc(self):
+            self.x += 1
+            return self.x
+
+    Counter.options(name="_mb_counter", lifetime="detached").remote()
+    script = textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {__file__.rsplit('/ray_tpu/', 1)[0]!r})
+        from ray_tpu.util import client
+        import ray_tpu
+        client.connect({addr!r})
+        a = ray_tpu.get_actor("_mb_counter")
+        ray_tpu.get(a.inc.remote())
+        t0 = time.perf_counter()
+        for _ in range({n}):
+            ray_tpu.get(a.inc.remote())
+        print("RATE", {n} / (time.perf_counter() - t0))
+        client.disconnect()
+    """)
+    import os
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=300)
+    for line in r.stdout.splitlines():
+        if line.startswith("RATE "):
+            return round(float(line.split()[1]), 1)
+    raise RuntimeError(
+        f"thin-client benchmark subprocess failed "
+        f"(rc={r.returncode}):\n{r.stderr[-2000:]}")
+
+
 def run_all(out_path: str | None = None) -> dict:
     import ray_tpu
+
+    # Phase 1: single-node mode — the core hot paths with no GCS hop.
     ray_tpu.init(num_cpus=4, object_store_memory=1 << 30,
                  ignore_reinit_error=True)
     results = {
@@ -118,6 +172,15 @@ def run_all(out_path: str | None = None) -> dict:
         "put_small_per_s": bench_put_small(ray_tpu),
         "put_gigabytes_per_s": bench_put_gbps(ray_tpu),
         "get_64kb_median_us": bench_get_latency_us(ray_tpu),
+    }
+    ray_tpu.shutdown()
+
+    # Phase 2: multinode head — the thin client needs the TCP endpoint.
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster()
+    ray_tpu.init(num_cpus=4, gcs_address=cluster.gcs_address)
+    results.update({
+        "client_actor_calls_sync_per_s": bench_thin_client_sync(),
         "note": ("this host: 1 vCPU, single client; reference numbers "
                  "are m5.16xlarge (64 vCPU) with multi-client "
                  "aggregation for put/task rates"),
@@ -130,14 +193,16 @@ def run_all(out_path: str | None = None) -> dict:
             "multi_client_tasks_async_per_s": 25166,
             "put_per_s": 12677,
             "put_gigabytes_per_s": 35.9,
+            "client_actor_calls_sync_per_s": 515,
         },
-    }
+    })
     blob = json.dumps(results, indent=1)
     print(blob)
     if out_path:
         with open(out_path, "w") as f:
             f.write(blob + "\n")
     ray_tpu.shutdown()
+    cluster.shutdown()
     return results
 
 

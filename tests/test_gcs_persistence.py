@@ -34,19 +34,19 @@ def test_state_survives_restart(tmp_path):
 def test_torn_tail_write_tolerated(tmp_path):
     d = str(tmp_path / "gcs")
     s1 = GlobalControlState(persist_dir=d)
-    s1.kv_put("ns", b"k1", b"v1")
-    s1.kv_put("ns", b"k2", b"v2")
+    s1.kv_put("default", b"k1", b"v1")
+    s1.kv_put("default", b"k2", b"v2")
     # simulate a crash mid-append: truncate the last few bytes
     wal = tmp_path / "gcs" / "gcs.wal"
     data = wal.read_bytes()
     wal.write_bytes(data[:-3])
 
     s2 = GlobalControlState(persist_dir=d)
-    assert s2.kv_get("ns", b"k1") == b"v1"     # good prefix replayed
+    assert s2.kv_get("default", b"k1") == b"v1"     # good prefix replayed
     # k2's record was torn; replay stops cleanly instead of crashing
-    s2.kv_put("ns", b"k3", b"v3")
+    s2.kv_put("default", b"k3", b"v3")
     s3 = GlobalControlState(persist_dir=d)
-    assert s3.kv_get("ns", b"k3") == b"v3"
+    assert s3.kv_get("default", b"k3") == b"v3"
 
 
 def test_server_restart_preserves_named_actor_record(tmp_path):
