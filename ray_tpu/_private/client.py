@@ -674,8 +674,9 @@ class RemoteCoreClient(CoreClient):
                                 "length": min(chunk, total - off)},
                                timeout=60.0)
             # Chunk replies carry "data" (no "found" key) — mirror the
-            # node's own peer-pull loop.
-            if r.get("data") is None:
+            # node's own peer-pull loop, including the empty-chunk
+            # abort (a truncated backing copy must not spin forever).
+            if not r.get("data"):
                 raise exc.ObjectLostError(oid.hex(),
                                           "evicted during fetch")
             parts.append(r["data"])

@@ -365,3 +365,69 @@ class ShuffleOp:
                                              *shard)
             else:
                 yield _reduce_concat.remote(*shard)
+
+
+@ray_tpu.remote
+def _reduce_join(key: str, n_left: int, *parts: B.Block) -> B.Block:
+    """Inner hash-join of one partition: the first n_left blocks are the
+    left side's shards, the rest the right's (reference:
+    data/grouped_data.py join exchange).  Overlapping non-key right
+    columns get a `_right` suffix."""
+    left = B.block_concat(list(parts[:n_left]))
+    right = B.block_concat(list(parts[n_left:]))
+    if not left or not right:
+        return {}
+    lk = np.asarray(left[key])
+    rk = np.asarray(right[key])
+    order = np.argsort(rk, kind="stable")
+    rk_sorted = rk[order]
+    lo = np.searchsorted(rk_sorted, lk, side="left")
+    hi = np.searchsorted(rk_sorted, lk, side="right")
+    counts = hi - lo
+    li = np.repeat(np.arange(len(lk)), counts)
+    if len(li) == 0:
+        return {}
+    ri = order[np.concatenate(
+        [np.arange(a, b) for a, b in zip(lo, hi) if b > a])]
+    out = {c: np.asarray(v)[li] for c, v in left.items()}
+    for c, v in right.items():
+        if c == key:
+            continue
+        out[f"{c}_right" if c in out else c] = np.asarray(v)[ri]
+    return out
+
+
+class JoinOp:
+    """Stage break: distributed inner hash-join against a second
+    dataset (reference: join exchange in data/grouped_data.py).  Left
+    side streams in from upstream (stage-break collect, same as every
+    shuffle); the right side materializes at execution time.  Reduce
+    refs yield lazily, so downstream pull provides the backpressure."""
+
+    def __init__(self, right_ds, on: str,
+                 num_partitions: Optional[int] = None) -> None:
+        self.right_ds = right_ds
+        self.on = on
+        self.P = num_partitions
+
+    def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
+               preserve_order: bool = True
+               ) -> Iterator[ray_tpu.ObjectRef]:
+        left = list(upstream)
+        right = self.right_ds._block_refs()
+        if not left or not right:
+            return
+        P = self.P or max(len(left), len(right))
+        if P == 1:
+            lparts = [[r] for r in left]
+            rparts = [[r] for r in right]
+        else:
+            lparts = [_partition_block.options(num_returns=P).remote(
+                r, "hash", P, self.on, None, 0) for r in left]
+            rparts = [_partition_block.options(num_returns=P).remote(
+                r, "hash", P, self.on, None, 0) for r in right]
+        for p in range(P):
+            lshard = [m[p] for m in lparts]
+            rshard = [m[p] for m in rparts]
+            yield _reduce_join.remote(self.on, len(lshard),
+                                      *lshard, *rshard)

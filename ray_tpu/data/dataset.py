@@ -285,6 +285,15 @@ class Dataset:
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
 
+    def join(self, other: "Dataset", on: str,
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed inner hash-join on column `on` (reference:
+        Dataset.join): a lazy stage break — both sides hash-partition
+        by key at execution time, one join task per partition, no block
+        ever landing in the driver.  Overlapping right columns get a
+        `_right` suffix."""
+        return self._with_op(X.JoinOp(other, on, num_partitions))
+
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._with_op(X.ShuffleOp("repartition",
                                          num_partitions=num_blocks))

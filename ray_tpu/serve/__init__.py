@@ -32,7 +32,15 @@ from ray_tpu.serve._controller import CONTROLLER_NAME, ServeController
 
 __all__ = ["deployment", "run", "delete", "shutdown", "status",
            "get_deployment_handle", "batch", "Deployment",
-           "DeploymentHandle"]
+           "DeploymentHandle", "start_http_proxy"]
+
+
+def start_http_proxy(port: int = 8000, host: str = "127.0.0.1"):
+    """Expose deployments over HTTP (reference: per-node ProxyActor,
+    _private/proxy.py): POST /<name> with a JSON body routes through
+    the pow-2 router to a replica.  See serve/_proxy.py."""
+    from ray_tpu.serve import _proxy
+    return _proxy.start(port=port, host=host)
 
 
 def _get_or_create_controller():
@@ -213,6 +221,8 @@ def status() -> Dict[str, dict]:
 
 def shutdown() -> None:
     import ray_tpu
+    from ray_tpu.serve import _proxy
+    _proxy.stop()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:

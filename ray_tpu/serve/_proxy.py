@@ -1,0 +1,128 @@
+"""Serve HTTP ingress: the proxy actor role.
+
+Reference: serve/_private/proxy.py (HTTPProxy :779 on uvicorn/ASGI)
+routing by deployment route prefix, forwarding to the router/replica
+scheduler.  Re-scoped to the stdlib http.server (no ASGI dependency in
+the image): JSON-over-HTTP data plane with the SAME routing semantics —
+
+    POST /<deployment>            -> handle.remote(body_json)
+    POST /<deployment>/<method>   -> handle.<method>.remote(body_json)
+    GET  /<deployment>?a=1&b=2    -> handle.remote({query params})
+    GET  /-/routes                -> route table (reference: /-/routes)
+    GET  /-/healthz               -> 200 ok
+
+The response body is the JSON-encoded return value.  Unknown
+deployments 404 by asking the controller (routes follow deploys with
+no proxy restart, the LongPoll role)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qsl, urlparse
+
+
+def _handles():
+    from ray_tpu import serve
+    return serve
+
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    # -- helpers -------------------------------------------------------
+    def _send(self, code: int, payload: Any) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _route(self, arg: Any) -> None:
+        import ray_tpu
+        from ray_tpu import serve
+
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        if parsed.path == "/-/healthz":
+            self._send(200, {"status": "ok"})
+            return
+        if parsed.path == "/-/routes":
+            # Read-only: a probe must never CREATE a controller.
+            from ray_tpu.serve._controller import CONTROLLER_NAME
+            try:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                names = ray_tpu.get(controller.status.remote(),
+                                    timeout=30)
+            except ValueError:
+                names = {}
+            self._send(200, {f"/{name}": name for name in names})
+            return
+        if not parts:
+            self._send(404, {"error": "no deployment in path"})
+            return
+        name, method = parts[0], (parts[1] if len(parts) > 1 else None)
+        # No per-request existence pre-check (that would add a full
+        # controller status() round-trip to the hot path): route
+        # directly and map "no replicas"/no-controller to 404.
+        handle = serve.get_deployment_handle(name)
+        try:
+            if method:
+                ref = getattr(handle, method).remote(arg)
+            else:
+                ref = handle.remote(arg)
+            self._send(200, {"result": ray_tpu.get(ref, timeout=120)})
+        except ValueError as e:
+            self._send(404, {"error": repr(e)})    # no controller actor
+        except RuntimeError as e:
+            if "no replicas" in str(e):
+                self._send(404, {"error": repr(e)})
+            else:
+                self._send(500, {"error": repr(e)})
+        except Exception as e:
+            self._send(500, {"error": repr(e)})
+
+    # -- verbs ---------------------------------------------------------
+    def do_GET(self) -> None:
+        q = dict(parse_qsl(urlparse(self.path).query))
+        self._route(q or None)
+
+    def do_POST(self) -> None:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        try:
+            arg = json.loads(raw) if raw else None
+        except ValueError:
+            self._send(400, {"error": "body must be JSON"})
+            return
+        self._route(arg)
+
+
+_server: Optional[ThreadingHTTPServer] = None
+_lock = threading.Lock()
+
+
+def start(port: int = 8000, host: str = "127.0.0.1"
+          ) -> ThreadingHTTPServer:
+    """Start (or return) the HTTP proxy.  Port 8000 mirrors the
+    reference's default serve port."""
+    global _server
+    with _lock:
+        if _server is not None:
+            return _server
+        _server = ThreadingHTTPServer((host, port), _ProxyHandler)
+        threading.Thread(target=_server.serve_forever, daemon=True,
+                         name="rtpu-serve-proxy").start()
+        return _server
+
+
+def stop() -> None:
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.shutdown()
+            _server = None

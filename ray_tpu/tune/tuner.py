@@ -221,6 +221,9 @@ class Tuner:
 
     @staticmethod
     def _drain_final(client, info, t: TrialResult, scheduler) -> None:
+        for ns in info.get("old_ns", []):
+            for key in client.kv_keys(ns):   # orphaned pre-exploit ns
+                client.kv_del(ns, key)
         for key in sorted(client.kv_keys(info["ns"])):
             blob = client.kv_get(info["ns"], key)
             client.kv_del(info["ns"], key)
@@ -243,6 +246,10 @@ class Tuner:
         self._stop_trial(info)
         t.config = dict(new_config)
         info["epoch"] += 1
+        # The old actor may land a report between our drain and the
+        # kill; remember its namespace so the final sweep deletes those
+        # orphans instead of leaking them in the GCS forever.
+        info.setdefault("old_ns", []).append(info["ns"])
         ns = (f"tune_reports/{exp_dir}/{t.trial_id}"
               f"/e{info['epoch']}")
         actor = _TrialActor.remote(

@@ -179,8 +179,13 @@ def run_all(out_path: str | None = None) -> dict:
     from ray_tpu.cluster_utils import Cluster
     cluster = Cluster()
     ray_tpu.init(num_cpus=4, gcs_address=cluster.gcs_address)
+    try:
+        results["client_actor_calls_sync_per_s"] = \
+            bench_thin_client_sync()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
     results.update({
-        "client_actor_calls_sync_per_s": bench_thin_client_sync(),
         "note": ("this host: 1 vCPU, single client; reference numbers "
                  "are m5.16xlarge (64 vCPU) with multi-client "
                  "aggregation for put/task rates"),
@@ -201,8 +206,6 @@ def run_all(out_path: str | None = None) -> dict:
     if out_path:
         with open(out_path, "w") as f:
             f.write(blob + "\n")
-    ray_tpu.shutdown()
-    cluster.shutdown()
     return results
 
 

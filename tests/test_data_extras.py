@@ -66,6 +66,26 @@ def test_streaming_split_batches(rt):
     assert sum(len(b["v"]) for b in batches) == 100
 
 
+def test_join_inner(rt):
+    users = Dataset.from_numpy({
+        "uid": np.array([1, 2, 3, 4, 5]),
+        "age": np.array([10, 20, 30, 40, 50])}, block_rows=2)
+    orders = Dataset.from_numpy({
+        "uid": np.array([2, 2, 3, 9]),
+        "amount": np.array([7.5, 2.5, 1.0, 99.0]),
+        "age": np.array([200, 201, 202, 203])}, block_rows=3)
+    j = users.join(orders, on="uid")
+    rows = sorted(j.iter_rows(), key=lambda r: (r["uid"], r["amount"]))
+    # uid 2 matches twice, uid 3 once; 1/4/5 and 9 drop (inner)
+    assert [r["uid"] for r in rows] == [2, 2, 3]
+    assert [r["amount"] for r in rows] == [2.5, 7.5, 1.0]
+    assert [r["age"] for r in rows] == [20, 20, 30]          # left col
+    assert [r["age_right"] for r in rows] == [201, 200, 202]  # suffixed
+
+    empty = Dataset.from_numpy({"uid": np.array([], np.int64)})
+    assert list(users.join(empty, on="uid").iter_rows()) == []
+
+
 def test_stats(rt):
     ds = Dataset.range(500, block_rows=100).map(
         lambda r: {"id": r["id"] * 2})

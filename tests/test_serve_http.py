@@ -1,0 +1,73 @@
+"""Serve HTTP ingress (reference: _private/proxy.py HTTPProxy)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+@serve.deployment(num_replicas=2)
+class Calc:
+    def __call__(self, body):
+        return {"doubled": body["x"] * 2}
+
+    def add(self, body):
+        return body["a"] + body["b"]
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_http_proxy_routes(rt):
+    serve.run(Calc.bind())
+    httpd = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    # __call__ route
+    out = _post(f"{base}/Calc", {"x": 21})
+    assert out == {"result": {"doubled": 42}}
+
+    # method route
+    out = _post(f"{base}/Calc/add", {"a": 3, "b": 4})
+    assert out == {"result": 7}
+
+    # GET with query params
+    with urllib.request.urlopen(f"{base}/Calc/add?a=x&b=y",
+                                timeout=60) as r:
+        assert json.loads(r.read()) == {"result": "xy"}
+
+    # system endpoints
+    with urllib.request.urlopen(f"{base}/-/healthz", timeout=30) as r:
+        assert json.loads(r.read())["status"] == "ok"
+    with urllib.request.urlopen(f"{base}/-/routes", timeout=30) as r:
+        assert "/Calc" in json.loads(r.read())
+
+    # unknown deployment -> 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{base}/Nope", {})
+    assert ei.value.code == 404
+
+    # user exception -> 500 with the error surfaced
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{base}/Calc/add", {"a": 1})   # missing kwarg
+    assert ei.value.code == 500
