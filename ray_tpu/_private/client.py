@@ -546,6 +546,25 @@ class CoreClient:
     def node_info(self) -> dict:
         return self.conn.call({"type": "node_info"})
 
+    # -- streaming generators ----------------------------------------------
+    def stream_next(self, stream_id: bytes, index: int) -> dict:
+        """Block until stream item `index` exists or the stream ends.
+        The node parks the reply (no client-side polling)."""
+        return self.conn.call({"type": "stream_next",
+                               "stream_id": stream_id,
+                               "index": index}, timeout=None)
+
+    def stream_release(self, stream_id: bytes) -> None:
+        try:
+            self.conn.notify({"type": "stream_release",
+                              "stream_id": stream_id})
+        except Exception:
+            pass
+
+    def stream_yield(self, stream_id: bytes, item_meta: tuple) -> None:
+        self.conn.notify({"type": "stream_yield",
+                          "stream_id": stream_id, "item": item_meta})
+
     # -- observability -----------------------------------------------------
     def state_dump(self, cluster: bool = True) -> dict:
         return self.conn.call({"type": "state_dump",

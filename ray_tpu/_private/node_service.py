@@ -269,6 +269,10 @@ class NodeService:
         # behind ray.timeline); workers attach execution spans to
         # task_done and push custom spans via profile_event.
         self._events: deque = deque(maxlen=config.profile_events_max)
+        # Streaming-generator item tables, keyed by the generator's
+        # completion object id: {"items": [oid...], "done": bool}
+        # (reference: streaming generator object refs in task_manager).
+        self._streams: Dict[bytes, dict] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -1678,6 +1682,15 @@ class NodeService:
             reason = (None if spec.get("pg") is not None
                       or self._autoscaler_live()
                       else self._infeasible_reason(spec.get("resources")))
+            if (reason is None and spec.get("streaming")
+                    and not self._local_totals_satisfy(
+                        spec.get("resources") or {})):
+                # Streaming tasks never spill (their item stream is
+                # node-local); an unsatisfiable-here request would
+                # otherwise hang pending forever.
+                reason = ("streaming generator tasks run on the "
+                          "submitting node, whose resources cannot "
+                          "satisfy this request")
             if reason is not None and spec.get("actor_id") is None:
                 self.tasks[rec.task_id] = rec
                 for oid in spec["return_ids"]:
@@ -1935,6 +1948,8 @@ class NodeService:
                     oid, loc, data, size,
                     state=FAILED if loc == "error" else READY,
                     embedded=embedded, creator_pid=ctx.pid)
+                if oid in self._streams:
+                    self.finish_stream(oid)   # wake parked consumers
             if rec is not None:
                 rec.state = "done"
                 # Lineage for reconstruction: remember how each return
@@ -2577,6 +2592,92 @@ class NodeService:
             return
         ctx.reply(m, {"dump": dump})
 
+    # -- streaming generators (reference: streaming generator returns) --
+    def _stream_rec(self, stream_id: bytes) -> dict:
+        rec = self._streams.get(stream_id)
+        if rec is None:
+            rec = {"items": [], "done": False, "released": False,
+                   "waiters": []}
+            self._streams[stream_id] = rec
+        return rec
+
+    def _h_stream_yield(self, ctx: _ConnCtx, m: dict) -> None:
+        oid, loc, data, size, embedded = m["item"]
+        with self.lock:
+            self._register_object(oid, loc, data, size,
+                                  embedded=embedded, creator_pid=ctx.pid)
+            rec = self._stream_rec(m["stream_id"])
+            if rec["released"]:
+                # Consumer is gone but the task still produces: drop the
+                # item's creation pin immediately or it leaks forever.
+                self._decref(oid)
+            else:
+                rec["items"].append(oid)
+                self._fire_stream_waiters(rec)
+            self._schedule()
+
+    def _fire_stream_waiters(self, rec: dict) -> None:
+        """Answer parked stream_next calls that can now be satisfied.
+        Caller holds the lock."""
+        still = []
+        for idx, ctx, msg in rec["waiters"]:
+            if idx < len(rec["items"]):
+                ctx.reply(msg, {"status": "item",
+                                "object_id": rec["items"][idx]})
+            elif rec["done"]:
+                ctx.reply(msg, {"status": "end"})
+            else:
+                still.append((idx, ctx, msg))
+        rec["waiters"] = still
+
+    def finish_stream(self, stream_id: bytes) -> None:
+        """Completion object resolved (success or failure): wake every
+        parked consumer.  Caller holds the lock."""
+        rec = self._streams.get(stream_id)
+        if rec is None:
+            return
+        rec["done"] = True
+        self._fire_stream_waiters(rec)
+        if rec["released"]:
+            self._streams.pop(stream_id, None)
+
+    def _h_stream_next(self, ctx: _ConnCtx, m: dict) -> None:
+        """Parked reply (no busy-poll): the answer goes out when the
+        item arrives or the stream finishes."""
+        with self.lock:
+            rec = self._streams.get(m["stream_id"])
+            idx = m["index"]
+            if rec is not None and idx < len(rec["items"]):
+                ctx.reply(m, {"status": "item",
+                              "object_id": rec["items"][idx]})
+                return
+            done = rec["done"] if rec is not None else False
+            if not done:
+                e = self.objects.get(m["stream_id"])
+                done = e is not None and e.state in (READY, FAILED)
+            if done:
+                ctx.reply(m, {"status": "end"})
+                return
+            self._stream_rec(m["stream_id"])["waiters"].append(
+                (idx, ctx, m))
+
+    def _h_stream_release(self, ctx: _ConnCtx, m: dict) -> None:
+        """Consumer dropped its generator: release the stream's item
+        holds (each item was born with the creation pin).  A tombstone
+        stays until the producing task completes so late yields are
+        dropped instead of resurrecting the record."""
+        with self.lock:
+            rec = self._streams.get(m["stream_id"])
+            if rec is None:
+                rec = self._stream_rec(m["stream_id"])
+            for oid in rec["items"]:
+                self._decref(oid)
+            rec["items"] = []
+            rec["released"] = True
+            rec["waiters"] = []
+            if rec["done"]:
+                self._streams.pop(m["stream_id"], None)
+
     def _h_profile_event(self, ctx: _ConnCtx, m: dict) -> None:
         """Custom user span from ray_tpu.util.profiling.span()."""
         ev = dict(m["event"])
@@ -2772,8 +2873,12 @@ class NodeService:
                     _charge(bundle.free, res)
                 elif not self._take(res):
                     # Affinity-pinned work must wait here, not spill.
+                    # Streaming generators also stay local: their item
+                    # stream lives in THIS node's table, and a peer
+                    # executing the task would yield into the wrong one.
                     if (self.multinode
                             and rec.spec.get("affinity") is None
+                            and not rec.spec.get("streaming")
                             and self._try_spill(rec, res)):
                         progressed = True
                     continue
@@ -3002,6 +3107,8 @@ class NodeService:
         for oid in rec.spec["return_ids"]:
             self._register_object(oid, "error", blob, len(blob),
                                   state=FAILED)
+            if oid in self._streams:
+                self.finish_stream(oid)   # wake parked consumers
         foreign_task = rec.spec.get("owner_node") not in (None,
                                                           self.node_id)
         if not rec.is_actor_creation and not foreign_task:

@@ -71,6 +71,8 @@ class WorkerRuntime:
             self._execute_and_report(spec, self._run_function, spec)
 
     def _run_function(self, spec: dict) -> Any:
+        import os as _os
+
         from ray_tpu._private import runtime_env as rte
         # The env must be live BEFORE unpickling: cloudpickle refers to
         # driver-side modules by name, and py_modules/working_dir exist
@@ -79,6 +81,17 @@ class WorkerRuntime:
                          self.client.session_dir, permanent=False):
             fn = self.client.fetch_function(spec["function_id"])
             args, kwargs = self.client.unpack_args(spec["args"])
+            if spec.get("streaming"):
+                # Streaming generator: register each yield immediately
+                # under the stream keyed by the completion oid, so the
+                # caller consumes items while we still run (reference:
+                # core_worker streaming generator report path).
+                stream_id = spec["return_ids"][0]
+                for value in fn(*args, **kwargs):
+                    oid = _os.urandom(16)
+                    meta = self.client.build_return_meta(oid, value)
+                    self.client.stream_yield(stream_id, meta)
+                return None        # completion object carries None
             return fn(*args, **kwargs)
 
     def _execute_actor_creation(self, spec: dict) -> None:

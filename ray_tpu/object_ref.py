@@ -75,3 +75,46 @@ class ObjectRef:
 def _get_client():
     from ray_tpu._private.client import get_global_client
     return get_global_client()
+
+
+class ObjectRefGenerator:
+    """Streaming-generator handle (reference: ObjectRefGenerator,
+    _raylet.pyx streaming generators): iterating yields ObjectRefs to
+    items AS THE TASK PRODUCES THEM — item 0 is consumable while the
+    generator task is still running.  Exhaustion raises StopIteration;
+    a mid-generator exception surfaces on the next consumed ref."""
+
+    def __init__(self, completion_ref: "ObjectRef", client) -> None:
+        self._completion_ref = completion_ref   # end/error signal
+        self._stream_id = completion_ref.binary()
+        self._client = client
+        self._index = 0
+        self._released = False
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        reply = self._client.stream_next(self._stream_id, self._index)
+        if reply["status"] == "item":
+            self._index += 1
+            return ObjectRef(reply["object_id"], owned=False)
+        # end of stream: the completion object carries None on success
+        # or the task error — get() it so failures propagate.
+        from ray_tpu import get
+        get(self._completion_ref)
+        raise StopIteration
+
+    def completed(self) -> "ObjectRef":
+        """Ref that resolves when the generator task finishes
+        (reference: generator 'completed' sentinel)."""
+        return self._completion_ref
+
+    def __del__(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        try:
+            self._client.stream_release(self._stream_id)
+        except Exception:
+            pass

@@ -85,6 +85,25 @@ class RemoteFunction:
         num_returns = self._options.get("num_returns", 1)
         resources = _resources_from_options(
             self._options, config.task_default_num_cpus)
+        if num_returns == "streaming":
+            # Streaming generator task (reference: num_returns="streaming"
+            # -> ObjectRefGenerator, core_worker streaming generators):
+            # each yield registers immediately; the caller consumes items
+            # while the task still runs.  Retries are disabled — a
+            # partially-consumed replay would double-deliver items.
+            from ray_tpu._private import runtime_env as rte
+            from ray_tpu.object_ref import ObjectRefGenerator
+            refs = client.submit_task(
+                function_id=fid,
+                name=(self._options.get("name")
+                      or self._fn.__qualname__),
+                args=args, kwargs=kwargs, num_returns=1,
+                resources=resources, retries=0,
+                pg=_pg_spec_from_options(self._options),
+                runtime_env=rte.pack(self._options.get("runtime_env")),
+                affinity=self._options.get("_affinity"),
+                actor_spec_extra={"streaming": True})
+            return ObjectRefGenerator(refs[0], client)
         from ray_tpu._private import runtime_env as rte
         refs = client.submit_task(
             function_id=fid,

@@ -68,21 +68,28 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         name, method = parts[0], (parts[1] if len(parts) > 1 else None)
         # No per-request existence pre-check (that would add a full
         # controller status() round-trip to the hot path): route
-        # directly and map "no replicas"/no-controller to 404.
+        # directly; only the TYPED routing failures map to 404 — a user
+        # method raising ValueError must surface as 500, not
+        # "not found".
+        from ray_tpu.serve._router import NoReplicasError
         handle = serve.get_deployment_handle(name)
         try:
             if method:
                 ref = getattr(handle, method).remote(arg)
             else:
                 ref = handle.remote(arg)
-            self._send(200, {"result": ray_tpu.get(ref, timeout=120)})
+        except NoReplicasError as e:
+            self._send(404, {"error": repr(e)})
+            return
         except ValueError as e:
-            self._send(404, {"error": repr(e)})    # no controller actor
-        except RuntimeError as e:
-            if "no replicas" in str(e):
-                self._send(404, {"error": repr(e)})
-            else:
-                self._send(500, {"error": repr(e)})
+            # get_actor(CONTROLLER_NAME) miss: serve never started.
+            self._send(404, {"error": repr(e)})
+            return
+        except Exception as e:
+            self._send(500, {"error": repr(e)})
+            return
+        try:
+            self._send(200, {"result": ray_tpu.get(ref, timeout=120)})
         except Exception as e:
             self._send(500, {"error": repr(e)})
 

@@ -22,6 +22,11 @@ from typing import Any, Dict, List, Optional
 _REFRESH_INTERVAL_S = 2.0
 
 
+class NoReplicasError(RuntimeError):
+    """Deployment has no live replicas (typed so ingress can 404 it
+    without string matching)."""
+
+
 class Router:
     def __init__(self, deployment_name: str) -> None:
         self._name = deployment_name
@@ -61,7 +66,7 @@ class Router:
         with self._lock:
             reps = self._replicas
             if not reps:
-                raise RuntimeError(
+                raise NoReplicasError(
                     f"deployment {self._name!r} has no replicas")
             if len(reps) == 1:
                 choice = reps[0]
