@@ -2597,9 +2597,21 @@ class NodeService:
         rec = self._streams.get(stream_id)
         if rec is None:
             rec = {"items": [], "done": False, "released": False,
-                   "waiters": []}
+                   "waiters": [], "dropped_upto": 0}
             self._streams[stream_id] = rec
         return rec
+
+    def _advance_stream(self, rec: dict, upto: int) -> None:
+        """Drop the stream's creation pins for items the consumer has
+        moved past.  Safe ordering: the consumer's borrow add_ref for
+        item i is notified on the same connection BEFORE its
+        stream_next(i+1), so by the time we process that call the
+        borrow is counted.  Keeps store usage O(in-flight), not
+        O(total items streamed).  Caller holds the lock."""
+        upto = min(upto, len(rec["items"]))
+        for pos in range(rec["dropped_upto"], upto):
+            self._decref(rec["items"][pos])
+        rec["dropped_upto"] = max(rec["dropped_upto"], upto)
 
     def _h_stream_yield(self, ctx: _ConnCtx, m: dict) -> None:
         oid, loc, data, size, embedded = m["item"]
@@ -2647,6 +2659,9 @@ class NodeService:
         with self.lock:
             rec = self._streams.get(m["stream_id"])
             idx = m["index"]
+            if rec is not None:
+                # Asking for item idx means items < idx are consumed.
+                self._advance_stream(rec, idx)
             if rec is not None and idx < len(rec["items"]):
                 ctx.reply(m, {"status": "item",
                               "object_id": rec["items"][idx]})
@@ -2670,12 +2685,20 @@ class NodeService:
             rec = self._streams.get(m["stream_id"])
             if rec is None:
                 rec = self._stream_rec(m["stream_id"])
-            for oid in rec["items"]:
+            for oid in rec["items"][rec["dropped_upto"]:]:
                 self._decref(oid)
             rec["items"] = []
+            rec["dropped_upto"] = 0
             rec["released"] = True
             rec["waiters"] = []
-            if rec["done"]:
+            done = rec["done"]
+            if not done:
+                # A stream that never recorded completion (e.g. zero
+                # yields, or failure before the first yield): consult
+                # the completion object so the tombstone doesn't leak.
+                e = self.objects.get(m["stream_id"])
+                done = e is not None and e.state in (READY, FAILED)
+            if done:
                 self._streams.pop(m["stream_id"], None)
 
     def _h_profile_event(self, ctx: _ConnCtx, m: dict) -> None:

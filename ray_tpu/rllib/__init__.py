@@ -5,8 +5,9 @@ rollout_worker.py, env vectorization).  See ppo.py for the TPU-first
 design notes.
 """
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
 from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker
 
-__all__ = ["PPO", "PPOConfig", "RolloutWorker", "CartPoleEnv",
-           "VectorEnv"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "RolloutWorker",
+           "CartPoleEnv", "VectorEnv"]

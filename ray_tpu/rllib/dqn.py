@@ -1,0 +1,273 @@
+"""DQN: off-policy Q-learning with replay + target network.
+
+Reference surface: rllib/algorithms/dqn/ (DQNConfig, replay buffer
+utils rllib/utils/replay_buffers/, target-network sync in
+Algorithm.training_step).  TPU-first split mirrors ppo.py: host-side
+actor-parallel epsilon-greedy sampling, ONE jit'd learner update doing
+`num_grad_steps` minibatched Bellman updates per train() inside a
+single compiled `lax.scan` (double-DQN targets, Huber loss), with a
+hard target-net sync every `target_update_interval` train calls.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.ppo import init_policy
+
+
+def q_forward(params, obs):
+    """Reuse the MLP trunk; the `pi` head doubles as Q-values and the
+    critic head is unused."""
+    import jax.numpy as jnp
+
+    x = jnp.tanh(obs @ params["l1"]["w"] + params["l1"]["b"])
+    x = jnp.tanh(x @ params["l2"]["w"] + params["l2"]["b"])
+    return x @ params["pi"]["w"] + params["pi"]["b"]
+
+
+class ReplayBuffer:
+    """Uniform ring buffer (reference:
+    utils/replay_buffers/replay_buffer.py)."""
+
+    def __init__(self, capacity: int, obs_size: int) -> None:
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_size), np.float32)
+        self.next_obs = np.zeros((capacity, obs_size), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.bool_)
+        self.size = 0
+        self._pos = 0
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones) -> None:
+        for i in range(len(actions)):
+            p = self._pos
+            self.obs[p] = obs[i]
+            self.actions[p] = actions[i]
+            self.rewards[p] = rewards[i]
+            self.next_obs[p] = next_obs[i]
+            self.dones[p] = dones[i]
+            self._pos = (p + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, rng: np.random.RandomState, n: int) -> Dict:
+        ix = rng.randint(0, self.size, size=n)
+        return {"obs": self.obs[ix], "actions": self.actions[ix],
+                "rewards": self.rewards[ix],
+                "next_obs": self.next_obs[ix],
+                "dones": self.dones[ix].astype(np.float32)}
+
+
+@ray_tpu.remote
+class DQNWorker:
+    """Epsilon-greedy transition collector (reference: EnvRunner
+    sampling for off-policy algos)."""
+
+    def __init__(self, worker_index: int, num_envs: int,
+                 rollout_len: int, env_maker=None,
+                 max_steps: int = 200) -> None:
+        import jax
+
+        maker = env_maker or (
+            lambda seed: CartPoleEnv(max_steps=max_steps, seed=seed))
+        self.vec = VectorEnv(maker, num_envs,
+                             seed=7000 * (worker_index + 1))
+        self.rollout_len = rollout_len
+        self.obs = self.vec.reset()
+        self.rng = np.random.RandomState(worker_index + 1)
+        self._infer = jax.jit(q_forward)
+
+    def sample(self, params, epsilon: float) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        T, N = self.rollout_len, self.vec.num_envs
+        obs_b, act_b, rew_b, nobs_b, done_b = [], [], [], [], []
+        for _ in range(T):
+            q = np.asarray(self._infer(params, jnp.asarray(self.obs)))
+            greedy = q.argmax(axis=1)
+            random = self.rng.randint(0, q.shape[1], size=N)
+            explore = self.rng.rand(N) < epsilon
+            action = np.where(explore, random, greedy)
+            prev = self.obs
+            self.obs, rew, done = self.vec.step(action)
+            obs_b.append(prev)
+            act_b.append(action)
+            rew_b.append(rew)
+            nobs_b.append(self.obs)
+            done_b.append(done)
+        return {"obs": np.concatenate(obs_b),
+                "actions": np.concatenate(act_b),
+                "rewards": np.concatenate(rew_b),
+                "next_obs": np.concatenate(nobs_b),
+                "dones": np.concatenate(done_b),
+                "episode_returns": self.vec.drain_episode_returns()}
+
+
+def make_update_fn(optimizer, gamma: float, num_grad_steps: int,
+                   batch_size: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, target_params, batch):
+        q = q_forward(params, batch["obs"])
+        q_sa = jnp.take_along_axis(
+            q, batch["actions"][:, None], axis=1)[:, 0]
+        # Double DQN: online net picks a', target net evaluates it.
+        next_online = q_forward(params, batch["next_obs"])
+        next_target = q_forward(target_params, batch["next_obs"])
+        a_prime = jnp.argmax(next_online, axis=1)
+        q_next = jnp.take_along_axis(
+            next_target, a_prime[:, None], axis=1)[:, 0]
+        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) \
+            * jax.lax.stop_gradient(q_next)
+        return optax.huber_loss(q_sa, target).mean()
+
+    @jax.jit
+    def update(params, target_params, opt_state, data, rng):
+        n = data["obs"].shape[0]
+
+        def step(carry, key):
+            params, opt_state = carry
+            ix = jax.random.randint(key, (batch_size,), 0, n)
+            batch = {k: v[ix] for k, v in data.items()}
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target_params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        keys = jax.random.split(rng, num_grad_steps)
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), keys)
+        return params, opt_state, losses.mean()
+
+    return update
+
+
+class DQNConfig:
+    def __init__(self) -> None:
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 4
+        self.rollout_len = 64
+        self.env_maker: Optional[Callable] = None
+        self.env_max_steps = 200
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.batch_size = 64
+        self.num_grad_steps = 32
+        self.target_update_interval = 4
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_iters = 15
+        self.hidden = 64
+        self.seed = 0
+
+    def rollouts(self, **kw) -> "DQNConfig":
+        for k, v in kw.items():
+            if k == "max_steps":          # PPOConfig.environment parity
+                k = "env_max_steps"
+            if not hasattr(self, k):
+                raise ValueError(f"unknown DQN config option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    training = rollouts
+    environment = rollouts
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    def __init__(self, config: DQNConfig) -> None:
+        import jax
+        import optax
+
+        self.config = config
+        rng = jax.random.PRNGKey(config.seed)
+        self._rng, init_rng = jax.random.split(rng)
+        self.params = init_policy(init_rng,
+                                  CartPoleEnv.observation_size,
+                                  CartPoleEnv.num_actions,
+                                  hidden=config.hidden)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_update_fn(
+            self.optimizer, config.gamma, config.num_grad_steps,
+            config.batch_size)
+        self.buffer = ReplayBuffer(config.buffer_capacity,
+                                   CartPoleEnv.observation_size)
+        self.workers = [
+            DQNWorker.remote(i, config.num_envs_per_worker,
+                             config.rollout_len, config.env_maker,
+                             config.env_max_steps)
+            for i in range(config.num_rollout_workers)]
+        self._np_rng = np.random.RandomState(config.seed)
+        self.iteration = 0
+        self._reward_window: List[float] = []
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(self.iteration / max(c.epsilon_decay_iters, 1), 1.0)
+        return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        eps = self._epsilon()
+        params_ref = ray_tpu.put(jax.device_get(self.params))
+        samples = ray_tpu.get([w.sample.remote(params_ref, eps)
+                               for w in self.workers])
+        episode_returns = []
+        for s in samples:
+            self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
+                                  s["next_obs"], s["dones"])
+            episode_returns.extend(s["episode_returns"])
+        self._reward_window.extend(episode_returns)
+        self._reward_window = self._reward_window[-100:]
+
+        loss = float("nan")
+        if self.buffer.size >= self.config.learning_starts:
+            # One compiled update does num_grad_steps minibatch SGD
+            # steps over a fixed sampled slab (resampled inside scan).
+            slab = self.buffer.sample(
+                self._np_rng,
+                min(self.buffer.size,
+                    self.config.batch_size * self.config.num_grad_steps))
+            self._rng, key = jax.random.split(self._rng)
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.target_params, self.opt_state,
+                {k: jnp.asarray(v) for k, v in slab.items()}, key)
+            loss = float(loss)
+        self.iteration += 1
+        if self.iteration % self.config.target_update_interval == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        steps = sum(len(s["actions"]) for s in samples)
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(self._reward_window))
+                                    if self._reward_window else 0.0),
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_this_iter": steps,
+            "buffer_size": self.buffer.size,
+            "epsilon": eps,
+            "loss": loss,
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def stop(self) -> None:
+        for w in self.workers:
+            ray_tpu.kill(w)
