@@ -22,13 +22,10 @@ from ray_tpu.rllib.ppo import init_policy
 
 
 def q_forward(params, obs):
-    """Reuse the MLP trunk; the `pi` head doubles as Q-values and the
-    critic head is unused."""
-    import jax.numpy as jnp
-
-    x = jnp.tanh(obs @ params["l1"]["w"] + params["l1"]["b"])
-    x = jnp.tanh(x @ params["l2"]["w"] + params["l2"]["b"])
-    return x @ params["pi"]["w"] + params["pi"]["b"]
+    """The `pi` head doubles as Q-values; the unused critic head is
+    dead code XLA eliminates under jit."""
+    from ray_tpu.rllib.ppo import policy_forward
+    return policy_forward(params, obs)[0]
 
 
 class ReplayBuffer:
@@ -46,15 +43,24 @@ class ReplayBuffer:
         self._pos = 0
 
     def add_batch(self, obs, actions, rewards, next_obs, dones) -> None:
-        for i in range(len(actions)):
-            p = self._pos
-            self.obs[p] = obs[i]
-            self.actions[p] = actions[i]
-            self.rewards[p] = rewards[i]
-            self.next_obs[p] = next_obs[i]
-            self.dones[p] = dones[i]
-            self._pos = (p + 1) % self.capacity
-            self.size = min(self.size + 1, self.capacity)
+        """Vectorized ring insert: at most two slice assignments per
+        array (split at the wrap point)."""
+        n = len(actions)
+        if n > self.capacity:      # keep only the newest fit
+            obs, actions = obs[-self.capacity:], actions[-self.capacity:]
+            rewards, dones = (rewards[-self.capacity:],
+                              dones[-self.capacity:])
+            next_obs = next_obs[-self.capacity:]
+            n = self.capacity
+        first = min(n, self.capacity - self._pos)
+        for dst, src in ((self.obs, obs), (self.actions, actions),
+                         (self.rewards, rewards),
+                         (self.next_obs, next_obs), (self.dones, dones)):
+            dst[self._pos:self._pos + first] = src[:first]
+            if n > first:
+                dst[:n - first] = src[first:]
+        self._pos = (self._pos + n) % self.capacity
+        self.size = min(self.size + n, self.capacity)
 
     def sample(self, rng: np.random.RandomState, n: int) -> Dict:
         ix = rng.randint(0, self.size, size=n)
@@ -200,7 +206,7 @@ class DQN:
                                   CartPoleEnv.observation_size,
                                   CartPoleEnv.num_actions,
                                   hidden=config.hidden)
-        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.target_params = self.params   # arrays are immutable
         self.optimizer = optax.adam(config.lr)
         self.opt_state = self.optimizer.init(self.params)
         self._update = make_update_fn(
@@ -242,11 +248,13 @@ class DQN:
         loss = float("nan")
         if self.buffer.size >= self.config.learning_starts:
             # One compiled update does num_grad_steps minibatch SGD
-            # steps over a fixed sampled slab (resampled inside scan).
+            # steps over a fixed-SHAPE sampled slab (sampling is with
+            # replacement, so a small buffer just repeats — a variable
+            # shape here would recompile the scan every iteration while
+            # the buffer fills).
             slab = self.buffer.sample(
                 self._np_rng,
-                min(self.buffer.size,
-                    self.config.batch_size * self.config.num_grad_steps))
+                self.config.batch_size * self.config.num_grad_steps)
             self._rng, key = jax.random.split(self._rng)
             self.params, self.opt_state, loss = self._update(
                 self.params, self.target_params, self.opt_state,
@@ -254,7 +262,7 @@ class DQN:
             loss = float(loss)
         self.iteration += 1
         if self.iteration % self.config.target_update_interval == 0:
-            self.target_params = jax.tree.map(lambda x: x, self.params)
+            self.target_params = self.params   # arrays are immutable
         steps = sum(len(s["actions"]) for s in samples)
         return {
             "training_iteration": self.iteration,
