@@ -502,8 +502,15 @@ class CoreClient:
 
     def submit_actor_task(self, actor_id: bytes, class_id: bytes,
                           method_name: str, args: tuple, kwargs: dict,
-                          num_returns: int, retries: int = 0
-                          ) -> List[ObjectRef]:
+                          num_returns, retries: int = 0):
+        if num_returns == "streaming":
+            refs = self.submit_task(
+                function_id=class_id, name=method_name, args=args,
+                kwargs=kwargs, num_returns=1, resources={},
+                retries=0, actor_id=actor_id, method_name=method_name,
+                actor_spec_extra={"streaming": True})
+            from ray_tpu.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(refs[0], self)
         return self.submit_task(
             function_id=class_id, name=method_name, args=args,
             kwargs=kwargs, num_returns=num_returns, resources={},

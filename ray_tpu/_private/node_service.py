@@ -1658,6 +1658,19 @@ class NodeService:
                     return
                 if home is not None and home != self.node_id:
                     rec = TaskRecord(spec)
+                    if spec.get("streaming"):
+                        # The stream table is node-local: a remote
+                        # actor's yields would land on its home node
+                        # while the consumer polls here.  Fail loudly
+                        # rather than return a silently-empty stream.
+                        self.tasks[rec.task_id] = rec
+                        for oid in spec["return_ids"]:
+                            self.objects.setdefault(oid, ObjectEntry())
+                        self._fail_task_returns(rec, exc.RayTpuError(
+                            "streaming generator methods require the "
+                            "actor to live on the calling node"))
+                        ctx.reply(m, {"ok": True})
+                        return
                     # Remote actor call: forward to its home node; results
                     # come back through the GCS location directory.
                     self.tasks[rec.task_id] = rec

@@ -71,8 +71,6 @@ class WorkerRuntime:
             self._execute_and_report(spec, self._run_function, spec)
 
     def _run_function(self, spec: dict) -> Any:
-        import os as _os
-
         from ray_tpu._private import runtime_env as rte
         # The env must be live BEFORE unpickling: cloudpickle refers to
         # driver-side modules by name, and py_modules/working_dir exist
@@ -82,17 +80,25 @@ class WorkerRuntime:
             fn = self.client.fetch_function(spec["function_id"])
             args, kwargs = self.client.unpack_args(spec["args"])
             if spec.get("streaming"):
-                # Streaming generator: register each yield immediately
-                # under the stream keyed by the completion oid, so the
-                # caller consumes items while we still run (reference:
-                # core_worker streaming generator report path).
-                stream_id = spec["return_ids"][0]
-                for value in fn(*args, **kwargs):
-                    oid = _os.urandom(16)
-                    meta = self.client.build_return_meta(oid, value)
-                    self.client.stream_yield(stream_id, meta)
+                self._stream_generator(fn(*args, **kwargs),
+                                       spec["return_ids"][0])
                 return None        # completion object carries None
             return fn(*args, **kwargs)
+
+    def _stream_generator(self, gen, stream_id: bytes) -> None:
+        """Shared yield path for streaming tasks AND actor methods:
+        register each item immediately under the stream keyed by the
+        completion oid, so the caller consumes items while the
+        producer still runs (reference: core_worker streaming
+        generator report path)."""
+        if inspect.isasyncgen(gen):
+            raise TypeError(
+                "async generator methods are not supported with "
+                'num_returns="streaming"; use a sync generator')
+        for value in gen:
+            oid = os.urandom(16)
+            meta = self.client.build_return_meta(oid, value)
+            self.client.stream_yield(stream_id, meta)
 
     def _execute_actor_creation(self, spec: dict) -> None:
         def create(spec: dict) -> Any:
@@ -180,6 +186,12 @@ class WorkerRuntime:
 
         def call(_spec: dict) -> Any:
             args, kwargs = self.client.unpack_args(_spec["args"])
+            if _spec.get("streaming"):
+                # Streaming generator METHOD: same yield path as
+                # streaming tasks (items registered as produced).
+                self._stream_generator(method(*args, **kwargs),
+                                       _spec["return_ids"][0])
+                return None
             return method(*args, **kwargs)
 
         if self.actor_pool is not None:

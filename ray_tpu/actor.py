@@ -116,7 +116,7 @@ class ActorMethod:
             args, kwargs, self._num_returns)
         if self._num_returns == 1:
             return refs[0]
-        return refs
+        return refs    # a list, or the ObjectRefGenerator for streaming
 
     def __call__(self, *args, **kwargs):
         raise TypeError(f"Actor method {self._name!r} cannot be called "

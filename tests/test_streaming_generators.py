@@ -98,3 +98,27 @@ def test_completed_sentinel(rt):
     g = gen.options(num_returns="streaming").remote(2)
     assert ray_tpu.get(g.completed(), timeout=30) is None
     assert [ray_tpu.get(r) for r in g] == [0, 10]
+
+
+@ray_tpu.remote
+class Producer:
+    def __init__(self, k):
+        self.k = k
+
+    def stream(self, n):
+        for i in range(n):
+            yield i * self.k
+
+    def plain(self):
+        return "still-works"
+
+
+def test_actor_method_streaming(rt):
+    p = Producer.remote(3)
+    g = p.stream.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r, timeout=30) for r in g] == [0, 3, 6, 9]
+    # the actor keeps serving normal calls afterward
+    assert ray_tpu.get(p.plain.remote(), timeout=30) == "still-works"
+    # and a second stream on the same actor works
+    g2 = p.stream.options(num_returns="streaming").remote(2)
+    assert [ray_tpu.get(r, timeout=30) for r in g2] == [0, 3]
