@@ -25,8 +25,13 @@ Semantics notes vs the reference:
   place); the reduced array is always returned.
 * Every rank must call the same collectives in the same order (standard
   collective contract) — a per-group operation counter sequences keys.
-* Garbage: a rank entering op N deletes its op N-2 keys — any rank at
-  N has finished N-1, so nobody can still be reading N-2.
+* Garbage: each rank remembers exactly which keys it published per op.
+  Completing a *synchronizing* op at seq S (one whose completion proves
+  every rank has entered S: barrier, allreduce, allgather,
+  reducescatter) makes every key with seq < S dead, so they are deleted
+  at the next op.  Broadcast does NOT synchronize (the src publishes
+  and returns without waiting), so it never advances the horizon — its
+  keys are reaped by the next synchronizing op or at destroy.
 """
 
 from __future__ import annotations
@@ -43,6 +48,9 @@ from ray_tpu._private.client import get_global_client
 
 _NS = "collective"
 _POLL_S = 0.002
+# Finite default so a protocol bug (mismatched op order, dead peer)
+# fails loudly instead of deadlocking the caller forever.
+_DEFAULT_TIMEOUT_S = 300.0
 
 _lock = threading.RLock()
 _groups: Dict[str, "_Group"] = {}
@@ -60,6 +68,12 @@ class _Group:
         # and independent of the collective counter, so the seq-horizon
         # GC must not touch them.  Released on receiver ack or destroy.
         self._p2p_refs: Dict[tuple, Any] = {}   # (dst, seq) -> ObjectRef
+        # GC bookkeeping: exact keys this rank published per op, the
+        # proven-safe horizon (all ranks have finished every op < this),
+        # and how far deletion has already run.
+        self._published: Dict[int, List[bytes]] = {}   # seq -> kv keys
+        self._safe_below = 0
+        self._gc_done_below = 0
 
 
 def _client():
@@ -88,11 +102,14 @@ def _put_blob(group: _Group, seq: int, tag: str, value: Any,
         payload = b"R" + ref.binary()
     else:
         payload = b"I" + blob
-    _client().kv_put(_NS, _key(group.name, seq, tag), payload)
+    key = _key(group.name, seq, tag)
+    if p2p_dst is None:
+        group._published.setdefault(seq, []).append(key)
+    _client().kv_put(_NS, key, payload)
 
 
 def _get_blob(group: _Group, seq: int, tag: str,
-              timeout: Optional[float] = None) -> Any:
+              timeout: Optional[float] = _DEFAULT_TIMEOUT_S) -> Any:
     key = _key(group.name, seq, tag)
     deadline = None if timeout is None else time.monotonic() + timeout
     while True:
@@ -110,18 +127,26 @@ def _get_blob(group: _Group, seq: int, tag: str,
     return pickle.loads(raw[1:])
 
 
-def _gc_horizon(group: _Group, seq: int) -> None:
-    """Delete this rank's keys from op seq-2 (provably unread by now)."""
-    old = seq - 2
-    if old < 0:
+def _gc(group: _Group) -> None:
+    """Delete this rank's published keys for every op that is provably
+    finished on all ranks (seq < _safe_below).  Exact-key deletion —
+    no prefix matching, so rank 1 can never clobber rank 12's data."""
+    if group._gc_done_below >= group._safe_below:
         return
     c = _client()
-    prefix = f"{group.name}/{old:09d}/r{group.rank}".encode()
-    for key in c.kv_keys(_NS, prefix):
-        c.kv_del(_NS, key)
-    if group.rank == 0:
-        c.kv_del(_NS, _key(group.name, old, "result"))
-    group._refs = [(s, r) for (s, r) in group._refs if s > old]
+    for s in range(group._gc_done_below, group._safe_below):
+        for key in group._published.pop(s, ()):
+            c.kv_del(_NS, key)
+    group._gc_done_below = group._safe_below
+    group._refs = [(s, r) for (s, r) in group._refs
+                   if s >= group._safe_below]
+
+
+def _mark_synced(group: _Group, seq: int) -> None:
+    """Record that the op at `seq` synchronized all ranks: its
+    completion proves every rank entered op `seq`, so every op < seq is
+    finished everywhere and its keys are dead."""
+    group._safe_below = max(group._safe_below, seq)
 
 
 # ---------------------------------------------------------------------------
@@ -177,20 +202,22 @@ def destroy_collective_group(group_name: str = "default") -> None:
         return
     c = _client()
     c.kv_del(_NS, f"{group_name}/roster/{g.rank}".encode())
+    # Exact-key deletion from the published ledger (covers broadcast
+    # "result" keys from any src rank, never touches peers' keys).
+    for keys in g._published.values():
+        for key in keys:
+            c.kv_del(_NS, key)
+    g._published.clear()
     prefix = f"{group_name}/".encode()
     for key in c.kv_keys(_NS, prefix):
-        # key = "{group}/{seq:09d}/{tag}"; parse the tag exactly —
+        # p2p keys aren't in the ledger; parse the tag exactly —
         # substring matching would let rank 1 delete rank 12's data.
         parts = key[len(prefix):].split(b"/", 1)
         if len(parts) != 2:
             continue
         tag = parts[1].decode(errors="replace")
-        mine = (tag == f"r{g.rank}"
-                or tag.startswith(f"r{g.rank}:")
-                or tag.startswith(f"p2p/{g.rank}->")
-                or tag.startswith(f"p2pack/{g.rank}->")
-                or (g.rank == 0 and tag == "result"))
-        if mine:
+        if (tag.startswith(f"p2p/{g.rank}->")
+                or tag.startswith(f"p2pack/{g.rank}->")):
             c.kv_del(_NS, key)
     if not c.kv_keys(_NS, f"{group_name}/roster/".encode()):
         for key in c.kv_keys(_NS, prefix):
@@ -237,13 +264,14 @@ def allreduce(arr, op: str = "sum", group_name: str = "default"):
     g = _group(group_name)
     seq = g.seq
     g.seq += 1
-    _gc_horizon(g, seq)
+    _gc(g)
     reducer = _REDUCERS.get(op)
     if reducer is None:
         raise ValueError(f"unknown reduce op {op!r} "
                          f"(have {sorted(_REDUCERS)})")
     local = np.asarray(arr)
     if g.world_size == 1:
+        _mark_synced(g, seq + 1)
         return _finish(arr, local)
     _put_blob(g, seq, f"r{g.rank}", local)
     if g.rank == 0:
@@ -253,6 +281,9 @@ def allreduce(arr, op: str = "sum", group_name: str = "default"):
         _put_blob(g, seq, "result", out)
     else:
         out = np.asarray(_get_blob(g, seq, "result"))
+    # Root read every rank's input; non-roots read the root's result,
+    # which implies the same — everyone has entered this op.
+    _mark_synced(g, seq)
     return _finish(arr, out)
 
 
@@ -261,12 +292,14 @@ def barrier(group_name: str = "default") -> None:
     g = _group(group_name)
     seq = g.seq
     g.seq += 1
-    _gc_horizon(g, seq)
+    _gc(g)
     if g.world_size == 1:
+        _mark_synced(g, seq + 1)
         return
     _put_blob(g, seq, f"r{g.rank}", True)
     for r in range(g.world_size):
         _get_blob(g, seq, f"r{r}")
+    _mark_synced(g, seq)
 
 
 def broadcast(arr, src_rank: int = 0, group_name: str = "default"):
@@ -274,14 +307,18 @@ def broadcast(arr, src_rank: int = 0, group_name: str = "default"):
     g = _group(group_name)
     seq = g.seq
     g.seq += 1
-    _gc_horizon(g, seq)
+    _gc(g)
     if g.world_size == 1:
+        _mark_synced(g, seq + 1)
         return _finish(arr, np.asarray(arr))
     if g.rank == src_rank:
         _put_blob(g, seq, "result", np.asarray(arr))
         out = np.asarray(arr)
     else:
         out = np.asarray(_get_blob(g, seq, "result"))
+    # NOT synced: the src published and moved on without waiting, and a
+    # non-src rank only proved the src entered this op — a slow peer may
+    # still be reading earlier keys, so the horizon must not advance.
     return _finish(arr, out)
 
 
@@ -290,13 +327,16 @@ def allgather(arr, group_name: str = "default") -> List[np.ndarray]:
     g = _group(group_name)
     seq = g.seq
     g.seq += 1
-    _gc_horizon(g, seq)
+    _gc(g)
     local = np.asarray(arr)
     if g.world_size == 1:
+        _mark_synced(g, seq + 1)
         return [local]
     _put_blob(g, seq, f"r{g.rank}", local)
-    return [np.asarray(_get_blob(g, seq, f"r{r}"))
-            for r in range(g.world_size)]
+    out = [np.asarray(_get_blob(g, seq, f"r{r}"))
+           for r in range(g.world_size)]
+    _mark_synced(g, seq)
+    return out
 
 
 def reducescatter(arr, op: str = "sum",
@@ -314,8 +354,9 @@ def reducescatter(arr, op: str = "sum",
             f"world_size ({g.world_size})")
     seq = g.seq
     g.seq += 1
-    _gc_horizon(g, seq)
+    _gc(g)
     if g.world_size == 1:
+        _mark_synced(g, seq + 1)
         return reducer(np.stack([local]))
     # Scatter-then-reduce: each rank publishes only the slice destined
     # for each peer, so no rank ever holds the full stacked array.
@@ -327,6 +368,7 @@ def reducescatter(arr, op: str = "sum",
              else np.asarray(_get_blob(g, seq, f"r{r}:{g.rank}"))
              for r in range(g.world_size)]
     out = reducer(np.stack(parts))
+    _mark_synced(g, seq)
     return out if op == "mean" else out.astype(local.dtype)
 
 

@@ -100,6 +100,72 @@ def test_single_rank_group(rt):
     assert not col.is_group_initialized("solo")
 
 
+def test_broadcast_does_not_advance_gc_horizon(rt):
+    """Regression: op N-1 being a broadcast must NOT let a fast rank
+    GC its op N-2 keys — a slow rank may still be reading them.
+
+    Drives three rank-local _Group states in one process and
+    interleaves ops by hand so the race is deterministic."""
+    from ray_tpu.util import collective as col
+
+    g0, g1, g2 = (col._Group("gcreg", 3, r) for r in range(3))
+
+    def as_rank(g):
+        with col._lock:
+            col._groups["gcreg"] = g
+
+    try:
+        # op0 = allgather.  Ranks 1 and 2 publish their keys (they have
+        # *entered* op0); rank 2 is slow — it has not read yet.
+        col._put_blob(g1, 0, "r1", np.array([1]))
+        col._put_blob(g2, 0, "r2", np.array([2]))
+        g2.seq = 1
+        as_rank(g0)
+        col.allgather(np.array([0]), "gcreg")     # rank 0 completes op0
+        # rank 1 "completes" op0: it already published; finish its reads
+        for r in range(3):
+            col._get_blob(g1, 0, f"r{r}", timeout=5.0)
+        g1.seq = 1
+        col._mark_synced(g1, 0)
+
+        # op1 = broadcast from rank 0 — does not synchronize.
+        as_rank(g0)
+        col.broadcast(np.array([7]), src_rank=0, group_name="gcreg")
+        as_rank(g1)
+        col.broadcast(np.array([0]), src_rank=0, group_name="gcreg")
+
+        # Rank 1 enters op2 (another broadcast, src=1: publish+return).
+        # The old seq-2 horizon deleted rank 1's op0 allgather key here.
+        as_rank(g1)
+        col.broadcast(np.array([9]), src_rank=1, group_name="gcreg")
+
+        # Slow rank 2 must still be able to finish its op0 reads.
+        for r in range(3):
+            got = col._get_blob(g2, 0, f"r{r}", timeout=5.0)
+            assert np.asarray(got).tolist() == [r]
+    finally:
+        with col._lock:
+            col._groups.pop("gcreg", None)
+
+
+def test_gc_deletes_exact_keys_only(rt):
+    """Rank 1's GC must not clobber rank 10+'s keys (old prefix match
+    r1 also hit r10..r19)."""
+    from ray_tpu.util import collective as col
+    g = col._Group("wide", 12, 1)
+    c = col._client()
+    try:
+        col._put_blob(g, 0, "r1", np.array([1]))
+        # rank 10's key at the same seq, published by "another process"
+        c.kv_put(col._NS, col._key("wide", 0, "r10"), b"Ipeer")
+        col._mark_synced(g, 1)   # pretend a later sync op completed
+        col._gc(g)
+        assert c.kv_get(col._NS, col._key("wide", 0, "r1")) is None
+        assert c.kv_get(col._NS, col._key("wide", 0, "r10")) == b"Ipeer"
+    finally:
+        c.kv_del(col._NS, col._key("wide", 0, "r10"))
+
+
 def test_errors(rt):
     from ray_tpu.util import collective as col
     with pytest.raises(RuntimeError, match="not initialized"):
