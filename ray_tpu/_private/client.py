@@ -553,6 +553,44 @@ class CoreClient:
     def node_info(self) -> dict:
         return self.conn.call({"type": "node_info"})
 
+    def actor_node(self, actor_id: bytes) -> bytes:
+        """Home node of an actor (compiled-DAG channel routing)."""
+        return self.conn.call({"type": "actor_node",
+                               "actor_id": actor_id})["node_id"]
+
+    # -- compiled-DAG channel plane (cross-node channels) ---------------
+    def chan_send(self, dst_node: bytes, key: bytes, value: Any,
+                  cap: int = 8, timeout: Optional[float] = None) -> None:
+        from ray_tpu.experimental.channel import ChannelClosed
+        rep = self.conn.call({"type": "chan_send", "dst": dst_node,
+                              "key": key, "payload": ser.dumps(value),
+                              "cap": cap}, timeout=timeout)
+        if rep.get("closed"):
+            raise ChannelClosed(key.decode(errors="replace"))
+
+    def chan_recv(self, key: bytes,
+                  timeout: Optional[float] = None) -> Any:
+        from ray_tpu.experimental.channel import ChannelClosed
+        msg: dict = {"type": "chan_recv", "key": key}
+        call_timeout = None
+        if timeout is not None:
+            # Expiry is node-side (the reply always comes from under
+            # the queue lock) so an abandoned parked reply can never
+            # swallow a delivered item; the rpc timeout is only a
+            # safety margin on top.
+            msg["block_ms"] = int(timeout * 1000)
+            call_timeout = timeout + 10.0
+        rep = self.conn.call(msg, timeout=call_timeout)
+        if rep.get("closed"):
+            raise ChannelClosed(key.decode(errors="replace"))
+        if rep.get("timeout"):
+            raise TimeoutError(f"chan_recv timed out")
+        return ser.loads(rep["payload"])
+
+    def chan_close(self, dst_node: Optional[bytes], key: bytes) -> None:
+        self.conn.call({"type": "chan_close", "dst": dst_node,
+                        "key": key}, timeout=15.0)
+
     # -- streaming generators ----------------------------------------------
     def stream_next(self, stream_id: bytes, index: int) -> dict:
         """Block until stream item `index` exists or the stream ends.

@@ -273,6 +273,15 @@ class NodeService:
         # completion object id: {"items": [oid...], "done": bool}
         # (reference: streaming generator object refs in task_manager).
         self._streams: Dict[bytes, dict] = {}
+        # Per-(destination, channel-key) compiled-DAG forwarder queues.
+        self._chan_fwd_queues: Dict[tuple, Any] = {}
+        # Compiled-DAG channel queues (cross-node channel plane;
+        # reference: experimental/channel/shared_memory_channel.py for
+        # same-host, torch_tensor_nccl_channel.py for cross-host).  A
+        # queue lives on the CONSUMER's node; producers anywhere
+        # chan_send to it (forwarded node-to-node when remote) with
+        # bounded capacity + parked-reply backpressure.
+        self._dag_queues: Dict[bytes, dict] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -2714,6 +2723,194 @@ class NodeService:
             if done:
                 self._streams.pop(m["stream_id"], None)
 
+    # -- compiled-DAG channel plane (cross-node channels) ---------------
+    # Reference: python/ray/experimental/channel/shared_memory_channel.py
+    # (cross-process channels) + dag/collective_node.py.  Queues are
+    # keyed cluster-wide and live on the consumer's node; a producer on
+    # another node chan_sends through its local node, which forwards
+    # over the persistent peer connection.  Backpressure = parked
+    # replies once `cap` items are queued.
+    def _dag_queue_rec(self, key: bytes, cap: int = 8) -> dict:
+        rec = self._dag_queues.get(key)
+        if rec is None:
+            rec = {"items": deque(), "closed": False, "cap": cap,
+                   "recv_waiters": [], "send_waiters": []}
+            self._dag_queues[key] = rec
+        return rec
+
+    def _h_chan_send(self, ctx: _ConnCtx, m: dict) -> None:
+        dst = m["dst"]
+        if dst == self.node_id or not self.multinode:
+            self._chan_deliver(ctx, m)
+            return
+        ninfo = self._node_info(dst)
+        if ninfo is None:
+            ctx.reply(m, {"ok": False, "closed": True,
+                          "error": "destination node is gone"})
+            return
+        # One persistent forwarder per (destination, channel key): off
+        # this connection's thread (a backpressured remote queue must
+        # not stall its other RPCs), strictly FIFO per channel
+        # (thread-per-message could reorder two sends racing onto the
+        # shared peer connection), and NOT shared across channels — a
+        # single per-destination forwarder would head-of-line-block
+        # every channel to that node behind one backpressured queue
+        # (deadlocking collectives whose consumer waits on a sibling
+        # channel).  Threads exit after 60s idle.
+        fkey = (dst, m["key"])
+        with self._peer_lock:
+            q = self._chan_fwd_queues.get(fkey)
+            if q is None:
+                q = queue.Queue()
+                self._chan_fwd_queues[fkey] = q
+                threading.Thread(target=self._chan_fwd_loop,
+                                 args=(fkey, q), daemon=True,
+                                 name="rtpu-chan-fwd").start()
+        q.put((ctx, m, ninfo))
+
+    def _chan_fwd_loop(self, fkey, q: "queue.Queue") -> None:
+        dst, _ = fkey
+        idle = 0
+        while not self._shutdown:
+            try:
+                ctx, m, ninfo = q.get(timeout=0.5)
+            except queue.Empty:
+                idle += 1
+                if idle > 120:        # ~60s idle: retire the thread
+                    with self._peer_lock:
+                        if q.empty():
+                            self._chan_fwd_queues.pop(fkey, None)
+                            return
+                continue
+            idle = 0
+            try:
+                rep = self._peer_conn_to(ninfo).call(
+                    {"type": "chan_send", "dst": dst, "key": m["key"],
+                     "payload": m["payload"], "cap": m.get("cap", 8)},
+                    timeout=120.0)
+            except Exception as e:
+                rep = {"ok": False, "closed": True, "error": str(e)}
+            try:
+                ctx.reply(m, rep)
+            except Exception:
+                pass
+
+    def _chan_deliver(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            rec = self._dag_queue_rec(m["key"], m.get("cap", 8))
+            # The consumer's first recv creates the record with the
+            # default cap; the producer carries the DAG's real
+            # capacity — let it win.
+            rec["cap"] = m.get("cap", rec["cap"])
+            if rec["closed"]:
+                ctx.reply(m, {"ok": False, "closed": True})
+                return
+            while rec["recv_waiters"]:
+                w = rec["recv_waiters"].pop(0)
+                if not w["live"]:
+                    continue
+                w["live"] = False
+                w["ctx"].reply(w["m"], {"ok": True,
+                                        "payload": m["payload"]})
+                ctx.reply(m, {"ok": True})
+                return
+            if len(rec["items"]) >= rec["cap"]:
+                rec["send_waiters"].append((ctx, m))
+                return
+            rec["items"].append(m["payload"])
+            ctx.reply(m, {"ok": True})
+
+    def _h_chan_recv(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            rec = self._dag_queue_rec(m["key"])
+            if rec["items"]:
+                payload = rec["items"].popleft()
+                # A freed slot admits one parked sender.
+                if rec["send_waiters"]:
+                    sctx, sm = rec["send_waiters"].pop(0)
+                    rec["items"].append(sm["payload"])
+                    sctx.reply(sm, {"ok": True})
+                ctx.reply(m, {"ok": True, "payload": payload})
+                return
+            if rec["closed"]:
+                ctx.reply(m, {"ok": False, "closed": True})
+                return
+            waiter = {"ctx": ctx, "m": m, "live": True}
+            rec["recv_waiters"].append(waiter)
+            block_ms = m.get("block_ms")
+            if block_ms is not None:
+                # Node-side expiry: the reply ALWAYS comes from under
+                # the lock — either an item, closed, or this timeout —
+                # so a client that stops waiting never strands a parked
+                # reply that would otherwise swallow a delivered item.
+                def expire() -> None:
+                    with self.lock:
+                        if not waiter["live"]:
+                            return
+                        waiter["live"] = False
+                        try:
+                            rec["recv_waiters"].remove(waiter)
+                        except ValueError:
+                            pass
+                    try:
+                        ctx.reply(m, {"ok": False, "timeout": True})
+                    except Exception:
+                        pass
+
+                self._deadline_waiters.append(
+                    (time.time() + block_ms / 1000.0, expire))
+
+    def _h_chan_close(self, ctx: _ConnCtx, m: dict) -> None:
+        dst = m["dst"]
+        if dst is not None and dst != self.node_id and self.multinode:
+            ninfo = self._node_info(dst)
+            if ninfo is not None:
+                try:
+                    self._peer_conn_to(ninfo).call(
+                        {"type": "chan_close", "dst": dst,
+                         "key": m["key"]}, timeout=10.0)
+                except Exception:
+                    pass
+            ctx.reply(m, {"ok": True})
+            return
+        with self.lock:
+            rec = self._dag_queue_rec(m["key"])
+            rec["closed"] = True
+            rec["items"].clear()
+            recvs = [w for w in rec["recv_waiters"] if w["live"]]
+            for w in recvs:
+                w["live"] = False
+            sends = rec["send_waiters"]
+            rec["recv_waiters"] = []
+            rec["send_waiters"] = []
+            for w in recvs:
+                try:
+                    w["ctx"].reply(w["m"], {"ok": False, "closed": True})
+                except Exception:
+                    pass
+            for sctx, sm in sends:
+                try:
+                    sctx.reply(sm, {"ok": False, "closed": True})
+                except Exception:
+                    pass
+        ctx.reply(m, {"ok": True})
+
+    def _h_actor_node(self, ctx: _ConnCtx, m: dict) -> None:
+        """Which node hosts this actor (compiled-DAG channel routing)."""
+        aid = m["actor_id"]
+        with self.lock:
+            if aid in self.actors:
+                ctx.reply(m, {"node_id": self.node_id})
+                return
+            home = self._actor_homes.get(aid)
+        if home is None and self.multinode:
+            try:
+                home = self.gcs.get_actor_node(aid)
+            except Exception:
+                home = None
+        ctx.reply(m, {"node_id": home if home is not None
+                      else self.node_id})
+
     def _h_profile_event(self, ctx: _ConnCtx, m: dict) -> None:
         """Custom user span from ray_tpu.util.profiling.span()."""
         ev = dict(m["event"])
@@ -3151,6 +3348,39 @@ class NodeService:
             for dep in rec.spec.get("embedded") or []:
                 self._decref(dep)
 
+    def _recheck_infeasible(self) -> None:
+        """Tasks admitted as pending demand while an autoscaler lease
+        was fresh are re-checked when the lease expires: if the shape
+        is unsatisfiable by any alive node's totals and nobody will
+        ever provision it, fail it with the reason instead of leaving
+        it pending forever (advisor round-2 finding)."""
+        if self._autoscaler_live():
+            return
+        with self.lock:
+            stale = []
+            for rec in list(self.pending_queue):
+                spec = rec.spec
+                if spec.get("pg") is not None:
+                    continue
+                reason = self._infeasible_reason(spec.get("resources"))
+                if reason is not None:
+                    stale.append((rec, reason))
+            for rec, reason in stale:
+                if rec.is_actor_creation:
+                    actor = self.actors.get(rec.actor_id)
+                    if actor is not None:
+                        actor.state = "dead"
+                        actor.death_reason = f"infeasible: {reason}"
+                        self._release_actor_holds(actor)
+                        # Method calls queued while the actor was
+                        # pending demand must fail too, or their
+                        # callers hang forever (the same queue-failing
+                        # the creation-failed path does).
+                        self._fail_actor_queue(actor)
+                self._fail_task_returns(rec, exc.InfeasibleResourceError(
+                    f"task {rec.spec.get('name')!r} is infeasible and "
+                    f"no autoscaler is alive to provision it: {reason}"))
+
     # ------------------------------------------------------------------
     # monitor: deadlines, dead procs, idle reaping
     # ------------------------------------------------------------------
@@ -3162,6 +3392,11 @@ class NodeService:
             if ticks % 20 == 0:       # ~1s: spill-threshold watchdog
                 try:
                     self._maybe_proactive_spill()
+                except Exception:
+                    pass
+            if ticks % 40 == 0:       # ~2s: infeasible-demand recheck
+                try:
+                    self._recheck_infeasible()
                 except Exception:
                     pass
             now = time.time()

@@ -157,7 +157,7 @@ class WorkerRuntime:
             def loop(spec: dict) -> int:
                 from ray_tpu.experimental.dag_executor import run_dag_loop
                 (ops,), _ = self.client.unpack_args(spec["args"])
-                return run_dag_loop(instance, ops)
+                return run_dag_loop(instance, ops, self.client)
 
             self._execute_and_report(spec, loop, spec)
             return

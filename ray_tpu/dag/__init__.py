@@ -1,14 +1,16 @@
-"""Compiled graphs (aDAG): pre-wired actor pipelines over shm channels.
+"""Compiled graphs (aDAG): pre-wired actor pipelines over channels.
 
 Reference surface: python/ray/dag/ — InputNode/MultiOutputNode
 (input_node.py, output_node.py), `.bind` on actor methods
 (class_node.py), `experimental_compile` → CompiledDAG
 (compiled_dag_node.py:549) executing via shared-memory channels instead
-of per-call task RPCs.
+of per-call task RPCs, and CollectiveOutputNode
+(dag/collective_node.py:134) for in-DAG allreduce.
 
 Why it matters on TPU: a decode step or pipeline stage dispatched
 through the normal task path pays ms-scale scheduling; a compiled DAG
-pays one shm ring-buffer hop (µs).  Usage:
+pays one shm ring-buffer hop (µs) locally, or one bounded node-queue
+hop across hosts.  Usage:
 
     with InputNode() as inp:
         x = preproc.step.bind(inp)
@@ -19,10 +21,13 @@ pays one shm ring-buffer hop (µs).  Usage:
 
 Compilation groups nodes by actor (one long-lived loop task per actor,
 ops in topological order; same-actor edges stay in-process), allocates
-one SPSC channel per cross-process edge, and returns a CompiledDAG whose
-`execute` writes the driver→graph channels and returns a ref that reads
-the graph→driver channels.  Pipelined: up to `capacity` executes may be
-in flight before the first `get`."""
+one transport per cross-process edge — an mmap SPSC ring when both
+endpoints live on the submitting node, a node-service rchan queue when
+they don't (the cross-host path; reference:
+experimental/channel/shared_memory_channel.py vs the NCCL channels) —
+and returns a CompiledDAG whose `execute` writes the driver→graph
+edges and returns a ref that reads the graph→driver edges.  Pipelined:
+up to `capacity` executes may be in flight before the first `get`."""
 
 from __future__ import annotations
 
@@ -35,7 +40,8 @@ import ray_tpu
 from ray_tpu.experimental.channel import Channel
 
 __all__ = ["InputNode", "MultiOutputNode", "CompiledDAG",
-           "CompiledDAGRef", "DAGNode"]
+           "CompiledDAGRef", "DAGNode", "CollectiveOutputNode",
+           "allreduce_bind"]
 
 
 class DAGNode:
@@ -68,6 +74,49 @@ class ClassMethodNode(DAGNode):
                 f".bind(...)")
 
 
+class _CollectiveGroup:
+    def __init__(self, nodes: List[ClassMethodNode], op: str) -> None:
+        self.nodes = list(nodes)
+        self.op = op
+
+
+class CollectiveOutputNode(DAGNode):
+    """Per-rank output of an in-DAG collective
+    (dag/collective_node.py:134).  Belongs to the same actor as its
+    source node; downstream ops on that actor consume the reduced
+    value."""
+
+    def __init__(self, src: ClassMethodNode,
+                 group: _CollectiveGroup) -> None:
+        self.src = src
+        self.group = group
+
+    @property
+    def handle(self):
+        return self.src.handle
+
+
+def allreduce_bind(nodes: List[DAGNode],
+                   op: str = "sum") -> List[CollectiveOutputNode]:
+    """Bind an allreduce across one node per participating actor
+    (reference: ray.dag.collective_node — `collective.allreduce.bind`).
+    Returns one CollectiveOutputNode per input, in rank order."""
+    if not nodes:
+        raise ValueError("allreduce_bind needs at least one node")
+    for n in nodes:
+        if not isinstance(n, ClassMethodNode):
+            raise TypeError("allreduce_bind takes actor-method nodes, "
+                            f"got {n!r}")
+    from ray_tpu.util.collective import _REDUCERS
+    if op not in _REDUCERS:
+        raise ValueError(f"unknown reduce op {op!r} "
+                         f"(have {sorted(_REDUCERS)})")
+    group = _CollectiveGroup(nodes, op)
+    members = [CollectiveOutputNode(n, group) for n in nodes]
+    group._members = members
+    return members
+
+
 class MultiOutputNode(DAGNode):
     """Terminal fan-in: execute() refs resolve to a list
     (output_node.py)."""
@@ -76,12 +125,27 @@ class MultiOutputNode(DAGNode):
         self.outputs = list(outputs)
 
 
-def _topo(root: DAGNode) -> List[ClassMethodNode]:
-    order: List[ClassMethodNode] = []
+def _topo(root: DAGNode) -> List[DAGNode]:
+    order: List[DAGNode] = []
     seen: set = set()
 
     def visit(n) -> None:
-        if id(n) in seen or not isinstance(n, ClassMethodNode):
+        if id(n) in seen:
+            return
+        if isinstance(n, CollectiveOutputNode):
+            # The whole collective group enters the schedule together:
+            # every rank's source is scheduled before any rank's
+            # collective op, and every member op is scheduled even when
+            # only some members are consumed downstream — otherwise the
+            # scheduled ranks would block forever waiting for peers.
+            members = getattr(n.group, "_members", [n])
+            for peer in members:
+                seen.add(id(peer))
+            for peer_src in n.group.nodes:
+                visit(peer_src)
+            order.extend(members)
+            return
+        if not isinstance(n, ClassMethodNode):
             return
         seen.add(id(n))
         for a in list(n.args) + list(n.kwargs.values()):
@@ -109,88 +173,161 @@ class CompiledDAG:
     def __init__(self, root: DAGNode, capacity: int,
                  slot_size: int) -> None:
         nodes = _topo(root)
-        if not nodes:
+        if not any(isinstance(n, ClassMethodNode) for n in nodes):
             raise ValueError("compiled DAG needs at least one "
                              "actor-method node")
         self._root = root
-        self._chan_dir = os.path.join(
-            ray_tpu._ensure_connected().session_dir, "channels")
+        client = ray_tpu._ensure_connected()
+        self._client = client
+        self._chan_dir = os.path.join(client.session_dir, "channels")
         os.makedirs(self._chan_dir, exist_ok=True)
         self._dag_id = os.urandom(4).hex()
+        self._capacity = capacity
         self._edge_n = 0
         self._channels: List[Channel] = []
-        self._input_chans: List[Channel] = []
+        # driver-side input edges: ("mmap", Channel) | ("rchan", key, dst)
+        self._in_edges: List[tuple] = []
+        # (key, resident_node) of every rchan queue, for teardown
+        self._rchans: List[Tuple[bytes, bytes]] = []
         self._torn_down = False
+
+        ninfo = client.node_info()
+        drv_node: bytes = ninfo["node_id"]
+        self._drv_node = drv_node
+        node_of_actor: Dict[bytes, bytes] = {}
+
+        def actor_node(aid: bytes) -> bytes:
+            nid = node_of_actor.get(aid)
+            if nid is None:
+                nid = client.actor_node(aid)
+                node_of_actor[aid] = nid
+            return nid
 
         # node -> where its output lives, per consumer kind
         out_slots: Dict[int, List[tuple]] = {id(n): [] for n in nodes}
-        in_slot_of: Dict[int, tuple] = {}
 
-        def new_chan() -> Tuple[str, Channel]:
+        def new_mmap() -> Tuple[str, Channel]:
             self._edge_n += 1
             path = os.path.join(
-                self._chan_dir,
-                f"dag-{self._dag_id}-e{self._edge_n}")
+                self._chan_dir, f"dag-{self._dag_id}-e{self._edge_n}")
             ch = Channel(path, capacity=capacity, slot_size=slot_size,
                          create=True)
             self._channels.append(ch)
             return path, ch
 
+        def new_rchan(resident: bytes) -> bytes:
+            self._edge_n += 1
+            key = f"dag-{self._dag_id}-e{self._edge_n}".encode()
+            self._rchans.append((key, resident))
+            return key
+
         actor_of = {id(n): n.handle._actor_id for n in nodes}
         local_n = 0
 
-        def slot_for_arg(consumer: ClassMethodNode, arg) -> tuple:
+        def local_slot(producer) -> tuple:
             nonlocal local_n
+            for kind, *rest in out_slots[id(producer)]:
+                if kind == "local":
+                    return ("local", rest[0])
+            local_n += 1
+            key = f"v{local_n}"
+            out_slots[id(producer)].append(("local", key))
+            return ("local", key)
+
+        def slot_for_arg(consumer, arg) -> tuple:
+            cons_node = actor_node(actor_of[id(consumer)])
             if isinstance(arg, InputNode):
-                path, ch = new_chan()
-                self._input_chans.append(ch)
-                return ("chan", path)
-            if isinstance(arg, ClassMethodNode):
+                if cons_node == drv_node:
+                    path, ch = new_mmap()
+                    self._in_edges.append(("mmap", ch))
+                    return ("chan", path)
+                key = new_rchan(cons_node)
+                self._in_edges.append(("rchan", key, cons_node))
+                return ("rchan_in", key)
+            if isinstance(arg, (ClassMethodNode, CollectiveOutputNode)):
                 if actor_of[id(arg)] == actor_of[id(consumer)]:
-                    # same actor: pass through the loop-local dict
-                    for kind, v in out_slots[id(arg)]:
-                        if kind == "local":
-                            return ("local", v)
-                    local_n += 1
-                    key = f"v{local_n}"
-                    out_slots[id(arg)].append(("local", key))
-                    return ("local", key)
-                path, _ = new_chan()
-                out_slots[id(arg)].append(("chan", path))
-                return ("chan", path)
+                    return local_slot(arg)
+                prod_node = actor_node(actor_of[id(arg)])
+                if prod_node == drv_node and cons_node == drv_node:
+                    path, _ = new_mmap()
+                    out_slots[id(arg)].append(("chan", path))
+                    return ("chan", path)
+                key = new_rchan(cons_node)
+                out_slots[id(arg)].append(
+                    ("rchan_out", key, cons_node.hex()))
+                return ("rchan_in", key)
             if isinstance(arg, MultiOutputNode):
                 raise TypeError("MultiOutputNode can only be the root")
             return ("const", arg)
 
+        # assign collective channel keys per group
+        coll_keys: Dict[int, bytes] = {}
+        coll_n = 0
+
+        def coll_spec(n: CollectiveOutputNode) -> dict:
+            nonlocal coll_n
+            g = n.group
+            key = coll_keys.get(id(g))
+            ranks = [actor_node(m.handle._actor_id).hex()
+                     for m in g.nodes]
+            if key is None:
+                coll_n += 1
+                key = f"dag-{self._dag_id}-c{coll_n}".encode()
+                coll_keys[id(g)] = key
+                root_node = bytes.fromhex(ranks[0])
+                world = len(g.nodes)
+                # root's per-rank in-queues + each rank's out-queue
+                for r in range(1, world):
+                    self._rchans.append((key + b"/in/%d" % r,
+                                         root_node))
+                    self._rchans.append(
+                        (key + b"/out/%d" % r,
+                         bytes.fromhex(ranks[r])))
+            rank = next(i for i, m in enumerate(g.nodes)
+                        if m is n.src)
+            return {"op": g.op, "key": key, "rank": rank,
+                    "world": len(g.nodes), "nodes": ranks}
+
         ops_by_actor: Dict[bytes, List[dict]] = {}
         handles: Dict[bytes, Any] = {}
         for n in nodes:
-            ins = [slot_for_arg(n, a) for a in n.args]
-            kw = {k: slot_for_arg(n, v) for k, v in n.kwargs.items()}
             aid = n.handle._actor_id
             handles[aid] = n.handle
+            if isinstance(n, CollectiveOutputNode):
+                ops_by_actor.setdefault(aid, []).append(
+                    {"collective": coll_spec(n),
+                     "ins": [slot_for_arg(n, n.src)],
+                     "kwargs": {},
+                     "outs": out_slots[id(n)]})
+                continue
+            ins = [slot_for_arg(n, a) for a in n.args]
+            kw = {k: slot_for_arg(n, v) for k, v in n.kwargs.items()}
             ops_by_actor.setdefault(aid, []).append(
                 {"method": n.method_name, "ins": ins, "kwargs": kw,
-                 "outs": out_slots[id(n)], "_node": id(n)})
+                 "outs": out_slots[id(n)]})
 
-        # terminal outputs -> driver channels
+        # terminal outputs -> driver edges
         terminals = (root.outputs if isinstance(root, MultiOutputNode)
                      else [root])
-        self._out_chans: List[Channel] = []
+        self._out_edges: List[tuple] = []
         for t in terminals:
-            if not isinstance(t, ClassMethodNode):
+            if not isinstance(t, (ClassMethodNode, CollectiveOutputNode)):
                 raise TypeError(f"DAG output must be an actor-method "
                                 f"node, got {t!r}")
-            path, ch = new_chan()
-            out_slots[id(t)].append(("chan", path))
-            self._out_chans.append(ch)
+            t_node = actor_node(actor_of[id(t)])
+            if t_node == drv_node:
+                path, ch = new_mmap()
+                out_slots[id(t)].append(("chan", path))
+                self._out_edges.append(("mmap", ch))
+            else:
+                key = new_rchan(drv_node)
+                out_slots[id(t)].append(
+                    ("rchan_out", key, drv_node.hex()))
+                self._out_edges.append(("rchan", key))
 
         # launch one loop per actor (ops in topo order)
-        client = ray_tpu._ensure_connected()
         self._loop_refs = []
         for aid, ops in ops_by_actor.items():
-            for op in ops:
-                op.pop("_node", None)
             h = handles[aid]
             refs = client.submit_actor_task(
                 aid, h._class_id, "__rtpu_dag_loop__", (ops,), {}, 1)
@@ -199,6 +336,7 @@ class CompiledDAG:
         self._exec_seq = 0
         self._read_seq = 0
         self._buffer: Dict[int, Any] = {}
+        self._partial: List[Any] = []
         self._lock = threading.Lock()
 
     # -- execution -----------------------------------------------------
@@ -206,17 +344,58 @@ class CompiledDAG:
         if self._torn_down:
             raise RuntimeError("DAG was torn down")
         value = args[0] if len(args) == 1 else tuple(args)
-        for ch in self._input_chans:
-            ch.write(value)
+        for edge in self._in_edges:
+            if edge[0] == "mmap":
+                edge[1].write(value)
+            else:
+                self._client.chan_send(edge[2], edge[1], value,
+                                       cap=self._capacity)
         with self._lock:
             seq = self._exec_seq
             self._exec_seq += 1
         return CompiledDAGRef(self, seq)
 
+    def _check_loops(self) -> None:
+        """Surface a dead loop task (e.g. a user-method exception) as
+        an error on the caller instead of an indefinite hang."""
+        done, _ = ray_tpu.wait(self._loop_refs,
+                               num_returns=len(self._loop_refs),
+                               timeout=0)
+        if done and not self._torn_down:
+            ray_tpu.get(done)   # raises the loop's error if it failed
+            raise RuntimeError(
+                "compiled DAG loop task(s) exited mid-run")
+
+    def _read_edge(self, edge: tuple,
+                   deadline: Optional[float]) -> Any:
+        while True:
+            step = 0.2
+            if deadline is not None:
+                step = min(step, max(0.001, deadline - time.monotonic()))
+            try:
+                if edge[0] == "mmap":
+                    return edge[1].read(timeout=step)
+                return self._client.chan_recv(edge[1], timeout=step)
+            except TimeoutError:
+                self._check_loops()
+                if (deadline is not None
+                        and time.monotonic() > deadline):
+                    raise
+
     def _read_result(self, seq: int, timeout: Optional[float]):
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         with self._lock:
             while self._read_seq <= seq:
-                out = [ch.read(timeout) for ch in self._out_chans]
+                # Edge reads CONSUME; keep partial progress in
+                # self._partial so a get() that times out mid-row can
+                # be retried without pairing edge 0's next row with
+                # edge 1's current one.
+                out = self._partial
+                while len(out) < len(self._out_edges):
+                    out.append(self._read_edge(
+                        self._out_edges[len(out)], deadline))
+                self._partial = []
                 self._buffer[self._read_seq] = (
                     out if isinstance(self._root, MultiOutputNode)
                     else out[0])
@@ -230,6 +409,11 @@ class CompiledDAG:
         self._torn_down = True
         for ch in self._channels:
             ch.close(unlink=True)
+        for key, resident in self._rchans:
+            try:
+                self._client.chan_close(resident, key)
+            except Exception:
+                pass
         # loops exit via ChannelClosed; their return is the tick count
         try:
             ray_tpu.get(self._loop_refs, timeout=10)

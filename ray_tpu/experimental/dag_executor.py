@@ -7,21 +7,63 @@ channels, until a channel is torn down.
 
 `ops` wire format (built by ray_tpu.dag compile):
     [{"method": name,
-      "ins":  [("chan", path) | ("local", key) | ("const", value)...],
-      "kwargs": {k: ("const", value) | ("chan", path) | ("local", key)},
-      "outs": [("chan", path) | ("local", key)...]}, ...]
+      "ins":  [("chan", path) | ("rchan_in", key) | ("local", key)
+               | ("const", value)...],
+      "kwargs": {k: slot},
+      "outs": [("chan", path) | ("rchan_out", key, dst_hex)
+               | ("local", key)...]},
+     {"collective": {"op": "sum", "key": bytes, "rank": r, "world": n,
+                     "nodes": [node_hex per rank]},
+      "ins": [slot], "outs": [...]}, ...]
 
-Same-actor edges ride `local` (an in-process dict — zero IPC); only
-cross-process edges pay a channel hop."""
+Same-actor edges ride `local` (an in-process dict — zero IPC);
+same-node cross-process edges ride mmap `chan` rings (µs); cross-node
+edges ride `rchan` — bounded queues on the consumer's node service,
+fed over the persistent peer connections (the reference's
+shared-memory/NCCL channel split, shared_memory_channel.py vs
+torch_tensor_nccl_channel.py).
+
+Collective ops (reference: dag/collective_node.py:134
+CollectiveOutputNode) run a rank-0-rooted reduce over the rchan plane:
+per-rank root in-queues keep ticks separated even when the DAG is
+pipelined (each sender's per-queue order is FIFO)."""
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
+
+import numpy as np
 
 from ray_tpu.experimental.channel import Channel, ChannelClosed
+from ray_tpu.util.collective import _REDUCERS
 
 
-def run_dag_loop(instance: Any, ops: List[dict]) -> int:
+def _run_collective(spec: dict, val: Any, client) -> Any:
+    base: bytes = spec["key"]
+    rank, world = spec["rank"], spec["world"]
+    arr = np.asarray(val)
+    if world == 1:
+        return _REDUCERS[spec["op"]](np.stack([arr]))
+    if rank == 0:
+        parts = [arr]
+        for r in range(1, world):
+            parts.append(np.asarray(
+                client.chan_recv(base + b"/in/%d" % r)))
+        out = _REDUCERS[spec["op"]](np.stack(parts))
+        for r in range(1, world):
+            client.chan_send(bytes.fromhex(spec["nodes"][r]),
+                             base + b"/out/%d" % r, out)
+        return out
+    client.chan_send(bytes.fromhex(spec["nodes"][0]),
+                     base + b"/in/%d" % rank, arr)
+    return np.asarray(client.chan_recv(base + b"/out/%d" % rank))
+
+
+def run_dag_loop(instance: Any, ops: List[dict],
+                 client: Optional[Any] = None) -> int:
+    if client is None:
+        from ray_tpu._private.client import get_global_client
+        client = get_global_client()
     chans: Dict[str, Channel] = {}
 
     def chan(path: str) -> Channel:
@@ -32,12 +74,23 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> int:
         return c
 
     def resolve(slot, local):
-        kind, v = slot
+        kind, *rest = slot
         if kind == "chan":
-            return chan(v).read()
+            return chan(rest[0]).read()
+        if kind == "rchan_in":
+            return client.chan_recv(rest[0])
         if kind == "local":
-            return local[v]
-        return v
+            return local[rest[0]]
+        return rest[0]
+
+    def emit(slot, out, local) -> None:
+        kind, *rest = slot
+        if kind == "chan":
+            chan(rest[0]).write(out)
+        elif kind == "rchan_out":
+            client.chan_send(bytes.fromhex(rest[1]), rest[0], out)
+        else:
+            local[rest[0]] = out
 
     ticks = 0
     try:
@@ -47,12 +100,13 @@ def run_dag_loop(instance: Any, ops: List[dict]) -> int:
                 args = [resolve(s, local) for s in op["ins"]]
                 kwargs = {k: resolve(s, local)
                           for k, s in (op.get("kwargs") or {}).items()}
-                out = getattr(instance, op["method"])(*args, **kwargs)
-                for kind, v in op["outs"]:
-                    if kind == "chan":
-                        chan(v).write(out)
-                    else:
-                        local[v] = out
+                if "collective" in op:
+                    out = _run_collective(op["collective"], args[0],
+                                          client)
+                else:
+                    out = getattr(instance, op["method"])(*args, **kwargs)
+                for slot in op["outs"]:
+                    emit(slot, out, local)
             ticks += 1
     except ChannelClosed:
         return ticks

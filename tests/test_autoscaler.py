@@ -89,3 +89,23 @@ def test_min_workers_floor(cluster):
     finally:
         scaler.stop()
         provider.shutdown()
+
+
+def test_pending_infeasible_fails_when_autoscaler_dies(cluster):
+    """A task admitted as pending demand under a fresh autoscaler lease
+    must be re-failed (not stay pending forever) once the lease goes
+    away (advisor round-2 finding; reference: infeasible-task errors,
+    raylet node_manager)."""
+    client = ray_tpu._ensure_connected()
+    # Fake a live autoscaler lease and let the heartbeat mirror it.
+    client.kv_put("cluster", b"autoscaler", str(time.time()).encode())
+    time.sleep(1.5)
+    ref = needs_gpu_ish.options(
+        resources={"no_such_resource": 1}).remote()
+    # Pending as demand, not failed:
+    done, _ = ray_tpu.wait([ref], timeout=2)
+    assert not done
+    # Autoscaler dies (lease deleted): the monitor recheck fails it.
+    client.kv_del("cluster", b"autoscaler")
+    with pytest.raises(ray_tpu.exceptions.InfeasibleResourceError):
+        ray_tpu.get(ref, timeout=30)
