@@ -378,7 +378,17 @@ def forward_hidden_aux(params: Dict[str, Any], tokens: jax.Array,
     """tokens: [B, S] int32 -> (final-norm hidden [B, S, D],
     summed MoE aux loss — zero for dense models)."""
     B, S = tokens.shape
-    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    # Shard the indices BEFORE the lookup: a replicated-index gather from
+    # the (vocab/embed)-sharded table comes out embed-sharded, and moving
+    # that to the (batch, seq)-sharded activation layout forces XLA into
+    # involuntary full rematerialization (spmd_partitioner.cc:652).  With
+    # (batch, seq)-sharded indices the gather lands directly in
+    # activation layout and the table's shards are all-gathered once —
+    # the same all-gather ZeRO-3 pays anyway when a weight is used.
+    tokens = constrain(tokens, ("batch", "seq"), mesh=mesh)
+    emb = constrain(params["tok_embed"], (None, None), mesh=mesh)
+    x = emb[tokens].astype(cfg.dtype)
+    x = constrain(x, ("batch", "seq", "embed"), mesh=mesh)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     if cfg.arch == "gpt2":
         x = x + params["pos_embed"][:S][None].astype(cfg.dtype)
