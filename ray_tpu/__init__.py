@@ -164,8 +164,18 @@ def is_initialized() -> bool:
 
 
 def _ensure_connected():
+    import threading
     with _session_lock:
         if _session is None:
+            # Auto-init only from the main thread (ray.get's implicit
+            # ray.init semantic).  A background thread that outlived
+            # shutdown() — a serve long-poll loop, a done-callback
+            # waiter — must fail its call, not silently resurrect a
+            # fresh session and break the next init() with
+            # "called twice".
+            if threading.current_thread() is not threading.main_thread():
+                raise RuntimeError(
+                    "ray_tpu is not initialized in this process")
             init()
         return _session.client
 

@@ -227,21 +227,13 @@ def prefill(params: Dict[str, Any], tokens: jax.Array, length: jax.Array,
     return k_all, v_all, logits
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",),
-                   donate_argnums=(1,))
-def prefill_insert(params: Dict[str, Any], caches: DecodeCaches,
-                   tokens: jax.Array, lengths: jax.Array,
-                   slots: jax.Array, valid: jax.Array,
-                   cfg: TransformerConfig
-                   ) -> Tuple[DecodeCaches, jax.Array]:
-    """Batched prefill of up to N prompts + cache insertion in ONE
-    dispatch.  tokens: [N, P] int32 (padded), lengths/slots/valid: [N].
-    Invalid rows rewrite their target slot with its existing contents
-    (gather-then-scatter no-op).  Returns (caches', first_tokens [N]).
-
-    Serving admission is the other latency cliff besides decode reads:
-    one serial prefill+sync per request costs ~70ms each through a
-    tunnel; batching them makes 16 admissions cost the same as one."""
+def _prefill_insert_core(params: Dict[str, Any], caches: DecodeCaches,
+                         tokens: jax.Array, lengths: jax.Array,
+                         slots: jax.Array, valid: jax.Array,
+                         cfg: TransformerConfig
+                         ) -> Tuple[DecodeCaches, jax.Array]:
+    """Traceable body shared by prefill_insert and the fused
+    admission+decode step."""
     N, P = tokens.shape
     x = params["tok_embed"][tokens].astype(cfg.dtype)        # [N,P,D]
     positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (N, P))
@@ -292,6 +284,60 @@ def prefill_insert(params: Dict[str, Any], caches: DecodeCaches,
         jnp.where(valid, first_tok, caches.last_token[slots]))
     return DecodeCaches(k=ck, v=cv, lengths=new_len,
                         last_token=new_last), first_tok
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnums=(1,))
+def prefill_insert(params: Dict[str, Any], caches: DecodeCaches,
+                   tokens: jax.Array, lengths: jax.Array,
+                   slots: jax.Array, valid: jax.Array,
+                   cfg: TransformerConfig
+                   ) -> Tuple[DecodeCaches, jax.Array]:
+    """Batched prefill of up to N prompts + cache insertion in ONE
+    dispatch.  tokens: [N, P] int32 (padded), lengths/slots/valid: [N].
+    Invalid rows rewrite their target slot with its existing contents
+    (gather-then-scatter no-op).  Returns (caches', first_tokens [N]).
+
+    Serving admission is the other latency cliff besides decode reads:
+    one serial prefill+sync per request costs ~70ms each through a
+    tunnel; batching them makes 16 admissions cost the same as one."""
+    return _prefill_insert_core(params, caches, tokens, lengths, slots,
+                                valid, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_steps",
+                                             "prompt_pad"),
+                   donate_argnums=(1,))
+def prefill_decode_packed(params: Dict[str, Any], caches: DecodeCaches,
+                          packed: jax.Array, cfg: TransformerConfig,
+                          num_steps: int, prompt_pad: int
+                          ) -> Tuple[DecodeCaches, jax.Array,
+                                     jax.Array]:
+    """prefill_decode_fused with ALL host-side inputs in ONE int32
+    array — through a tunneled chip every separate host->device
+    transfer pays link latency, so the engine packs
+    tokens/lengths/slots/valid/active into a single upload.
+
+    packed: [N+1, W] int32 with W = max(prompt_pad + 3, num_slots);
+      rows 0..N-1: [tokens[0:P] | length | slot | valid]
+      row  N:      active mask for the B decode slots in cols 0..B-1.
+    """
+    P = prompt_pad
+    B = caches.lengths.shape[0]
+    tokens = packed[:-1, :P]
+    lengths = packed[:-1, P]
+    slots = packed[:-1, P + 1]
+    valid = packed[:-1, P + 2] > 0
+    active = packed[-1, :B] > 0
+    caches, first = _prefill_insert_core(params, caches, tokens,
+                                         lengths, slots, valid, cfg)
+    active = active.at[slots].set(jnp.where(valid, True, active[slots]))
+
+    def body(c, _):
+        return _decode_core(params, c, active, cfg)
+
+    caches, toks = jax.lax.scan(body, caches, None, length=num_steps)
+    return caches, first, toks
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

@@ -51,8 +51,11 @@ def _get_or_create_controller():
         pass
     cls = ray_tpu.remote(ServeController)
     try:
+        # max_concurrency: long-poll wait_for_update calls park inside
+        # the controller (reference: LongPoll host inside the
+        # controller); they must not serialize control calls.
         return cls.options(name=CONTROLLER_NAME, lifetime="detached",
-                           max_restarts=2).remote()
+                           max_restarts=2, max_concurrency=32).remote()
     except ValueError:
         # Lost the name race with a concurrent caller.
         return ray_tpu.get_actor(CONTROLLER_NAME)
@@ -141,13 +144,25 @@ class DeploymentHandle:
 
 
 class _HandleMethod:
-    def __init__(self, handle: DeploymentHandle, method: str) -> None:
+    def __init__(self, handle: DeploymentHandle, method: str,
+                 stream: bool = False) -> None:
         self._handle = handle
         self._method = method
+        self._stream = stream
+
+    def options(self, *, stream: bool = False) -> "_HandleMethod":
+        """`handle.method.options(stream=True).remote(...)` returns an
+        ObjectRefGenerator of per-item refs (reference:
+        serve/handle.py DeploymentResponseGenerator)."""
+        return _HandleMethod(self._handle, self._method, stream=stream)
 
     def remote(self, *args, **kwargs):
-        import ray_tpu
         router = self._handle._get_router()
+        if self._stream:
+            gen, replica = router.assign_stream(self._method, args,
+                                                kwargs)
+            _attach_done_callback(router, gen.completed(), replica)
+            return gen
         ref, replica = router.assign(self._method, args, kwargs)
         _attach_done_callback(router, ref, replica)
         return ref

@@ -40,6 +40,11 @@ class ServeController:
         # Guards deployment state: the autoscale daemon thread mutates
         # it concurrently with actor-method execution.
         self._state_lock = threading.RLock()
+        # Long-poll push (reference: serve/_private/long_poll.py:64):
+        # routers park wait_for_update calls on this condition; every
+        # version bump notifies them.  Requires the controller actor to
+        # run with max_concurrency > 1 (serve.__init__ sets it).
+        self._update_cond = threading.Condition(self._state_lock)
 
     # -- control ----------------------------------------------------------
     def deploy(self, name: str, cls_blob: bytes, init_args: tuple,
@@ -96,6 +101,7 @@ class ServeController:
         d["version"] += 1
         self._version += 1
         self._reconcile(name)
+        self._notify_update()
         return d["version"]
 
     def delete(self, name: str) -> bool:
@@ -108,6 +114,7 @@ class ServeController:
             return False
         self._stop_replicas(d["replicas"])
         self._version += 1
+        self._notify_update()
         return True
 
     def shutdown_all(self) -> None:
@@ -126,6 +133,30 @@ class ServeController:
 
     def version(self) -> int:
         return self._version
+
+    def wait_for_update(self, name: str, known_version: int,
+                        timeout: float = 60.0) -> Optional[dict]:
+        """Long-poll (reference: long_poll.py:177 listen_for_change):
+        parks until deployment `name`'s version advances past
+        `known_version`, then returns the fresh replica listing; None on
+        timeout (the client re-arms).  Deleted deployments answer with
+        version -1 immediately."""
+        import time
+        deadline = time.time() + timeout
+        with self._update_cond:
+            while True:
+                d = self._deployments.get(name)
+                cur = d["version"] if d is not None else -1
+                if cur != known_version:
+                    return self.get_replicas(name)
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None
+                self._update_cond.wait(remaining)
+
+    def _notify_update(self) -> None:
+        """Caller holds _state_lock."""
+        self._update_cond.notify_all()
 
     def status(self) -> Dict[str, dict]:
         import ray_tpu
@@ -161,6 +192,7 @@ class ServeController:
             d["version"] += 1
             self._version += 1
         self._reconcile(name)
+        self._notify_update()
 
     # -- reconciliation ----------------------------------------------------
     def _reconcile(self, name: str) -> None:
@@ -184,12 +216,14 @@ class ServeController:
                 d["replicas"].append(h)
             d["version"] += 1
             self._version += 1
+            self._notify_update()
         elif have > want:
             extra = d["replicas"][want:]
             d["replicas"] = d["replicas"][:want]
             self._stop_replicas(extra)
             d["version"] += 1
             self._version += 1
+            self._notify_update()
 
     # -- replica autoscaling ----------------------------------------------
     # Reference: replicas report ongoing-request metrics, the controller

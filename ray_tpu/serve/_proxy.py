@@ -11,9 +11,16 @@ the image): JSON-over-HTTP data plane with the SAME routing semantics —
     GET  /-/routes                -> route table (reference: /-/routes)
     GET  /-/healthz               -> 200 ok
 
-The response body is the JSON-encoded return value.  Unknown
-deployments 404 by asking the controller (routes follow deploys with
-no proxy restart, the LongPoll role)."""
+Streaming (reference: HTTPProxy streaming replica calls + SSE,
+proxy.py:779): `?stream=1` — or an `Accept: text/event-stream` header
+— routes through a streaming-generator replica call and the response
+is chunked Server-Sent Events, one `data:` event per yielded item,
+then `event: end`.  Token streaming from serve.llm rides this
+end-to-end: engine → streaming generator → router → SSE.
+
+The non-streaming response body is the JSON-encoded return value.
+Unknown deployments 404 by asking the controller (routes follow
+deploys with no proxy restart, the LongPoll role)."""
 
 from __future__ import annotations
 
@@ -30,6 +37,9 @@ def _handles():
 
 
 class _ProxyHandler(BaseHTTPRequestHandler):
+    # HTTP/1.1 so chunked transfer-encoding (SSE streaming) is legal.
+    protocol_version = "HTTP/1.1"
+
     def log_message(self, *a):
         pass
 
@@ -41,6 +51,31 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_sse(self, gen) -> None:
+        """Drain a streaming-generator handle as chunked SSE."""
+        import ray_tpu
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(data: bytes) -> None:
+            self.wfile.write(b"%X\r\n%s\r\n" % (len(data), data))
+            self.wfile.flush()
+
+        try:
+            for ref in gen:
+                item = ray_tpu.get(ref, timeout=120)
+                chunk(b"data: %s\n\n"
+                      % json.dumps(item, default=str).encode())
+            chunk(b"event: end\ndata: null\n\n")
+        except Exception as e:
+            chunk(b"event: error\ndata: %s\n\n"
+                  % json.dumps(repr(e)).encode())
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
 
     def _route(self, arg: Any) -> None:
         import ray_tpu
@@ -66,6 +101,10 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             self._send(404, {"error": "no deployment in path"})
             return
         name, method = parts[0], (parts[1] if len(parts) > 1 else None)
+        query = dict(parse_qsl(parsed.query))
+        stream = (query.pop("stream", "") in ("1", "true")
+                  or "text/event-stream"
+                  in (self.headers.get("Accept") or ""))
         # No per-request existence pre-check (that would add a full
         # controller status() round-trip to the hot path): route
         # directly; only the TYPED routing failures map to 404 — a user
@@ -74,10 +113,12 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         from ray_tpu.serve._router import NoReplicasError
         handle = serve.get_deployment_handle(name)
         try:
-            if method:
-                ref = getattr(handle, method).remote(arg)
+            m = (getattr(handle, method) if method
+                 else handle.method("__call__"))
+            if stream:
+                gen = m.options(stream=True).remote(arg)
             else:
-                ref = handle.remote(arg)
+                ref = m.remote(arg)
         except NoReplicasError as e:
             self._send(404, {"error": repr(e)})
             return
@@ -88,6 +129,9 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         except Exception as e:
             self._send(500, {"error": repr(e)})
             return
+        if stream:
+            self._send_sse(gen)
+            return
         try:
             self._send(200, {"result": ray_tpu.get(ref, timeout=120)})
         except Exception as e:
@@ -96,6 +140,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
     # -- verbs ---------------------------------------------------------
     def do_GET(self) -> None:
         q = dict(parse_qsl(urlparse(self.path).query))
+        q.pop("stream", None)      # routing flag, not a user argument
         self._route(q or None)
 
     def do_POST(self) -> None:

@@ -37,6 +37,20 @@ class Replica:
             self._inflight -= 1
             self._served += 1
 
+    def handle_request_stream(self, method: str, args: tuple,
+                              kwargs: dict):
+        """Streaming request: the user method returns a generator whose
+        items are re-yielded through the core streaming-generator plane
+        (reference: replica.py streaming ASGI responses ride streaming
+        generator actor calls)."""
+        self._inflight += 1
+        try:
+            out = getattr(self._user, method)(*args, **(kwargs or {}))
+            yield from out
+        finally:
+            self._inflight -= 1
+            self._served += 1
+
     def queue_len(self) -> int:
         """Probed by the pow-2 router (reference: replica queue-length
         probing in pow_2_scheduler.py)."""

@@ -4,10 +4,15 @@ Analog of the reference's Router (serve/_private/router.py:311) +
 PowerOfTwoChoicesReplicaScheduler
 (_private/replica_scheduler/pow_2_scheduler.py:52): sample two
 replicas, send to the one with the smaller queue.  Queue depth is the
-caller-side outstanding count (cheap, no probe RPC on the hot path);
-the replica-side `queue_len` stays available for diagnostics, matching
-how the reference caches probed queue lengths rather than probing per
-request.
+caller-side outstanding count (cheap, no probe RPC on the hot path),
+periodically CORRECTED by replica-side queue_len probes so two routers
+sharing a deployment converge instead of each believing the replicas
+are idle (reference: cached queue-length probing).
+
+Config updates arrive by PUSH: a long-poll thread parks a
+`wait_for_update` call on the controller (reference:
+serve/_private/long_poll.py:64 LongPollClient) and refreshes the
+replica list the moment the version advances — no hot-path polling.
 """
 
 from __future__ import annotations
@@ -17,9 +22,11 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-# Seconds between controller polls: existing handles pick up scale-ups /
-# redeploys within this window (reference uses LongPoll pushes).
-_REFRESH_INTERVAL_S = 2.0
+# Fallback full-refresh period if the long-poll thread dies (e.g.
+# controller restart): keeps handles converging even without pushes.
+_FALLBACK_REFRESH_S = 30.0
+# Replica queue-length probe period (correct cross-router drift).
+_PROBE_INTERVAL_S = 1.0
 
 
 class NoReplicasError(RuntimeError):
@@ -33,8 +40,15 @@ class Router:
         self._replicas: List[Any] = []
         self._version = -1
         self._outstanding: Dict[bytes, int] = {}
+        # replica-side queue lengths from the last probe (baseline the
+        # caller-side delta is applied to).
+        self._probed: Dict[bytes, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        self._last_probe = 0.0
+        self._probe_thread = None
+        self._poll_thread: Optional[threading.Thread] = None
+        self._closed = False
 
     def _controller(self):
         import ray_tpu
@@ -46,23 +60,105 @@ class Router:
         now = time.time()
         with self._lock:
             fresh = (self._replicas
-                     and now - self._last_refresh < _REFRESH_INTERVAL_S)
+                     and now - self._last_refresh < _FALLBACK_REFRESH_S)
         if fresh and not force:
             return
         info = ray_tpu.get(
             self._controller().get_replicas.remote(self._name),
             timeout=30)
+        self._apply(info)
+        self._ensure_poll_thread()
+
+    def _apply(self, info: dict) -> None:
         with self._lock:
             self._replicas = info["replicas"]
             self._version = info["version"]
-            self._last_refresh = now
+            self._last_refresh = time.time()
             self._outstanding = {
                 r._actor_id: self._outstanding.get(r._actor_id, 0)
                 for r in self._replicas}
+            self._probed = {
+                r._actor_id: self._probed.get(r._actor_id, 0)
+                for r in self._replicas}
+
+    # -- long-poll push (reference: long_poll.py LongPollClient) --------
+    def _ensure_poll_thread(self) -> None:
+        if self._poll_thread is not None and self._poll_thread.is_alive():
+            return
+        t = threading.Thread(target=self._poll_loop, daemon=True,
+                             name=f"rtpu-serve-longpoll-{self._name}")
+        self._poll_thread = t
+        t.start()
+
+    def _poll_loop(self) -> None:
+        import ray_tpu
+        from ray_tpu._private.client import get_global_client
+        client0 = get_global_client()
+        while not self._closed:
+            if get_global_client() is not client0:
+                return          # session shut down / replaced
+            try:
+                with self._lock:
+                    known = self._version
+                info = ray_tpu.get(
+                    self._controller().wait_for_update.remote(
+                        self._name, known), timeout=70)
+                if info is not None:
+                    self._apply(info)
+            except Exception:
+                # Controller restart / timeout: back off, the fallback
+                # refresh in pick() keeps correctness.
+                if self._closed:
+                    return
+                time.sleep(1.0)
+
+    # -- replica queue-length folding (cross-router correctness) --------
+    def _maybe_probe(self) -> None:
+        now = time.time()
+        with self._lock:
+            if (now - self._last_probe < _PROBE_INTERVAL_S
+                    or (self._probe_thread is not None
+                        and self._probe_thread.is_alive())):
+                return        # previous probe still draining slow replicas
+            self._last_probe = now
+            reps = list(self._replicas)
+        if not reps:
+            return
+
+        def probe() -> None:
+            import ray_tpu
+            from ray_tpu._private.client import get_global_client
+            for r in reps:
+                if get_global_client() is None:
+                    return      # session shut down mid-probe
+                try:
+                    qlen = ray_tpu.get(r.queue_len.remote(), timeout=5)
+                except Exception:
+                    continue
+                with self._lock:
+                    if r._actor_id in self._probed:
+                        # The replica-side count includes THIS router's
+                        # own in-flight requests; subtract them so
+                        # probed only carries other callers' load and
+                        # _load doesn't double-count ours.
+                        ours = self._outstanding.get(r._actor_id, 0)
+                        self._probed[r._actor_id] = max(
+                            0, int(qlen) - ours)
+
+        t = threading.Thread(target=probe, daemon=True,
+                             name="rtpu-serve-probe")
+        with self._lock:
+            self._probe_thread = t
+        t.start()
+
+    def _load(self, replica) -> int:
+        k = replica._actor_id
+        return self._outstanding.get(k, 0) + self._probed.get(k, 0)
 
     def pick(self):
-        """Pow-2 choice over the caller-side outstanding counts."""
+        """Pow-2 choice over caller-side outstanding + probed counts."""
         self._refresh()
+        self._maybe_probe()
         with self._lock:
             reps = self._replicas
             if not reps:
@@ -72,8 +168,7 @@ class Router:
                 choice = reps[0]
             else:
                 a, b = random.sample(reps, 2)
-                choice = (a if self._outstanding.get(a._actor_id, 0)
-                          <= self._outstanding.get(b._actor_id, 0) else b)
+                choice = a if self._load(a) <= self._load(b) else b
             self._outstanding[choice._actor_id] = \
                 self._outstanding.get(choice._actor_id, 0) + 1
             return choice
@@ -90,6 +185,15 @@ class Router:
         ref = replica.handle_request.remote(method, args, kwargs)
         return ref, replica
 
+    def assign_stream(self, method: str, args: tuple, kwargs: dict):
+        """Submit one STREAMING request; returns (ObjectRefGenerator,
+        replica).  Items ride the core streaming-generator plane
+        (reference: streaming replica calls, proxy.py:779)."""
+        replica = self.pick()
+        gen = replica.handle_request_stream.options(
+            num_returns="streaming").remote(method, args, kwargs)
+        return gen, replica
+
     def report_failure(self, replica) -> None:
         """A request errored with a dead replica: tell the controller,
         drop local state, force a refresh."""
@@ -103,3 +207,6 @@ class Router:
             self._replicas = [r for r in self._replicas
                               if r._actor_id != replica._actor_id]
         self._refresh(force=True)
+
+    def close(self) -> None:
+        self._closed = True
