@@ -253,38 +253,115 @@ class FusedMapOp:
 
 
 class ActorPoolMapOp:
-    """map_batches(compute='actors'): blocks run on a pool of N
-    reusable actors — stateful/expensive UDF setup happens once per
-    actor, not once per block."""
+    """map_batches(compute='actors'): blocks run on a pool of actors —
+    stateful/expensive UDF setup happens once per actor, not once per
+    block.  `size` may be an int (fixed pool) or (min, max): the pool
+    then AUTOSCALES on backlog — a saturated window that makes no
+    progress for `scale_up_after_s` grows the pool; sustained instant
+    completions shrink it back toward min (reference:
+    data/_internal/execution/autoscaler/default_autoscaler.py)."""
 
-    def __init__(self, fn_or_cls, size: int,
+    def __init__(self, fn_or_cls, size=1,
                  fn_args: tuple = (), fn_kwargs: Optional[dict] = None,
                  num_cpus: float = 1.0,
-                 stages_before: Optional[List[Callable]] = None) -> None:
+                 stages_before: Optional[List[Callable]] = None,
+                 scale_up_after_s: float = 0.5) -> None:
         self.fn_or_cls = fn_or_cls
-        self.size = max(size, 1)
+        if isinstance(size, (tuple, list)):
+            self.min_size = max(int(size[0]), 1)
+            self.max_size = max(int(size[1]), self.min_size)
+        else:
+            self.min_size = self.max_size = max(int(size), 1)
         self.fn_args = fn_args
         self.fn_kwargs = fn_kwargs or {}
         self.num_cpus = num_cpus
         self.stages_before = list(stages_before or [])
+        self.scale_up_after_s = scale_up_after_s
+        # Observable pool size (peak within the last stream()).
+        self.current_size = 0
+        self.peak_size = 0
 
     def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
                preserve_order: bool = True
                ) -> Iterator[ray_tpu.ObjectRef]:
         cls = ray_tpu.remote(_MapActor)
-        actors = [cls.options(num_cpus=self.num_cpus).remote(
-            self.fn_or_cls, self.fn_args, self.fn_kwargs)
-            for _ in range(self.size)]
-        counter = [0]
+        actors: List[Any] = []
 
-        def submit(ref):
-            actor = actors[counter[0] % self.size]
+        def spawn() -> None:
+            actors.append(cls.options(num_cpus=self.num_cpus).remote(
+                self.fn_or_cls, self.fn_args, self.fn_kwargs))
+            self.current_size = len(actors)
+            self.peak_size = max(self.peak_size, len(actors))
+
+        for _ in range(self.min_size):
+            spawn()
+        counter = [0]
+        window: List[ray_tpu.ObjectRef] = []
+        owner: dict = {}              # result ref id -> actor
+        up = iter(upstream)
+        exhausted = False
+        fast_completions = 0
+
+        def submit(ref) -> None:
+            actor = actors[counter[0] % len(actors)]
             counter[0] += 1
-            return actor.apply.remote(ref, self.stages_before)
+            out = actor.apply.remote(ref, self.stages_before)
+            owner[out.binary()] = actor
+            window.append(out)
 
         try:
-            yield from _windowed(upstream, submit, 2 * self.size,
-                                 preserve_order)
+            while not exhausted or window:
+                while not exhausted and len(window) < 2 * len(actors):
+                    try:
+                        ref = next(up)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    submit(ref)
+                if not window:
+                    continue
+                targets = [window[0]] if preserve_order else window
+                # Instant-readiness probe FIRST: only completions that
+                # were already done when we looked count as "fast" for
+                # the downscale heuristic.
+                ready, _ = ray_tpu.wait(targets, num_returns=1,
+                                        timeout=0)
+                if ready:
+                    fast_completions += 1
+                else:
+                    fast_completions = 0
+                    ready, _ = ray_tpu.wait(
+                        targets, num_returns=1,
+                        timeout=self.scale_up_after_s)
+                if not ready:
+                    # Saturated and stalled: add an actor (helps the
+                    # blocks still waiting in the upstream).
+                    if (len(actors) < self.max_size
+                            and not exhausted):
+                        spawn()
+                    continue
+                if preserve_order:
+                    got = window.pop(0)
+                else:
+                    window.remove(ready[0])
+                    got = ready[0]
+                owner.pop(got.binary(), None)
+                yield got
+                # Sustained instant completions: the pool is oversized;
+                # retire an actor that owns none of the in-flight work.
+                if (fast_completions >= 4 * len(actors)
+                        and len(actors) > self.min_size):
+                    busy = {id(a) for a in owner.values()}
+                    for idx in range(len(actors) - 1, -1, -1):
+                        if id(actors[idx]) not in busy:
+                            victim = actors.pop(idx)
+                            self.current_size = len(actors)
+                            fast_completions = 0
+                            try:
+                                ray_tpu.kill(victim)
+                            except Exception:
+                                pass
+                            break
         finally:
             for a in actors:
                 try:
@@ -431,3 +508,32 @@ class JoinOp:
             rshard = [m[p] for m in rparts]
             yield _reduce_join.remote(self.on, len(lshard),
                                       *lshard, *rshard)
+
+
+@ray_tpu.remote
+def _write_block(block: B.Block, path: str, fmt: str,
+                 index: int) -> str:
+    """Write one block as `part-{index}` under `path` through the
+    filesystem layer (reference: per-block write tasks in
+    data/datasource/ writers).  Runs where the block lives."""
+    from ray_tpu.data.filesystem import open_file
+    sep = "" if path.endswith("/") else "/"
+    ext = {"parquet": "parquet", "csv": "csv", "json": "jsonl"}[fmt]
+    out = f"{path}{sep}part-{index:05d}.{ext}"
+    table = B.block_to_arrow(block)
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        with open_file(out, "wb") as f:
+            pq.write_table(table, f)
+    elif fmt == "csv":
+        import pyarrow.csv as pacsv
+        with open_file(out, "wb") as f:
+            pacsv.write_csv(table, f)
+    else:
+        import json as _json
+        with open_file(out, "wb") as f:
+            for row in B.block_rows(block):
+                f.write(_json.dumps(
+                    {k: (v.item() if hasattr(v, "item") else v)
+                     for k, v in row.items()}).encode() + b"\n")
+    return out

@@ -91,12 +91,17 @@ class Dataset:
 
     @staticmethod
     def read_parquet(paths: Union[str, List[str]]) -> "Dataset":
+        """One block per file; paths may be local, globs, dirs, or any
+        fsspec URI (memory://, s3://, gs://, ... — reference:
+        data/read_api.py over pyarrow/fsspec filesystems)."""
         files = _expand_paths(paths, (".parquet",))
 
         def make_reader(path):
             def read():
                 import pyarrow.parquet as pq
-                return B.block_from_arrow(pq.read_table(path))
+                from ray_tpu.data.filesystem import open_file
+                with open_file(path, "rb") as f:
+                    return B.block_from_arrow(pq.read_table(f))
             return read
 
         return Dataset([make_reader(f) for f in files], [])
@@ -108,7 +113,9 @@ class Dataset:
         def make_reader(path):
             def read():
                 import pyarrow.csv as pacsv
-                return B.block_from_arrow(pacsv.read_csv(path))
+                from ray_tpu.data.filesystem import open_file
+                with open_file(path, "rb") as f:
+                    return B.block_from_arrow(pacsv.read_csv(f))
             return read
 
         return Dataset([make_reader(f) for f in files], [])
@@ -120,7 +127,9 @@ class Dataset:
         def make_reader(path):
             def read():
                 import pyarrow.json as pajson
-                return B.block_from_arrow(pajson.read_json(path))
+                from ray_tpu.data.filesystem import open_file
+                with open_file(path, "rb") as f:
+                    return B.block_from_arrow(pajson.read_json(f))
             return read
 
         return Dataset([make_reader(f) for f in files], [])
@@ -144,13 +153,17 @@ class Dataset:
                        self._materialized)
 
     def map_batches(self, fn, *, compute: str = "tasks",
-                    concurrency: int = 2, num_cpus: float = 1.0,
+                    concurrency: Union[int, Tuple[int, int]] = 2,
+                    num_cpus: float = 1.0,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[dict] = None
                     ) -> "Dataset":
         """Per-block batch transform.  compute='actors' (or a class fn)
         runs on a reusable actor pool: stateful/expensive setup happens
-        once per actor (reference: actor_pool_map_operator.py)."""
+        once per actor (reference: actor_pool_map_operator.py).
+        `concurrency` may be (min, max) for an autoscaling pool that
+        grows on backlog and shrinks when oversized (reference:
+        execution/autoscaler/default_autoscaler.py)."""
         if compute == "actors" or isinstance(fn, type):
             # Fold any pending fused stages into the actor op so the
             # pool applies them in the same task.
@@ -333,6 +346,38 @@ class Dataset:
         return Dataset([], [], materialized=[
             X._zip_blocks.remote(left, right)])
 
+    # ------------------------------------------------------------------
+    # writes (reference: Dataset.write_parquet/write_csv/write_json in
+    # python/ray/data/dataset.py over data/datasource/ writers):
+    # distributed — one file per block, written by the task/actor that
+    # holds the block, through the fsspec filesystem layer (so
+    # memory:// / s3:// / gs:// URIs work like local dirs).
+    # ------------------------------------------------------------------
+    def _write(self, path: str, fmt: str,
+               concurrency: int = 8) -> List[str]:
+        from ray_tpu.data import _executor as _X
+        out: List[str] = []
+        window: List[ray_tpu.ObjectRef] = []
+        for i, block_ref in enumerate(self._iter_block_refs()):
+            window.append(_X._write_block.remote(block_ref, path,
+                                                 fmt, i))
+            if len(window) >= concurrency:   # bounded in-flight writes
+                out.append(ray_tpu.get(window.pop(0)))
+        out.extend(ray_tpu.get(window))
+        return out
+
+    def write_parquet(self, path: str) -> List[str]:
+        """Write one parquet file per block into `path` (dir or URI);
+        returns the written file paths."""
+        return self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        """JSON-lines, one file per block."""
+        return self._write(path, "json")
+
     def streaming_split(self, n: int, equal: bool = False
                         ) -> List["DataIterator"]:
         """n iterators fed from ONE streaming execution via a
@@ -432,6 +477,17 @@ class Dataset:
             if len(out) >= n:
                 break
         return out
+
+    def to_pandas(self):
+        """Materialize into one pandas DataFrame (reference:
+        Dataset.to_pandas).  Pulls every block to the driver — for
+        small/test datasets; use iter_batches for anything big."""
+        blocks = [b for b in self._iter_blocks()
+                  if B.block_num_rows(b)]
+        if not blocks:
+            import pandas as pd
+            return pd.DataFrame()
+        return B.block_to_pandas(B.block_concat(blocks))
 
     def schema(self) -> Dict[str, str]:
         for b in self._iter_blocks():
@@ -582,24 +638,8 @@ class GroupedData:
 
 def _expand_paths(paths: Union[str, List[str]],
                   exts: Tuple[str, ...]) -> List[str]:
-    import os
-    if isinstance(paths, str):
-        paths = [paths]
-    files: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            for ext in exts:
-                files.extend(sorted(
-                    globlib.glob(os.path.join(p, f"*{ext}"))))
-        elif any(ch in p for ch in "*?["):
-            files.extend(sorted(globlib.glob(p)))
-        else:
-            if not os.path.exists(p):
-                raise FileNotFoundError(p)
-            files.append(p)
-    if not files:
-        raise FileNotFoundError(f"no files match {paths}")
-    return files
+    from ray_tpu.data.filesystem import expand
+    return expand(paths, exts)
 
 
 # Module-level constructors mirroring ray.data.* entry points.
