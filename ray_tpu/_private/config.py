@@ -153,6 +153,13 @@ _D("mesh_default_axes", str, "dp,fsdp,tp",
 _D("train_report_queue_size", int, 64, "Buffered train.report() messages.")
 _D("prefetch_buffer_size", int, 2,
    "Device prefetch depth for host->HBM input pipelines.")
+_D("memory_usage_threshold", float, 0.95,
+   "Host-memory used fraction above which the memory monitor kills a "
+   "worker (reference: memory_monitor.h); >= 1.0 disables killing.")
+_D("memory_monitor_refresh_ms", int, 1000,
+   "Memory-monitor poll period; 0 disables the monitor.")
+_D("memory_monitor_min_rss_mb", float, 64.0,
+   "Workers below this RSS are never chosen as OOM-kill victims.")
 _D("profile_events_max", int, 10_000,
    "Per-node ring capacity for profile/trace events (ray.timeline "
    "analog; reference: RAY_PROFILING event table).")

@@ -3252,7 +3252,8 @@ class NodeService:
                 pass
 
     def _handle_worker_death(self, w: WorkerHandle, reason: str,
-                             actor_already_handled: bool = False) -> None:
+                             actor_already_handled: bool = False,
+                             oom: bool = False) -> None:
         if w.state == "dead":
             return
         if w.state == "starting":
@@ -3280,8 +3281,10 @@ class NodeService:
                 rec.worker = None
                 self.pending_queue.append(rec)
             else:
+                err_cls = (exc.OutOfMemoryError if oom
+                           else exc.WorkerCrashedError)
                 self._fail_task_returns(
-                    rec, exc.WorkerCrashedError(
+                    rec, err_cls(
                         f"worker died while running "
                         f"{rec.spec.get('name')}: {reason}"))
                 if rec.is_actor_creation and rec.actor_id is not None:
@@ -3348,6 +3351,85 @@ class NodeService:
             for dep in rec.spec.get("embedded") or []:
                 self._decref(dep)
 
+    # ------------------------------------------------------------------
+    # OOM defense (reference: src/ray/common/memory_monitor.h:52 +
+    # raylet worker-killing policies, worker_killing_policy.h:34 /
+    # worker_killing_policy_retriable_fifo.h:31)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _host_memory_used_fraction() -> float:
+        try:
+            total = avail = None
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = float(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = float(line.split()[1])
+                    if total is not None and avail is not None:
+                        break
+            if not total or avail is None:
+                # No MemAvailable (exotic kernel): better a disabled
+                # monitor than a kill-storm from reading "100% used".
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    @staticmethod
+    def _rss_mb(pid: int) -> float:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+        except (OSError, ValueError, IndexError):
+            return 0.0
+
+    def _check_memory_pressure(self) -> None:
+        """Kill one worker per check while the host is above the memory
+        threshold.  Victim policy (reference retriable-FIFO +
+        group-by-owner, simplified): retriable non-actor tasks first
+        (their retry makes the kill recoverable), then non-retriable
+        tasks, actors last; within a class, the newest-started first
+        (least progress lost).  The killed task fails with a typed
+        OutOfMemoryError that counts against its retries."""
+        threshold = config.memory_usage_threshold
+        if threshold >= 1.0:
+            return
+        used = self._host_memory_used_fraction()
+        if used < threshold:
+            return
+        min_rss = config.memory_monitor_min_rss_mb
+        with self.lock:
+            candidates = []
+            for w in self.workers.values():
+                if w.state not in ("busy", "blocked"):
+                    continue
+                rss = self._rss_mb(w.pid)
+                if rss < min_rss:
+                    continue
+                rec = w.current_task
+                retriable = (rec is not None and rec.retries_left > 0
+                             and not rec.is_actor_creation)
+                is_actor = w.actor_id is not None
+                klass = 0 if retriable and not is_actor else \
+                    (1 if not is_actor else 2)
+                candidates.append((klass, -w.last_idle_time, rss, w))
+            if not candidates:
+                return
+            candidates.sort(key=lambda t: (t[0], t[1]))
+            _, _, rss, victim = candidates[0]
+            reason = (f"killed by the memory monitor: host memory at "
+                      f"{used:.0%} >= threshold {threshold:.0%} "
+                      f"(worker RSS {rss:.0f} MB)")
+            try:
+                if victim.proc is not None:
+                    victim.proc.kill()
+            except Exception:
+                pass
+            self._handle_worker_death(victim, reason, oom=True)
+            self._schedule()
+
     def _recheck_infeasible(self) -> None:
         """Tasks admitted as pending demand while an autoscaler lease
         was fresh are re-checked when the lease expires: if the shape
@@ -3397,6 +3479,12 @@ class NodeService:
             if ticks % 40 == 0:       # ~2s: infeasible-demand recheck
                 try:
                     self._recheck_infeasible()
+                except Exception:
+                    pass
+            refresh_ms = config.memory_monitor_refresh_ms
+            if refresh_ms > 0 and ticks % max(refresh_ms // 50, 1) == 0:
+                try:
+                    self._check_memory_pressure()
                 except Exception:
                     pass
             now = time.time()

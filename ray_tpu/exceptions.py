@@ -78,6 +78,14 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """A worker was killed by the node's memory monitor (reference:
+    src/ray/common/memory_monitor.h:52 + worker-killing policies).
+    Subclasses WorkerCrashedError so every existing worker-death
+    handler (Train restarts, Serve failover, Tune reaping) treats it
+    as the worker failure it is; counts against the task's retries."""
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Preparing a task/actor runtime environment failed."""
 

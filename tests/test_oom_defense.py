@@ -1,0 +1,68 @@
+"""OOM defense: memory monitor + worker-killing policy (reference:
+src/ray/common/memory_monitor.h:52,
+src/ray/raylet/worker_killing_policy_retriable_fifo.h:31).
+
+Determinism without exhausting host RAM: memory_usage_threshold=0.0
+makes the monitor treat the host as always over budget, and
+memory_monitor_min_rss_mb selects only genuinely-large workers as
+victims — so a memory-hog UDF is killed while small tasks run
+untouched."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@pytest.fixture
+def oom_rt():
+    ray_tpu.init(num_cpus=4, _system_config={
+        "memory_usage_threshold": 0.0,
+        "memory_monitor_refresh_ms": 200,
+        # Victims must exceed this RSS: hogs allocate ~500 MB, normal
+        # workers idle far below it.
+        "memory_monitor_min_rss_mb": 350.0,
+    })
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_memory_hog_killed_retried_then_typed_error(oom_rt, tmp_path):
+    marker = tmp_path / "attempts"
+
+    @ray_tpu.remote(max_retries=1)
+    def hog():
+        with open(marker, "a") as f:
+            f.write("x\n")
+        ballast = np.ones(500_000_000 // 8, np.float64)  # ~500 MB RSS
+        time.sleep(30)
+        return float(ballast[0])
+
+    with pytest.raises(exc.OutOfMemoryError, match="memory monitor"):
+        ray_tpu.get(hog.remote(), timeout=120)
+    # First run + one retry, both OOM-killed.
+    assert marker.read_text().count("x") == 2
+
+
+def test_small_tasks_survive_and_node_recovers(oom_rt):
+    @ray_tpu.remote
+    def small(x):
+        return x + 1
+
+    # Below min-RSS: never a victim even with threshold 0.
+    assert ray_tpu.get([small.remote(i) for i in range(8)],
+                       timeout=60) == list(range(1, 9))
+
+    @ray_tpu.remote(max_retries=0)
+    def hog():
+        ballast = np.ones(500_000_000 // 8, np.float64)
+        time.sleep(30)
+        return float(ballast[0])
+
+    with pytest.raises(exc.OutOfMemoryError):
+        ray_tpu.get(hog.remote(), timeout=120)
+    # The node survives the kill and keeps serving.
+    assert ray_tpu.get(small.remote(100), timeout=60) == 101
