@@ -11,13 +11,13 @@ train/base_trainer.py:693).
 
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      PopulationBasedTraining)
-from ray_tpu.tune.search import (choice, grid_search, loguniform,
-                                 randint, uniform)
+from ray_tpu.tune.search import (TPESearcher, choice, grid_search,
+                                 loguniform, randint, uniform)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "ASHAScheduler",
     "PopulationBasedTraining",
     "FIFOScheduler", "grid_search", "uniform", "loguniform", "randint",
-    "choice",
+    "choice", "TPESearcher",
 ]

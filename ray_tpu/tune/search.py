@@ -114,3 +114,191 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int,
             assign = dict(zip(grid_paths, combo))
             variants.append(build(param_space, (), assign))
     return variants
+
+
+# ---------------------------------------------------------------------------
+# Model-based search: TPE (reference: tune/search/optuna/optuna_search.py
+# wraps Optuna's TPE sampler; here the estimator is native).
+# ---------------------------------------------------------------------------
+def _flatten(space: Dict[str, Any], path=()):  # leaves that are Domains
+    for k, v in space.items():
+        if isinstance(v, Domain):
+            yield path + (k,), v
+        elif isinstance(v, dict):
+            yield from _flatten(v, path + (k,))
+
+
+def _get(cfg, path):
+    for k in path:
+        cfg = cfg[k]
+    return cfg
+
+
+def _set(cfg, path, value):
+    for k in path[:-1]:
+        cfg = cfg.setdefault(k, {})
+    cfg[path[-1]] = value
+
+
+class TPESearcher:
+    """Tree-structured Parzen Estimator-style searcher.
+
+    After `n_startup` random trials, observations split into good/bad
+    by the `gamma` quantile of the objective; candidates are sampled by
+    perturbing good configurations and ranked by a kernel density
+    ratio l(x)/g(x) (good-density over bad-density) in each numeric
+    domain's transformed space.  Plugs into TuneConfig(search_alg=...);
+    the Tuner calls suggest() per trial and record() per completion.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 n_startup: int = 5, gamma: float = 0.25,
+                 n_candidates: int = 32, seed: int = 0) -> None:
+        if mode not in ("max", "min"):
+            raise ValueError(f"mode must be max|min, got {mode!r}")
+        self.metric = metric
+        self.mode = mode
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._obs: List[tuple] = []       # (config, score)
+
+    # -- observation -----------------------------------------------------
+    def record(self, config: Dict[str, Any],
+               metrics: Dict[str, Any]) -> None:
+        if self.metric not in metrics:
+            return
+        score = float(metrics[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._obs.append((config, score))
+
+    # -- suggestion ------------------------------------------------------
+    def _random(self, space: Dict[str, Any]) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        for path, dom in _flatten(space):
+            _set(cfg, path, dom.sample(self._rng))
+        # constants pass through
+        def fill(node, out):
+            for k, v in node.items():
+                if isinstance(v, dict):
+                    fill(v, out.setdefault(k, {}))
+                elif not isinstance(v, Domain):
+                    out[k] = v
+        fill(space, cfg)
+        return cfg
+
+    @staticmethod
+    def _warp(dom: Domain, value):
+        import math
+        if isinstance(dom, LogUniform):
+            return math.log(value)
+        return float(value) if isinstance(dom, (Uniform, RandInt)) \
+            else value
+
+    def _density(self, dom: Domain, pts: List[Any], x) -> float:
+        """Parzen window density of x under the point set (numeric
+        domains: gaussian kernels; categorical: smoothed counts)."""
+        import math
+        if isinstance(dom, Choice) or not pts:
+            n = len(pts) or 1
+            hits = sum(1 for p in pts if p == x)
+            return (hits + 0.5) / (n + 0.5 * max(len(getattr(
+                dom, "options", [1])), 1))
+        xs = [self._warp(dom, p) for p in pts]
+        xv = self._warp(dom, x)
+        spread = (max(xs) - min(xs)) or 1.0
+        h = max(spread / max(len(xs) ** 0.5, 1.0), 1e-3)
+        return sum(math.exp(-0.5 * ((xv - p) / h) ** 2)
+                   for p in xs) / (len(xs) * h)
+
+    def suggest(self, space: Dict[str, Any]) -> Dict[str, Any]:
+        if len(self._obs) < self.n_startup:
+            return self._random(space)
+        ranked = sorted(self._obs, key=lambda t: -t[1])
+        n_good = max(1, int(len(ranked) * self.gamma))
+        good = [c for c, _ in ranked[:n_good]]
+        bad = [c for c, _ in ranked[n_good:]] or good
+        domains = list(_flatten(space))
+        best_cfg, best_ratio = None, -1.0
+        for _ in range(self.n_candidates):
+            cand = self._random(space)
+            # Perturb toward a good point: half the time the single
+            # best observation (exploitation), otherwise a random good
+            # point (diversity).
+            anchor = (good[0] if self._rng.random() < 0.5
+                      else self._rng.choice(good))
+            for path, dom in domains:
+                if self._rng.random() < 0.8:
+                    try:
+                        av = _get(anchor, path)
+                    except (KeyError, TypeError):
+                        continue
+                    if isinstance(dom, Choice):
+                        _set(cand, path, av)
+                    elif isinstance(dom, RandInt):
+                        lo, hi = dom.low, dom.high
+                        width = max((hi - lo) // 5, 1)
+                        _set(cand, path, max(lo, min(
+                            hi - 1, av + self._rng.randint(-width,
+                                                           width))))
+                    else:
+                        import math
+                        w = self._warp(dom, av)
+                        # Self-tightening bandwidth (classic TPE): the
+                        # kernel width tracks the good set's spread, so
+                        # exploitation sharpens as evidence accumulates.
+                        gv = []
+                        for c in good:
+                            try:
+                                gv.append(self._warp(dom, _get(c, path)))
+                            except (KeyError, TypeError):
+                                pass
+                        if isinstance(dom, LogUniform):
+                            span = (dom._hi - dom._lo) or 1.0
+                            lo, hi = dom._lo, dom._hi
+                        else:
+                            span = (dom.high - dom.low) or 1.0
+                            lo, hi = dom.low, dom.high
+                        spread = ((max(gv) - min(gv))
+                                  if len(gv) > 1 else span)
+                        # Annealed floor: wide early (escape local
+                        # clusters), tightening as evidence accumulates
+                        # so late trials refine instead of wandering.
+                        floor = span / (8.0 + len(self._obs) / 2.0)
+                        sigma = max(spread / max(len(gv), 1) ** 0.5,
+                                    floor)
+                        w += self._rng.gauss(0, sigma)
+                        w = max(lo, min(hi, w))
+                        _set(cand, path,
+                             math.exp(w) if isinstance(dom, LogUniform)
+                             else w)
+            ratio = 1.0
+            for path, dom in domains:
+                x = _get(cand, path)
+                lg = self._density(dom, [_get(c, path) for c in good], x)
+                lb = self._density(dom, [_get(c, path) for c in bad], x)
+                ratio *= (lg + 1e-12) / (lb + 1e-12)
+            # Novelty factor: pure density-ratio argmax re-evaluates the
+            # good cluster's center forever (measured); weighting by
+            # distance to the nearest ALREADY-EVALUATED point pushes
+            # suggestions to the cluster's rim, which is what actually
+            # drags the good set toward the optimum.
+            novelty = 1.0
+            for path, dom in domains:
+                if isinstance(dom, Choice):
+                    continue
+                xv = self._warp(dom, _get(cand, path))
+                if isinstance(dom, LogUniform):
+                    span = (dom._hi - dom._lo) or 1.0
+                else:
+                    span = (dom.high - dom.low) or 1.0
+                dmin = min((abs(xv - self._warp(dom, _get(c, path)))
+                            for c, _ in self._obs), default=span)
+                scale = span / (8.0 + len(self._obs) / 2.0)
+                novelty *= min(dmin / scale, 1.0) + 0.05
+            ratio *= novelty
+            if ratio > best_ratio:
+                best_ratio, best_cfg = ratio, cand
+        return best_cfg if best_cfg is not None else self._random(space)

@@ -35,6 +35,10 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    # Model-based searcher (e.g. tune.search.TPESearcher): suggests a
+    # config per trial and observes completions (reference:
+    # tune/search/optuna/optuna_search.py role).
+    search_alg: Any = None
     seed: int = 0
 
 
@@ -114,12 +118,61 @@ class Tuner:
         self._tune_config = tune_config or TuneConfig()
         self._run_config = run_config or RunConfig()
         self._param_space = dict(param_space or {})
+        self._restored_trials: Optional[List[TrialResult]] = None
         if isinstance(trainable, TpuTrainer):
             self._fn = _trainer_trainable(trainable)
         elif callable(trainable):
             self._fn = trainable
         else:
             raise TypeError("trainable must be a function or TpuTrainer")
+
+    # -- experiment state (reference: tune/execution/experiment_state.py
+    # periodic snapshots + Tuner.restore) ------------------------------
+    def _save_experiment_state(self, exp_dir: str,
+                               trials: List[TrialResult]) -> None:
+        state = {"param_space": self._param_space,
+                 "trials": [{"trial_id": t.trial_id, "config": t.config,
+                             "metrics": t.metrics, "history": t.history,
+                             "checkpoint": (t.checkpoint.path
+                                            if t.checkpoint else None),
+                             "error": t.error, "status": t.status,
+                             "path": t.path} for t in trials]}
+        tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
+
+    @classmethod
+    def restore(cls, path: str, trainable: Union[Callable, Any],
+                *, tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[Any] = None) -> "Tuner":
+        """Resume an interrupted sweep from its experiment directory:
+        finished trials keep their results, unfinished ones re-run
+        (from their last checkpoint when present).  Reference:
+        Tuner.restore over experiment-state snapshots."""
+        from ray_tpu.train.trainer import RunConfig
+        with open(os.path.join(path, "experiment_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        if isinstance(state, list):            # pre-param_space format
+            state = {"param_space": {}, "trials": state}
+        rc = run_config or RunConfig()
+        rc.name = os.path.basename(path.rstrip("/"))
+        rc.storage_path = os.path.dirname(path.rstrip("/"))
+        tuner = cls(trainable, param_space=state["param_space"],
+                    tune_config=tune_config, run_config=rc)
+        trials = []
+        for d in state["trials"]:
+            t = TrialResult(trial_id=d["trial_id"], config=d["config"],
+                            metrics=d["metrics"],
+                            history=list(d["history"]),
+                            checkpoint=(Checkpoint(d["checkpoint"])
+                                        if d["checkpoint"] else None),
+                            error=d["error"], status=d["status"],
+                            path=d["path"])
+            trials.append(t)
+        tuner._restored_trials = trials
+        return tuner
 
     # ------------------------------------------------------------------
     def fit(self) -> ResultGrid:
@@ -133,24 +186,63 @@ class Tuner:
         exp_dir = os.path.join(storage, run_name)
         os.makedirs(exp_dir, exist_ok=True)
 
-        variants = generate_variants(self._param_space, tc.num_samples,
-                                     seed=tc.seed)
-        trials = [TrialResult(trial_id=f"trial_{i:05d}", config=v,
-                              metrics={},
-                              path=os.path.join(exp_dir, f"trial_{i:05d}"))
-                  for i, v in enumerate(variants)]
-        pending = list(trials)
+        searcher = tc.search_alg
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+            # Finished trials keep their results; everything else
+            # re-runs, resuming from its last checkpoint when present.
+            pending = [t for t in trials
+                       if t.status not in ("TERMINATED",
+                                           "EARLY_STOPPED")]
+            # A searcher-driven sweep still owes the rest of its
+            # num_samples budget; seed the searcher with the finished
+            # trials so it resumes informed, not cold.
+            remaining_suggestions = (
+                max(tc.num_samples - len(trials), 0)
+                if searcher is not None else 0)
+            if searcher is not None:
+                for t in trials:
+                    if t.status in ("TERMINATED", "EARLY_STOPPED")                             and t.metrics:
+                        searcher.record(t.config, t.metrics)
+        elif searcher is not None:
+            trials = []
+            pending = []
+            remaining_suggestions = max(tc.num_samples, 1)
+        else:
+            variants = generate_variants(self._param_space,
+                                         tc.num_samples, seed=tc.seed)
+            trials = [TrialResult(
+                trial_id=f"trial_{i:05d}", config=v, metrics={},
+                path=os.path.join(exp_dir, f"trial_{i:05d}"))
+                for i, v in enumerate(variants)]
+            pending = list(trials)
+            remaining_suggestions = 0
         running: Dict[str, dict] = {}     # trial_id -> {actor, ref, ...}
         client = ray_tpu._ensure_connected()
+        last_snapshot = 0.0
 
         trials_by_id = {t.trial_id: t for t in trials}
-        while pending or running:
-            while pending and len(running) < tc.max_concurrent_trials:
-                t = pending.pop(0)
+        while pending or running or remaining_suggestions:
+            while len(running) < tc.max_concurrent_trials:
+                if pending:
+                    t = pending.pop(0)
+                elif remaining_suggestions:
+                    cfg = searcher.suggest(self._param_space)
+                    tid = f"trial_{len(trials):05d}"
+                    t = TrialResult(trial_id=tid, config=cfg,
+                                    metrics={},
+                                    path=os.path.join(exp_dir, tid))
+                    trials.append(t)
+                    trials_by_id[tid] = t
+                    remaining_suggestions -= 1
+                else:
+                    break
                 os.makedirs(t.path, exist_ok=True)
                 ns = f"tune_reports/{exp_dir}/{t.trial_id}"
+                resume = (t.checkpoint.path
+                          if t.checkpoint is not None else None)
                 actor = _TrialActor.remote(t.trial_id, t.path, t.config,
-                                           ns)
+                                           ns, restore_checkpoint=resume)
                 ref = actor.run.remote(self._fn)
                 t.status = "RUNNING"
                 running[t.trial_id] = {"trial": t, "actor": actor,
@@ -217,6 +309,16 @@ class Tuner:
                 self._drain_final(client, info, t, scheduler)
                 self._stop_trial(info)
                 del running[tid]
+                if searcher is not None:
+                    searcher.record(t.config, t.metrics)
+            now = time.time()
+            if now - last_snapshot > 1.0:
+                last_snapshot = now
+                try:
+                    self._save_experiment_state(exp_dir, trials)
+                except Exception:
+                    pass
+        self._save_experiment_state(exp_dir, trials)
         return ResultGrid(trials)
 
     @staticmethod
