@@ -124,15 +124,23 @@ class MeshGroup:
             raise TimeoutError(
                 f"MeshGroup placement group ({num_hosts} x {res}, "
                 f"{strategy}) did not become ready")
+        self._res = res
+        self._platform = platform
+        self._devices_per_host = devices_per_host
+        self.restarts = 0
+        self._spawn_gang()
+
+    def _spawn_gang(self) -> None:
         cls = ray_tpu.remote(_MeshHostWorker)
+        res, platform = self._res, self._platform
         tpus = res.get("TPU", 0) if platform == "tpu" else 0
         self.workers = [
             cls.options(num_cpus=res.get("CPU", 0), num_tpus=tpus,
                         placement_group=self.pg,
                         placement_group_bundle_index=i).remote(
-                rank=i, world=num_hosts, platform=platform,
-                local_devices=devices_per_host)
-            for i in range(num_hosts)
+                rank=i, world=self.num_hosts, platform=platform,
+                local_devices=self._devices_per_host)
+            for i in range(self.num_hosts)
         ]
         # Rank 0 picks the coordinator address on ITS host (which may
         # not be the driver's machine), then every rank joins — setup
@@ -142,6 +150,80 @@ class MeshGroup:
             self.workers[0].choose_coordinator.remote(), timeout=120)
         ray_tpu.get([w.setup.remote(coordinator) for w in self.workers],
                     timeout=300)
+
+    # -- elasticity (reference: backend_executor.py restart paths) ------
+    def rebuild(self) -> None:
+        """Tear down and re-rendezvous the whole gang.  One dead member
+        poisons jax.distributed for everyone (the survivors hang in
+        collectives against the dead peer), so recovery is always
+        all-ranks: kill, respawn on the SAME placement-group bundles,
+        re-initialize."""
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.restarts += 1
+        self._spawn_gang()
+
+    def run_elastic(self, fn: Callable, *args,
+                    max_restarts: int = 2,
+                    timeout: Optional[float] = None,
+                    **kwargs) -> List[Any]:
+        """run(), surviving gang-member death: on a worker failure the
+        gang is rebuilt and fn re-runs from scratch on every rank — fn
+        must be resumable (load its latest checkpoint at start), the
+        TpuTrainer/orbax pattern.  Reference:
+        train/_internal/backend_executor.py worker-group restart +
+        FailureConfig."""
+        import time as _time
+        from ray_tpu import exceptions as exc
+        attempt = 0
+        while True:
+            refs = [w.run.remote(fn, *args, **kwargs)
+                    for w in self.workers]
+            deadline = (None if timeout is None
+                        else _time.monotonic() + timeout)
+            failure: Optional[BaseException] = None
+            checked: set = set()
+            while True:
+                # Poll instead of one blocking get: a dead rank leaves
+                # the survivors HUNG in collectives, so their refs
+                # never resolve — the dead rank's error must be
+                # noticed while the others are still pending.
+                done, not_done = ray_tpu.wait(
+                    refs, num_returns=len(refs), timeout=1.0)
+                for r in done:
+                    if r.binary() in checked:
+                        continue
+                    checked.add(r.binary())
+                    try:
+                        ray_tpu.get(r)
+                    except BaseException as e:   # noqa: BLE001
+                        failure = e
+                        break
+                if failure is not None or not not_done:
+                    break
+                if deadline is not None and _time.monotonic() > deadline:
+                    # Survivors may be hung in collectives: a leaked
+                    # gang is unusable, so tear it down before raising.
+                    self.rebuild()
+                    raise TimeoutError(
+                        f"run_elastic timed out after {timeout}s")
+            if failure is None:
+                return ray_tpu.get(refs)
+            worker_death = isinstance(
+                failure, (exc.ActorDiedError, exc.WorkerCrashedError,
+                          exc.ActorUnavailableError))
+            if not worker_death or attempt >= max_restarts:
+                # Application error (or restart budget exhausted): the
+                # other ranks are hung against the failed peer — kill
+                # and respawn the gang so the MeshGroup stays usable,
+                # then surface the error.
+                self.rebuild()
+                raise failure
+            attempt += 1
+            self.rebuild()
 
     def device_counts(self) -> List[Dict[str, int]]:
         return ray_tpu.get(
