@@ -526,8 +526,18 @@ class NodeService:
                         self._idle_since = None
                     elif getattr(self, "_idle_since", None) is None:
                         self._idle_since = time.time()
+                    # Pending placement-group demand (gang shapes the
+                    # autoscaler must bin-pack into whole node sets;
+                    # reference: resource_demand_scheduler PG demand).
+                    pg_demand = [
+                        {"pg_id": pid.hex(),
+                         "bundles": [dict(b) for b in r["bundles"]],
+                         "strategy": r["strategy"]}
+                        for pid, r in self.pgs.items()
+                        if r["state"] == "pending"][:8]
                     load = {"pending": len(self.pending_queue),
                             "shapes": shapes,
+                            "pg_demand": pg_demand,
                             "idle_since": self._idle_since}
                 self.gcs.heartbeat(self.node_id, avail, load)
                 # Autoscaler lease (StandardAutoscaler refreshes a
@@ -1448,7 +1458,15 @@ class NodeService:
             if assignment is None:
                 if _place_bundles(bundles, strategy, view,
                                   use_avail=False) is None:
-                    # No placement even against TOTALS: infeasible.
+                    # No placement even against TOTALS.  With a live
+                    # autoscaler lease the gang stays PENDING as
+                    # demand (the heartbeat carries it; the autoscaler
+                    # bin-packs whole node sets for it) — otherwise
+                    # fail fast (reference: infeasible PG handling vs
+                    # autoscaler demand).
+                    if self._autoscaler_live():
+                        time.sleep(0.2)
+                        continue
                     blob = ser.dumps(exc.InfeasibleResourceError(
                         f"placement group {pg_id.hex()[:8]} "
                         f"({strategy}, {bundles}) cannot fit on any "

@@ -27,6 +27,11 @@ def _fits(avail: Dict[str, float], shape: Dict[str, float]) -> bool:
                for k, v in (shape or {}).items())
 
 
+def _charge(pool: Dict[str, float], shape: Dict[str, float]) -> None:
+    for k, v in (shape or {}).items():
+        pool[k] = pool.get(k, 0.0) - v
+
+
 class StandardAutoscaler:
     def __init__(self, provider: NodeProvider, gcs_address: tuple,
                  worker_resources: Dict[str, float],
@@ -48,6 +53,10 @@ class StandardAutoscaler:
         # same demand in the meantime.
         self._last_launch = 0.0
         self.launch_cooldown_s = 3.0
+        # pg_id -> slice name already provisioned for that gang: slice
+        # provisioning takes minutes while the PG stays pending in
+        # heartbeats; never provision twice for the same gang.
+        self._slices_for_pg: Dict[str, str] = {}
         # Announce to the cluster that an autoscaler is live.  The
         # value is a LEASE timestamp, refreshed by every update(): node
         # services keep infeasible shapes PENDING (demand) only while
@@ -87,6 +96,43 @@ class StandardAutoscaler:
                 pass
             self._stop.wait(self.poll_interval_s)
 
+    def _bin_pack_new_nodes(self, shapes: List[Dict[str, float]],
+                            pg_demand: List[dict],
+                            nodes: List[dict]) -> int:
+        """First-fit-decreasing pack of the demand that existing nodes
+        cannot hold into hypothetical fresh workers; returns how many
+        to launch.  STRICT_SPREAD/SPREAD gang bundles never share a
+        fresh node with a sibling bundle."""
+        existing = [dict(n["resources_avail"]) for n in nodes]
+        fresh: List[Dict[str, float]] = []
+
+        def place(shape, banned: set, spread: bool) -> Optional[int]:
+            for i, pool in enumerate(existing):
+                if ("e", i) not in banned and _fits(pool, shape):
+                    _charge(pool, shape)
+                    return ("e", i) if spread else None
+            for i, pool in enumerate(fresh):
+                if ("f", i) not in banned and _fits(pool, shape):
+                    _charge(pool, shape)
+                    return ("f", i) if spread else None
+            if not _fits(self.worker_resources, shape):
+                return None          # no worker shape can ever hold it
+            fresh.append(dict(self.worker_resources))
+            _charge(fresh[-1], shape)
+            return ("f", len(fresh) - 1) if spread else None
+
+        for d in pg_demand:
+            spread = d.get("strategy", "PACK").endswith("SPREAD")
+            used: set = set()
+            for b in sorted(d["bundles"],
+                            key=lambda b: -sum(b.values())):
+                spot = place(b, used if spread else set(), spread)
+                if spread and spot is not None:
+                    used.add(spot)
+        for shape in sorted(shapes, key=lambda s: -sum(s.values())):
+            place(shape, set(), False)
+        return len(fresh)
+
     # -- one reconcile step (unit-testable) ----------------------------
     def update(self) -> dict:
         self._refresh_lease()
@@ -100,21 +146,94 @@ class StandardAutoscaler:
             workers = self.provider.non_terminated_nodes()
             actions["launched"] += 1
 
-        # Scale-up on unfulfilled demand.
+        # Scale-up: bin-pack the full demand vector (pending task
+        # shapes + pending placement-group gangs) into fresh workers of
+        # this provider's shape and launch them ALL in one reconcile —
+        # a 4-host gang needs one 4-node scale-up, not 4 cooldown-
+        # separated rounds (reference:
+        # autoscaler/_private/resource_demand_scheduler.py).
         unfulfilled = []
+        pg_demand = []
         for n in nodes:
-            for shape in (n.get("load", {}).get("shapes") or []):
+            load = n.get("load", {})
+            for shape in (load.get("shapes") or []):
                 if not any(_fits(m["resources_avail"], shape)
                            for m in nodes):
                     unfulfilled.append(shape)
-        if unfulfilled and len(workers) < self.max_workers \
-                and time.time() - self._last_launch \
-                >= self.launch_cooldown_s:
-            # Launch only if a fresh worker would actually help.
-            if any(_fits(self.worker_resources, s) for s in unfulfilled):
+            pg_demand.extend(load.get("pg_demand") or [])
+        if time.time() - self._last_launch >= self.launch_cooldown_s:
+            # Gang demand on a slice provider: whole slices, atomically.
+            from ray_tpu.autoscaler.node_provider import TpuSliceProvider
+            if isinstance(self.provider, TpuSliceProvider):
+                pending_ids = set()
+                for d in pg_demand:
+                    head = next(
+                        (k for b in d["bundles"] for k in b
+                         if k.startswith("TPU-")
+                         and k.endswith("-head")), None)
+                    if head is None:
+                        continue
+                    pg_id = d.get("pg_id", "")
+                    pending_ids.add(pg_id)
+                    if pg_id in self._slices_for_pg:
+                        continue       # already provisioning this gang
+                    slice_type = head[len("TPU-"):-len("-head")]
+                    name = self.provider.create_slice(
+                        slice_type, len(d["bundles"]))
+                    self._slices_for_pg[pg_id] = name
+                    self._last_launch = time.time()
+                    actions["launched"] += len(d["bundles"])
+                # Gangs no longer pending free their tracking entry.
+                for pg_id in list(self._slices_for_pg):
+                    if pg_id not in pending_ids:
+                        del self._slices_for_pg[pg_id]
+                pg_demand = [d for d in pg_demand
+                             if not any(k.startswith("TPU-")
+                                        and k.endswith("-head")
+                                        for b in d["bundles"]
+                                        for k in b)]
+            needed = self._bin_pack_new_nodes(unfulfilled, pg_demand,
+                                              nodes)
+            budget = self.max_workers - len(workers)
+            for _ in range(min(needed, max(budget, 0))):
                 self.provider.create_node(self.worker_resources)
                 self._last_launch = time.time()
                 actions["launched"] += 1
+
+        # Slices are atomic (TpuSliceProvider contract): release a
+        # slice only when EVERY one of its hosts is idle past the
+        # timeout, via delete_slice — never per-host terminate_node.
+        from ray_tpu.autoscaler.node_provider import TpuSliceProvider \
+            as _TSP
+        slice_members: set = set()
+        if isinstance(self.provider, _TSP):
+            by_id = {bytes(n["node_id"]): n for n in nodes}
+            now = time.time()
+            for sname in list(self.provider.list_slices()):
+                members = self.provider.slice_nodes(sname)
+                slice_members.update(members)
+                idle = []
+                for m in members:
+                    info = by_id.get(self.provider.node_cluster_id(m))
+                    if info is None:
+                        break
+                    since = info.get("load", {}).get("idle_since")
+                    free = (info["resources_avail"]
+                            == info["resources_total"])
+                    if not (since and free
+                            and now - since > self.idle_timeout_s):
+                        break
+                    idle.append(m)
+                else:
+                    for m in members:
+                        nid = self.provider.node_cluster_id(m)
+                        try:
+                            self._gcs.mark_node_dead(
+                                nid, "autoscaler slice release")
+                        except Exception:
+                            pass
+                    self.provider.delete_slice(sname)
+                    actions["terminated"] += len(members)
 
         # Scale-down idle provider workers past the timeout.
         if len(workers) > self.min_workers:
@@ -123,6 +242,8 @@ class StandardAutoscaler:
                 by_id[bytes(n["node_id"])] = n
             now = time.time()
             for name in list(workers):
+                if name in slice_members:
+                    continue           # whole-slice lifecycle above
                 if len(self.provider.non_terminated_nodes()) \
                         <= self.min_workers:
                     break

@@ -137,3 +137,39 @@ class LocalNodeProvider(NodeProvider):
 
     def node_cluster_id(self, name: str) -> Optional[bytes]:
         return self._node_ids.get(name)
+
+
+class TpuSliceProvider(NodeProvider):
+    """Provider contract for WHOLE-TPU-SLICE provisioning (reference
+    role: the TPU pod support in autoscaler cloud providers +
+    _private/accelerators/tpu.py's `TPU-<type>-head` gang resource).
+
+    A slice is an atomic unit of num_hosts machines wired by ICI; the
+    autoscaler asks for slices (never individual slice hosts) when the
+    demand contains `TPU-<type>-head` gang bundles, and each launched
+    host must register advertising:
+
+        {"TPU": <chips_per_host>, "TPU-<type>-head": 1}   # host 0
+        {"TPU": <chips_per_host>}                         # hosts 1..N-1
+
+    so tpu_slice_bundles() placement groups land on exactly one slice.
+    Cloud implementations map create_slice to GKE node pools or
+    QueuedResources; delete_slice must release the whole slice (TPU
+    slices cannot shrink).  `create_node` (inherited contract) may be
+    implemented as a 1-host slice or left unsupported for pure-TPU
+    pools.
+    """
+
+    def create_slice(self, slice_type: str, num_hosts: int) -> str:
+        """Provision one slice; returns a provider-scoped slice name."""
+        raise NotImplementedError
+
+    def delete_slice(self, name: str) -> None:
+        raise NotImplementedError
+
+    def list_slices(self) -> List[str]:
+        raise NotImplementedError
+
+    def slice_nodes(self, name: str) -> List[str]:
+        """Provider node names of every host in the slice."""
+        raise NotImplementedError

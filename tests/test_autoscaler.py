@@ -109,3 +109,119 @@ def test_pending_infeasible_fails_when_autoscaler_dies(cluster):
     client.kv_del("cluster", b"autoscaler")
     with pytest.raises(ray_tpu.exceptions.InfeasibleResourceError):
         ray_tpu.get(ref, timeout=30)
+
+
+def test_pg_gang_demand_single_round_scale_up(cluster):
+    """A pending 4-bundle STRICT_SPREAD placement group triggers ONE
+    4-node scale-up in a single reconcile (reference:
+    resource_demand_scheduler bin-packing), and idle nodes are reaped
+    afterward."""
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    provider = LocalNodeProvider(cluster.gcs_address)
+    scaler = StandardAutoscaler(
+        provider, cluster.gcs_address,
+        worker_resources={"CPU": 2, "gang": 1},
+        min_workers=0, max_workers=6, idle_timeout_s=2.0,
+        poll_interval_s=0.3)
+    try:
+        time.sleep(1.5)      # lease mirrored by the head's heartbeat
+        pg = placement_group([{"gang": 1}] * 4,
+                             strategy="STRICT_SPREAD")
+        # Let the head heartbeat carry the pending-PG demand.
+        launched = 0
+        for _ in range(40):
+            acts = scaler.update()
+            launched += acts["launched"]
+            if launched:
+                break
+            time.sleep(0.3)
+        assert launched == 4, f"expected one 4-node scale-up, " \
+                              f"got {launched}"
+        assert pg.wait(timeout_seconds=90)
+        remove_placement_group(pg)
+        # Idle long enough: everything above min_workers reaped.
+        deadline = time.time() + 60
+        terminated = 0
+        while time.time() < deadline and terminated < 4:
+            terminated += scaler.update()["terminated"]
+            time.sleep(0.5)
+        assert terminated == 4
+    finally:
+        scaler.stop()
+        provider.shutdown()
+
+
+def test_slice_provider_gang_scale_up(cluster):
+    """TPU-head gang demand on a TpuSliceProvider provisions WHOLE
+    slices (one create_slice call), never individual hosts."""
+    from ray_tpu.autoscaler.node_provider import TpuSliceProvider
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    calls = []
+
+    class FakeSliceProvider(TpuSliceProvider):
+        def __init__(self):
+            self._local = LocalNodeProvider(cluster.gcs_address)
+            self._slices = {}
+
+        def create_slice(self, slice_type, num_hosts):
+            calls.append((slice_type, num_hosts))
+            names = []
+            for i in range(num_hosts):
+                res = {"CPU": 1, "TPU": 4.0}
+                if i == 0:
+                    res[f"TPU-{slice_type}-head"] = 1.0
+                names.append(self._local.create_node(res))
+            sname = f"slice-{len(self._slices)}"
+            self._slices[sname] = names
+            return sname
+
+        def delete_slice(self, name):
+            for n in self._slices.pop(name, []):
+                self._local.terminate_node(n)
+
+        def list_slices(self):
+            return list(self._slices)
+
+        def slice_nodes(self, name):
+            return list(self._slices.get(name, []))
+
+        def create_node(self, resources):
+            return self._local.create_node(resources)
+
+        def terminate_node(self, name):
+            self._local.terminate_node(name)
+
+        def non_terminated_nodes(self):
+            return self._local.non_terminated_nodes()
+
+        def node_cluster_id(self, name):
+            return self._local.node_cluster_id(name)
+
+        def shutdown(self):
+            self._local.shutdown()
+
+    provider = FakeSliceProvider()
+    scaler = StandardAutoscaler(
+        provider, cluster.gcs_address,
+        worker_resources={"CPU": 1},
+        min_workers=0, max_workers=8, idle_timeout_s=30.0)
+    try:
+        time.sleep(1.5)
+        from ray_tpu.util.placement_group import tpu_slice_bundles
+        pg = placement_group(tpu_slice_bundles("v5e", num_hosts=2),
+                             strategy="STRICT_SPREAD")
+        launched = 0
+        for _ in range(40):
+            launched += scaler.update()["launched"]
+            if launched:
+                break
+            time.sleep(0.3)
+        assert calls == [("v5e", 2)], calls
+        assert pg.wait(timeout_seconds=90)
+        remove_placement_group(pg)
+    finally:
+        scaler.stop()
+        provider.shutdown()
