@@ -19,7 +19,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.node_provider import (NodeProvider,
+                                              TpuSliceProvider)
 
 
 def _fits(avail: Dict[str, float], shape: Dict[str, float]) -> bool:
@@ -98,11 +99,15 @@ class StandardAutoscaler:
 
     def _bin_pack_new_nodes(self, shapes: List[Dict[str, float]],
                             pg_demand: List[dict],
-                            nodes: List[dict]) -> int:
+                            nodes: List[dict], budget: int) -> int:
         """First-fit-decreasing pack of the demand that existing nodes
         cannot hold into hypothetical fresh workers; returns how many
-        to launch.  STRICT_SPREAD/SPREAD gang bundles never share a
-        fresh node with a sibling bundle."""
+        to launch (<= budget).  STRICT_SPREAD/SPREAD gang bundles never
+        share a fresh node with a sibling bundle, and a gang whose
+        fresh-node need exceeds the remaining budget is dropped WHOLE —
+        launching a useless prefix would churn launch/idle-reap forever
+        (reference: resource_demand_scheduler drops over-cap gangs)."""
+        import copy
         existing = [dict(n["resources_avail"]) for n in nodes]
         fresh: List[Dict[str, float]] = []
 
@@ -122,6 +127,7 @@ class StandardAutoscaler:
             return ("f", len(fresh) - 1) if spread else None
 
         for d in pg_demand:
+            snapshot = (copy.deepcopy(existing), copy.deepcopy(fresh))
             spread = d.get("strategy", "PACK").endswith("SPREAD")
             used: set = set()
             for b in sorted(d["bundles"],
@@ -129,9 +135,14 @@ class StandardAutoscaler:
                 spot = place(b, used if spread else set(), spread)
                 if spread and spot is not None:
                     used.add(spot)
+            if len(fresh) > budget:
+                existing, fresh = snapshot    # drop the whole gang
         for shape in sorted(shapes, key=lambda s: -sum(s.values())):
+            if len(fresh) >= budget and not any(
+                    _fits(p, shape) for p in existing + fresh):
+                continue
             place(shape, set(), False)
-        return len(fresh)
+        return min(len(fresh), budget)
 
     # -- one reconcile step (unit-testable) ----------------------------
     def update(self) -> dict:
@@ -163,7 +174,6 @@ class StandardAutoscaler:
             pg_demand.extend(load.get("pg_demand") or [])
         if time.time() - self._last_launch >= self.launch_cooldown_s:
             # Gang demand on a slice provider: whole slices, atomically.
-            from ray_tpu.autoscaler.node_provider import TpuSliceProvider
             if isinstance(self.provider, TpuSliceProvider):
                 pending_ids = set()
                 for d in pg_demand:
@@ -177,12 +187,16 @@ class StandardAutoscaler:
                     pending_ids.add(pg_id)
                     if pg_id in self._slices_for_pg:
                         continue       # already provisioning this gang
+                    hosts = len(d["bundles"])
+                    current = len(self.provider.non_terminated_nodes())
+                    if current + hosts > self.max_workers:
+                        continue   # whole gang or nothing — a partial
+                                   # slice can never serve it
                     slice_type = head[len("TPU-"):-len("-head")]
-                    name = self.provider.create_slice(
-                        slice_type, len(d["bundles"]))
+                    name = self.provider.create_slice(slice_type, hosts)
                     self._slices_for_pg[pg_id] = name
                     self._last_launch = time.time()
-                    actions["launched"] += len(d["bundles"])
+                    actions["launched"] += hosts
                 # Gangs no longer pending free their tracking entry.
                 for pg_id in list(self._slices_for_pg):
                     if pg_id not in pending_ids:
@@ -192,10 +206,10 @@ class StandardAutoscaler:
                                         and k.endswith("-head")
                                         for b in d["bundles"]
                                         for k in b)]
+            budget = max(self.max_workers - len(workers), 0)
             needed = self._bin_pack_new_nodes(unfulfilled, pg_demand,
-                                              nodes)
-            budget = self.max_workers - len(workers)
-            for _ in range(min(needed, max(budget, 0))):
+                                              nodes, budget)
+            for _ in range(needed):
                 self.provider.create_node(self.worker_resources)
                 self._last_launch = time.time()
                 actions["launched"] += 1
@@ -203,10 +217,8 @@ class StandardAutoscaler:
         # Slices are atomic (TpuSliceProvider contract): release a
         # slice only when EVERY one of its hosts is idle past the
         # timeout, via delete_slice — never per-host terminate_node.
-        from ray_tpu.autoscaler.node_provider import TpuSliceProvider \
-            as _TSP
         slice_members: set = set()
-        if isinstance(self.provider, _TSP):
+        if isinstance(self.provider, TpuSliceProvider):
             by_id = {bytes(n["node_id"]): n for n in nodes}
             now = time.time()
             for sname in list(self.provider.list_slices()):
@@ -234,6 +246,11 @@ class StandardAutoscaler:
                             pass
                     self.provider.delete_slice(sname)
                     actions["terminated"] += len(members)
+                    # The gang (if still pending) must be eligible for
+                    # re-provisioning, not pinned to a dead slice.
+                    for pg_id, nm in list(self._slices_for_pg.items()):
+                        if nm == sname:
+                            del self._slices_for_pg[pg_id]
 
         # Scale-down idle provider workers past the timeout.
         if len(workers) > self.min_workers:

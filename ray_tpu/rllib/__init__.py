@@ -6,8 +6,10 @@ design notes.
 """
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig
-from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.env import CartPoleEnv, PixelCartPoleEnv, VectorEnv
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "RolloutWorker",
-           "CartPoleEnv", "VectorEnv"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
+           "IMPALAConfig", "RolloutWorker", "CartPoleEnv",
+           "PixelCartPoleEnv", "VectorEnv"]

@@ -102,3 +102,57 @@ class VectorEnv:
     def drain_episode_returns(self) -> list:
         out, self.completed_returns = self.completed_returns, []
         return out
+
+
+class PixelCartPoleEnv:
+    """CartPole with PIXEL observations: the 'CartPole -> Atari' shape
+    (BASELINE config #4) without shipping ROMs.  Each step renders the
+    cart (block) and pole (line) into a small grayscale frame; the
+    observation stacks the last two frames as channels so velocity is
+    visible (the same role as Atari frame-stacking).
+
+    Observation: [H, W, 2] float32 in [0, 1]; actions as CartPoleEnv.
+    """
+
+    H = 40
+    W = 60
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 200,
+                 seed: Optional[int] = None) -> None:
+        self._env = CartPoleEnv(max_steps=max_steps, seed=seed)
+        self._prev = np.zeros((self.H, self.W), np.float32)
+
+    @property
+    def observation_shape(self) -> Tuple[int, int, int]:
+        return (self.H, self.W, 2)
+
+    def _render(self) -> np.ndarray:
+        x, _, th, _ = self._env.state
+        f = np.zeros((self.H, self.W), np.float32)
+        # cart: 3x7 block on the bottom band, x in [-2.4, 2.4] -> col
+        cx = int((x / CartPoleEnv.X_LIMIT + 1) * 0.5 * (self.W - 1))
+        cx = min(max(cx, 3), self.W - 4)
+        f[self.H - 6:self.H - 3, cx - 3:cx + 4] = 1.0
+        # pole: line from cart top at angle th (up = -rows)
+        L = self.H - 12
+        for i in range(L):
+            r = self.H - 7 - int(i * math.cos(th))
+            c = cx + int(i * math.sin(th))
+            if 0 <= r < self.H and 0 <= c < self.W:
+                f[r, c] = 1.0
+        return f
+
+    def reset(self) -> np.ndarray:
+        self._env.reset()
+        frame = self._render()
+        self._prev = frame
+        return np.stack([frame, frame], axis=-1)
+
+    def step(self, action: int
+             ) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        _, r, done, info = self._env.step(action)
+        frame = self._render()
+        obs = np.stack([self._prev, frame], axis=-1)
+        self._prev = frame
+        return obs, r, done, info

@@ -1,0 +1,393 @@
+"""IMPALA: asynchronous actor-learner RL with V-trace correction.
+
+Reference: rllib/algorithms/impala/ — decoupled acting and learning:
+rollout workers continuously produce trajectory batches with a STALE
+policy while one learner consumes them as fast as they arrive,
+correcting the off-policy gap with V-trace (Espeholt et al. 2018).
+
+TPU-first mapping:
+  * Workers stream batches through the core STREAMING-GENERATOR plane
+    (a `stream_rollouts` generator method; items flow as produced — the
+    learner never round-trips per batch the way the synchronous PPO
+    driver does).
+  * The learner is one jitted V-trace update; weight broadcast is a
+    fire-and-forget `set_params` actor call every `broadcast_every`
+    updates (workers run with max_concurrency=2 so the swap interleaves
+    with the in-flight generator).
+  * Policies are pluggable: the MLP for state observations and a conv
+    net for PIXEL observations (rllib/env.py PixelCartPoleEnv — the
+    CartPole→Atari pixel-control shape without shipping ROMs).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import CartPoleEnv, PixelCartPoleEnv, VectorEnv
+from ray_tpu.rllib.ppo import init_policy, policy_forward
+
+
+# ---------------------------------------------------------------------------
+# conv policy (pixel observations)
+# ---------------------------------------------------------------------------
+def init_conv_policy(rng, obs_shape, num_actions: int,
+                     hidden: int = 128):
+    """obs_shape: (H, W, C).  Two stride-2 convs + dense torso."""
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.split(rng, 5)
+    H, W, C = obs_shape
+
+    def conv(key, cin, cout, k_hw):
+        scale = jnp.sqrt(2.0 / (cin * k_hw * k_hw))
+        return {"w": jax.random.normal(
+            key, (k_hw, k_hw, cin, cout)) * scale,
+            "b": jnp.zeros((cout,))}
+
+    def dense(key, n_in, n_out):
+        scale = jnp.sqrt(2.0 / n_in)
+        return {"w": jax.random.normal(key, (n_in, n_out)) * scale,
+                "b": jnp.zeros((n_out,))}
+
+    h2, w2 = (H + 1) // 2, (W + 1) // 2
+    h4, w4 = (h2 + 1) // 2, (w2 + 1) // 2
+    flat = h4 * w4 * 16
+    return {"c1": conv(k[0], C, 8, 4), "c2": conv(k[1], 8, 16, 4),
+            "fc": dense(k[2], flat, hidden),
+            "pi": dense(k[3], hidden, num_actions),
+            "vf": dense(k[4], hidden, 1)}
+
+
+def conv_policy_forward(params, obs):
+    """obs: [..., H, W, C] float32 -> (logits [..., A], value [...])."""
+    import jax
+    import jax.numpy as jnp
+
+    lead = obs.shape[:-3]
+    x = obs.reshape((-1,) + obs.shape[-3:])
+
+    def c(p, x):
+        y = jax.lax.conv_general_dilated(
+            x, p["w"], window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jax.nn.relu(y + p["b"])
+
+    x = c(params["c1"], x)
+    x = c(params["c2"], x)
+    x = x.reshape(x.shape[0], -1)
+    x = jnp.tanh(x @ params["fc"]["w"] + params["fc"]["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return (logits.reshape(lead + logits.shape[-1:]),
+            value.reshape(lead))
+
+
+# ---------------------------------------------------------------------------
+# V-trace learner update
+# ---------------------------------------------------------------------------
+def make_vtrace_update(forward, optimizer, gamma: float,
+                       rho_clip: float, c_clip: float,
+                       vf_coef: float, ent_coef: float):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, batch):
+        obs = batch["obs"]                    # [T, N, ...]
+        T = obs.shape[0]
+        all_obs = jnp.concatenate([obs, batch["last_obs"][None]], 0)
+        logits, values = forward(params, all_obs)   # [T+1, N, A]/[T+1,N]
+        logits, values = logits[:T], values
+        logp_all = jax.nn.log_softmax(logits)
+        tgt_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        rho = jnp.exp(tgt_logp - batch["logp"])
+        rho_c = jnp.minimum(rho, rho_clip)
+        cs = jnp.minimum(rho, c_clip)
+        not_done = 1.0 - batch["dones"].astype(jnp.float32)
+        v, v_next = values[:-1], values[1:]
+        deltas = rho_c * (batch["rewards"] + gamma * not_done * v_next
+                          - v)
+
+        def back(carry, inp):
+            delta, c_t, nd = inp
+            acc = delta + gamma * nd * c_t * carry
+            return acc, acc
+
+        _, adv_v = jax.lax.scan(back, jnp.zeros_like(deltas[0]),
+                                (deltas, cs, not_done), reverse=True)
+        vs = v + adv_v
+        vs_next = jnp.concatenate([vs[1:], values[-1][None]], 0)
+        pg_adv = rho_c * (batch["rewards"]
+                          + gamma * not_done * vs_next - v)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+        vs = jax.lax.stop_gradient(vs)
+
+        pg_loss = -jnp.mean(tgt_logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean((v - vs) ** 2)
+        probs = jax.nn.softmax(logits)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+        total = pg_loss + vf_coef * vf_loss - ent_coef * entropy
+        return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_rho": jnp.mean(rho)}
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def update(params, opt_state, batch):
+        import optax
+        (l, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["loss"] = l
+        return params, opt_state, metrics
+
+    return update
+
+
+# ---------------------------------------------------------------------------
+# streaming rollout worker
+# ---------------------------------------------------------------------------
+class VTraceRolloutWorker:
+    """Continuously produces rollout batches with the latest params it
+    has SEEN — a stale policy by design; V-trace corrects the gap.
+    Runs with max_concurrency=2 so set_params interleaves with the live
+    stream_rollouts generator (streaming-generator actor method)."""
+
+    def __init__(self, worker_index: int, num_envs: int,
+                 rollout_len: int, params, network: str,
+                 env_maker=None, max_steps: int = 200) -> None:
+        import jax
+
+        self._network = network
+        if network == "conv":
+            maker = env_maker or (lambda s: PixelCartPoleEnv(
+                max_steps=max_steps, seed=s))
+            self._forward = jax.jit(conv_policy_forward)
+        else:
+            maker = env_maker or (lambda s: CartPoleEnv(
+                max_steps=max_steps, seed=s))
+            self._forward = jax.jit(policy_forward)
+        self.vec = VectorEnv(maker, num_envs,
+                             seed=1000 * (worker_index + 1))
+        self.rollout_len = rollout_len
+        self.obs = self.vec.reset()
+        self.rng = jax.random.PRNGKey(worker_index)
+        self._params = params
+        self.batches_produced = 0
+
+    def set_params(self, params) -> int:
+        """Weight broadcast target (fire-and-forget from the learner)."""
+        self._params = params
+        return self.batches_produced
+
+    def stream_rollouts(self, num_batches: int):
+        """Streaming generator: one trajectory batch per yield."""
+        for _ in range(num_batches):
+            yield self._sample()
+            self.batches_produced += 1
+
+    def _sample(self) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        params = self._params        # snapshot for the whole batch
+        T, N = self.rollout_len, self.vec.num_envs
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        for t in range(T):
+            logits, _ = self._forward(params, jnp.asarray(self.obs))
+            self.rng, key = jax.random.split(self.rng)
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(N), action]
+            obs_buf[t] = self.obs
+            act_buf[t] = np.asarray(action)
+            logp_buf[t] = np.asarray(logp)
+            self.obs, rew_buf[t], done_buf[t] = self.vec.step(
+                np.asarray(action))
+        return {"obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+                "rewards": rew_buf, "dones": done_buf,
+                "last_obs": self.obs.astype(np.float32),
+                "episode_returns": self.vec.drain_episode_returns()}
+
+
+# ---------------------------------------------------------------------------
+# config + algorithm
+# ---------------------------------------------------------------------------
+@dataclass
+class IMPALAConfig:
+    num_rollout_workers: int = 2
+    num_envs_per_worker: int = 4
+    rollout_len: int = 64
+    lr: float = 5e-4
+    gamma: float = 0.99
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    broadcast_every: int = 1
+    network: str = "mlp"             # "mlp" | "conv" (pixel obs)
+    env_maker: Optional[Callable] = None
+    env_max_steps: int = 200
+    hidden: int = 64
+    seed: int = 0
+
+    def rollouts(self, **kw) -> "IMPALAConfig":
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def training(self, **kw) -> "IMPALAConfig":
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def environment(self, **kw) -> "IMPALAConfig":
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALA:
+    """Async actor-learner driver: workers stream rollout batches into
+    a learner queue (core streaming generators); the learner applies
+    V-trace updates as batches arrive and broadcasts weights back."""
+
+    def __init__(self, config: IMPALAConfig) -> None:
+        import jax
+        import optax
+
+        self.config = config
+        rng = jax.random.PRNGKey(config.seed)
+        self._rng, init_rng = jax.random.split(rng)
+        if config.network == "conv":
+            probe_env = (config.env_maker or (
+                lambda s: PixelCartPoleEnv(
+                    max_steps=config.env_max_steps, seed=s)))(0)
+            self.params = init_conv_policy(
+                init_rng, probe_env.reset().shape,
+                probe_env.num_actions, hidden=config.hidden)
+            forward = conv_policy_forward
+        else:
+            probe_env = (config.env_maker or (
+                lambda s: CartPoleEnv(
+                    max_steps=config.env_max_steps, seed=s)))(0)
+            self.params = init_policy(
+                init_rng, probe_env.reset().shape[0],
+                probe_env.num_actions, hidden=config.hidden)
+            forward = policy_forward
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_vtrace_update(
+            forward, self.optimizer, config.gamma, config.rho_clip,
+            config.c_clip, config.vf_coef, config.ent_coef)
+        import jax as _jax
+        host_params = _jax.device_get(self.params)
+        cls = ray_tpu.remote(VTraceRolloutWorker)
+        self.workers = [
+            cls.options(max_concurrency=2).remote(
+                i, config.num_envs_per_worker, config.rollout_len,
+                host_params, config.network, config.env_maker,
+                config.env_max_steps)
+            for i in range(config.num_rollout_workers)]
+        self.updates = 0
+        self._reward_window: List[float] = []
+
+    def train_async(self, num_updates: int) -> Dict[str, Any]:
+        """Run the async loop until `num_updates` learner updates have
+        been applied; returns aggregate metrics including learner
+        throughput."""
+        import jax
+        import jax.numpy as jnp
+
+        # `num_updates` is a TOTAL across the algorithm's life (train
+        # calls accumulate, Algorithm.train semantics).
+        needed = num_updates - self.updates
+        if needed <= 0:
+            return {"num_updates": self.updates,
+                    "episode_reward_mean": (
+                        float(np.mean(self._reward_window))
+                        if self._reward_window else 0.0),
+                    "env_steps": 0, "learner_steps_per_s": 0.0,
+                    "updates_per_s": 0.0, "wall_s": 0.0}
+        per_worker = -(-needed // len(self.workers))
+        gens = [w.stream_rollouts.options(
+            num_returns="streaming").remote(per_worker)
+            for w in self.workers]
+        batch_q: "queue.Queue" = queue.Queue(maxsize=4)
+
+        def drain(gen) -> None:
+            try:
+                for ref in gen:
+                    batch_q.put(ray_tpu.get(ref))
+            except Exception as e:          # surface on the learner
+                batch_q.put(e)
+
+        threads = [threading.Thread(target=drain, args=(g,),
+                                    daemon=True) for g in gens]
+        for t in threads:
+            t.start()
+
+        t0 = time.time()
+        steps = 0
+        metrics: Dict[str, Any] = {}
+        while self.updates < num_updates:
+            batch = batch_q.get(timeout=300)
+            if isinstance(batch, Exception):
+                raise batch
+            self._reward_window.extend(batch.pop("episode_returns"))
+            self._reward_window = self._reward_window[-100:]
+            steps += batch["rewards"].size
+            jb = {k: jnp.asarray(v) for k, v in batch.items()}
+            self.params, self.opt_state, metrics = self._update(
+                self.params, self.opt_state, jb)
+            self.updates += 1
+            if self.updates % self.config.broadcast_every == 0:
+                host = jax.device_get(self.params)
+                pref = ray_tpu.put(host)
+                for w in self.workers:
+                    w.set_params.remote(pref)   # fire and forget
+        wall = time.time() - t0
+        # Per-worker batch counts round up, so up to W-1 surplus
+        # batches may still be in flight; drain them so no producer
+        # thread blocks forever on a full queue.
+        while any(t.is_alive() for t in threads):
+            try:
+                batch_q.get(timeout=0.2)
+            except queue.Empty:
+                pass
+        for t in threads:
+            t.join(timeout=60)
+        return {
+            "num_updates": self.updates,
+            "episode_reward_mean": (float(np.mean(self._reward_window))
+                                    if self._reward_window else 0.0),
+            "env_steps": steps,
+            "learner_steps_per_s": round(steps / max(wall, 1e-9), 1),
+            "updates_per_s": round(needed / max(wall, 1e-9), 2),
+            "wall_s": round(wall, 2),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
