@@ -1,0 +1,287 @@
+"""Placement groups: creation/2PC reserve-commit/bundle accounting.
+
+Mixin split out of node_service.py (reference:
+python/ray/util/placement_group.py:41 API, 2PC at
+src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h:283).  Shares
+NodeService's state and lock; see node_objects.py for the split
+rationale.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization as ser
+from ray_tpu import exceptions as exc
+from ray_tpu._private.node_state import (
+    Bundle, FAILED, ObjectEntry, PENDING, TaskRecord, _ConnCtx,
+    _place_bundles)
+
+
+class PlacementGroupMixin:
+    # ------------------------------------------------------------------
+    # placement groups (reference: python/ray/util/placement_group.py:41,
+    # 2PC at src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h:283)
+    # ------------------------------------------------------------------
+    def _h_create_pg(self, ctx: _ConnCtx, m: dict) -> None:
+        pg_id = m["pg_id"]
+        with self.lock:
+            rec = {"bundles": m["bundles"], "strategy": m["strategy"],
+                   "name": m.get("name"), "ready_oid": m["ready_oid"],
+                   "state": "pending", "nodes": None}
+            self.pgs[pg_id] = rec
+            e = self.objects.setdefault(m["ready_oid"], ObjectEntry())
+            e.refcount = max(e.refcount, 1)
+        threading.Thread(target=self._pg_create_loop, args=(pg_id,),
+                         daemon=True, name="rtpu-pg-create").start()
+        ctx.reply(m, {"ok": True})
+
+    def _h_remove_pg(self, ctx: _ConnCtx, m: dict) -> None:
+        pg_id = m["pg_id"]
+        with self.lock:
+            rec = self.pgs.get(pg_id)
+            if rec is None:
+                ctx.reply(m, {"ok": False})
+                return
+            was_pending = rec["state"] == "pending"
+            rec["state"] = "removed"
+            if was_pending:
+                # Resolve pg.ready() waiters instead of hanging them.
+                blob = ser.dumps(ValueError(
+                    "placement group was removed before it was placed"))
+                self._register_object(rec["ready_oid"], "error", blob,
+                                      len(blob), state=FAILED)
+            nodes = rec["nodes"] or []
+            local = [(i, n) for i, n in enumerate(nodes)
+                     if n == self.node_id]
+            remote = [(i, n) for i, n in enumerate(nodes)
+                      if n != self.node_id]
+            for i, _ in local:
+                self._return_bundle_local(pg_id, i)
+            self._schedule()
+        for i, nid in remote:
+            ninfo = self._node_info(nid)
+            if ninfo is not None:
+                try:
+                    self._peer_conn_to(ninfo).notify(
+                        {"type": "return_bundle", "pg_id": pg_id,
+                         "bundle_index": i})
+                except Exception:
+                    pass
+        ctx.reply(m, {"ok": True})
+
+    def _h_pg_state(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            rec = self.pgs.get(m["pg_id"])
+            ctx.reply(m, {"state": rec["state"] if rec else "unknown",
+                          "nodes": rec["nodes"] if rec else None})
+
+    def _h_reserve_bundle(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            ok = self._reserve_bundle_local(
+                m["pg_id"], m["bundle_index"], m["resources"])
+        ctx.reply(m, {"ok": ok})
+
+    def _h_return_bundle(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            self._return_bundle_local(m["pg_id"], m["bundle_index"])
+            self._schedule()
+
+    def _reserve_bundle_local(self, pg_id: bytes, idx: int,
+                              res: Dict[str, float]) -> bool:
+        """Phase-1 reserve: carve the bundle out of this node's available
+        pool.  Caller holds self.lock."""
+        key = (pg_id, idx)
+        if key in self.bundles:
+            return True     # idempotent (2PC retry)
+        if not self._take(res):
+            return False
+        self.bundles[key] = Bundle(res)
+        return True
+
+    def _return_bundle_local(self, pg_id: bytes, idx: int) -> None:
+        """Release a bundle back to the node pool.  Running tasks keep
+        their share until completion (their give-back routes to the node
+        pool once the bundle is gone).  Caller holds self.lock."""
+        b = self.bundles.pop((pg_id, idx), None)
+        if b is not None:
+            self._give_back(b.free)
+
+    def _pg_create_loop(self, pg_id: bytes) -> None:
+        """Coordinator: place bundles, 2PC reserve/commit, retrying while
+        resources are transiently busy; fails the ready object if no
+        placement can ever exist."""
+        while not self._shutdown:
+            with self.lock:
+                rec = self.pgs.get(pg_id)
+                if rec is None or rec["state"] != "pending":
+                    return
+                bundles = rec["bundles"]
+                strategy = rec["strategy"]
+                my_avail = dict(self.resources_avail)
+                my_total = dict(self.resources_total)
+            view = [{"node_id": self.node_id, "self": True,
+                     "resources_avail": my_avail,
+                     "resources_total": my_total, "state": "alive"}]
+            if self.multinode:
+                view += [n for n in self._cluster_view
+                         if n.get("state") == "alive"
+                         and n["node_id"] != self.node_id]
+            assignment = _place_bundles(bundles, strategy, view,
+                                        use_avail=True)
+            if assignment is None:
+                if _place_bundles(bundles, strategy, view,
+                                  use_avail=False) is None:
+                    # No placement even against TOTALS.  With a live
+                    # autoscaler lease the gang stays PENDING as
+                    # demand (the heartbeat carries it; the autoscaler
+                    # bin-packs whole node sets for it) — otherwise
+                    # fail fast (reference: infeasible PG handling vs
+                    # autoscaler demand).
+                    if self._autoscaler_live():
+                        time.sleep(0.2)
+                        continue
+                    blob = ser.dumps(exc.InfeasibleResourceError(
+                        f"placement group {pg_id.hex()[:8]} "
+                        f"({strategy}, {bundles}) cannot fit on any "
+                        f"node combination"))
+                    with self.lock:
+                        rec["state"] = "failed"
+                        self._register_object(rec["ready_oid"], "error",
+                                              blob, len(blob),
+                                              state=FAILED)
+                    return
+                time.sleep(0.1)
+                continue
+            if self._pg_try_commit(pg_id, rec, bundles, assignment):
+                return
+            time.sleep(0.1)
+
+    def _pg_try_commit(self, pg_id: bytes, rec: dict, bundles: List[dict],
+                       assignment: List[dict]) -> bool:
+        """2PC: reserve every bundle on its assigned node; roll back all
+        on any failure."""
+        reserved: List[Tuple[int, dict]] = []
+        ok = True
+        for idx, target in enumerate(assignment):
+            if target.get("self"):
+                with self.lock:
+                    got = self._reserve_bundle_local(pg_id, idx,
+                                                     bundles[idx])
+            else:
+                try:
+                    got = self._peer_conn_to(target).call(
+                        {"type": "reserve_bundle", "pg_id": pg_id,
+                         "bundle_index": idx,
+                         "resources": bundles[idx]},
+                        timeout=10.0)["ok"]
+                except Exception:
+                    got = False
+            if not got:
+                ok = False
+                break
+            reserved.append((idx, target))
+        if not ok:
+            for idx, target in reserved:
+                if target.get("self"):
+                    with self.lock:
+                        self._return_bundle_local(pg_id, idx)
+                else:
+                    try:
+                        self._peer_conn_to(target).notify(
+                            {"type": "return_bundle", "pg_id": pg_id,
+                             "bundle_index": idx})
+                    except Exception:
+                        pass
+            return False
+        blob = ser.dumps(True)
+        rollback: List[Tuple[int, dict]] = []
+        with self.lock:
+            if rec["state"] != "pending":
+                # remove_placement_group raced the commit: undo the
+                # reserves instead of resurrecting a removed PG.
+                rollback = reserved
+            else:
+                rec["nodes"] = [t["node_id"] for t in assignment]
+                rec["state"] = "created"
+                self._register_object(rec["ready_oid"], "inline", blob,
+                                      len(blob))
+                self._schedule()
+        for idx, target in rollback:
+            if target.get("self"):
+                with self.lock:
+                    self._return_bundle_local(pg_id, idx)
+            else:
+                try:
+                    self._peer_conn_to(target).notify(
+                        {"type": "return_bundle", "pg_id": pg_id,
+                         "bundle_index": idx})
+                except Exception:
+                    pass
+        return True
+
+    def _create_actor_with_pg(self, ctx: _ConnCtx, m: dict) -> None:
+        """Wait for the actor's placement group to commit, then create
+        the actor locally or forward the whole creation to the bundle's
+        node (side thread; replies to the original create_actor call)."""
+        spec = m["spec"]
+        pg = spec["pg"]
+        deadline = time.time() + 120.0
+        target: Optional[bytes] = None
+        while time.time() < deadline and not self._shutdown:
+            with self.lock:
+                rec = self.pgs.get(pg["id"])
+                state = rec["state"] if rec else "unknown"
+                target = self._pg_bundle_node(pg) if rec else None
+            if state == "created":
+                break
+            if state in ("failed", "removed", "unknown"):
+                ctx.reply(m, {"__error__": ValueError(
+                    f"placement group is {state}")})
+                return
+            time.sleep(0.05)
+        else:
+            ctx.reply(m, {"__error__": TimeoutError(
+                "placement group did not become ready within 120s")})
+            return
+        if target is None or target == self.node_id or not self.multinode:
+            # Bundle is local (or single-node): run the normal creation
+            # path — the bundle check at the top will now pass.
+            self._h_create_actor(ctx, m)
+            return
+        ninfo = self._node_info(target)
+        if ninfo is None:
+            ctx.reply(m, {"__error__": RuntimeError(
+                "placement group bundle's node is gone")})
+            return
+        actor_id = spec["actor_id"]
+        self._actor_homes[actor_id] = target
+        spec2 = dict(spec)
+        spec2["creation_task"] = dict(spec2["creation_task"])
+        spec2["creation_task"]["owner_node"] = self.node_id
+        crec = TaskRecord(spec2["creation_task"])
+        with self.lock:
+            self.forwarded[crec.task_id] = (crec, target)
+        try:
+            conn = self._peer_conn_to(ninfo)
+            conn.call({"type": "create_actor", "spec": spec2},
+                      timeout=30.0)
+            ctx.reply(m, {"ok": True})
+        except Exception as e:
+            self._actor_homes.pop(actor_id, None)
+            with self.lock:
+                self.forwarded.pop(crec.task_id, None)
+            ctx.reply(m, {"__error__": e})
+
+    def _pg_bundle_node(self, pg: dict) -> Optional[bytes]:
+        """Home node of a pg bundle, from the coordinator record.  Caller
+        holds self.lock; returns None while the PG is still pending."""
+        rec = self.pgs.get(pg["id"])
+        if rec is None or rec["nodes"] is None:
+            return None
+        try:
+            return rec["nodes"][pg["bundle"]]
+        except IndexError:
+            return None

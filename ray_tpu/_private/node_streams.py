@@ -1,0 +1,389 @@
+"""Streaming generators + the compiled-DAG channel plane.
+
+Mixin split out of node_service.py (reference: streaming generator
+returns in core_worker task_manager; channels
+experimental/channel/shared_memory_channel.py).  Shares NodeService's
+state and lock; see node_objects.py for the split rationale.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.node_state import (
+    FAILED, READY, _ConnCtx)
+
+
+class StreamChannelMixin:
+    # -- streaming generators (reference: streaming generator returns) --
+    def _stream_rec(self, stream_id: bytes) -> dict:
+        rec = self._streams.get(stream_id)
+        if rec is None:
+            rec = {"items": [], "done": False, "released": False,
+                   "waiters": [], "dropped_upto": 0}
+            self._streams[stream_id] = rec
+        return rec
+
+    def _advance_stream(self, rec: dict, upto: int) -> None:
+        """Drop the stream's creation pins for items the consumer has
+        moved past.  Safe ordering: the consumer's borrow add_ref for
+        item i is notified on the same connection BEFORE its
+        stream_next(i+1), so by the time we process that call the
+        borrow is counted.  Keeps store usage O(in-flight), not
+        O(total items streamed).  Caller holds the lock."""
+        upto = min(upto, len(rec["items"]))
+        for pos in range(rec["dropped_upto"], upto):
+            self._decref(rec["items"][pos])
+        rec["dropped_upto"] = max(rec["dropped_upto"], upto)
+
+    def _h_stream_yield(self, ctx: _ConnCtx, m: dict) -> None:
+        oid, loc, data, size, embedded = m["item"]
+        with self.lock:
+            self._register_object(oid, loc, data, size,
+                                  embedded=embedded, creator_pid=ctx.pid)
+            rec = self._stream_rec(m["stream_id"])
+            if rec["released"]:
+                # Consumer is gone but the task still produces: drop the
+                # item's creation pin immediately or it leaks forever.
+                self._decref(oid)
+            else:
+                rec["items"].append(oid)
+                self._fire_stream_waiters(rec)
+            self._schedule()
+
+    def _fire_stream_waiters(self, rec: dict) -> None:
+        """Answer parked stream_next calls that can now be satisfied.
+        Caller holds the lock."""
+        still = []
+        for idx, ctx, msg in rec["waiters"]:
+            if idx < len(rec["items"]):
+                ctx.reply(msg, {"status": "item",
+                                "object_id": rec["items"][idx]})
+            elif rec["done"]:
+                ctx.reply(msg, {"status": "end"})
+            else:
+                still.append((idx, ctx, msg))
+        rec["waiters"] = still
+
+    def finish_stream(self, stream_id: bytes) -> None:
+        """Completion object resolved (success or failure): wake every
+        parked consumer.  Caller holds the lock."""
+        rec = self._streams.get(stream_id)
+        if rec is None:
+            return
+        rec["done"] = True
+        self._fire_stream_waiters(rec)
+        if rec["released"]:
+            self._streams.pop(stream_id, None)
+
+    def _h_stream_next(self, ctx: _ConnCtx, m: dict) -> None:
+        """Parked reply (no busy-poll): the answer goes out when the
+        item arrives or the stream finishes."""
+        with self.lock:
+            rec = self._streams.get(m["stream_id"])
+            idx = m["index"]
+            if rec is not None:
+                # Asking for item idx means items < idx are consumed.
+                self._advance_stream(rec, idx)
+            if rec is not None and idx < len(rec["items"]):
+                ctx.reply(m, {"status": "item",
+                              "object_id": rec["items"][idx]})
+                return
+            done = rec["done"] if rec is not None else False
+            if not done:
+                e = self.objects.get(m["stream_id"])
+                done = e is not None and e.state in (READY, FAILED)
+            if done:
+                ctx.reply(m, {"status": "end"})
+                return
+            self._stream_rec(m["stream_id"])["waiters"].append(
+                (idx, ctx, m))
+
+    def _h_stream_release(self, ctx: _ConnCtx, m: dict) -> None:
+        """Consumer dropped its generator: release the stream's item
+        holds (each item was born with the creation pin).  A tombstone
+        stays until the producing task completes so late yields are
+        dropped instead of resurrecting the record."""
+        with self.lock:
+            rec = self._streams.get(m["stream_id"])
+            if rec is None:
+                rec = self._stream_rec(m["stream_id"])
+            for oid in rec["items"][rec["dropped_upto"]:]:
+                self._decref(oid)
+            rec["items"] = []
+            rec["dropped_upto"] = 0
+            rec["released"] = True
+            rec["waiters"] = []
+            done = rec["done"]
+            if not done:
+                # A stream that never recorded completion (e.g. zero
+                # yields, or failure before the first yield): consult
+                # the completion object so the tombstone doesn't leak.
+                e = self.objects.get(m["stream_id"])
+                done = e is not None and e.state in (READY, FAILED)
+            if done:
+                self._streams.pop(m["stream_id"], None)
+
+    # -- compiled-DAG channel plane (cross-node channels) ---------------
+    # Reference: python/ray/experimental/channel/shared_memory_channel.py
+    # (cross-process channels) + dag/collective_node.py.  Queues are
+    # keyed cluster-wide and live on the consumer's node; a producer on
+    # another node chan_sends through its local node, which forwards
+    # over the persistent peer connection.  Backpressure = parked
+    # replies once `cap` items are queued.
+    def _dag_queue_rec(self, key: bytes, cap: int = 8) -> dict:
+        rec = self._dag_queues.get(key)
+        if rec is None:
+            rec = {"items": deque(), "closed": False, "cap": cap,
+                   "recv_waiters": [], "send_waiters": []}
+            self._dag_queues[key] = rec
+        return rec
+
+    def _h_chan_send(self, ctx: _ConnCtx, m: dict) -> None:
+        dst = m["dst"]
+        if dst == self.node_id or not self.multinode:
+            self._chan_deliver(ctx, m)
+            return
+        ninfo = self._node_info(dst)
+        if ninfo is None:
+            ctx.reply(m, {"ok": False, "closed": True,
+                          "error": "destination node is gone"})
+            return
+        # One persistent forwarder per (destination, channel key): off
+        # this connection's thread (a backpressured remote queue must
+        # not stall its other RPCs), strictly FIFO per channel
+        # (thread-per-message could reorder two sends racing onto the
+        # shared peer connection), and NOT shared across channels — a
+        # single per-destination forwarder would head-of-line-block
+        # every channel to that node behind one backpressured queue
+        # (deadlocking collectives whose consumer waits on a sibling
+        # channel).  Threads exit after 60s idle.
+        fkey = (dst, m["key"])
+        with self._peer_lock:
+            q = self._chan_fwd_queues.get(fkey)
+            if q is None:
+                q = queue.Queue()
+                self._chan_fwd_queues[fkey] = q
+                threading.Thread(target=self._chan_fwd_loop,
+                                 args=(fkey, q), daemon=True,
+                                 name="rtpu-chan-fwd").start()
+        q.put((ctx, m, ninfo))
+
+    def _chan_fwd_loop(self, fkey, q: "queue.Queue") -> None:
+        dst, _ = fkey
+        idle = 0
+        while not self._shutdown:
+            try:
+                ctx, m, ninfo = q.get(timeout=0.5)
+            except queue.Empty:
+                idle += 1
+                if idle > 120:        # ~60s idle: retire the thread
+                    with self._peer_lock:
+                        if q.empty():
+                            self._chan_fwd_queues.pop(fkey, None)
+                            return
+                continue
+            idle = 0
+            try:
+                rep = self._peer_conn_to(ninfo).call(
+                    {"type": "chan_send", "dst": dst, "key": m["key"],
+                     "payload": m["payload"], "cap": m.get("cap", 8)},
+                    timeout=120.0)
+            except Exception as e:
+                rep = {"ok": False, "closed": True, "error": str(e)}
+            try:
+                ctx.reply(m, rep)
+            except Exception:
+                pass
+
+    def _chan_deliver(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            rec = self._dag_queue_rec(m["key"], m.get("cap", 8))
+            # The consumer's first recv creates the record with the
+            # default cap; the producer carries the DAG's real
+            # capacity — let it win.
+            rec["cap"] = m.get("cap", rec["cap"])
+            if rec["closed"]:
+                ctx.reply(m, {"ok": False, "closed": True})
+                return
+            while rec["recv_waiters"]:
+                w = rec["recv_waiters"].pop(0)
+                if not w["live"]:
+                    continue
+                w["live"] = False
+                w["ctx"].reply(w["m"], {"ok": True,
+                                        "payload": m["payload"]})
+                ctx.reply(m, {"ok": True})
+                return
+            if len(rec["items"]) >= rec["cap"]:
+                rec["send_waiters"].append((ctx, m))
+                return
+            rec["items"].append(m["payload"])
+            ctx.reply(m, {"ok": True})
+
+    def _h_chan_recv(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            rec = self._dag_queue_rec(m["key"])
+            if rec["items"]:
+                payload = rec["items"].popleft()
+                # A freed slot admits one parked sender.
+                if rec["send_waiters"]:
+                    sctx, sm = rec["send_waiters"].pop(0)
+                    rec["items"].append(sm["payload"])
+                    sctx.reply(sm, {"ok": True})
+                ctx.reply(m, {"ok": True, "payload": payload})
+                return
+            if rec["closed"]:
+                ctx.reply(m, {"ok": False, "closed": True})
+                return
+            waiter = {"ctx": ctx, "m": m, "live": True}
+            rec["recv_waiters"].append(waiter)
+            block_ms = m.get("block_ms")
+            if block_ms is not None:
+                # Node-side expiry: the reply ALWAYS comes from under
+                # the lock — either an item, closed, or this timeout —
+                # so a client that stops waiting never strands a parked
+                # reply that would otherwise swallow a delivered item.
+                def expire() -> None:
+                    with self.lock:
+                        if not waiter["live"]:
+                            return
+                        waiter["live"] = False
+                        try:
+                            rec["recv_waiters"].remove(waiter)
+                        except ValueError:
+                            pass
+                    try:
+                        ctx.reply(m, {"ok": False, "timeout": True})
+                    except Exception:
+                        pass
+
+                self._deadline_waiters.append(
+                    (time.time() + block_ms / 1000.0, expire))
+
+    def _h_chan_close(self, ctx: _ConnCtx, m: dict) -> None:
+        dst = m["dst"]
+        if dst is not None and dst != self.node_id and self.multinode:
+            ninfo = self._node_info(dst)
+            if ninfo is not None:
+                try:
+                    self._peer_conn_to(ninfo).call(
+                        {"type": "chan_close", "dst": dst,
+                         "key": m["key"]}, timeout=10.0)
+                except Exception:
+                    pass
+            ctx.reply(m, {"ok": True})
+            return
+        with self.lock:
+            rec = self._dag_queue_rec(m["key"])
+            rec["closed"] = True
+            rec["items"].clear()
+            recvs = [w for w in rec["recv_waiters"] if w["live"]]
+            for w in recvs:
+                w["live"] = False
+            sends = rec["send_waiters"]
+            rec["recv_waiters"] = []
+            rec["send_waiters"] = []
+            for w in recvs:
+                try:
+                    w["ctx"].reply(w["m"], {"ok": False, "closed": True})
+                except Exception:
+                    pass
+            for sctx, sm in sends:
+                try:
+                    sctx.reply(sm, {"ok": False, "closed": True})
+                except Exception:
+                    pass
+        ctx.reply(m, {"ok": True})
+
+    def _h_actor_node(self, ctx: _ConnCtx, m: dict) -> None:
+        """Which node hosts this actor (compiled-DAG channel routing)."""
+        aid = m["actor_id"]
+        with self.lock:
+            if aid in self.actors:
+                ctx.reply(m, {"node_id": self.node_id})
+                return
+            home = self._actor_homes.get(aid)
+        if home is None and self.multinode:
+            try:
+                home = self.gcs.get_actor_node(aid)
+            except Exception:
+                home = None
+        ctx.reply(m, {"node_id": home if home is not None
+                      else self.node_id})
+
+    def _h_profile_event(self, ctx: _ConnCtx, m: dict) -> None:
+        """Custom user span from ray_tpu.util.profiling.span()."""
+        ev = dict(m["event"])
+        ev["node_id"] = self.node_id.hex()
+        self._events.append(ev)
+
+    def _h_timeline(self, ctx: _ConnCtx, m: dict) -> None:
+        events = list(self._events)
+        if m.get("cluster") and self.multinode:
+            replies, _ = self._fanout_peers({"type": "timeline",
+                                             "cluster": False})
+            for _, peer in replies:
+                events.extend(peer["events"])
+        ctx.reply(m, {"events": events})
+
+    def _h_metrics_push(self, ctx: _ConnCtx, m: dict) -> None:
+        """Merge a batch of metric series from a worker/driver process.
+        Counters accumulate deltas, gauges keep the latest value,
+        histograms merge bucket counts."""
+        with self.lock:
+            for s in m["series"]:
+                key = (s["name"], s["kind"],
+                       tuple(sorted(s.get("tags", {}).items())))
+                cur = self._metrics.get(key)
+                if cur is None:
+                    cur = {"name": s["name"], "kind": s["kind"],
+                           "tags": dict(s.get("tags", {})),
+                           "value": 0.0, "buckets": {}, "sum": 0.0,
+                           "count": 0.0,
+                           "description": s.get("description", "")}
+                    self._metrics[key] = cur
+                if s["kind"] == "counter":
+                    cur["value"] += s["value"]
+                elif s["kind"] == "gauge":
+                    cur["value"] = s["value"]
+                else:  # histogram
+                    for b, c in s.get("buckets", {}).items():
+                        cur["buckets"][b] = cur["buckets"].get(b, 0) + c
+                    cur["sum"] += s.get("sum", 0.0)
+                    cur["count"] += s.get("count", 0.0)
+        ctx.reply(m, {"ok": True})
+
+    def _h_metrics_scrape(self, ctx: _ConnCtx, m: dict) -> None:
+        """All aggregated series + built-in runtime gauges."""
+        with self.lock:
+            series = [dict(v, buckets=dict(v["buckets"]))
+                      for v in self._metrics.values()]
+            builtin = {
+                "ray_tpu_tasks_pending": float(len(self.pending_queue)),
+                "ray_tpu_tasks_total": float(len(self.tasks)),
+                "ray_tpu_actors_alive": float(
+                    sum(1 for a in self.actors.values()
+                        if a.state == "alive")),
+                "ray_tpu_workers": float(len(self.workers)),
+                "ray_tpu_objects_local": float(len(self.objects)),
+            }
+        stats = self._store().stats()
+        builtin["ray_tpu_object_store_bytes_used"] = float(
+            stats.get("used_bytes", 0))
+        builtin["ray_tpu_object_store_capacity_bytes"] = float(
+            stats.get("capacity_bytes", 0))
+        for name, val in builtin.items():
+            series.append({"name": name, "kind": "gauge", "tags": {},
+                           "value": val, "buckets": {}, "sum": 0.0,
+                           "count": 0.0,
+                           "description": "ray_tpu runtime built-in"})
+        ctx.reply(m, {"series": series})
+
+    def _h_shutdown(self, ctx: _ConnCtx, m: dict) -> None:
+        ctx.reply(m, {"ok": True})
+        threading.Thread(target=self.shutdown, daemon=True).start()
