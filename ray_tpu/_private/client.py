@@ -303,6 +303,13 @@ class CoreClient:
         }
         if actor_spec_extra:
             spec.update(actor_spec_extra)
+        # Wire diet on the hottest rpc: these keys are only ever read
+        # back via spec.get(...) server-side, so absent == default.
+        # (actor_id/pg/resources are accessed directly and must stay.)
+        for k in ("method_name", "runtime_env", "affinity",
+                  "is_actor_creation"):
+            if not spec.get(k):
+                del spec[k]
         # One-way submit: return ids are generated client-side and any
         # failure (infeasible, worker crash) is delivered through the
         # return objects — no reply to wait for.  This is what makes
@@ -542,6 +549,14 @@ class CoreClient:
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
         return self.conn.call({"type": "kv_get", "ns": ns,
                                "key": key})["value"]
+
+    def kv_wait(self, ns: str, key: bytes,
+                timeout: float) -> Optional[bytes]:
+        """Blocking kv read: parked node-side until the key exists or
+        `timeout` elapses (returns None)."""
+        return self.conn.call({"type": "kv_wait", "ns": ns, "key": key,
+                               "timeout": timeout},
+                              timeout=timeout + 20.0)["value"]
 
     def kv_del(self, ns: str, key: bytes) -> bool:
         return self.conn.call({"type": "kv_del", "ns": ns, "key": key})["ok"]

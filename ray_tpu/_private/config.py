@@ -127,6 +127,9 @@ _D("testing_rpc_failure", str, "",
 _D("testing_asio_delay_us", str, "",
    "Chaos: 'method:min:max' artificial delays in message dispatch "
    "(reference: RAY_testing_asio_delay_us).")
+_D("object_store_prefault", bool, True,
+   "Write-touch every store page at creation so puts never pay "
+   "first-touch page faults (~4x single-copy put bandwidth).")
 _D("object_spilling_enabled", bool, True,
    "Spill sealed objects to disk when the store fills (reference: "
    "automatic_object_spilling_enabled).")

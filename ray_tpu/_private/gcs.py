@@ -90,6 +90,10 @@ class GlobalControlState:
         self._actor_nodes: Dict[bytes, bytes] = {}  # actor_id -> node_id
         # subscriptions (server wires these to connection pushes)
         self._loc_subs: Dict[bytes, List[Callable[[bytes, dict], None]]] = {}
+        # kv_wait parking: (ns, key) -> callbacks fired on the next put
+        # (the long-poll primitive process collectives block on instead
+        # of 2ms polling; reference: pubsub long-poll, src/ray/pubsub/)
+        self._kv_waiters: Dict[tuple, List[Callable[[bytes], None]]] = {}
         self._node_subs: List[Callable[[str, dict], None]] = []
         self._wal = None
         if persist_dir:
@@ -152,7 +156,13 @@ class GlobalControlState:
             table[key] = value
             if ns in self._durable_ns:
                 self._log("kv_put", ns, key, value)
-            return True
+            waiters = self._kv_waiters.pop((ns, key), [])
+        for cb in waiters:          # outside the lock: cbs do IO
+            try:
+                cb(value)
+            except Exception:
+                pass
+        return True
 
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
         with self._lock:
@@ -164,6 +174,29 @@ class GlobalControlState:
             if hit and ns in self._durable_ns:
                 self._log("kv_del", ns, key)
             return hit
+
+    def kv_wait_register(self, ns: str, key: bytes,
+                         cb: Callable[[bytes], None]
+                         ) -> Optional[bytes]:
+        """Return the value if present, else park `cb` for the next
+        kv_put of this key."""
+        with self._lock:
+            v = self._kv.get(ns, {}).get(key)
+            if v is not None:
+                return v
+            self._kv_waiters.setdefault((ns, key), []).append(cb)
+            return None
+
+    def kv_wait_unregister(self, ns: str, key: bytes, cb) -> None:
+        with self._lock:
+            lst = self._kv_waiters.get((ns, key))
+            if lst is not None:
+                try:
+                    lst.remove(cb)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self._kv_waiters[(ns, key)]
 
     def kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
         with self._lock:

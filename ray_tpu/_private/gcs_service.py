@@ -172,6 +172,42 @@ class GcsServer:
     def _h_kv_get(self, conn, m):
         conn.reply(m, {"value": self.state.kv_get(m["ns"], m["key"])})
 
+    def _h_kv_wait(self, conn, m):
+        """Parked reply until the key exists or `timeout` elapses (the
+        long-poll that replaces client-side kv polling)."""
+        import threading as _th
+        ns, key = m["ns"], m["key"]
+        timeout = m.get("timeout", 60.0)
+        fired = _th.Event()
+        timer_box = []
+
+        def cb(value):
+            if fired.is_set():
+                return
+            fired.set()
+            if timer_box:           # don't leave a dead timer thread
+                timer_box[0].cancel()
+            try:
+                conn.reply(m, {"value": value})
+            except Exception:
+                pass
+
+        val = self.state.kv_wait_register(ns, key, cb)
+        if val is not None:
+            conn.reply(m, {"value": val})
+            return
+
+        def expire():
+            if fired.is_set():
+                return
+            self.state.kv_wait_unregister(ns, key, cb)
+            cb(None)
+
+        t = _th.Timer(max(timeout, 0.001), expire)
+        t.daemon = True
+        timer_box.append(t)
+        t.start()
+
     def _h_kv_del(self, conn, m):
         conn.reply(m, {"ok": self.state.kv_del(m["ns"], m["key"])})
 
@@ -318,6 +354,11 @@ class GcsClient:
         return self.conn.call({"type": "kv_put", "ns": ns, "key": key,
                                "value": value,
                                "overwrite": overwrite})["ok"]
+
+    def kv_wait(self, ns, key, timeout):
+        return self.conn.call({"type": "kv_wait", "ns": ns, "key": key,
+                               "timeout": timeout},
+                              timeout=timeout + 15.0)["value"]
 
     def kv_get(self, ns, key):
         return self.conn.call({"type": "kv_get", "ns": ns,

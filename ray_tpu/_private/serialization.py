@@ -87,6 +87,11 @@ def _device_arrays_to_host(obj: Any) -> Any:
     return obj
 
 
+class _RawBytes:
+    """Sentinel marking a top-level large-bytes payload shipped
+    out-of-band (collision-proof: compared by identity)."""
+
+
 class _Pickler(cloudpickle.Pickler):
     def __init__(self, file, buffer_callback, ref_reducer=None):
         super().__init__(file, protocol=5, buffer_callback=buffer_callback)
@@ -124,6 +129,15 @@ def serialize(
             return False
         return True
 
+    # Top-level large bytes: pickle copies builtin bytes INTO the
+    # inband stream (reducer_override is never consulted for them), so
+    # a put(b"...") would pay 3x the memcpys of the numpy path.  Ship
+    # the payload out-of-band under a sentinel instead (write side
+    # zero-copy; one copy at read to rebuild the immutable bytes).
+    if type(obj) is bytes and len(obj) >= 4096:
+        inband = pickle.dumps((_RawBytes, pickle.PickleBuffer(obj)),
+                              protocol=5, buffer_callback=cb)
+        return SerializedObject(inband, buffers)
     f = io.BytesIO()
     _Pickler(f, cb, ref_reducer).dump(obj)
     return SerializedObject(f.getvalue(), buffers)
@@ -150,7 +164,14 @@ def deserialize(data: memoryview, copy_buffers: bool = False) -> Any:
             mv = memoryview(bytes(mv))
         bufs.append(mv)
     inband = data[pos:pos + inband_len]
-    return pickle.loads(inband, buffers=bufs)
+    out = pickle.loads(inband, buffers=bufs)
+    if (type(out) is tuple and len(out) == 2
+            and out[0] is _RawBytes):
+        buf = out[1]
+        if isinstance(buf, pickle.PickleBuffer):
+            buf = buf.raw()
+        return bytes(buf)
+    return out
 
 
 def dumps(obj: Any) -> bytes:

@@ -110,17 +110,23 @@ def _put_blob(group: _Group, seq: int, tag: str, value: Any,
 
 def _get_blob(group: _Group, seq: int, tag: str,
               timeout: Optional[float] = _DEFAULT_TIMEOUT_S) -> Any:
+    """Blocking read via the node's parked kv_wait (long-poll): no
+    2ms client polling, no latency floor — the value arrives on the
+    same push that stores it."""
     key = _key(group.name, seq, tag)
     deadline = None if timeout is None else time.monotonic() + timeout
+    c = _client()
     while True:
-        raw = _client().kv_get(_NS, key)
+        step = 30.0
+        if deadline is not None:
+            step = min(step, deadline - time.monotonic())
+            if step <= 0:
+                raise TimeoutError(
+                    f"collective {tag} (group={group.name!r} seq={seq}) "
+                    f"timed out after {timeout}s")
+        raw = c.kv_wait(_NS, key, max(step, 0.001))
         if raw is not None:
             break
-        if deadline is not None and time.monotonic() > deadline:
-            raise TimeoutError(
-                f"collective {tag} (group={group.name!r} seq={seq}) "
-                f"timed out after {timeout}s")
-        time.sleep(_POLL_S)
     if raw[:1] == b"R":
         from ray_tpu.object_ref import ObjectRef
         return ray_tpu.get(ObjectRef._from_wire(raw[1:]))
