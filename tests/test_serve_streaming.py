@@ -120,9 +120,11 @@ def test_llm_deployment_streams_tokens(serve_session):
                         remat=False, attn_impl="reference"),
         num_slots=2, max_len=48, prompt_pad=8)
     h = serve.run(dep, name="llm")
+    # Generous timeouts: under a full parallel suite on the 1-vCPU
+    # host, engine warmup compiles contend with every other test.
     whole = ray_tpu.get(h.generate.remote([5, 6], max_new=5),
-                        timeout=120)
+                        timeout=300)
     gen = h.generate_stream.options(stream=True).remote([5, 6], 5)
-    toks = [ray_tpu.get(r, timeout=120) for r in gen]
+    toks = [ray_tpu.get(r, timeout=300) for r in gen]
     assert toks == whole["tokens"]
     assert len(toks) == 5
