@@ -134,6 +134,14 @@ def _get(cfg, path):
     return cfg
 
 
+def _has(cfg, path) -> bool:
+    try:
+        _get(cfg, path)
+        return True
+    except (KeyError, TypeError):
+        return False
+
+
 def _set(cfg, path, value):
     for k in path[:-1]:
         cfg = cfg.setdefault(k, {})
@@ -274,11 +282,20 @@ class TPESearcher:
                         _set(cand, path,
                              math.exp(w) if isinstance(dom, LogUniform)
                              else w)
+            def _vals(cfgs, path):
+                out = []
+                for c in cfgs:
+                    try:
+                        out.append(_get(c, path))
+                    except (KeyError, TypeError):
+                        pass   # config from an older param space
+                return out
+
             ratio = 1.0
             for path, dom in domains:
                 x = _get(cand, path)
-                lg = self._density(dom, [_get(c, path) for c in good], x)
-                lb = self._density(dom, [_get(c, path) for c in bad], x)
+                lg = self._density(dom, _vals(good, path), x)
+                lb = self._density(dom, _vals(bad, path), x)
                 ratio *= (lg + 1e-12) / (lb + 1e-12)
             # Novelty factor: pure density-ratio argmax re-evaluates the
             # good cluster's center forever (measured); weighting by
@@ -295,7 +312,8 @@ class TPESearcher:
                 else:
                     span = (dom.high - dom.low) or 1.0
                 dmin = min((abs(xv - self._warp(dom, _get(c, path)))
-                            for c, _ in self._obs), default=span)
+                            for c, _ in self._obs
+                            if _has(c, path)), default=span)
                 scale = span / (8.0 + len(self._obs) / 2.0)
                 novelty *= min(dmin / scale, 1.0) + 0.05
             ratio *= novelty

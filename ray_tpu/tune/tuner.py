@@ -187,6 +187,17 @@ class Tuner:
         os.makedirs(exp_dir, exist_ok=True)
 
         searcher = tc.search_alg
+        if searcher is not None:
+            def _no_grid(node):
+                from ray_tpu.tune.search import GridSearch
+                if isinstance(node, GridSearch):
+                    raise ValueError(
+                        "grid_search is not supported together with "
+                        "search_alg — the searcher owns the sampling")
+                if isinstance(node, dict):
+                    for v in node.values():
+                        _no_grid(v)
+            _no_grid(self._param_space)
         if self._restored_trials is not None:
             trials = self._restored_trials
             # Finished trials keep their results; everything else
@@ -281,6 +292,8 @@ class Tuner:
                     t.status = "EARLY_STOPPED"
                     self._stop_trial(info)
                     del running[tid]
+                    if searcher is not None and t.metrics:
+                        searcher.record(t.config, t.metrics)
                 elif exploit is not None:
                     src = trials_by_id.get(exploit["source"])
                     if src is None or src.checkpoint is None:
@@ -309,7 +322,10 @@ class Tuner:
                 self._drain_final(client, info, t, scheduler)
                 self._stop_trial(info)
                 del running[tid]
-                if searcher is not None:
+                # Only completed runs inform the model: an ERROR
+                # trial's last metric never finished.
+                if searcher is not None and t.status == "TERMINATED" \
+                        and t.metrics:
                     searcher.record(t.config, t.metrics)
             now = time.time()
             if now - last_snapshot > 1.0:
