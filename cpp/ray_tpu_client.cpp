@@ -1,0 +1,677 @@
+// Implementation of the ray_tpu C++ client.  See ray_tpu_client.hpp.
+
+#include "ray_tpu_client.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+#include <random>
+
+namespace ray_tpu {
+
+// ---------------------------------------------------------------------------
+// Value helpers
+// ---------------------------------------------------------------------------
+namespace {
+constexpr size_t kListIdx = 6;
+constexpr size_t kTupleIdx = 7;
+}  // namespace
+
+double Value::as_float() const {
+  if (v.index() == 3) return std::get<3>(v);
+  if (v.index() == 2) return static_cast<double>(std::get<2>(v));
+  throw PickleError("value is not a number");
+}
+
+const ValueList &Value::as_list() const {
+  if (v.index() == kListIdx) return *std::get<kListIdx>(v);
+  if (v.index() == kTupleIdx) {
+    return *std::get<kTupleIdx>(v);
+  }
+  throw PickleError("value is not a list/tuple");
+}
+
+const Value *Value::dict_get(const std::string &key) const {
+  for (const auto &kv : as_dict()) {
+    if (kv.first.v.index() == 4 && kv.first.as_str() == key)
+      return &kv.second;
+  }
+  return nullptr;
+}
+
+Value Value::none() { return Value{}; }
+Value Value::boolean(bool b) { Value x; x.v.emplace<1>(b); return x; }
+Value Value::integer(int64_t i) { Value x; x.v.emplace<2>(i); return x; }
+Value Value::real(double d) { Value x; x.v.emplace<3>(d); return x; }
+Value Value::str(std::string s) {
+  Value x; x.v.emplace<4>(std::move(s)); return x;
+}
+Value Value::bytes(std::vector<uint8_t> b) {
+  Value x; x.v.emplace<5>(std::move(b)); return x;
+}
+Value Value::bytes(const void *data, size_t n) {
+  const uint8_t *p = static_cast<const uint8_t *>(data);
+  return bytes(std::vector<uint8_t>(p, p + n));
+}
+Value Value::list(ValueList items) {
+  Value x;
+  x.v.emplace<kListIdx>(std::make_shared<ValueList>(std::move(items)));
+  return x;
+}
+Value Value::tuple(ValueList items) {
+  Value x;
+  x.v.emplace<kTupleIdx>(std::make_shared<ValueList>(std::move(items)));
+  return x;
+}
+Value Value::dict(ValueDict items) {
+  Value x;
+  x.v.emplace<8>(std::make_shared<ValueDict>(std::move(items)));
+  return x;
+}
+
+// ---------------------------------------------------------------------------
+// pickle writer (protocol 2)
+// ---------------------------------------------------------------------------
+namespace {
+
+void put_u32(std::vector<uint8_t> &out, uint32_t n) {
+  out.push_back(n & 0xff);
+  out.push_back((n >> 8) & 0xff);
+  out.push_back((n >> 16) & 0xff);
+  out.push_back((n >> 24) & 0xff);
+}
+
+void dump_value(std::vector<uint8_t> &out, const Value &val) {
+  switch (val.v.index()) {
+    case 0:  // None
+      out.push_back('N');
+      break;
+    case 1:  // bool
+      out.push_back(std::get<1>(val.v) ? 0x88 : 0x89);
+      break;
+    case 2: {  // int -> BININT or LONG1
+      int64_t i = std::get<2>(val.v);
+      if (i >= INT32_MIN && i <= INT32_MAX) {
+        out.push_back('J');
+        put_u32(out, static_cast<uint32_t>(static_cast<int32_t>(i)));
+      } else {
+        out.push_back(0x8a);  // LONG1
+        out.push_back(8);
+        for (int b = 0; b < 8; b++)
+          out.push_back((static_cast<uint64_t>(i) >> (8 * b)) & 0xff);
+      }
+      break;
+    }
+    case 3: {  // float -> BINFLOAT (big-endian)
+      out.push_back('G');
+      double d = std::get<3>(val.v);
+      uint64_t bits;
+      std::memcpy(&bits, &d, 8);
+      for (int b = 7; b >= 0; b--) out.push_back((bits >> (8 * b)) & 0xff);
+      break;
+    }
+    case 4: {  // str -> BINUNICODE (must be utf-8)
+      const std::string &s = std::get<4>(val.v);
+      out.push_back('X');
+      put_u32(out, static_cast<uint32_t>(s.size()));
+      out.insert(out.end(), s.begin(), s.end());
+      break;
+    }
+    case 5: {  // bytes: protocol-2-compatible via
+               // _codecs.encode(latin1_str, 'latin-1')?  Simpler:
+               // SHORT_BINBYTES/BINBYTES are protocol 3 — every
+               // supported CPython accepts protocol 3 opcodes, so use
+               // them (the PROTO header still says 3).
+      const auto &b = std::get<5>(val.v);
+      if (b.size() < 256) {
+        out.push_back('C');  // SHORT_BINBYTES
+        out.push_back(static_cast<uint8_t>(b.size()));
+      } else {
+        out.push_back('B');  // BINBYTES
+        put_u32(out, static_cast<uint32_t>(b.size()));
+      }
+      out.insert(out.end(), b.begin(), b.end());
+      break;
+    }
+    case kListIdx: {
+      out.push_back(']');  // EMPTY_LIST
+      const auto &items = *std::get<kListIdx>(val.v);
+      if (!items.empty()) {
+        out.push_back('(');  // MARK
+        for (const auto &it : items) dump_value(out, it);
+        out.push_back('e');  // APPENDS
+      }
+      break;
+    }
+    case kTupleIdx: {
+      const auto &items = *std::get<kTupleIdx>(val.v);
+      if (items.empty()) {
+        out.push_back(')');
+      } else if (items.size() == 1) {
+        dump_value(out, items[0]);
+        out.push_back(0x85);
+      } else if (items.size() == 2) {
+        dump_value(out, items[0]);
+        dump_value(out, items[1]);
+        out.push_back(0x86);
+      } else if (items.size() == 3) {
+        dump_value(out, items[0]);
+        dump_value(out, items[1]);
+        dump_value(out, items[2]);
+        out.push_back(0x87);
+      } else {
+        out.push_back('(');
+        for (const auto &it : items) dump_value(out, it);
+        out.push_back('t');
+      }
+      break;
+    }
+    case 8: {  // dict
+      out.push_back('}');  // EMPTY_DICT
+      const auto &items = *std::get<8>(val.v);
+      if (!items.empty()) {
+        out.push_back('(');
+        for (const auto &kv : items) {
+          dump_value(out, kv.first);
+          dump_value(out, kv.second);
+        }
+        out.push_back('u');  // SETITEMS
+      }
+      break;
+    }
+    default:
+      throw PickleError("unserializable value");
+  }
+}
+
+}  // namespace
+
+std::vector<uint8_t> pickle_dumps(const Value &value) {
+  std::vector<uint8_t> out;
+  out.push_back(0x80);  // PROTO
+  out.push_back(3);     // bytes opcodes need >= 3
+  dump_value(out, value);
+  out.push_back('.');  // STOP
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// pickle reader (bounded opcode VM for the node's replies)
+// ---------------------------------------------------------------------------
+namespace {
+
+struct Reader {
+  const uint8_t *p;
+  const uint8_t *end;
+  std::vector<Value> stack;
+  std::vector<size_t> marks;
+  std::vector<Value> memo;
+
+  uint8_t u8() {
+    if (p >= end) throw PickleError("truncated pickle");
+    return *p++;
+  }
+  uint32_t u32() {
+    uint32_t n = 0;
+    for (int b = 0; b < 4; b++) n |= static_cast<uint32_t>(u8()) << (8 * b);
+    return n;
+  }
+  uint64_t u64() {
+    uint64_t n = 0;
+    for (int b = 0; b < 8; b++) n |= static_cast<uint64_t>(u8()) << (8 * b);
+    return n;
+  }
+  const uint8_t *take(size_t n) {
+    if (static_cast<size_t>(end - p) < n) throw PickleError("truncated");
+    const uint8_t *q = p;
+    p += n;
+    return q;
+  }
+  Value pop() {
+    if (stack.empty()) throw PickleError("stack underflow");
+    Value v = std::move(stack.back());
+    stack.pop_back();
+    return v;
+  }
+  std::vector<Value> pop_to_mark() {
+    if (marks.empty()) throw PickleError("no mark");
+    size_t m = marks.back();
+    marks.pop_back();
+    std::vector<Value> items(stack.begin() + m, stack.end());
+    stack.resize(m);
+    return items;
+  }
+  void memoize() { memo.push_back(stack.back()); }
+
+  Value run() {
+    for (;;) {
+      uint8_t op = u8();
+      switch (op) {
+        case 0x80:  // PROTO
+          u8();
+          break;
+        case 0x95:  // FRAME
+          u64();
+          break;
+        case '.':  // STOP
+          return pop();
+        case 'N':
+          stack.push_back(Value::none());
+          break;
+        case 0x88:
+          stack.push_back(Value::boolean(true));
+          break;
+        case 0x89:
+          stack.push_back(Value::boolean(false));
+          break;
+        case 'J':
+          stack.push_back(Value::integer(
+              static_cast<int32_t>(u32())));
+          break;
+        case 'K':
+          stack.push_back(Value::integer(u8()));
+          break;
+        case 'M': {
+          uint32_t n = u8();
+          n |= static_cast<uint32_t>(u8()) << 8;
+          stack.push_back(Value::integer(n));
+          break;
+        }
+        case 0x8a: {  // LONG1
+          uint8_t n = u8();
+          if (n > 8) throw PickleError("LONG1 too big");
+          const uint8_t *q = take(n);
+          uint64_t raw = 0;
+          for (int b = 0; b < n; b++)
+            raw |= static_cast<uint64_t>(q[b]) << (8 * b);
+          // sign-extend
+          if (n > 0 && (q[n - 1] & 0x80))
+            for (int b = n; b < 8; b++) raw |= 0xffULL << (8 * b);
+          stack.push_back(Value::integer(static_cast<int64_t>(raw)));
+          break;
+        }
+        case 'G': {  // BINFLOAT big-endian
+          uint64_t bits = 0;
+          for (int b = 0; b < 8; b++)
+            bits = (bits << 8) | u8();
+          double d;
+          std::memcpy(&d, &bits, 8);
+          stack.push_back(Value::real(d));
+          break;
+        }
+        case 0x8c: {  // SHORT_BINUNICODE
+          uint8_t n = u8();
+          const uint8_t *q = take(n);
+          stack.push_back(Value::str(std::string(q, q + n)));
+          break;
+        }
+        case 'X': {  // BINUNICODE
+          uint32_t n = u32();
+          const uint8_t *q = take(n);
+          stack.push_back(Value::str(std::string(q, q + n)));
+          break;
+        }
+        case 'C': {  // SHORT_BINBYTES
+          uint8_t n = u8();
+          const uint8_t *q = take(n);
+          stack.push_back(Value::bytes(q, n));
+          break;
+        }
+        case 'B': {  // BINBYTES
+          uint32_t n = u32();
+          const uint8_t *q = take(n);
+          stack.push_back(Value::bytes(q, n));
+          break;
+        }
+        case 0x8e: {  // BINBYTES8
+          uint64_t n = u64();
+          const uint8_t *q = take(n);
+          stack.push_back(Value::bytes(q, n));
+          break;
+        }
+        case ']':
+          stack.push_back(Value::list({}));
+          break;
+        case ')':
+          stack.push_back(Value::tuple({}));
+          break;
+        case '}':
+          stack.push_back(Value::dict({}));
+          break;
+        case '(':
+          marks.push_back(stack.size());
+          break;
+        case 'a': {  // APPEND
+          Value item = pop();
+          std::get<kListIdx>(stack.back().v)->push_back(std::move(item));
+          break;
+        }
+        case 'e': {  // APPENDS
+          auto items = pop_to_mark();
+          auto &lst = *std::get<kListIdx>(stack.back().v);
+          for (auto &it : items) lst.push_back(std::move(it));
+          break;
+        }
+        case 's': {  // SETITEM
+          Value val = pop();
+          Value key = pop();
+          std::get<8>(stack.back().v)
+              ->emplace_back(std::move(key), std::move(val));
+          break;
+        }
+        case 'u': {  // SETITEMS
+          auto items = pop_to_mark();
+          auto &d = *std::get<8>(stack.back().v);
+          for (size_t i = 0; i + 1 < items.size(); i += 2)
+            d.emplace_back(std::move(items[i]), std::move(items[i + 1]));
+          break;
+        }
+        case 't': {  // TUPLE
+          auto items = pop_to_mark();
+          stack.push_back(Value::tuple(std::move(items)));
+          break;
+        }
+        case 0x85: {  // TUPLE1
+          Value a = pop();
+          stack.push_back(Value::tuple({std::move(a)}));
+          break;
+        }
+        case 0x86: {  // TUPLE2
+          Value b = pop();
+          Value a = pop();
+          stack.push_back(Value::tuple({std::move(a), std::move(b)}));
+          break;
+        }
+        case 0x87: {  // TUPLE3
+          Value c = pop();
+          Value b = pop();
+          Value a = pop();
+          stack.push_back(
+              Value::tuple({std::move(a), std::move(b), std::move(c)}));
+          break;
+        }
+        case 0x94:  // MEMOIZE
+          memoize();
+          break;
+        case 'q':  // BINPUT
+          u8();
+          memoize();
+          break;
+        case 'r':  // LONG_BINPUT
+          u32();
+          memoize();
+          break;
+        case 'h': {  // BINGET
+          uint8_t i = u8();
+          if (i >= memo.size()) throw PickleError("bad memo index");
+          stack.push_back(memo[i]);
+          break;
+        }
+        case 'j': {  // LONG_BINGET
+          uint32_t i = u32();
+          if (i >= memo.size()) throw PickleError("bad memo index");
+          stack.push_back(memo[i]);
+          break;
+        }
+        default:
+          throw PickleError(
+              "unsupported pickle opcode 0x" +
+              std::to_string(static_cast<int>(op)) +
+              " (reply holds a non-plain Python object)");
+      }
+    }
+  }
+};
+
+}  // namespace
+
+Value pickle_loads(const uint8_t *data, size_t size) {
+  Reader r{data, data + size, {}, {}, {}};
+  return r.run();
+}
+
+// ---------------------------------------------------------------------------
+// RTO1 object framing (ray_tpu/_private/serialization.py)
+// ---------------------------------------------------------------------------
+namespace {
+
+Value decode_rto1(const std::vector<uint8_t> &blob) {
+  if (blob.size() < 16 || std::memcmp(blob.data(), "RTO1", 4) != 0)
+    throw PickleError("bad object header");
+  uint32_t n_buffers;
+  uint64_t inband_len;
+  std::memcpy(&n_buffers, blob.data() + 4, 4);
+  std::memcpy(&inband_len, blob.data() + 8, 8);
+  if (n_buffers != 0)
+    throw PickleError(
+        "result holds out-of-band buffers (numpy/large-bytes) — "
+        "cross-language results must be plain values");
+  size_t pos = 16;
+  if (blob.size() < pos + inband_len) throw PickleError("truncated object");
+  return pickle_loads(blob.data() + pos, inband_len);
+}
+
+std::vector<uint8_t> encode_rto1(const Value &value) {
+  std::vector<uint8_t> inband = pickle_dumps(value);
+  std::vector<uint8_t> out(16);
+  std::memcpy(out.data(), "RTO1", 4);
+  uint32_t zero = 0;
+  uint64_t n = inband.size();
+  std::memcpy(out.data() + 4, &zero, 4);
+  std::memcpy(out.data() + 8, &n, 8);
+  out.insert(out.end(), inband.begin(), inband.end());
+  return out;
+}
+
+std::vector<uint8_t> random_id() {
+  static std::random_device rd;
+  std::vector<uint8_t> id(16);
+  for (auto &b : id) b = static_cast<uint8_t>(rd());
+  return id;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+Client::Client(const std::string &host, int port) {
+  client_id_ = random_id();
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) throw std::runtime_error("socket() failed");
+  int one = 1;
+  ::setsockopt(fd_, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    hostent *he = ::gethostbyname(host.c_str());
+    if (he == nullptr) throw std::runtime_error("resolve failed: " + host);
+    std::memcpy(&addr.sin_addr, he->h_addr, sizeof(addr.sin_addr));
+  }
+  if (::connect(fd_, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) != 0)
+    throw std::runtime_error("connect failed");
+  Value reply = call(Value::dict({
+      {Value::str("type"), Value::str("register_client")},
+      {Value::str("kind"), Value::str("driver")},
+      {Value::str("client_id"), Value::bytes(client_id_)},
+      {Value::str("pid"), Value::integer(::getpid())},
+  }));
+  if (reply.dict_get("session_dir") == nullptr)
+    throw std::runtime_error("register_client: unexpected reply");
+}
+
+Client::~Client() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+void Client::send_frame(const std::vector<uint8_t> &payload) {
+  uint64_t n = payload.size();
+  uint8_t hdr[8];
+  std::memcpy(hdr, &n, 8);
+  std::vector<uint8_t> buf(hdr, hdr + 8);
+  buf.insert(buf.end(), payload.begin(), payload.end());
+  size_t off = 0;
+  while (off < buf.size()) {
+    ssize_t w = ::send(fd_, buf.data() + off, buf.size() - off, 0);
+    if (w <= 0) throw std::runtime_error("send failed");
+    off += static_cast<size_t>(w);
+  }
+}
+
+std::vector<uint8_t> Client::recv_frame() {
+  uint8_t hdr[8];
+  size_t got = 0;
+  while (got < 8) {
+    ssize_t r = ::recv(fd_, hdr + got, 8 - got, 0);
+    if (r <= 0) throw std::runtime_error("recv failed");
+    got += static_cast<size_t>(r);
+  }
+  uint64_t n;
+  std::memcpy(&n, hdr, 8);
+  std::vector<uint8_t> out(n);
+  got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd_, out.data() + got, n - got, 0);
+    if (r <= 0) throw std::runtime_error("recv failed");
+    got += static_cast<size_t>(r);
+  }
+  return out;
+}
+
+Value Client::call(Value msg, double /*timeout_s*/) {
+  int64_t req = ++next_req_;
+  std::get<8>(msg.v)->emplace_back(Value::str("__req_id__"),
+                                   Value::integer(req));
+  send_frame(pickle_dumps(msg));
+  for (;;) {
+    std::vector<uint8_t> frame = recv_frame();
+    Value reply;
+    try {
+      reply = pickle_loads(frame.data(), frame.size());
+    } catch (const PickleError &) {
+      // Undecodable frame.  If it carries "__reply_to__" it is a
+      // solicited reply whose payload holds a rich Python object —
+      // which on the control plane means {"__error__": Exception}.
+      // This client is strictly one-request-at-a-time, so that reply
+      // is ours: fail loudly instead of waiting forever for a frame
+      // that will never come.  Frames WITHOUT the marker are
+      // unsolicited pushes (log batches etc.): skip them.
+      static const std::string marker = "__reply_to__";
+      if (std::search(frame.begin(), frame.end(), marker.begin(),
+                      marker.end()) != frame.end())
+        throw std::runtime_error(
+            "rpc failed with a Python exception (reply not "
+            "plain-value decodable; see server logs)");
+      continue;
+    }
+    if (reply.v.index() != 8) continue;
+    const Value *rid = reply.dict_get("__reply_to__");
+    if (rid == nullptr || rid->as_int() != req) continue;  // push/stale
+    const Value *err = reply.dict_get("__error__");
+    if (err != nullptr && !err->is_none())
+      throw std::runtime_error(
+          "rpc error: " + (err->is_str() ? err->as_str()
+                                         : std::string("python exception")));
+    return reply;
+  }
+}
+
+void Client::kv_put(const std::string &ns, const std::string &key,
+                    const std::vector<uint8_t> &value) {
+  call(Value::dict({
+      {Value::str("type"), Value::str("kv_put")},
+      {Value::str("ns"), Value::str(ns)},
+      {Value::str("key"), Value::bytes(key.data(), key.size())},
+      {Value::str("value"), Value::bytes(value)},
+      {Value::str("overwrite"), Value::boolean(true)},
+  }));
+}
+
+std::optional<std::vector<uint8_t>> Client::kv_get(const std::string &ns,
+                                                   const std::string &key) {
+  Value reply = call(Value::dict({
+      {Value::str("type"), Value::str("kv_get")},
+      {Value::str("ns"), Value::str(ns)},
+      {Value::str("key"), Value::bytes(key.data(), key.size())},
+  }));
+  const Value *v = reply.dict_get("value");
+  if (v == nullptr || v->is_none()) return std::nullopt;
+  return v->as_bytes();
+}
+
+ObjectRef Client::submit(const std::string &exported_name,
+                         const ValueList &args) {
+  auto it = fn_cache_.find(exported_name);
+  if (it == fn_cache_.end()) {
+    auto fid = kv_get("cross_lang", exported_name);
+    if (!fid.has_value())
+      throw std::runtime_error("no exported function named '" +
+                               exported_name + "'");
+    it = fn_cache_.emplace(exported_name, *fid).first;
+  }
+  // args blob: ((positional...), ref_slots=[], kw_ref_items=[],
+  // plain_kwargs={}) in the RTO1 framing (_pack_args wire format).
+  Value payload = Value::tuple({Value::list(args), Value::list({}),
+                                Value::list({}), Value::dict({})});
+  std::vector<uint8_t> blob = encode_rto1(payload);
+  ObjectRef ref{random_id()};
+  Value spec = Value::dict({
+      {Value::str("task_id"), Value::bytes(random_id())},
+      {Value::str("name"), Value::str(exported_name)},
+      {Value::str("function_id"), Value::bytes(it->second)},
+      {Value::str("args"),
+       Value::list({Value::tuple(
+           {Value::str("inline"), Value::bytes(std::move(blob))})})},
+      {Value::str("embedded"), Value::list({})},
+      {Value::str("num_returns"), Value::integer(1)},
+      {Value::str("return_ids"),
+       Value::list({Value::bytes(ref.id)})},
+      {Value::str("resources"), Value::dict({})},
+      {Value::str("retries"), Value::integer(0)},
+      {Value::str("actor_id"), Value::none()},
+      {Value::str("owner"), Value::bytes(client_id_)},
+      {Value::str("pg"), Value::none()},
+  });
+  // One-way submit (no __req_id__), same as the Python client.
+  send_frame(pickle_dumps(Value::dict({
+      {Value::str("type"), Value::str("submit_task")},
+      {Value::str("spec"), std::move(spec)},
+  })));
+  return ref;
+}
+
+Value Client::get(const ObjectRef &ref, double timeout_s) {
+  Value reply = call(
+      Value::dict({
+          {Value::str("type"), Value::str("get_objects")},
+          {Value::str("object_ids"),
+           Value::list({Value::bytes(ref.id)})},
+          {Value::str("timeout"), Value::real(timeout_s)},
+      }),
+      timeout_s + 15.0);
+  const Value *timed_out = reply.dict_get("timed_out");
+  if (timed_out != nullptr && timed_out->v.index() == 1 &&
+      std::get<1>(timed_out->v))
+    throw std::runtime_error("get() timed out");
+  const Value *results = reply.dict_get("results");
+  if (results == nullptr) throw std::runtime_error("malformed reply");
+  for (const auto &kv : results->as_dict()) {
+    const ValueList &t = kv.second.as_list();  // (loc, data, size)
+    const std::string &loc = t.at(0).as_str();
+    if (loc == "error")
+      throw std::runtime_error("task failed (Python exception; see logs)");
+    if (loc != "inline")
+      throw std::runtime_error(
+          "result too large for the cross-language inline path (loc=" +
+          loc + ")");
+    return decode_rto1(t.at(1).as_bytes());
+  }
+  throw std::runtime_error("empty get_objects reply");
+}
+
+}  // namespace ray_tpu

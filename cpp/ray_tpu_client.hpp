@@ -1,0 +1,121 @@
+// ray_tpu C++ client: a native driver API over the node's TCP control
+// endpoint (SURVEY §2.1 N16 — the reference ships a 9k-LoC C++ worker
+// API in cpp/; see cpp/README.md for the scope decision here).
+//
+// Speaks the same length-prefixed message protocol as Python thin
+// clients (ray_tpu/_private/protocol.py): each frame is an 8-byte LE
+// length + a pickled dict.  Messages are WRITTEN as pickle protocol 2
+// (every Python unpickler accepts it) and replies are READ with a
+// bounded pickle-opcode VM covering everything the node service emits
+// for control replies (ints, floats, bools, None, str, bytes, lists,
+// tuples, dicts, memo refs).  Anything outside that — i.e. an
+// arbitrary Python object — surfaces as a typed decode error, never a
+// silent misread.
+//
+// Cross-language calls (reference: python/ray/cross_language.py): the
+// Python side exports a @remote function under a name
+// (ray_tpu.util.cross_lang.export_function); this client looks the
+// name up in the GCS KV, submits a task whose args are plain values
+// (ints/floats/strings/bytes/lists), and reads back a plain-value
+// result.  Values richer than that are a Python<->Python concern by
+// design.
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <variant>
+#include <vector>
+
+namespace ray_tpu {
+
+struct Value;
+using ValueList = std::vector<Value>;
+using ValueDict = std::vector<std::pair<Value, Value>>;
+
+// A decoded Python value (the bounded control-plane subset).
+struct Value {
+  // order matters for index(): none, bool, int, float, str, bytes,
+  // list, tuple, dict
+  std::variant<std::monostate, bool, int64_t, double, std::string,
+               std::vector<uint8_t>, std::shared_ptr<ValueList>,
+               std::shared_ptr<ValueList>, std::shared_ptr<ValueDict>>
+      v;
+
+  bool is_none() const { return v.index() == 0; }
+  bool is_bytes() const { return v.index() == 5; }
+  bool is_str() const { return v.index() == 4; }
+  int64_t as_int() const { return std::get<2>(v); }
+  double as_float() const;
+  const std::string &as_str() const { return std::get<4>(v); }
+  const std::vector<uint8_t> &as_bytes() const { return std::get<5>(v); }
+  const ValueList &as_list() const;
+  const ValueDict &as_dict() const { return *std::get<8>(v); }
+  const Value *dict_get(const std::string &key) const;
+
+  static Value none();
+  static Value boolean(bool b);
+  static Value integer(int64_t i);
+  static Value real(double d);
+  static Value str(std::string s);
+  static Value bytes(std::vector<uint8_t> b);
+  static Value bytes(const void *data, size_t n);
+  static Value list(ValueList items);
+  static Value tuple(ValueList items);
+  static Value dict(ValueDict items);
+};
+
+class PickleError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+// Serialize a Value as pickle protocol 2.
+std::vector<uint8_t> pickle_dumps(const Value &value);
+// Parse a pickle stream (the node's protocol-5 replies included).
+Value pickle_loads(const uint8_t *data, size_t size);
+
+// An ObjectRef: the 16-byte id of a task return.
+struct ObjectRef {
+  std::vector<uint8_t> id;
+};
+
+class Client {
+ public:
+  // Connect to a node's TCP control endpoint (multinode
+  // client_address, printed by `python -m ray_tpu start --head`).
+  Client(const std::string &host, int port);
+  ~Client();
+
+  // -- KV (GCS passthrough) ------------------------------------------
+  void kv_put(const std::string &ns, const std::string &key,
+              const std::vector<uint8_t> &value);
+  std::optional<std::vector<uint8_t>> kv_get(const std::string &ns,
+                                             const std::string &key);
+
+  // -- cross-language task calls -------------------------------------
+  // Call a Python function exported via
+  // ray_tpu.util.cross_lang.export_function(name, fn).
+  ObjectRef submit(const std::string &exported_name,
+                   const ValueList &args);
+  // Block until the task's (plain-value) result is ready.
+  Value get(const ObjectRef &ref, double timeout_s = 60.0);
+
+  const std::vector<uint8_t> &client_id() const { return client_id_; }
+
+ private:
+  Value call(Value msg, double timeout_s = 60.0);
+  void send_frame(const std::vector<uint8_t> &payload);
+  std::vector<uint8_t> recv_frame();
+
+  int fd_ = -1;
+  int64_t next_req_ = 0;
+  std::vector<uint8_t> client_id_;
+  std::map<std::string, std::vector<uint8_t>> fn_cache_;
+};
+
+}  // namespace ray_tpu

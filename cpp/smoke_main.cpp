@@ -1,0 +1,66 @@
+// End-to-end smoke driver for the C++ client (run by
+// tests/test_cpp_client.py): kv roundtrip + cross-language calls.
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "ray_tpu_client.hpp"
+
+using ray_tpu::Value;
+
+int main(int argc, char **argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: smoke <host> <port>\n");
+    return 2;
+  }
+  ray_tpu::Client client(argv[1], std::atoi(argv[2]));
+
+  // KV roundtrip
+  std::string payload = "from-cpp";
+  client.kv_put("cpp_smoke", "k1",
+                std::vector<uint8_t>(payload.begin(), payload.end()));
+  auto got = client.kv_get("cpp_smoke", "k1");
+  if (!got.has_value() ||
+      std::string(got->begin(), got->end()) != payload) {
+    std::fprintf(stderr, "kv roundtrip failed\n");
+    return 1;
+  }
+  if (client.kv_get("cpp_smoke", "absent").has_value()) {
+    std::fprintf(stderr, "kv_get absent returned a value\n");
+    return 1;
+  }
+
+  // Cross-language call: Python-exported add(a, b)
+  auto ref = client.submit("add", {Value::integer(20),
+                                   Value::integer(22)});
+  Value out = client.get(ref, 120.0);
+  if (out.as_int() != 42) {
+    std::fprintf(stderr, "add returned %lld\n",
+                 static_cast<long long>(out.as_int()));
+    return 1;
+  }
+
+  // Strings + floats + lists
+  auto ref2 = client.submit(
+      "describe", {Value::str("tpu"), Value::real(2.5)});
+  Value d = client.get(ref2, 120.0);
+  const Value *msg = d.dict_get("msg");
+  const Value *nums = d.dict_get("nums");
+  if (msg == nullptr || msg->as_str() != "tpu:2.5" || nums == nullptr ||
+      nums->as_list().size() != 3 || nums->as_list()[2].as_int() != 3) {
+    std::fprintf(stderr, "describe result mismatch\n");
+    return 1;
+  }
+
+  // bytes roundtrip through a task
+  std::vector<uint8_t> raw = {0, 1, 2, 254, 255};
+  auto ref3 = client.submit("echo_bytes", {Value::bytes(raw)});
+  Value b = client.get(ref3, 120.0);
+  if (b.as_bytes() != raw) {
+    std::fprintf(stderr, "bytes roundtrip failed\n");
+    return 1;
+  }
+
+  std::printf("CPP-SMOKE-OK\n");
+  return 0;
+}

@@ -134,6 +134,16 @@ def _get(cfg, path):
     return cfg
 
 
+def _vals(cfgs, path):
+    out = []
+    for c in cfgs:
+        try:
+            out.append(_get(c, path))
+        except (KeyError, TypeError):
+            pass   # config from an older param space
+    return out
+
+
 def _has(cfg, path) -> bool:
     try:
         _get(cfg, path)
@@ -205,6 +215,15 @@ class TPESearcher:
         return float(value) if isinstance(dom, (Uniform, RandInt)) \
             else value
 
+    @classmethod
+    def _safe_warp(cls, dom: Domain, value):
+        """None when a legacy value no longer fits the domain (restored
+        sweeps may carry configs from an older param space)."""
+        try:
+            return cls._warp(dom, value)
+        except (TypeError, ValueError):
+            return None
+
     def _density(self, dom: Domain, pts: List[Any], x) -> float:
         """Parzen window density of x under the point set (numeric
         domains: gaussian kernels; categorical: smoothed counts)."""
@@ -214,8 +233,11 @@ class TPESearcher:
             hits = sum(1 for p in pts if p == x)
             return (hits + 0.5) / (n + 0.5 * max(len(getattr(
                 dom, "options", [1])), 1))
-        xs = [self._warp(dom, p) for p in pts]
-        xv = self._warp(dom, x)
+        xs = [w for p in pts
+              if (w := self._safe_warp(dom, p)) is not None]
+        xv = self._safe_warp(dom, x)
+        if xv is None or not xs:
+            return 1e-12
         spread = (max(xs) - min(xs)) or 1.0
         h = max(spread / max(len(xs) ** 0.5, 1.0), 1e-3)
         return sum(math.exp(-0.5 * ((xv - p) / h) ** 2)
@@ -229,6 +251,10 @@ class TPESearcher:
         good = [c for c, _ in ranked[:n_good]]
         bad = [c for c, _ in ranked[n_good:]] or good
         domains = list(_flatten(space))
+        # Per-path observation values, extracted ONCE per suggest()
+        # (not per candidate).
+        good_vals = {path: _vals(good, path) for path, _ in domains}
+        bad_vals = {path: _vals(bad, path) for path, _ in domains}
         best_cfg, best_ratio = None, -1.0
         for _ in range(self.n_candidates):
             cand = self._random(space)
@@ -257,12 +283,9 @@ class TPESearcher:
                         # Self-tightening bandwidth (classic TPE): the
                         # kernel width tracks the good set's spread, so
                         # exploitation sharpens as evidence accumulates.
-                        gv = []
-                        for c in good:
-                            try:
-                                gv.append(self._warp(dom, _get(c, path)))
-                            except (KeyError, TypeError):
-                                pass
+                        gv = [w for c in good if _has(c, path)
+                              and (w := self._safe_warp(
+                                  dom, _get(c, path))) is not None]
                         if isinstance(dom, LogUniform):
                             span = (dom._hi - dom._lo) or 1.0
                             lo, hi = dom._lo, dom._hi
@@ -282,20 +305,11 @@ class TPESearcher:
                         _set(cand, path,
                              math.exp(w) if isinstance(dom, LogUniform)
                              else w)
-            def _vals(cfgs, path):
-                out = []
-                for c in cfgs:
-                    try:
-                        out.append(_get(c, path))
-                    except (KeyError, TypeError):
-                        pass   # config from an older param space
-                return out
-
             ratio = 1.0
             for path, dom in domains:
                 x = _get(cand, path)
-                lg = self._density(dom, _vals(good, path), x)
-                lb = self._density(dom, _vals(bad, path), x)
+                lg = self._density(dom, good_vals[path], x)
+                lb = self._density(dom, bad_vals[path], x)
                 ratio *= (lg + 1e-12) / (lb + 1e-12)
             # Novelty factor: pure density-ratio argmax re-evaluates the
             # good cluster's center forever (measured); weighting by
@@ -311,9 +325,11 @@ class TPESearcher:
                     span = (dom._hi - dom._lo) or 1.0
                 else:
                     span = (dom.high - dom.low) or 1.0
-                dmin = min((abs(xv - self._warp(dom, _get(c, path)))
-                            for c, _ in self._obs
-                            if _has(c, path)), default=span)
+                dmin = min((abs(xv - w) for c, _ in self._obs
+                            if _has(c, path)
+                            and (w := self._safe_warp(
+                                dom, _get(c, path))) is not None),
+                           default=span)
                 scale = span / (8.0 + len(self._obs) / 2.0)
                 novelty *= min(dmin / scale, 1.0) + 0.05
             ratio *= novelty

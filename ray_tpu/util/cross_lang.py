@@ -1,0 +1,36 @@
+"""Cross-language function export (reference:
+python/ray/cross_language.py).
+
+The C++ client (cpp/ray_tpu_client.hpp) cannot ship cloudpickled
+closures, so cross-language callables are EXPORTED by name from
+Python: `export_function("add", add)` registers the function body in
+the GCS function table and publishes its function id under the name in
+the "cross_lang" KV namespace.  Any native client then submits tasks
+against the name with plain-value arguments (ints/floats/strings/
+bytes/lists) and reads back a plain-value result — the same
+plain-value contract the reference's msgpack-based cross-language
+boundary enforces.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+from ray_tpu.remote_function import RemoteFunction
+
+_NS = "cross_lang"
+
+
+def export_function(name: str, fn) -> bytes:
+    """Publish a @ray_tpu.remote function for native-client callers;
+    returns its function id."""
+    if not isinstance(fn, RemoteFunction):
+        fn = ray_tpu.remote(fn)
+    client = ray_tpu._ensure_connected()
+    fid = fn._ensure_registered(client)
+    client.kv_put(_NS, name.encode(), fid)
+    return fid
+
+
+def unexport_function(name: str) -> bool:
+    client = ray_tpu._ensure_connected()
+    return client.kv_del(_NS, name.encode())

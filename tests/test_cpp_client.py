@@ -1,0 +1,63 @@
+"""C++ client end-to-end (SURVEY §2.1 N16): compile
+cpp/ray_tpu_client.cpp with g++, then drive a live cluster from the
+binary — kv roundtrip + cross-language calls against Python-exported
+functions (cpp/README.md records the N16/N17 scope decision)."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CPP = os.path.join(_REPO, "cpp")
+
+
+@pytest.fixture(scope="module")
+def smoke_bin(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain on this host")
+    out = str(tmp_path_factory.mktemp("cpp") / "smoke")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-o", out,
+         os.path.join(_CPP, "smoke_main.cpp"),
+         os.path.join(_CPP, "ray_tpu_client.cpp")],
+        check=True, capture_output=True, text=True)
+    return out
+
+
+def test_cpp_client_end_to_end(smoke_bin):
+    c = Cluster()
+    ray_tpu.init(num_cpus=2, gcs_address=c.gcs_address)
+    try:
+        from ray_tpu.util import cross_lang
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        @ray_tpu.remote
+        def describe(name, x):
+            return {"msg": f"{name}:{x}", "nums": [1, 2, 3]}
+
+        @ray_tpu.remote
+        def echo_bytes(b):
+            return b
+
+        cross_lang.export_function("add", add)
+        cross_lang.export_function("describe", describe)
+        cross_lang.export_function("echo_bytes", echo_bytes)
+
+        info = ray_tpu._ensure_connected().node_info()
+        proc = subprocess.run(
+            [smoke_bin, info["host"], str(info["control_port"])],
+            capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "CPP-SMOKE-OK" in proc.stdout
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
