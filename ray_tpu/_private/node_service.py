@@ -149,6 +149,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._streams: Dict[bytes, dict] = {}
         # Per-(destination, channel-key) compiled-DAG forwarder queues.
         self._chan_fwd_queues: Dict[tuple, Any] = {}
+        # In-flight on-demand stack dumps: token -> collection record.
+        self._stack_dumps: Dict[bytes, dict] = {}
         # Compiled-DAG channel queues (cross-node channel plane;
         # reference: experimental/channel/shared_memory_channel.py for
         # same-host, torch_tensor_nccl_channel.py for cross-host).  A
@@ -1207,6 +1209,58 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
 
         threading.Thread(target=fwd, daemon=True,
                          name="rtpu-kv-wait").start()
+
+    def _h_stack_dump(self, ctx: _ConnCtx, m: dict) -> None:
+        """On-demand stack profiling of every live worker on this node
+        (reference: the dashboard reporter's py-spy role).  Parked
+        reply; answers with whatever arrived when `timeout` expires."""
+        token = os.urandom(8)
+        timeout = m.get("timeout", 10.0)
+        with self.lock:
+            workers = [w for w in self.workers.values()
+                       if w.conn_send is not None and w.state != "dead"]
+            rec = {"stacks": {}, "pending": set(), "ctx": ctx,
+                   "m": m, "done": False}
+            for w in workers:
+                try:
+                    w.conn_send({"type": "dump_stacks", "token": token})
+                    rec["pending"].add(w.pid)
+                except Exception:
+                    pass
+            if not rec["pending"]:
+                ctx.reply(m, {"stacks": {}})
+                return
+            self._stack_dumps[token] = rec
+
+            def expire() -> None:
+                with self.lock:
+                    r = self._stack_dumps.pop(token, None)
+                    if r is None or r["done"]:
+                        return
+                    r["done"] = True
+                try:
+                    ctx.reply(m, {"stacks": r["stacks"]})
+                except Exception:
+                    pass
+
+            self._deadline_waiters.append(
+                (time.time() + timeout, expire))
+
+    def _h_stacks_reply(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            rec = self._stack_dumps.get(m["token"])
+            if rec is None or rec["done"]:
+                return
+            rec["stacks"][m["pid"]] = m["text"]
+            rec["pending"].discard(m["pid"])
+            if rec["pending"]:
+                return
+            rec["done"] = True
+            self._stack_dumps.pop(m["token"], None)
+        try:
+            rec["ctx"].reply(rec["m"], {"stacks": rec["stacks"]})
+        except Exception:
+            pass
 
     def _h_kv_del(self, ctx: _ConnCtx, m: dict) -> None:
         ctx.reply(m, {"ok": self.gcs.kv_del(m["ns"], m["key"])})

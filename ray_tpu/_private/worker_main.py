@@ -45,6 +45,25 @@ class WorkerRuntime:
     def handle_push(self, msg: dict) -> None:
         if msg["type"] == "execute_task":
             self.task_queue.put(msg)
+        elif msg["type"] == "dump_stacks":
+            # On-demand stack profiling (reference: dashboard
+            # reporter's py-spy role): formatted stacks of every
+            # thread, answered out-of-band so a busy task can't block
+            # the observation of what it's busy ON.
+            import sys
+            import traceback
+            frames = sys._current_frames()
+            out = []
+            for t in threading.enumerate():
+                f = frames.get(t.ident)
+                if f is None:
+                    continue
+                out.append(f"--- thread {t.name} (tid={t.ident}) ---")
+                out.extend(s.rstrip() for s in
+                           traceback.format_stack(f))
+            self.client.conn.notify({
+                "type": "stacks_reply", "token": msg["token"],
+                "pid": os.getpid(), "text": "\n".join(out)})
         elif msg["type"] == "exit":
             os._exit(0)
 

@@ -104,3 +104,69 @@ def annotate(name: str):
     regions show under `name` in the xprof timeline."""
     import jax
     return jax.profiler.TraceAnnotation(name)
+
+
+def export_otlp(filename: Optional[str] = None,
+                endpoint: Optional[str] = None,
+                service_name: str = "ray_tpu") -> dict:
+    """Export the profile spans as OTLP/JSON (the OpenTelemetry
+    ExportTraceServiceRequest schema), so any OTLP-ingesting backend
+    (Jaeger, Tempo, collector) can read them — the reference's
+    util/tracing/tracing_helper.py role without requiring the otel SDK
+    in the image.  Writes to `filename` and/or POSTs to `endpoint`
+    (an OTLP/HTTP traces URL); returns the payload."""
+    import os
+    import urllib.request
+
+    def span_id(n: int) -> str:
+        return f"{n & 0xFFFFFFFFFFFFFFFF:016x}"
+
+    spans = []
+    trace_id = os.urandom(16).hex()
+    for i, ev in enumerate(timeline_events()):
+        attrs = [{"key": "node.id",
+                  "value": {"stringValue": str(ev.get("node_id", ""))[:16]}},
+                 {"key": "process.pid",
+                  "value": {"intValue": str(ev.get("pid", 0))}}]
+        for k, v in (ev.get("extra") or {}).items() \
+                if isinstance(ev.get("extra"), dict) else []:
+            attrs.append({"key": str(k),
+                          "value": {"stringValue": str(v)}})
+        spans.append({
+            "traceId": trace_id,
+            "spanId": span_id(i + 1),
+            "name": ev.get("name", "<span>"),
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(int(ev["start"] * 1e9)),
+            "endTimeUnixNano": str(int(max(ev["end"], ev["start"]) * 1e9)),
+            "attributes": attrs,
+            "status": ({"code": 2} if ev.get("failed")
+                       else {"code": 1}),
+        })
+    payload = {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": service_name}}]},
+        "scopeSpans": [{
+            "scope": {"name": "ray_tpu.profiling"},
+            "spans": spans,
+        }],
+    }]}
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(payload, f)
+    if endpoint:
+        req = urllib.request.Request(
+            endpoint, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).read()
+    return payload
+
+
+def stack_traces(timeout: float = 10.0) -> Dict[int, str]:
+    """On-demand stack dump of every live worker process on this node
+    (reference: the dashboard reporter's py-spy integration).  Returns
+    {pid: formatted stacks}."""
+    return _client().conn.call({"type": "stack_dump",
+                                "timeout": timeout},
+                               timeout=timeout + 10.0)["stacks"]

@@ -67,3 +67,50 @@ def test_failed_task_span_flagged(rt):
     events = profiling.timeline_events()
     assert any(e["name"].endswith("boom") and e.get("failed")
                for e in events)
+
+
+def test_otlp_export_schema(ray_start, tmp_path):
+    from ray_tpu.util import profiling
+
+    @ray_tpu.remote
+    def work(x):
+        with profiling.span("inner", tag="t1"):
+            return x + 1
+
+    assert ray_tpu.get(work.remote(1), timeout=60) == 2
+    out = str(tmp_path / "otlp.json")
+    payload = profiling.export_otlp(out)
+    import json as _json
+    disk = _json.load(open(out))
+    assert disk == payload
+    rs = payload["resourceSpans"][0]
+    svc = rs["resource"]["attributes"][0]
+    assert svc["key"] == "service.name"
+    spans = rs["scopeSpans"][0]["spans"]
+    assert any(sp["name"] == "inner" for sp in spans)
+    for sp in spans:
+        assert len(sp["traceId"]) == 32 and len(sp["spanId"]) == 16
+        assert int(sp["endTimeUnixNano"]) >= int(sp["startTimeUnixNano"])
+
+
+def test_on_demand_stack_traces(ray_start):
+    import time as _time
+    from ray_tpu.util import profiling
+
+    @ray_tpu.remote
+    class Sleeper:
+        def snooze(self):
+            _time.sleep(20)
+            return 1
+
+        def marker_fn_for_stack(self):
+            return _time.sleep(20) or 1
+
+    a = Sleeper.remote()
+    ref = a.marker_fn_for_stack.remote()
+    _time.sleep(1.0)          # let the method start
+    stacks = profiling.stack_traces(timeout=15.0)
+    assert stacks, "no worker stacks returned"
+    joined = "\n".join(stacks.values())
+    assert "marker_fn_for_stack" in joined, joined[-2000:]
+    ray_tpu.kill(a)
