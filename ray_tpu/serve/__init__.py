@@ -28,11 +28,14 @@ from typing import Any, Dict, List, Optional
 import cloudpickle
 
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
+                                     multiplexed)
 from ray_tpu.serve._controller import CONTROLLER_NAME, ServeController
 
 __all__ = ["deployment", "run", "delete", "shutdown", "status",
            "get_deployment_handle", "batch", "Deployment",
-           "DeploymentHandle", "start_http_proxy"]
+           "DeploymentHandle", "start_http_proxy", "multiplexed",
+           "get_multiplexed_model_id"]
 
 
 def start_http_proxy(port: int = 8000, host: str = "127.0.0.1"):
@@ -145,16 +148,21 @@ class DeploymentHandle:
 
 class _HandleMethod:
     def __init__(self, handle: DeploymentHandle, method: str,
-                 stream: bool = False) -> None:
+                 stream: bool = False, model_id: str = "") -> None:
         self._handle = handle
         self._method = method
         self._stream = stream
+        self._model_id = model_id
 
-    def options(self, *, stream: bool = False) -> "_HandleMethod":
+    def options(self, *, stream: bool = False,
+                multiplexed_model_id: str = "") -> "_HandleMethod":
         """`handle.method.options(stream=True).remote(...)` returns an
         ObjectRefGenerator of per-item refs (reference:
-        serve/handle.py DeploymentResponseGenerator)."""
-        return _HandleMethod(self._handle, self._method, stream=stream)
+        serve/handle.py DeploymentResponseGenerator);
+        `multiplexed_model_id` routes to replicas holding the model
+        (reference: handle multiplexing)."""
+        return _HandleMethod(self._handle, self._method, stream=stream,
+                             model_id=multiplexed_model_id)
 
     def remote(self, *args, **kwargs):
         router = self._handle._get_router()
@@ -163,7 +171,8 @@ class _HandleMethod:
                                                 kwargs)
             _attach_done_callback(router, gen.completed(), replica)
             return gen
-        ref, replica = router.assign(self._method, args, kwargs)
+        ref, replica = router.assign(self._method, args, kwargs,
+                                     self._model_id)
         _attach_done_callback(router, ref, replica)
         return ref
 

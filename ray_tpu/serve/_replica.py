@@ -23,10 +23,13 @@ class Replica:
         self._served = 0
 
     async def handle_request(self, method: str, args: tuple,
-                             kwargs: dict) -> Any:
+                             kwargs: dict,
+                             multiplexed_model_id: str = "") -> Any:
         """Run one request on the user instance (async so batched /
         concurrent user methods interleave on the actor's event loop)."""
+        from ray_tpu.serve.multiplex import _set_current_model_id
         self._inflight += 1
+        token = _set_current_model_id(multiplexed_model_id)
         try:
             target = getattr(self._user, method)
             out = target(*args, **(kwargs or {}))
@@ -55,6 +58,13 @@ class Replica:
         """Probed by the pow-2 router (reference: replica queue-length
         probing in pow_2_scheduler.py)."""
         return self._inflight
+
+    def replica_info(self) -> dict:
+        """Router probe: queue length + resident multiplexed models
+        (reference: multiplex-aware pow-2 scheduling)."""
+        from ray_tpu.serve.multiplex import resident_model_ids
+        return {"qlen": self._inflight,
+                "model_ids": resident_model_ids(self._user)}
 
     def stats(self) -> dict:
         return {"inflight": self._inflight, "served": self._served}

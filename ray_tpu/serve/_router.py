@@ -43,6 +43,8 @@ class Router:
         # replica-side queue lengths from the last probe (baseline the
         # caller-side delta is applied to).
         self._probed: Dict[bytes, int] = {}
+        # replica -> resident multiplexed model ids (last probe)
+        self._models: Dict[bytes, list] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         self._last_probe = 0.0
@@ -132,7 +134,8 @@ class Router:
                 if get_global_client() is None:
                     return      # session shut down mid-probe
                 try:
-                    qlen = ray_tpu.get(r.queue_len.remote(), timeout=5)
+                    info = ray_tpu.get(r.replica_info.remote(),
+                                       timeout=5)
                 except Exception:
                     continue
                 with self._lock:
@@ -143,7 +146,8 @@ class Router:
                         # _load doesn't double-count ours.
                         ours = self._outstanding.get(r._actor_id, 0)
                         self._probed[r._actor_id] = max(
-                            0, int(qlen) - ours)
+                            0, int(info["qlen"]) - ours)
+                        self._models[r._actor_id] = info["model_ids"]
 
         t = threading.Thread(target=probe, daemon=True,
                              name="rtpu-serve-probe")
@@ -155,8 +159,10 @@ class Router:
         k = replica._actor_id
         return self._outstanding.get(k, 0) + self._probed.get(k, 0)
 
-    def pick(self):
-        """Pow-2 choice over caller-side outstanding + probed counts."""
+    def pick(self, model_id: str = ""):
+        """Pow-2 choice over caller-side outstanding + probed counts;
+        with a multiplexed model id, replicas already holding the
+        model win (reference: multiplex-aware pow_2_scheduler)."""
         self._refresh()
         self._maybe_probe()
         with self._lock:
@@ -164,10 +170,16 @@ class Router:
             if not reps:
                 raise NoReplicasError(
                     f"deployment {self._name!r} has no replicas")
-            if len(reps) == 1:
-                choice = reps[0]
+            pool = reps
+            if model_id:
+                holders = [r for r in reps if model_id in
+                           self._models.get(r._actor_id, ())]
+                if holders:
+                    pool = holders
+            if len(pool) == 1:
+                choice = pool[0]
             else:
-                a, b = random.sample(reps, 2)
+                a, b = random.sample(pool, 2)
                 choice = a if self._load(a) <= self._load(b) else b
             self._outstanding[choice._actor_id] = \
                 self._outstanding.get(choice._actor_id, 0) + 1
@@ -179,10 +191,12 @@ class Router:
             if self._outstanding.get(k, 0) > 0:
                 self._outstanding[k] -= 1
 
-    def assign(self, method: str, args: tuple, kwargs: dict):
+    def assign(self, method: str, args: tuple, kwargs: dict,
+               model_id: str = ""):
         """Submit one request; returns (ObjectRef, replica)."""
-        replica = self.pick()
-        ref = replica.handle_request.remote(method, args, kwargs)
+        replica = self.pick(model_id)
+        ref = replica.handle_request.remote(method, args, kwargs,
+                                            model_id)
         return ref, replica
 
     def assign_stream(self, method: str, args: tuple, kwargs: dict):

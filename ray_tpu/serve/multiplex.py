@@ -1,0 +1,129 @@
+"""Model multiplexing: many models per deployment, LRU-resident per
+replica (reference: python/ray/serve/multiplex.py
+_ModelMultiplexWrapper + serve.multiplexed / get_multiplexed_model_id).
+
+Usage:
+
+    @serve.deployment
+    class ModelServer:
+        @serve.multiplexed(max_num_models_per_replica=3)
+        async def get_model(self, model_id: str):
+            return load_model(model_id)          # expensive
+
+        async def __call__(self, x):
+            model = await self.get_model(
+                serve.get_multiplexed_model_id())
+            return model(x)
+
+    handle.options(multiplexed_model_id="m7").remote(x)
+
+The router prefers replicas that already hold the requested model
+(multiplex-aware pow-2: replicas report their resident model ids with
+the queue-length probe), so hot models stay loaded instead of
+thrashing the LRU across replicas.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import functools
+import inspect
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_current_model_id: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "rtpu_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a request: the model id the caller routed with
+    (reference: serve.get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+def _set_current_model_id(model_id: str):
+    return _current_model_id.set(model_id)
+
+
+class _ModelCache:
+    """Per-replica LRU of loaded models with single-flight loading."""
+
+    def __init__(self, loader: Callable, max_models: int) -> None:
+        self._loader = loader
+        self._max = max(max_models, 1)
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._loading: dict = {}
+        self._lock = asyncio.Lock()
+
+    def model_ids(self):
+        return list(self._models) + list(self._loading)
+
+    async def get(self, owner, model_id: str):
+        async with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+            fut = self._loading.get(model_id)
+            if fut is None:
+                fut = asyncio.get_running_loop().create_future()
+                self._loading[model_id] = fut
+                load_here = True
+            else:
+                load_here = False
+        if not load_here:
+            return await fut
+        try:
+            out = self._loader(owner, model_id)
+            if inspect.isawaitable(out):
+                out = await out
+        except BaseException as e:      # noqa: BLE001
+            async with self._lock:
+                self._loading.pop(model_id, None)
+            fut.set_exception(e)
+            raise
+        async with self._lock:
+            self._loading.pop(model_id, None)
+            self._models[model_id] = out
+            evicted = None
+            if len(self._models) > self._max:
+                _, evicted = self._models.popitem(last=False)
+        if evicted is not None:
+            deleter = getattr(evicted, "__del__", None)
+            del evicted
+        fut.set_result(out)
+        return out
+
+
+def multiplexed(_fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for the per-replica model loader (reference:
+    serve.multiplexed)."""
+
+    def deco(fn: Callable):
+        cache = _ModelCache(fn, max_num_models_per_replica)
+
+        @functools.wraps(fn)
+        async def wrapper(self, model_id: str):
+            return await cache.get(self, model_id)
+
+        wrapper.__rtpu_multiplex_cache__ = cache
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco
+
+
+def resident_model_ids(user_instance) -> list:
+    """Model ids currently loaded on this replica (router probe)."""
+    out = []
+    for name in dir(type(user_instance)):
+        try:
+            attr = getattr(type(user_instance), name)
+        except AttributeError:
+            continue
+        cache = getattr(attr, "__rtpu_multiplex_cache__", None)
+        if cache is not None:
+            out.extend(cache.model_ids())
+    return out
