@@ -34,7 +34,8 @@ from ray_tpu.serve._controller import CONTROLLER_NAME, ServeController
 
 __all__ = ["deployment", "run", "delete", "shutdown", "status",
            "get_deployment_handle", "batch", "Deployment",
-           "DeploymentHandle", "start_http_proxy", "multiplexed",
+           "DeploymentHandle", "start_http_proxy", "start_grpc_proxy",
+           "multiplexed",
            "get_multiplexed_model_id"]
 
 
@@ -44,6 +45,15 @@ def start_http_proxy(port: int = 8000, host: str = "127.0.0.1"):
     the pow-2 router to a replica.  See serve/_proxy.py."""
     from ray_tpu.serve import _proxy
     return _proxy.start(port=port, host=host)
+
+
+def start_grpc_proxy(port: int = 9000, host: str = "127.0.0.1"):
+    """Expose deployments over gRPC (reference: gRPCProxy,
+    serve/_private/proxy.py:558).  Generic bytes-in/bytes-out methods
+    /ray_tpu.serve.Serve/{Call,Stream} — no compiled protos needed;
+    see serve/_grpc_proxy.py for the JSON envelope."""
+    from ray_tpu.serve import _grpc_proxy
+    return _grpc_proxy.start(port=port, host=host)
 
 
 def _get_or_create_controller():
@@ -247,6 +257,11 @@ def shutdown() -> None:
     import ray_tpu
     from ray_tpu.serve import _proxy
     _proxy.stop()
+    try:
+        from ray_tpu.serve import _grpc_proxy
+        _grpc_proxy.stop()
+    except Exception:
+        pass
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:

@@ -1,0 +1,111 @@
+"""Serve gRPC ingress (reference: serve/_private/proxy.py gRPCProxy
+:558).
+
+A generic-handler gRPC server — no compiled protos needed on either
+side (any gRPC client can call with bytes in/out):
+
+    /ray_tpu.serve.Serve/Call       unary:  request JSON -> reply JSON
+    /ray_tpu.serve.Serve/Stream     server-streaming: one JSON message
+                                    per yielded item
+
+Request JSON: {"deployment": str, "method": str (optional),
+"arg": any, "multiplexed_model_id": str (optional)}.
+Reply JSON: {"result": ...} or {"error": "..."}.
+
+Python example without generated stubs:
+
+    ch = grpc.insecure_channel(addr)
+    call = ch.unary_unary("/ray_tpu.serve.Serve/Call")
+    reply = json.loads(call(json.dumps(
+        {"deployment": "Model", "arg": 21}).encode()))
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Optional
+
+_SERVICE = "ray_tpu.serve.Serve"
+
+
+def _handle_unary(request: bytes) -> bytes:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve._router import NoReplicasError
+    try:
+        req = json.loads(request)
+        handle = serve.get_deployment_handle(req["deployment"])
+        m = handle.method(req.get("method") or "__call__")
+        if req.get("multiplexed_model_id"):
+            m = m.options(
+                multiplexed_model_id=req["multiplexed_model_id"])
+        result = ray_tpu.get(m.remote(req.get("arg")), timeout=120)
+        return json.dumps({"result": result}, default=str).encode()
+    except (NoReplicasError, ValueError, KeyError) as e:
+        return json.dumps({"error": repr(e), "code": 404}).encode()
+    except Exception as e:  # noqa: BLE001
+        return json.dumps({"error": repr(e), "code": 500}).encode()
+
+
+def _handle_stream(request: bytes):
+    import ray_tpu
+    from ray_tpu import serve
+    try:
+        req = json.loads(request)
+        handle = serve.get_deployment_handle(req["deployment"])
+        m = handle.method(req.get("method") or "__call__")
+        gen = m.options(stream=True).remote(req.get("arg"))
+        for ref in gen:
+            item = ray_tpu.get(ref, timeout=120)
+            yield json.dumps({"item": item}, default=str).encode()
+        yield json.dumps({"end": True}).encode()
+    except Exception as e:  # noqa: BLE001
+        yield json.dumps({"error": repr(e)}).encode()
+
+
+class _GenericServe:
+    """grpc.GenericRpcHandler over raw bytes."""
+
+    def service(self, handler_call_details):
+        import grpc
+        method = handler_call_details.method
+        if method == f"/{_SERVICE}/Call":
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: _handle_unary(req),
+                request_deserializer=None, response_serializer=None)
+        if method == f"/{_SERVICE}/Stream":
+            return grpc.unary_stream_rpc_method_handler(
+                lambda req, ctx: _handle_stream(req),
+                request_deserializer=None, response_serializer=None)
+        return None
+
+
+_server = None
+_lock = threading.Lock()
+
+
+def start(port: int = 9000, host: str = "127.0.0.1"):
+    """Start (or return) the gRPC proxy; returns (server, bound_port).
+    Port 9000 mirrors the reference's default serve gRPC port."""
+    global _server
+    import grpc
+    with _lock:
+        if _server is not None:
+            return _server
+        server = grpc.server(
+            __import__("concurrent.futures", fromlist=["f"])
+            .ThreadPoolExecutor(max_workers=16),
+            handlers=(_GenericServe(),))
+        bound = server.add_insecure_port(f"{host}:{port}")
+        server.start()
+        _server = (server, bound)
+        return _server
+
+
+def stop() -> None:
+    global _server
+    with _lock:
+        if _server is not None:
+            _server[0].stop(grace=1.0)
+            _server = None

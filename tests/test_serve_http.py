@@ -71,3 +71,37 @@ def test_http_proxy_routes(rt):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(f"{base}/Calc/add", {"a": 1})   # missing kwarg
     assert ei.value.code == 500
+
+
+def test_grpc_proxy_unary_and_stream(rt):
+    """gRPC ingress over generic bytes methods (reference: gRPCProxy,
+    proxy.py:558) — no generated stubs on either side."""
+    grpc = pytest.importorskip("grpc")
+    import json as _json
+
+    @serve.deployment
+    class G:
+        def __call__(self, x):
+            return {"doubled": x * 2}
+
+        def ticks(self, n):
+            for i in range(int(n)):
+                yield i * 7
+
+    serve.run(G)
+    _, port = serve.start_grpc_proxy(port=0)
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_unary(f"/ray_tpu.serve.Serve/Call")
+    reply = _json.loads(call(_json.dumps(
+        {"deployment": "G", "arg": 21}).encode(), timeout=60))
+    assert reply == {"result": {"doubled": 42}}
+    reply = _json.loads(call(_json.dumps(
+        {"deployment": "NoSuch", "arg": 1}).encode(), timeout=60))
+    assert reply.get("code") in (404, 500)
+    stream = ch.unary_stream(f"/ray_tpu.serve.Serve/Stream")
+    msgs = [_json.loads(m) for m in stream(_json.dumps(
+        {"deployment": "G", "method": "ticks", "arg": 3}).encode(),
+        timeout=60)]
+    assert msgs[:3] == [{"item": 0}, {"item": 7}, {"item": 14}]
+    assert msgs[-1] == {"end": True}
+    ch.close()
