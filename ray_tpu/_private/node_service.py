@@ -151,6 +151,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._chan_fwd_queues: Dict[tuple, Any] = {}
         # In-flight on-demand stack dumps: token -> collection record.
         self._stack_dumps: Dict[bytes, dict] = {}
+        # stream_id -> home node for streaming calls on REMOTE actors:
+        # the item table lives on the actor's node; stream_next/release
+        # proxy there (cross-node streaming generators).
+        self._remote_streams: Dict[bytes, bytes] = {}
         # Compiled-DAG channel queues (cross-node channel plane;
         # reference: experimental/channel/shared_memory_channel.py for
         # same-host, torch_tensor_nccl_channel.py for cross-host).  A
@@ -693,18 +697,13 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 if home is not None and home != self.node_id:
                     rec = TaskRecord(spec)
                     if spec.get("streaming"):
-                        # The stream table is node-local: a remote
-                        # actor's yields would land on its home node
-                        # while the consumer polls here.  Fail loudly
-                        # rather than return a silently-empty stream.
-                        self.tasks[rec.task_id] = rec
-                        for oid in spec["return_ids"]:
-                            self.objects.setdefault(oid, ObjectEntry())
-                        self._fail_task_returns(rec, exc.RayTpuError(
-                            "streaming generator methods require the "
-                            "actor to live on the calling node"))
-                        ctx.reply(m, {"ok": True})
-                        return
+                        # Remote-actor stream: the item table fills on
+                        # the actor's HOME node; remember where so
+                        # stream_next/release from local consumers
+                        # proxy there (items themselves are ordinary
+                        # GCS-located objects and pull across).
+                        self._remote_streams[
+                            spec["return_ids"][0]] = home
                     # Remote actor call: forward to its home node; results
                     # come back through the GCS location directory.
                     self.tasks[rec.task_id] = rec

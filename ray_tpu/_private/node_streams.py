@@ -83,6 +83,10 @@ class StreamChannelMixin:
     def _h_stream_next(self, ctx: _ConnCtx, m: dict) -> None:
         """Parked reply (no busy-poll): the answer goes out when the
         item arrives or the stream finishes."""
+        home = self._remote_streams.get(m["stream_id"])
+        if home is not None and home != self.node_id:
+            self._proxy_stream_rpc(ctx, m, home)
+            return
         with self.lock:
             rec = self._streams.get(m["stream_id"])
             idx = m["index"]
@@ -103,11 +107,48 @@ class StreamChannelMixin:
             self._stream_rec(m["stream_id"])["waiters"].append(
                 (idx, ctx, m))
 
+    def _proxy_stream_rpc(self, ctx: _ConnCtx, m: dict, home: bytes,
+                          oneway: bool = False) -> None:
+        """Forward a stream_next/stream_release for a REMOTE actor's
+        stream to its home node on a side thread (the home parks the
+        stream_next reply until the item lands; blocking this
+        connection's dispatch would stall the consumer's other rpcs).
+        stream_release is fire-and-forget on both hops."""
+        def fwd() -> None:
+            ninfo = self._node_info(home)
+            wire = {k: v for k, v in m.items()
+                    if not k.startswith("__")}
+            if ninfo is None:
+                rep = {"status": "end"}
+            elif oneway:
+                try:
+                    self._peer_conn_to(ninfo).notify(wire)
+                except Exception:
+                    pass
+                return
+            else:
+                try:
+                    rep = self._peer_conn_to(ninfo).call(wire,
+                                                         timeout=600.0)
+                except Exception:
+                    rep = {"status": "end"}
+            try:
+                ctx.reply(m, rep)
+            except Exception:
+                pass
+
+        threading.Thread(target=fwd, daemon=True,
+                         name="rtpu-stream-proxy").start()
+
     def _h_stream_release(self, ctx: _ConnCtx, m: dict) -> None:
         """Consumer dropped its generator: release the stream's item
         holds (each item was born with the creation pin).  A tombstone
         stays until the producing task completes so late yields are
         dropped instead of resurrecting the record."""
+        home = self._remote_streams.pop(m["stream_id"], None)
+        if home is not None and home != self.node_id:
+            self._proxy_stream_rpc(ctx, m, home, oneway=True)
+            return
         with self.lock:
             rec = self._streams.get(m["stream_id"])
             if rec is None:
