@@ -11,6 +11,10 @@ exists here as JSON):
     GET /api/nodes      node table
     GET /api/summary    task/actor/object rollups
     GET /metrics        Prometheus exposition (scrape endpoint)
+    GET /graphs         self-contained metrics graphs (canvas
+                        sparklines over /api/metrics.json samples —
+                        the dashboard-metrics role without Grafana)
+    GET /api/metrics.json   metric series as JSON
 
 Runs as a daemon thread inside whichever process calls `serve()` — the
 CLI head process by default."""
@@ -39,6 +43,37 @@ fetch('/api/summary').then(r=>r.json()).then(s=>{
        '<h3>objects</h3><pre>' +
        JSON.stringify(s.objects, null, 1) + '</pre>';
   document.getElementById('c').innerHTML = h;});
+</script></body></html>"""
+
+
+_GRAPHS = """<!doctype html><html><head><title>ray_tpu metrics</title>
+<style>body{font-family:monospace;margin:2em}canvas{border:1px solid
+#ccc;display:block;margin-bottom:4px}h4{margin:12px 0 2px}</style>
+</head><body><h2>ray_tpu metrics</h2>
+<div id=c>sampling…</div><script>
+const hist = {};           // name -> [values]
+async function tick(){
+  const series = await (await fetch('/api/metrics.json')).json();
+  const box = document.getElementById('c'); box.innerHTML='';
+  for (const s of series){
+    const key = s.name + JSON.stringify(s.tags||{});
+    (hist[key] = hist[key]||[]).push(s.value);
+    if (hist[key].length > 120) hist[key].shift();
+    const h = document.createElement('h4');
+    h.textContent = key + ' = ' + s.value.toFixed(3);
+    const cv = document.createElement('canvas');
+    cv.width = 480; cv.height = 60;
+    const g = cv.getContext('2d'); const d = hist[key];
+    const mx = Math.max(...d, 1e-9), mn = Math.min(...d, 0);
+    g.strokeStyle = '#07c'; g.beginPath();
+    d.forEach((v,i)=>{
+      const x = i*(480/119), y = 58-56*((v-mn)/((mx-mn)||1));
+      i ? g.lineTo(x,y) : g.moveTo(x,y);});
+    g.stroke(); box.appendChild(h); box.appendChild(cv);
+  }
+  setTimeout(tick, 2000);
+}
+tick();
 </script></body></html>"""
 
 
@@ -76,6 +111,19 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path == "/metrics":
                 self._send(200, metrics.prometheus_text().encode(),
                            "text/plain; version=0.0.4")
+            elif self.path == "/graphs":
+                self._send(200, _GRAPHS.encode(), "text/html")
+            elif self.path == "/api/metrics.json":
+                import ray_tpu
+                series = ray_tpu._ensure_connected().metrics_scrape()
+                out = []
+                for m in series:
+                    v = m.get("value")
+                    if isinstance(v, (int, float)):
+                        out.append({"name": m.get("name"),
+                                    "tags": m.get("tags") or {},
+                                    "value": float(v)})
+                self._send(200, json.dumps(out).encode())
             else:
                 self._send(404, b'{"error": "not found"}')
         except Exception as e:   # introspection must never crash serving

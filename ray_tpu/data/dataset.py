@@ -441,6 +441,24 @@ class Dataset:
         for blk in self._iter_blocks():
             yield from B.block_rows(blk)
 
+    def iter_torch_batches(self, batch_size: int = 256,
+                           drop_last: bool = False,
+                           device: Optional[str] = None):
+        """Batches as {col: torch.Tensor} (reference:
+        Dataset.iter_torch_batches).  Zero-copy from the block arrays
+        when dtypes allow (torch.from_numpy)."""
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                try:
+                    t = torch.from_numpy(np.ascontiguousarray(v))
+                except TypeError:
+                    t = torch.tensor(v.tolist())
+                out[k] = t.to(device) if device else t
+            yield out
+
     def iter_device_batches(self, batch_size: int, sharding=None,
                             prefetch: int = 2,
                             drop_last: bool = True) -> Iterator[Any]:

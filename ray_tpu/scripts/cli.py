@@ -293,6 +293,30 @@ def _job_client(args):
     return JobSubmissionClient(addr)
 
 
+def cmd_serve(args) -> int:
+    """Declarative serve apply/status/shutdown (reference: `serve
+    deploy` over the REST config, serve/schema.py)."""
+    from ray_tpu.util import client as thin
+    addr = getattr(args, "address", None) or _head_address(args)
+    if not addr:
+        raise SystemExit("no cluster on record; pass --address H:P")
+    ctx = thin.connect(addr)
+    try:
+        from ray_tpu import serve
+        if args.serve_cmd == "deploy":
+            from ray_tpu.serve.schema import serve_apply
+            names = serve_apply(args.config)
+            print(json.dumps({"deployed": names}))
+        elif args.serve_cmd == "status":
+            print(json.dumps(serve.status(), indent=1, default=str))
+        elif args.serve_cmd == "shutdown":
+            serve.shutdown()
+            print("serve shut down")
+    finally:
+        ctx.disconnect()
+    return 0
+
+
 def cmd_job(args) -> int:
     jc = _job_client(args)
     try:
@@ -392,6 +416,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     j = jsub.add_parser("list")
     j.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("serve", help="declarative serve config")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    sp = ssub.add_parser("deploy", help="apply a YAML app config")
+    sp.add_argument("config")
+    sp.add_argument("--address", default=None,
+                    help="cluster client address host:port")
+    sp2 = ssub.add_parser("status")
+    sp2.add_argument("--address", default=None)
+    sp3 = ssub.add_parser("shutdown")
+    sp3.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("microbench", help="core perf harness")
     p.set_defaults(fn=cmd_microbench)

@@ -1,0 +1,94 @@
+"""Declarative Serve config (reference: serve/schema.py + the REST/YAML
+`serve deploy` flow).
+
+YAML shape:
+
+    applications:
+      - name: app1                       # optional label
+        deployments:
+          - name: Model                  # deployment name
+            import_path: mypkg.mod:Model # class or Deployment object
+            num_replicas: 2
+            max_concurrent_queries: 8
+            init_args: [1, 2]            # optional
+            init_kwargs: {scale: 3}      # optional
+            ray_actor_options: {num_cpus: 1}
+            autoscaling_config: {min_replicas: 1, max_replicas: 4}
+    http:
+      port: 8000                         # optional ingress
+    grpc:
+      port: 9000                         # optional gRPC ingress
+
+`serve_apply(config)` reconciles the cluster to the file: deploys (or
+redeploys) every listed deployment and deletes previously-applied ones
+that vanished from the config (tracked in the GCS KV under
+"serve_config").  CLI: `python -m ray_tpu serve deploy app.yaml` /
+`serve status` / `serve shutdown`.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+from typing import Any, Dict, List, Optional
+
+_KV_NS = "serve_config"
+_KV_KEY = b"applied_deployments"
+
+
+def _import_target(path: str):
+    mod_name, _, attr = path.partition(":")
+    if not attr:
+        mod_name, _, attr = path.rpartition(".")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, attr)
+
+
+def load_config(path_or_dict) -> Dict[str, Any]:
+    if isinstance(path_or_dict, dict):
+        return path_or_dict
+    import yaml
+    with open(path_or_dict) as f:
+        return yaml.safe_load(f)
+
+
+def serve_apply(config) -> List[str]:
+    """Reconcile deployments to the config; returns deployed names."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    cfg = load_config(config)
+    deployed: List[str] = []
+    for app in cfg.get("applications", []):
+        for d in app.get("deployments", []):
+            target = _import_target(d["import_path"])
+            if not isinstance(target, serve.Deployment):
+                target = serve.deployment(target)
+            opts: Dict[str, Any] = {}
+            for k in ("num_replicas", "max_concurrent_queries",
+                      "ray_actor_options", "autoscaling_config"):
+                if k in d:
+                    opts[k] = d[k]
+            if opts:
+                target = target.options(**opts)
+            target = target.bind(*(d.get("init_args") or ()),
+                                 **(d.get("init_kwargs") or {}))
+            serve.run(target, name=d["name"])
+            deployed.append(d["name"])
+    # Reap deployments applied by a previous config but dropped now.
+    client = ray_tpu._ensure_connected()
+    prev_raw = client.kv_get(_KV_NS, _KV_KEY)
+    prev = json.loads(prev_raw) if prev_raw else []
+    for name in prev:
+        if name not in deployed:
+            serve.delete(name)
+    client.kv_put(_KV_NS, _KV_KEY, json.dumps(deployed).encode())
+    http = cfg.get("http")
+    if http:
+        serve.start_http_proxy(port=int(http.get("port", 8000)),
+                               host=http.get("host", "127.0.0.1"))
+    grpc_cfg = cfg.get("grpc")
+    if grpc_cfg:
+        serve.start_grpc_proxy(port=int(grpc_cfg.get("port", 9000)),
+                               host=grpc_cfg.get("host", "127.0.0.1"))
+    return deployed

@@ -56,6 +56,14 @@ def test_cli_cluster_lifecycle(cli_env):
     with urllib.request.urlopen(state["dashboard_url"] + "/metrics",
                                 timeout=10) as resp:
         assert b"ray_tpu_workers" in resp.read()
+    with urllib.request.urlopen(state["dashboard_url"] + "/graphs",
+                                timeout=10) as resp:
+        assert b"canvas" in resp.read()
+    with urllib.request.urlopen(
+            state["dashboard_url"] + "/api/metrics.json",
+            timeout=10) as resp:
+        series = json.loads(resp.read())
+    assert any(s["name"].startswith("ray_tpu") for s in series)
 
     # join a second node, then status shows 2
     r = _cli(cli_env, "start", "--resources", '{"extra": 1}')

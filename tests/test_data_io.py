@@ -84,3 +84,14 @@ def test_actor_pool_fixed_size_unchanged(rt):
         == [i * 2 for i in range(16)]
     op = ds2._plan[-1]
     assert op.min_size == op.max_size == 2
+
+
+def test_iter_torch_batches(rt):
+    torch = pytest.importorskip("torch")
+    ds = Dataset.from_numpy({"x": np.arange(10, dtype=np.float32),
+                             "y": np.arange(10)}, block_rows=4)
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert [len(b["x"]) for b in batches] == [4, 4, 2]
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    assert batches[0]["x"].dtype == torch.float32
+    assert torch.equal(batches[2]["y"], torch.tensor([8, 9]))

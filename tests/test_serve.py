@@ -234,3 +234,38 @@ def test_model_multiplexing(serve_session):
         o = ray_tpu.get(h.method("__call__").options(
             multiplexed_model_id=mid).remote(2), timeout=60)
         assert o["y"] == 2 * scale
+
+
+def test_declarative_yaml_apply(serve_session, tmp_path):
+    """serve/schema.py: YAML-shaped config reconciliation (reference:
+    serve deploy + serve/schema.py) — deploys listed deployments,
+    reaps ones dropped from a later config."""
+    import sys
+    mod = tmp_path / "served_mod.py"
+    mod.write_text(
+        "class Doubler:\n"
+        "    def __init__(self, scale=2):\n"
+        "        self.scale = scale\n"
+        "    def __call__(self, x):\n"
+        "        return x * self.scale\n"
+        "class Echo:\n"
+        "    def __call__(self, x):\n"
+        "        return x\n")
+    sys.path.insert(0, str(tmp_path))
+    try:
+        from ray_tpu.serve.schema import serve_apply
+        cfg = {"applications": [{"name": "app", "deployments": [
+            {"name": "Doubler", "import_path": "served_mod:Doubler",
+             "num_replicas": 1, "init_kwargs": {"scale": 5}},
+            {"name": "Echo", "import_path": "served_mod:Echo"},
+        ]}]}
+        assert serve_apply(cfg) == ["Doubler", "Echo"]
+        h = serve.get_deployment_handle("Doubler")
+        assert ray_tpu.get(h.remote(3), timeout=60) == 15
+        assert set(serve.status()) == {"Doubler", "Echo"}
+        # Drop Echo from the config: reconciliation reaps it.
+        cfg["applications"][0]["deployments"].pop()
+        serve_apply(cfg)
+        assert set(serve.status()) == {"Doubler"}
+    finally:
+        sys.path.remove(str(tmp_path))
