@@ -119,6 +119,9 @@ class StreamChannelMixin:
             wire = {k: v for k, v in m.items()
                     if not k.startswith("__")}
             if ninfo is None:
+                # Home node gone: "end" is correct — the completion
+                # object's failure (node-death recovery) carries the
+                # error to the consumer.
                 rep = {"status": "end"}
             elif oneway:
                 try:
@@ -127,11 +130,21 @@ class StreamChannelMixin:
                     pass
                 return
             else:
-                try:
-                    rep = self._peer_conn_to(ninfo).call(wire,
-                                                         timeout=600.0)
-                except Exception:
-                    rep = {"status": "end"}
+                while True:
+                    try:
+                        rep = self._peer_conn_to(ninfo).call(
+                            wire, timeout=60.0)
+                        break
+                    except TimeoutError:
+                        # Slow producer (long gap between yields): keep
+                        # waiting, matching the local path's indefinite
+                        # park — never truncate the stream silently.
+                        if self._shutdown:
+                            return
+                        continue
+                    except Exception:
+                        rep = {"status": "end"}
+                        break
             try:
                 ctx.reply(m, rep)
             except Exception:

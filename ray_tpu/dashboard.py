@@ -53,7 +53,9 @@ _GRAPHS = """<!doctype html><html><head><title>ray_tpu metrics</title>
 <div id=c>sampling…</div><script>
 const hist = {};           // name -> [values]
 async function tick(){
+ try{
   const series = await (await fetch('/api/metrics.json')).json();
+  if (!Array.isArray(series)) throw new Error('scrape failed');
   const box = document.getElementById('c'); box.innerHTML='';
   for (const s of series){
     const key = s.name + JSON.stringify(s.tags||{});
@@ -71,6 +73,7 @@ async function tick(){
       i ? g.lineTo(x,y) : g.moveTo(x,y);});
     g.stroke(); box.appendChild(h); box.appendChild(cv);
   }
+ }catch(e){ /* transient scrape error: keep the loop alive */ }
   setTimeout(tick, 2000);
 }
 tick();

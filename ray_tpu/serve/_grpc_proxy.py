@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
 _SERVICE = "ray_tpu.serve.Serve"
@@ -55,7 +56,10 @@ def _handle_stream(request: bytes):
         req = json.loads(request)
         handle = serve.get_deployment_handle(req["deployment"])
         m = handle.method(req.get("method") or "__call__")
-        gen = m.options(stream=True).remote(req.get("arg"))
+        gen = m.options(
+            stream=True,
+            multiplexed_model_id=req.get("multiplexed_model_id") or "",
+        ).remote(req.get("arg"))
         for ref in gen:
             item = ray_tpu.get(ref, timeout=120)
             yield json.dumps({"item": item}, default=str).encode()
@@ -93,10 +97,8 @@ def start(port: int = 9000, host: str = "127.0.0.1"):
     with _lock:
         if _server is not None:
             return _server
-        server = grpc.server(
-            __import__("concurrent.futures", fromlist=["f"])
-            .ThreadPoolExecutor(max_workers=16),
-            handlers=(_GenericServe(),))
+        server = grpc.server(ThreadPoolExecutor(max_workers=16),
+                             handlers=(_GenericServe(),))
         bound = server.add_insecure_port(f"{host}:{port}")
         server.start()
         _server = (server, bound)

@@ -27,7 +27,8 @@ class Replica:
                              multiplexed_model_id: str = "") -> Any:
         """Run one request on the user instance (async so batched /
         concurrent user methods interleave on the actor's event loop)."""
-        from ray_tpu.serve.multiplex import _set_current_model_id
+        from ray_tpu.serve.multiplex import (_current_model_id,
+                                             _set_current_model_id)
         self._inflight += 1
         token = _set_current_model_id(multiplexed_model_id)
         try:
@@ -37,6 +38,7 @@ class Replica:
                 out = await out
             return out
         finally:
+            _current_model_id.reset(token)
             self._inflight -= 1
             self._served += 1
 

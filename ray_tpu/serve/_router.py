@@ -82,6 +82,9 @@ class Router:
             self._probed = {
                 r._actor_id: self._probed.get(r._actor_id, 0)
                 for r in self._replicas}
+            self._models = {
+                r._actor_id: self._models.get(r._actor_id, [])
+                for r in self._replicas}
 
     # -- long-poll push (reference: long_poll.py LongPollClient) --------
     def _ensure_poll_thread(self) -> None:

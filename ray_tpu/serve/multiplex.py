@@ -88,9 +88,12 @@ class _ModelCache:
             evicted = None
             if len(self._models) > self._max:
                 _, evicted = self._models.popitem(last=False)
-        if evicted is not None:
-            deleter = getattr(evicted, "__del__", None)
-            del evicted
+        if evicted is not None and hasattr(evicted, "close"):
+            try:
+                evicted.close()     # eager teardown hook, if offered
+            except Exception:
+                pass
+        del evicted
         fut.set_result(out)
         return out
 
