@@ -159,6 +159,13 @@ def shutdown() -> None:
                 ns.NodeService._store_client = None
 
 
+def get_runtime_context():
+    """Identity/introspection for the current driver/task/actor
+    (reference: ray.get_runtime_context)."""
+    from ray_tpu.runtime_context import get_runtime_context as _grc
+    return _grc()
+
+
 def is_initialized() -> bool:
     return _session is not None
 

@@ -188,6 +188,8 @@ class WorkerRuntime:
 
         if inspect.iscoroutinefunction(method) and self.actor_loop:
             async def run_async() -> Any:
+                from ray_tpu.runtime_context import _current_spec
+                _current_spec.set(spec)   # task-local: no reset needed
                 async with self.actor_semaphore:
                     args, kwargs = self.client.unpack_args(spec["args"])
                     return await method(*args, **kwargs)
@@ -227,12 +229,16 @@ class WorkerRuntime:
     # ------------------------------------------------------------------
     def _execute_and_report(self, spec: dict, fn, *args) -> None:
         import time
+        from ray_tpu.runtime_context import _current_spec
         t0 = time.time()
+        token = _current_spec.set(spec)
         try:
             value = fn(*args)
         except BaseException as e:  # noqa: BLE001
             self._report_error(spec, e, start=t0)
             return
+        finally:
+            _current_spec.reset(token)
         self._report_value(spec, value, start=t0)
 
     def _profile(self, spec: dict, start: Optional[float],

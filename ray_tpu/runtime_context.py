@@ -1,0 +1,66 @@
+"""Runtime context: who/where am I (reference:
+python/ray/runtime_context.py — ray.get_runtime_context() with
+get_node_id/get_actor_id/get_task_id/get_worker_id/namespace).
+
+Worker-side identity comes from a contextvar the worker runtime sets
+around each task execution (so threaded/async actors see their own
+task), driver-side from the session.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Any, Dict, Optional
+
+# Set by worker_main around each execution: the task spec.
+_current_spec: "contextvars.ContextVar[Optional[dict]]" = \
+    contextvars.ContextVar("rtpu_current_spec", default=None)
+
+
+class RuntimeContext:
+    def __init__(self, client, spec: Optional[dict]) -> None:
+        self._client = client
+        self._spec = spec or {}
+
+    # -- identity ------------------------------------------------------
+    def get_node_id(self) -> str:
+        return self._client.node_info()["node_id"].hex()
+
+    def get_worker_id(self) -> str:
+        return self._client.client_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        tid = self._spec.get("task_id")
+        return tid.hex() if tid else None
+
+    def get_actor_id(self) -> Optional[str]:
+        aid = self._spec.get("actor_id")
+        return aid.hex() if aid else None
+
+    def get_actor_name(self) -> Optional[str]:
+        return self._spec.get("name") if self.get_actor_id() else None
+
+    @property
+    def namespace(self) -> str:
+        return self._spec.get("namespace", "default")
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return bool(self._spec.get("restarted"))
+
+    def get_assigned_resources(self) -> Dict[str, float]:
+        return dict(self._spec.get("resources") or {})
+
+    def get(self) -> Dict[str, Any]:
+        """Legacy dict form (reference: RuntimeContext.get)."""
+        return {"node_id": self.get_node_id(),
+                "worker_id": self.get_worker_id(),
+                "task_id": self.get_task_id(),
+                "actor_id": self.get_actor_id(),
+                "namespace": self.namespace}
+
+
+def get_runtime_context() -> RuntimeContext:
+    import ray_tpu
+    client = ray_tpu._ensure_connected()
+    return RuntimeContext(client, _current_spec.get())

@@ -185,6 +185,40 @@ def init_collective_group(world_size: int, rank: int,
         time.sleep(_POLL_S)
 
 
+def declare_collective_group(actors, world_size: Optional[int] = None,
+                             ranks: Optional[List[int]] = None,
+                             group_name: str = "default") -> None:
+    """Declare a group FROM THE DRIVER for a set of actors (reference:
+    collective.py declare_collective_group): each actor's first
+    collective op auto-joins with the rank declared for its actor id —
+    no explicit init_collective_group call inside the actors."""
+    world = world_size if world_size is not None else len(actors)
+    rank_list = ranks if ranks is not None else list(range(len(actors)))
+    if sorted(rank_list) != list(range(world)):
+        raise ValueError(f"ranks {rank_list} must cover 0..{world - 1}")
+    c = _client()
+    for actor, rank in zip(actors, rank_list):
+        c.kv_put(_NS, f"{group_name}/declared/"
+                      f"{actor._actor_id.hex()}".encode(),
+                 f"{rank}/{world}".encode())
+
+
+def _maybe_auto_init(name: str) -> Optional[_Group]:
+    """Join a driver-declared group using this actor's identity."""
+    import ray_tpu
+    ctx = ray_tpu.get_runtime_context()
+    aid = ctx.get_actor_id()
+    if aid is None:
+        return None
+    raw = _client().kv_get(_NS, f"{name}/declared/{aid}".encode())
+    if raw is None:
+        return None
+    rank_s, _, world_s = raw.decode().partition("/")
+    init_collective_group(int(world_s), int(rank_s), group_name=name)
+    with _lock:
+        return _groups.get(name)
+
+
 def is_group_initialized(group_name: str = "default") -> bool:
     with _lock:
         return group_name in _groups
@@ -234,9 +268,12 @@ def _group(name: str) -> _Group:
     with _lock:
         g = _groups.get(name)
     if g is None:
+        g = _maybe_auto_init(name)
+    if g is None:
         raise RuntimeError(
             f"collective group {name!r} is not initialized in this "
-            f"process (call init_collective_group first)")
+            f"process (call init_collective_group, or declare it from "
+            f"the driver with declare_collective_group)")
     return g
 
 

@@ -172,3 +172,22 @@ def test_errors(rt):
         col.allreduce(np.ones(1))
     with pytest.raises(ValueError):
         col.init_collective_group(2, 5, group_name="bad")
+
+
+def test_declare_collective_group_auto_join(rt):
+    """Driver-declared group: actors auto-join on their first op
+    (reference: collective.py declare_collective_group)."""
+    from ray_tpu.util import collective as col
+
+    @ray_tpu.remote
+    class Member:
+        def reduce(self, v):
+            import numpy as _np
+            return col.allreduce(_np.array([v], _np.float64),
+                                 "sum", "declared_g").tolist()
+
+    members = [Member.remote() for _ in range(3)]
+    col.declare_collective_group(members, group_name="declared_g")
+    outs = ray_tpu.get([m.reduce.remote(float(i + 1))
+                        for i, m in enumerate(members)], timeout=120)
+    assert outs == [[6.0]] * 3

@@ -335,3 +335,36 @@ def test_actor_num_returns(ray_start):
     m = M.remote()
     x, y = m.pair.remote()
     assert ray_tpu.get([x, y]) == ["a", "b"]
+
+
+def test_runtime_context(ray_start):
+    """ray_tpu.get_runtime_context (reference:
+    python/ray/runtime_context.py): driver/task/actor identity."""
+    ctx = ray_tpu.get_runtime_context()
+    assert len(ctx.get_node_id()) == 32
+    assert ctx.get_task_id() is None and ctx.get_actor_id() is None
+
+    @ray_tpu.remote
+    def in_task():
+        c = ray_tpu.get_runtime_context()
+        return {"task": c.get_task_id(), "actor": c.get_actor_id(),
+                "node": c.get_node_id(),
+                "res": c.get_assigned_resources()}
+
+    out = ray_tpu.get(in_task.remote(), timeout=60)
+    assert out["task"] and out["actor"] is None
+    assert out["node"] == ctx.get_node_id()      # single-node run
+    assert out["res"].get("CPU", 0) >= 1
+
+    @ray_tpu.remote
+    class Ctx:
+        def who(self):
+            c = ray_tpu.get_runtime_context()
+            return {"actor": c.get_actor_id(), "task": c.get_task_id(),
+                    "d": c.get()}
+
+    a = Ctx.remote()
+    out = ray_tpu.get(a.who.remote(), timeout=60)
+    assert out["actor"] == a._actor_id.hex()
+    assert out["task"]
+    assert out["d"]["actor_id"] == out["actor"]
