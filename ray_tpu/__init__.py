@@ -35,6 +35,9 @@ class _Session:
         self.client = client
         self.session_dir = session_dir
         self.is_worker = is_worker
+        # config-override snapshot to restore at shutdown (None = no
+        # _system_config was applied by this session)
+        self.prev_config_overrides = None
 
 
 def _detect_tpu_chips() -> int:
@@ -92,7 +95,13 @@ def init(num_cpus: Optional[float] = None,
             raise RuntimeError("ray_tpu.init() called twice "
                                "(pass ignore_reinit_error=True to allow)")
         if _system_config:
+            # Session-scoped: shutdown() restores the previous override
+            # state, so one session's knobs (e.g. a test's aggressive
+            # OOM thresholds) can never leak into the next.
+            _prev_overrides = dict(config._overrides)
             config.update(_system_config)
+        else:
+            _prev_overrides = None
         if gcs_address is None and os.environ.get("RAY_TPU_GCS_ADDRESS"):
             # Injected by job submission (reference: RAY_ADDRESS) so a
             # plain init() inside a job script joins the cluster.
@@ -131,6 +140,7 @@ def init(num_cpus: Optional[float] = None,
         client = CoreClient(node.socket_path, kind="driver")
         set_global_client(client)
         _session = _Session(node, client, session_dir)
+        _session.prev_config_overrides = _prev_overrides
         atexit.register(shutdown)
 
 
@@ -140,6 +150,10 @@ def shutdown() -> None:
         if _session is None:
             return
         sess, _session = _session, None
+        if sess.prev_config_overrides is not None:
+            with config._lock:
+                config._overrides.clear()
+                config._overrides.update(sess.prev_config_overrides)
         from ray_tpu._private.client import set_global_client
         try:
             sess.client.close()
