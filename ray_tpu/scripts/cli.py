@@ -293,6 +293,27 @@ def _job_client(args):
     return JobSubmissionClient(addr)
 
 
+def cmd_stack(args) -> int:
+    """On-demand stack dump of every live worker (reference: `ray
+    stack` / the dashboard's py-spy role)."""
+    from ray_tpu.util import client as thin
+    addr = getattr(args, "address", None) or _head_address(args)
+    if not addr:
+        raise SystemExit("no cluster on record; pass --address H:P")
+    ctx = thin.connect(addr)
+    try:
+        from ray_tpu.util.profiling import stack_traces
+        stacks = stack_traces(timeout=args.timeout)
+        if not stacks:
+            print("no live workers")
+        for pid, text in sorted(stacks.items()):
+            print(f"===== worker pid {pid} =====")
+            print(text)
+    finally:
+        ctx.disconnect()
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Declarative serve apply/status/shutdown (reference: `serve
     deploy` over the REST config, serve/schema.py)."""
@@ -416,6 +437,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     j = jsub.add_parser("list")
     j.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("stack", help="dump live worker stack traces")
+    p.add_argument("--address", default=None)
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("serve", help="declarative serve config")
     ssub = p.add_subparsers(dest="serve_cmd", required=True)
