@@ -201,6 +201,20 @@ class Dataset:
         return self._with_stage(
             lambda b: [{k: b[k] for k in cols}])
 
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        """Rename columns (reference: Dataset.rename_columns)."""
+        def stage(b: B.Block) -> List[B.Block]:
+            return [{mapping.get(k, k): v for k, v in b.items()}]
+        return self._with_stage(stage)
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column (reference: Dataset.unique)."""
+        seen: set = set()
+        for blk in self._iter_blocks():
+            if column in blk:
+                seen.update(np.unique(blk[column]).tolist())
+        return sorted(seen)
+
     def drop_columns(self, cols: List[str]) -> "Dataset":
         return self._with_stage(
             lambda b: [{k: v for k, v in b.items() if k not in cols}])

@@ -1,0 +1,103 @@
+"""ActorPool: work distribution over a fixed set of actors
+(reference: python/ray/util/actor_pool.py — submit/get_next/
+get_next_unordered/map/map_unordered/has_next/has_free/push/pop_idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]) -> None:
+        self._idle: List[Any] = list(actors)
+        if not self._idle:
+            raise ValueError("ActorPool needs at least one actor")
+        self._future_to_actor: dict = {}
+        self._pending: List[Any] = []       # submission order (refs)
+        self._next_return = 0               # ordered get_next cursor
+
+    # -- submission ----------------------------------------------------
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; runs on the next free actor
+        (raises if none free — check has_free())."""
+        if not self._idle:
+            raise ValueError("no free actors (call get_next first)")
+        actor = self._idle.pop(0)
+        ref = fn(actor, value)
+        self._future_to_actor[ref.binary()] = actor
+        self._pending.append(ref)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def has_next(self) -> bool:
+        return bool(self._pending)
+
+    # -- results -------------------------------------------------------
+    def _finish(self, ref) -> Any:
+        actor = self._future_to_actor.pop(ref.binary(), None)
+        if actor is not None:
+            self._idle.append(actor)
+        self._pending.remove(ref)
+        return ray_tpu.get(ref)
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in SUBMISSION order."""
+        if not self._pending:
+            raise StopIteration("no pending results")
+        ref = self._pending[0]
+        done, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+        if not done:
+            raise TimeoutError("get_next timed out")
+        return self._finish(ref)
+
+    def get_next_unordered(self,
+                           timeout: Optional[float] = None) -> Any:
+        """Whichever pending result completes first."""
+        if not self._pending:
+            raise StopIteration("no pending results")
+        done, _ = ray_tpu.wait(list(self._pending), num_returns=1,
+                               timeout=timeout)
+        if not done:
+            raise TimeoutError("get_next_unordered timed out")
+        return self._finish(done[0])
+
+    # -- bulk ----------------------------------------------------------
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]):
+        """Ordered streaming map keeping every actor busy."""
+        values = iter(values)
+        exhausted = False
+        while True:
+            while not exhausted and self.has_free():
+                try:
+                    self.submit(fn, next(values))
+                except StopIteration:
+                    exhausted = True
+            if not self.has_next():
+                return
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]):
+        values = iter(values)
+        exhausted = False
+        while True:
+            while not exhausted and self.has_free():
+                try:
+                    self.submit(fn, next(values))
+                except StopIteration:
+                    exhausted = True
+            if not self.has_next():
+                return
+            yield self.get_next_unordered()
+
+    # -- membership ----------------------------------------------------
+    def push(self, actor: Any) -> None:
+        self._idle.append(actor)
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._idle.pop(0) if self._idle else None

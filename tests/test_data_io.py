@@ -95,3 +95,28 @@ def test_iter_torch_batches(rt):
     assert isinstance(batches[0]["x"], torch.Tensor)
     assert batches[0]["x"].dtype == torch.float32
     assert torch.equal(batches[2]["y"], torch.tensor([8, 9]))
+
+
+def test_rename_and_unique(rt):
+    ds = Dataset.from_numpy({"a": np.array([3, 1, 2, 1, 3]),
+                             "b": np.arange(5)}, block_rows=2)
+    out = ds.rename_columns({"a": "key"}).sort("key").to_pandas()
+    assert list(out.columns) == ["key", "b"] or set(out.columns) == {"key", "b"}
+    assert ds.unique("a") == [1, 2, 3]
+
+
+def test_actor_pool(rt):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote
+    class Sq:
+        def f(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(2)])
+    assert list(pool.map(lambda a, v: a.f.remote(v), range(6))) \
+        == [0, 1, 4, 9, 16, 25]
+    assert sorted(pool.map_unordered(lambda a, v: a.f.remote(v),
+                                     [2, 3])) == [4, 9]
+    pool.submit(lambda a, v: a.f.remote(v), 7)
+    assert pool.get_next(timeout=60) == 49
