@@ -204,15 +204,34 @@ class Dataset:
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         """Rename columns (reference: Dataset.rename_columns)."""
         def stage(b: B.Block) -> List[B.Block]:
-            return [{mapping.get(k, k): v for k, v in b.items()}]
+            out = {}
+            for k, v in b.items():
+                nk = mapping.get(k, k)
+                if nk in out:
+                    raise ValueError(
+                        f"rename_columns collision: two columns map "
+                        f"to {nk!r}")
+                out[nk] = v
+            return [out]
         return self._with_stage(stage)
 
     def unique(self, column: str) -> List[Any]:
-        """Distinct values of a column (reference: Dataset.unique)."""
+        """Distinct values of a column (reference: Dataset.unique).
+        Projects to the one column before shipping blocks to the
+        driver; a missing column raises (empty shuffle-reducer blocks
+        are tolerated)."""
+        def project(b: B.Block) -> List[B.Block]:
+            if not b:
+                return [b]          # empty reducer partition
+            if column not in b:
+                raise KeyError(
+                    f"no column {column!r} (have {sorted(b)})")
+            return [{column: np.unique(b[column])}]
+
         seen: set = set()
-        for blk in self._iter_blocks():
+        for blk in self._with_stage(project)._iter_blocks():
             if column in blk:
-                seen.update(np.unique(blk[column]).tolist())
+                seen.update(blk[column].tolist())
         return sorted(seen)
 
     def drop_columns(self, cols: List[str]) -> "Dataset":

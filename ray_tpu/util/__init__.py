@@ -9,3 +9,5 @@ __all__ = [
     "PlacementGroup", "placement_group", "remove_placement_group",
     "placement_group_table", "tpu_slice_bundles",
 ]
+
+from ray_tpu.util.actor_pool import ActorPool  # noqa: E402,F401

@@ -17,24 +17,32 @@ class ActorPool:
             raise ValueError("ActorPool needs at least one actor")
         self._future_to_actor: dict = {}
         self._pending: List[Any] = []       # submission order (refs)
-        self._next_return = 0               # ordered get_next cursor
+        # (fn, value) submissions waiting for a free actor (reference:
+        # _pending_submits — submit() queues when the pool is busy).
+        self._queued: List[tuple] = []
 
     # -- submission ----------------------------------------------------
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
-        """fn(actor, value) -> ObjectRef; runs on the next free actor
-        (raises if none free — check has_free())."""
+        """fn(actor, value) -> ObjectRef; runs on the next free actor,
+        or queues until one frees up (reference semantics)."""
         if not self._idle:
-            raise ValueError("no free actors (call get_next first)")
+            self._queued.append((fn, value))
+            return
         actor = self._idle.pop(0)
         ref = fn(actor, value)
         self._future_to_actor[ref.binary()] = actor
         self._pending.append(ref)
 
+    def _drain_queued(self) -> None:
+        while self._queued and self._idle:
+            fn, value = self._queued.pop(0)
+            self.submit(fn, value)
+
     def has_free(self) -> bool:
         return bool(self._idle)
 
     def has_next(self) -> bool:
-        return bool(self._pending)
+        return bool(self._pending) or bool(self._queued)
 
     # -- results -------------------------------------------------------
     def _finish(self, ref) -> Any:
@@ -42,6 +50,7 @@ class ActorPool:
         if actor is not None:
             self._idle.append(actor)
         self._pending.remove(ref)
+        self._drain_queued()        # a freed actor admits queued work
         return ray_tpu.get(ref)
 
     def get_next(self, timeout: Optional[float] = None) -> Any:
@@ -66,34 +75,28 @@ class ActorPool:
         return self._finish(done[0])
 
     # -- bulk ----------------------------------------------------------
+    def _map(self, fn, values, getter):
+        values = iter(values)
+        exhausted = False
+        while True:
+            while not exhausted and self.has_free():
+                try:
+                    self.submit(fn, next(values))
+                except StopIteration:
+                    exhausted = True
+            if not self.has_next():
+                return
+            yield getter()
+
     def map(self, fn: Callable[[Any, Any], Any],
             values: Iterable[Any]):
         """Ordered streaming map keeping every actor busy."""
-        values = iter(values)
-        exhausted = False
-        while True:
-            while not exhausted and self.has_free():
-                try:
-                    self.submit(fn, next(values))
-                except StopIteration:
-                    exhausted = True
-            if not self.has_next():
-                return
-            yield self.get_next()
+        return self._map(fn, values, self.get_next)
 
     def map_unordered(self, fn: Callable[[Any, Any], Any],
                       values: Iterable[Any]):
-        values = iter(values)
-        exhausted = False
-        while True:
-            while not exhausted and self.has_free():
-                try:
-                    self.submit(fn, next(values))
-                except StopIteration:
-                    exhausted = True
-            if not self.has_next():
-                return
-            yield self.get_next_unordered()
+        """Completion-order streaming map."""
+        return self._map(fn, values, self.get_next_unordered)
 
     # -- membership ----------------------------------------------------
     def push(self, actor: Any) -> None:

@@ -120,3 +120,9 @@ def test_actor_pool(rt):
                                      [2, 3])) == [4, 9]
     pool.submit(lambda a, v: a.f.remote(v), 7)
     assert pool.get_next(timeout=60) == 49
+    # Queue-on-busy (reference semantics): more submits than actors.
+    for v in range(5):
+        pool.submit(lambda a, v: a.f.remote(v), v)
+    assert [pool.get_next(timeout=60) for _ in range(5)]         == [0, 1, 4, 9, 16]
+    from ray_tpu.util import ActorPool as CanonicalActorPool
+    assert CanonicalActorPool is ActorPool
