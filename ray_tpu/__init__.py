@@ -256,6 +256,14 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
     return _ensure_connected().wait(list(refs), num_returns, timeout)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Cancel the task producing `ref` (reference: ray.cancel).
+    Pending tasks fail with TaskCancelledError immediately; running
+    tasks receive KeyboardInterrupt (or are force-killed); retries do
+    not resurrect a cancelled task."""
+    _ensure_connected().cancel_task(ref.binary(), force=force)
+
+
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     _ensure_connected().kill_actor(actor._actor_id, no_restart)
 

@@ -523,6 +523,10 @@ class CoreClient:
             kwargs=kwargs, num_returns=num_returns, resources={},
             retries=retries, actor_id=actor_id, method_name=method_name)
 
+    def cancel_task(self, object_id: bytes, force: bool = False) -> dict:
+        return self.conn.call({"type": "cancel_task",
+                               "object_id": object_id, "force": force})
+
     def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
         self.conn.call({"type": "kill_actor", "actor_id": actor_id,
                         "no_restart": no_restart})

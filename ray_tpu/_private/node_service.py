@@ -990,6 +990,13 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 entry = self.objects.get(oid)
                 if entry is not None and entry.deleted:
                     continue
+                if rec is not None and rec.cancelled and loc == "error":
+                    # Normalize the in-worker KeyboardInterrupt to the
+                    # typed cancellation error (reference:
+                    # TaskCancelledError on get()).
+                    blob = ser.dumps(exc.TaskCancelledError(
+                        f"task {rec.spec.get('name')!r} was cancelled"))
+                    loc, data, size = "error", blob, len(blob)
                 self._register_object(
                     oid, loc, data, size,
                     state=FAILED if loc == "error" else READY,
@@ -1145,6 +1152,55 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         return NodeService._store_client
 
     # -- GCS passthrough ---------------------------------------------------
+    def _h_cancel_task(self, ctx: _ConnCtx, m: dict) -> None:
+        """ray_tpu.cancel (reference: ray.cancel / CancelTask RPC):
+        pending tasks fail immediately with TaskCancelledError;
+        dispatched tasks get SIGINT (KeyboardInterrupt in the worker,
+        the reference's in-band cancel) or SIGKILL with force=True.
+        Retries never resurrect a cancelled task; actor tasks are
+        rejected (only async-actor cancel exists in the reference; our
+        actors are in-order queues)."""
+        oid = m["object_id"]
+        force = m.get("force", False)
+        victim = None
+        with self.lock:
+            rec = None
+            e = self.objects.get(oid)
+            if e is not None and e.producing_task is not None:
+                rec = self.tasks.get(e.producing_task)
+            if rec is None:
+                for r in list(self.tasks.values()):
+                    if oid in r.spec["return_ids"]:
+                        rec = r
+                        break
+            if rec is None or rec.state == "done":
+                ctx.reply(m, {"ok": False, "state": "done"})
+                return
+            if rec.actor_id is not None and not rec.is_actor_creation:
+                ctx.reply(m, {"__error__": ValueError(
+                    "actor tasks cannot be cancelled")})
+                return
+            rec.cancelled = True
+            rec.retries_left = 0
+            if rec.state == "pending":
+                self._fail_task_returns(rec, exc.TaskCancelledError(
+                    f"task {rec.spec.get('name')!r} was cancelled "
+                    f"before it started"))
+                self._schedule()
+                ctx.reply(m, {"ok": True, "state": "pending"})
+                return
+            victim = rec.worker
+        if victim is not None and victim.proc is not None:
+            try:
+                if force:
+                    victim.proc.kill()
+                else:
+                    import signal
+                    os.kill(victim.pid, signal.SIGINT)
+            except OSError:
+                pass
+        ctx.reply(m, {"ok": True, "state": "dispatched"})
+
     def _h_kv_put(self, ctx: _ConnCtx, m: dict) -> None:
         ok = self.gcs.kv_put(m["ns"], m["key"], m["value"],
                              m.get("overwrite", True))
@@ -2044,7 +2100,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 rec.worker = None
                 self.pending_queue.append(rec)
             else:
-                err_cls = (exc.OutOfMemoryError if oom
+                err_cls = (exc.TaskCancelledError if rec.cancelled
+                           else exc.OutOfMemoryError if oom
                            else exc.WorkerCrashedError)
                 self._fail_task_returns(
                     rec, err_cls(

@@ -56,7 +56,8 @@ class ObjectEntry:
 
 class TaskRecord:
     __slots__ = ("task_id", "spec", "deps", "state", "worker",
-                 "retries_left", "is_actor_creation", "actor_id")
+                 "retries_left", "is_actor_creation", "actor_id",
+                 "cancelled")
 
     def __init__(self, spec: dict) -> None:
         self.task_id: bytes = spec["task_id"]
@@ -66,6 +67,7 @@ class TaskRecord:
         self.worker: Optional[WorkerHandle] = None
         self.retries_left: int = spec.get("retries", 0)
         self.is_actor_creation = spec.get("is_actor_creation", False)
+        self.cancelled = False
         self.actor_id: Optional[bytes] = spec.get("actor_id")
 
 

@@ -74,6 +74,11 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     """`get(..., timeout=)` expired before the object became available."""
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel (reference:
+    ray.exceptions.TaskCancelledError)."""
+
+
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 

@@ -368,3 +368,51 @@ def test_runtime_context(ray_start):
     assert out["actor"] == a._actor_id.hex()
     assert out["task"]
     assert out["d"]["actor_id"] == out["actor"]
+
+
+def test_cancel_pending_and_running(ray_start):
+    """ray_tpu.cancel (reference: ray.cancel): pending tasks fail
+    immediately; running tasks get KeyboardInterrupt; force kills; no
+    retry resurrection."""
+    import time as _time
+    from ray_tpu import exceptions as exc
+
+    @ray_tpu.remote(max_retries=2)
+    def sleepy(tag):
+        _time.sleep(30)
+        return tag
+
+    # Fill every CPU so a 5th task stays PENDING.
+    running = [sleepy.remote(i) for i in range(4)]
+    _time.sleep(1.0)
+    pending = sleepy.remote("p")
+    _time.sleep(0.3)
+    ray_tpu.cancel(pending)
+    with pytest.raises(exc.TaskCancelledError):
+        ray_tpu.get(pending, timeout=30)
+
+    # Cancel a RUNNING task (SIGINT -> KeyboardInterrupt).
+    ray_tpu.cancel(running[0])
+    with pytest.raises((exc.TaskCancelledError, exc.TaskError)):
+        ray_tpu.get(running[0], timeout=60)
+
+    # Force-cancel another (worker killed; still TaskCancelledError,
+    # not a retry).
+    ray_tpu.cancel(running[1], force=True)
+    with pytest.raises(exc.TaskCancelledError):
+        ray_tpu.get(running[1], timeout=60)
+
+    for r in running[2:]:
+        ray_tpu.cancel(r, force=True)
+
+    # Actor tasks are rejected.
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            _time.sleep(5)
+            return 1
+
+    a = A.remote()
+    ref = a.m.remote()
+    with pytest.raises(ValueError, match="actor tasks"):
+        ray_tpu.cancel(ref)
