@@ -1,0 +1,137 @@
+"""Distributed queue (reference: python/ray/util/queue.py — an
+actor-backed Queue with put/get/qsize/empty/full and blocking
+semantics).
+
+The backing actor is ASYNC: puts and gets park on an asyncio.Queue
+inside the actor's event loop, so blocking calls cost no polling
+anywhere — a get on an empty queue simply leaves its actor call
+pending until a put lands (the actor runs with max_concurrency so
+parked gets never block puts).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int) -> None:
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item: Any,
+                  timeout: Optional[float] = None) -> bool:
+        if timeout is None:
+            await self._q.put(item)
+            return True
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None) -> tuple:
+        if timeout is None:
+            return (True, await self._q.get())
+        try:
+            return (True, await asyncio.wait_for(self._q.get(),
+                                                 timeout))
+        except asyncio.TimeoutError:
+            return (False, None)
+
+    async def put_nowait(self, item: Any) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get_nowait(self) -> tuple:
+        try:
+            return (True, self._q.get_nowait())
+        except asyncio.QueueEmpty:
+            return (False, None)
+
+    async def qsize(self) -> int:
+        return self._q.qsize()
+
+    async def maxsize(self) -> int:
+        return self._q.maxsize
+
+
+class Queue:
+    """Client handle; safe to pass to tasks/actors (pickles to the
+    same backing actor)."""
+
+    def __init__(self, maxsize: int = 0, *,
+                 _actor: Optional[Any] = None) -> None:
+        if _actor is not None:
+            self._actor = _actor
+            return
+        cls = ray_tpu.remote(_QueueActor)
+        self._actor = cls.options(max_concurrency=64).remote(maxsize)
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            ok = ray_tpu.get(self._actor.put_nowait.remote(item))
+            if not ok:
+                raise Full("queue is full")
+            return
+        ok = ray_tpu.get(self._actor.put.remote(item, timeout))
+        if not ok:
+            raise Full(f"put timed out after {timeout}s")
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = ray_tpu.get(self._actor.get_nowait.remote())
+            if not ok:
+                raise Empty("queue is empty")
+            return item
+        ok, item = ray_tpu.get(self._actor.get.remote(timeout))
+        if not ok:
+            raise Empty(f"get timed out after {timeout}s")
+        return item
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        for it in items:
+            self.put(it, block=False)
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        return [self.get(block=False) for _ in range(n)]
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        ms = ray_tpu.get(self._actor.maxsize.remote())
+        return bool(ms) and self.qsize() >= ms
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self._actor)
+
+    def __reduce__(self):
+        return (Queue, (0,), {"_actor": self._actor})
+
+    def __setstate__(self, state):
+        self._actor = state["_actor"]

@@ -112,3 +112,39 @@ def test_node_affinity_single_node(rt):
     ref = where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
         "cd" * 16, soft=True)).remote()
     assert ray_tpu.get(ref, timeout=30) == 1
+
+
+def test_distributed_queue(ray_start):
+    """ray_tpu.util.queue.Queue (reference: ray/util/queue.py):
+    actor-backed FIFO with blocking put/get shared across tasks."""
+    import time
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=2)
+    try:
+        q.put(1)
+        q.put(2)
+        assert q.qsize() == 2 and q.full()
+        with pytest.raises(Full):
+            q.put(3, block=False)
+        assert q.get() == 1
+        assert q.get() == 2
+        assert q.empty()
+        with pytest.raises(Empty):
+            q.get(block=False)
+        with pytest.raises(Empty):
+            q.get(timeout=0.3)
+
+        # Producer task / consumer driver through the SAME queue handle.
+        @ray_tpu.remote
+        def producer(queue, n):
+            for i in range(n):
+                queue.put(i * 10)
+            return "done"
+
+        ref = producer.remote(q, 4)
+        got = [q.get(timeout=30) for _ in range(4)]
+        assert got == [0, 10, 20, 30]
+        assert ray_tpu.get(ref, timeout=30) == "done"
+    finally:
+        q.shutdown()
