@@ -128,3 +128,38 @@ def test_llm_deployment_streams_tokens(serve_session):
     toks = [ray_tpu.get(r, timeout=300) for r in gen]
     assert toks == whole["tokens"]
     assert len(toks) == 5
+
+
+def test_engine_eos_retirement(serve_session):
+    """With an eos_id the drained-slot pre-admission is disabled (the
+    finish point is unpredictable) and generation stops AT the eos
+    token; slots still recycle for later requests."""
+    import jax
+    from ray_tpu.models import transformer
+    from ray_tpu.serve.llm import ContinuousBatcher
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, max_seq=64,
+        arch="llama", remat=False, attn_impl="reference")
+    params = transformer.init_params(cfg, jax.random.PRNGKey(3))
+    bat = ContinuousBatcher(params, cfg, num_slots=2, max_len=48,
+                            prompt_pad=8, decode_chunk=4)
+    try:
+        # Find what the greedy model emits, then declare one of the
+        # early tokens as EOS for a second batcher run.
+        probe = bat.generate([1, 2], max_new=8)["tokens"]
+    finally:
+        bat.stop()
+    eos = probe[2]
+    first = probe.index(eos)             # stops at the FIRST occurrence
+    bat = ContinuousBatcher(params, cfg, num_slots=2, max_len=48,
+                            prompt_pad=8, decode_chunk=4, eos_id=eos)
+    try:
+        out = bat.generate([1, 2], max_new=8)
+        assert out["finish_reason"] == "eos"
+        assert out["tokens"] == probe[:first + 1]
+        assert out["tokens"][-1] == eos
+        # Slots recycle after eos retirement.
+        out2 = bat.generate([3, 4], max_new=3)
+        assert len(out2["tokens"]) <= 3 and out2["tokens"]
+    finally:
+        bat.stop()
