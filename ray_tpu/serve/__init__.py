@@ -32,7 +32,7 @@ from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
                                      multiplexed)
 from ray_tpu.serve._controller import CONTROLLER_NAME, ServeController
 
-__all__ = ["deployment", "run", "delete", "shutdown", "status",
+__all__ = ["deployment", "run", "build", "delete", "shutdown", "status",
            "get_deployment_handle", "batch", "Deployment",
            "DeploymentHandle", "start_http_proxy", "start_grpc_proxy",
            "multiplexed",
@@ -212,29 +212,110 @@ def _attach_done_callback(router, ref, replica) -> None:
                      name="rtpu-serve-done").start()
 
 
-def run(target: Deployment, *, name: Optional[str] = None
-        ) -> DeploymentHandle:
-    """Deploy (or redeploy) and return a handle once replicas exist
-    (reference: serve.run, serve/api.py:494)."""
-    import ray_tpu
+def build(target: Deployment, *, name: Optional[str] = None
+          ) -> List[tuple]:
+    """Resolve a nested-``.bind()`` application graph into a bottom-up
+    deploy plan (reference: serve.run -> deployment_graph_build.py:17
+    build() — bound Deployments inside another deployment's init args
+    become injected DeploymentHandles).
+
+    Returns ``[(name, deployment, init_args, init_kwargs), ...]`` in
+    dependency order: every nested bound ``Deployment`` in the plan's
+    args has already been replaced by a ``DeploymentHandle`` to an
+    earlier entry.  A bound deployment shared by two parents (diamond)
+    deploys once; distinct deployments that collide on name get ``_1``,
+    ``_2`` suffixes (root keeps its explicit name).
+    """
     if not isinstance(target, Deployment):
-        raise TypeError("serve.run expects a Deployment "
+        raise TypeError("serve.build expects a Deployment "
                         "(use @serve.deployment)")
-    controller = _get_or_create_controller()
-    opts = target._options
-    actor_opts = dict(opts.get("ray_actor_options") or {})
+    plan: List[tuple] = []
+    names: Dict[int, str] = {}      # id(deployment) -> assigned name
+    taken: Dict[str, int] = {}      # name -> count of distinct users
+    in_progress: set = set()
+    root_name = name or target.name
+    taken[root_name] = 1            # reserve: root keeps its name
+
+    def assign_name(dep: Deployment, forced: Optional[str]) -> str:
+        if dep is target:
+            return root_name        # reserved up front
+        want = forced or dep.name
+        n = taken.get(want, 0)
+        taken[want] = n + 1
+        return want if n == 0 else f"{want}_{n}"
+
+    def inject(obj):
+        """Replace bound Deployments in an init-arg tree with handles."""
+        if isinstance(obj, Deployment):
+            return DeploymentHandle(visit(obj, None))
+        if isinstance(obj, dict):
+            return {k: inject(v) for k, v in obj.items()}
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+            return type(obj)(*(inject(v) for v in obj))   # namedtuple
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(inject(v) for v in obj)
+        return obj
+
+    def visit(dep: Deployment, forced: Optional[str]) -> str:
+        if id(dep) in names:
+            return names[id(dep)]
+        if id(dep) in in_progress:
+            raise ValueError(
+                f"cycle in deployment graph at {dep.name!r}")
+        in_progress.add(id(dep))
+        args = inject(dep._init_args)
+        kwargs = inject(dep._init_kwargs)
+        in_progress.discard(id(dep))
+        assigned = assign_name(dep, forced)
+        names[id(dep)] = assigned
+        plan.append((assigned, dep, args, kwargs))
+        return assigned
+
+    visit(target, name)
+    return plan
+
+
+def _validate_opts(dep: Deployment) -> Dict[str, Any]:
+    actor_opts = dict(dep._options.get("ray_actor_options") or {})
     unsupported = set(actor_opts) - {"num_cpus", "num_tpus", "resources"}
     if unsupported:
         raise ValueError(
-            f"unsupported ray_actor_options {sorted(unsupported)}; "
+            f"unsupported ray_actor_options {sorted(unsupported)} on "
+            f"deployment {dep.name!r}; "
             f"supported: num_cpus, num_tpus, resources")
-    blob = cloudpickle.dumps(target._cls)
+    return actor_opts
+
+
+def _deploy_one(controller, name: str, dep: Deployment,
+                init_args, init_kwargs) -> None:
+    import ray_tpu
+    opts = dep._options
+    actor_opts = _validate_opts(dep)
+    blob = cloudpickle.dumps(dep._cls)
     ray_tpu.get(controller.deploy.remote(
-        name or target.name, blob, target._init_args,
-        target._init_kwargs, opts.get("num_replicas", 1),
+        name, blob, init_args, init_kwargs,
+        opts.get("num_replicas", 1),
         opts.get("max_concurrent_queries", 8),
         actor_opts, opts.get("autoscaling_config")), timeout=120)
-    return DeploymentHandle(name or target.name)
+
+
+def run(target: Deployment, *, name: Optional[str] = None
+        ) -> DeploymentHandle:
+    """Deploy an application — a single Deployment or a whole
+    nested-``.bind()`` graph — and return a handle to the root once
+    replicas exist (reference: serve.run, serve/api.py:494).
+
+    Bound ``Deployment`` objects anywhere inside the root's init args
+    (including in lists/dicts) are deployed first and replaced with
+    ``DeploymentHandle``s, so a composed app (ingress -> models) goes
+    up in one call."""
+    controller = _get_or_create_controller()
+    plan = build(target, name=name)
+    for _, dep, _, _ in plan:       # validate before ANY deploy lands
+        _validate_opts(dep)
+    for dep_name, dep, args, kwargs in plan:
+        _deploy_one(controller, dep_name, dep, args, kwargs)
+    return DeploymentHandle(plan[-1][0])
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:

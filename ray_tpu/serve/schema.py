@@ -4,8 +4,17 @@
 YAML shape:
 
     applications:
-      - name: app1                       # optional label
-        deployments:
+      # Form A — a whole bound .bind() graph (reference: the
+      # application `import_path` pointing at a built app,
+      # serve/schema.py ServeApplicationSchema); `deployments` entries
+      # are per-name OPTION OVERRIDES applied before deploy:
+      - name: app1                       # optional root rename
+        import_path: mypkg.pipelines:app # a bound Deployment graph
+        deployments:                     # optional overrides by name
+          - name: Model
+            num_replicas: 2
+      # Form B — flat per-deployment list (round-2 shape, kept):
+      - deployments:
           - name: Model                  # deployment name
             import_path: mypkg.mod:Model # class or Deployment object
             num_replicas: 2
@@ -60,6 +69,34 @@ def serve_apply(config) -> List[str]:
     cfg = load_config(config)
     deployed: List[str] = []
     for app in cfg.get("applications", []):
+        if "import_path" in app:
+            # Form A: a bound graph; deployments are option overrides.
+            target = _import_target(app["import_path"])
+            if not isinstance(target, serve.Deployment):
+                raise TypeError(
+                    f"app import_path {app['import_path']!r} must "
+                    f"resolve to a bound Deployment graph")
+            overrides = {d["name"]: d for d in app.get("deployments", [])}
+            plan = serve.build(target, name=app.get("name"))
+            unknown = set(overrides) - {n for n, *_ in plan}
+            if unknown:
+                raise ValueError(
+                    f"deployment overrides {sorted(unknown)} match no "
+                    f"deployment in app graph "
+                    f"{sorted(n for n, *_ in plan)}")
+            controller = serve._get_or_create_controller()
+            for dep_name, dep, args, kwargs in plan:
+                ov = overrides.get(dep_name)
+                if ov:
+                    opts = {k: ov[k] for k in
+                            ("num_replicas", "max_concurrent_queries",
+                             "ray_actor_options", "autoscaling_config")
+                            if k in ov}
+                    dep = dep.options(**opts)
+                serve._deploy_one(controller, dep_name, dep, args,
+                                  kwargs)
+                deployed.append(dep_name)
+            continue
         for d in app.get("deployments", []):
             target = _import_target(d["import_path"])
             if not isinstance(target, serve.Deployment):

@@ -236,6 +236,86 @@ def test_model_multiplexing(serve_session):
         assert o["y"] == 2 * scale
 
 
+def test_app_graph_build_plan():
+    """serve.build resolves nested .bind() graphs bottom-up with handle
+    injection, diamond sharing, and name-collision suffixing
+    (reference: _private/deployment_graph_build.py:17)."""
+    @serve.deployment
+    class Leaf:
+        def __init__(self, tag):
+            self.tag = tag
+
+    @serve.deployment
+    class Mid:
+        def __init__(self, left, right):
+            pass
+
+    shared = Leaf.bind("shared")
+    other = Leaf.bind("other")           # distinct Leaf -> name suffix
+    mid_a = Mid.bind(shared, other)
+    mid_b = Mid.bind(shared, {"nested": [shared]})
+
+    @serve.deployment
+    class Root:
+        def __init__(self, a, b):
+            pass
+
+    plan = serve.build(Root.bind(mid_a, mid_b))
+    names = [n for n, *_ in plan]
+    # Dependencies come before their parents; shared Leaf appears once.
+    assert names.index("Leaf") < names.index("Mid")
+    assert names.count("Leaf") == 1 and "Leaf_1" in names
+    assert names[-1] == "Root"
+    assert len(plan) == 5                # 2 leaves + 2 mids + root
+    # Injected args are handles, including inside containers.
+    root_args = plan[-1][2]
+    assert all(isinstance(a, serve.DeploymentHandle) for a in root_args)
+    mid_b_args = [e for e in plan if e[0] == "Mid_1"][0][2]
+    assert isinstance(mid_b_args[1]["nested"][0], serve.DeploymentHandle)
+    assert mid_b_args[0].deployment_name == "Leaf"
+
+    # Forced root name wins over a colliding child name.
+    plan2 = serve.build(Root.bind(Leaf.bind("x")), name="Leaf")
+    assert plan2[-1][0] == "Leaf" and plan2[0][0] == "Leaf_1"
+
+    # namedtuple init args survive injection.
+    import collections
+    Pair = collections.namedtuple("Pair", ["m", "tag"])
+    plan3 = serve.build(Root.bind(Pair(m=Leaf.bind("y"), tag=7), None))
+    pair = plan3[-1][2][0]
+    assert isinstance(pair, Pair) and pair.tag == 7
+    assert isinstance(pair.m, serve.DeploymentHandle)
+
+
+def test_app_graph_deploys_in_one_run(serve_session):
+    """A 3-deployment pipeline (ingress -> two models) deploys with ONE
+    serve.run(app); nested Deployments arrive as live handles."""
+    @serve.deployment(num_replicas=1)
+    class Scaler:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def __call__(self, x):
+            return x * self.scale
+
+    @serve.deployment(num_replicas=1)
+    class Ingress:
+        def __init__(self, doubler, tripler):
+            self.doubler = doubler
+            self.tripler = tripler
+
+        def __call__(self, x):
+            a = ray_tpu.get(self.doubler.remote(x), timeout=60)
+            b = ray_tpu.get(self.tripler.remote(x), timeout=60)
+            return a + b
+
+    app = Ingress.bind(Scaler.options(name="Doubler").bind(2),
+                       Scaler.options(name="Tripler").bind(3))
+    h = serve.run(app)
+    assert ray_tpu.get(h.remote(7), timeout=120) == 7 * 2 + 7 * 3
+    assert {"Ingress", "Doubler", "Tripler"} <= set(serve.status())
+
+
 def test_declarative_yaml_apply(serve_session, tmp_path):
     """serve/schema.py: YAML-shaped config reconciliation (reference:
     serve deploy + serve/schema.py) — deploys listed deployments,
@@ -267,5 +347,41 @@ def test_declarative_yaml_apply(serve_session, tmp_path):
         cfg["applications"][0]["deployments"].pop()
         serve_apply(cfg)
         assert set(serve.status()) == {"Doubler"}
+    finally:
+        sys.path.remove(str(tmp_path))
+
+
+def test_declarative_yaml_app_graph(serve_session, tmp_path):
+    """Form A: app-level import_path resolving to a bound graph, with
+    per-deployment option overrides (reference: ServeApplicationSchema
+    import_path apps)."""
+    import sys
+    mod = tmp_path / "served_graph_mod.py"
+    mod.write_text(
+        "import ray_tpu\n"
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "class M:\n"
+        "    def __init__(self, k):\n"
+        "        self.k = k\n"
+        "    def __call__(self, x):\n"
+        "        return x * self.k\n"
+        "@serve.deployment\n"
+        "class Gate:\n"
+        "    def __init__(self, m):\n"
+        "        self.m = m\n"
+        "    def __call__(self, x):\n"
+        "        return ray_tpu.get(self.m.remote(x), timeout=60) + 1\n"
+        "app = Gate.bind(M.bind(10))\n")
+    sys.path.insert(0, str(tmp_path))
+    try:
+        from ray_tpu.serve.schema import serve_apply
+        cfg = {"applications": [
+            {"import_path": "served_graph_mod:app",
+             "deployments": [{"name": "M", "num_replicas": 2}]}]}
+        assert serve_apply(cfg) == ["M", "Gate"]
+        h = serve.get_deployment_handle("Gate")
+        assert ray_tpu.get(h.remote(4), timeout=120) == 41
+        assert serve.status()["M"]["target_replicas"] == 2
     finally:
         sys.path.remove(str(tmp_path))
