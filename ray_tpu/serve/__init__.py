@@ -231,23 +231,26 @@ def build(target: Deployment, *, name: Optional[str] = None
                         "(use @serve.deployment)")
     plan: List[tuple] = []
     names: Dict[int, str] = {}      # id(deployment) -> assigned name
-    taken: Dict[str, int] = {}      # name -> count of distinct users
+    taken: set = set()              # every assigned name
     in_progress: set = set()
     root_name = name or target.name
-    taken[root_name] = 1            # reserve: root keeps its name
+    taken.add(root_name)            # reserve: root keeps its name
 
-    def assign_name(dep: Deployment, forced: Optional[str]) -> str:
+    def assign_name(dep: Deployment) -> str:
         if dep is target:
             return root_name        # reserved up front
-        want = forced or dep.name
-        n = taken.get(want, 0)
-        taken[want] = n + 1
-        return want if n == 0 else f"{want}_{n}"
+        want = dep.name
+        n = 0
+        while (want if n == 0 else f"{want}_{n}") in taken:
+            n += 1
+        got = want if n == 0 else f"{want}_{n}"
+        taken.add(got)
+        return got
 
     def inject(obj):
         """Replace bound Deployments in an init-arg tree with handles."""
         if isinstance(obj, Deployment):
-            return DeploymentHandle(visit(obj, None))
+            return DeploymentHandle(visit(obj))
         if isinstance(obj, dict):
             return {k: inject(v) for k, v in obj.items()}
         if isinstance(obj, tuple) and hasattr(obj, "_fields"):
@@ -256,7 +259,7 @@ def build(target: Deployment, *, name: Optional[str] = None
             return type(obj)(inject(v) for v in obj)
         return obj
 
-    def visit(dep: Deployment, forced: Optional[str]) -> str:
+    def visit(dep: Deployment) -> str:
         if id(dep) in names:
             return names[id(dep)]
         if id(dep) in in_progress:
@@ -266,12 +269,12 @@ def build(target: Deployment, *, name: Optional[str] = None
         args = inject(dep._init_args)
         kwargs = inject(dep._init_kwargs)
         in_progress.discard(id(dep))
-        assigned = assign_name(dep, forced)
+        assigned = assign_name(dep)
         names[id(dep)] = assigned
         plan.append((assigned, dep, args, kwargs))
         return assigned
 
-    visit(target, name)
+    visit(target)
     return plan
 
 

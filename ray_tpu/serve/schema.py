@@ -84,7 +84,7 @@ def serve_apply(config) -> List[str]:
                     f"deployment overrides {sorted(unknown)} match no "
                     f"deployment in app graph "
                     f"{sorted(n for n, *_ in plan)}")
-            controller = serve._get_or_create_controller()
+            resolved = []
             for dep_name, dep, args, kwargs in plan:
                 ov = overrides.get(dep_name)
                 if ov:
@@ -93,6 +93,10 @@ def serve_apply(config) -> List[str]:
                              "ray_actor_options", "autoscaling_config")
                             if k in ov}
                     dep = dep.options(**opts)
+                serve._validate_opts(dep)   # whole plan, before deploys
+                resolved.append((dep_name, dep, args, kwargs))
+            controller = serve._get_or_create_controller()
+            for dep_name, dep, args, kwargs in resolved:
                 serve._deploy_one(controller, dep_name, dep, args,
                                   kwargs)
                 deployed.append(dep_name)

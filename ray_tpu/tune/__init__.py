@@ -10,14 +10,15 @@ train/base_trainer.py:693).
 """
 
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
-                                     PopulationBasedTraining)
-from ray_tpu.tune.search import (TPESearcher, choice, grid_search,
-                                 loguniform, randint, uniform)
+                                     PB2, PopulationBasedTraining)
+from ray_tpu.tune.search import (BOHBSearcher, TPESearcher, choice,
+                                 grid_search, loguniform, randint,
+                                 uniform)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "ASHAScheduler",
-    "PopulationBasedTraining",
+    "PopulationBasedTraining", "PB2",
     "FIFOScheduler", "grid_search", "uniform", "loguniform", "randint",
-    "choice", "TPESearcher",
+    "choice", "TPESearcher", "BOHBSearcher",
 ]

@@ -162,3 +162,134 @@ class PopulationBasedTraining:
             return CONTINUE
         return {"decision": EXPLOIT, "source": source,
                 "config": self._mutate(src_cfg)}
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: tune/schedulers/pb2.py:256
+    PB2 — Parker-Holder et al., NeurIPS'20): the PBT scaffold
+    (quantiles, checkpoint exploit) is unchanged, but the EXPLORE step
+    replaces random x0.8/x1.2 perturbation with a time-varying GP
+    bandit: every `perturbation_interval` the scheduler records
+    (hyperparams, t) -> reward-improvement datapoints from all trials,
+    fits a GP with an RBF kernel over normalized (config, time), and
+    picks the exploiting trial's new config by maximizing the UCB
+    acquisition mu + kappa*sigma within `hyperparam_bounds`.
+
+    Continuous bounds only, matching the reference
+    (pb2.py:339 hyperparam_bounds: {key: [min, max]}).
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 2,
+                 hyperparam_bounds: Dict[str, Any] = None,
+                 quantile_fraction: float = 0.25,
+                 kappa: float = 2.0, seed: int = 0) -> None:
+        if not hyperparam_bounds:
+            raise ValueError("hyperparam_bounds must be non-empty")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        for k, (lo, hi) in self.bounds.items():
+            if not hi > lo:
+                raise ValueError(f"bounds for {k!r} must have hi > lo")
+        self.kappa = kappa
+        # Parent needs non-empty mutations for its invariants; PB2
+        # overrides _mutate, so give it in-bounds uniform samplers as
+        # the (never-reached) fallback shape.
+        mutations = {k: (lambda lo=lo, hi=hi:
+                         __import__("random").uniform(lo, hi))
+                     for k, (lo, hi) in self.bounds.items()}
+        super().__init__(metric, mode=mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations=mutations,
+                         quantile_fraction=quantile_fraction,
+                         resample_probability=0.0, seed=seed)
+        self._keys = sorted(self.bounds)
+        self._X: List[List[float]] = []    # normalized config + raw t
+        self._y: List[float] = []          # reward delta over interval
+        self._prev: Dict[str, tuple] = {}  # trial -> (t, score)
+        self._max_points = 512             # GP refit is O(n^3); window
+
+    def register_trial(self, trial_id: str,
+                       config: Dict[str, Any]) -> None:
+        """Called at trial start AND after exploit restarts: the trial
+        resumes from a DIFFERENT checkpoint, so the previous score is
+        not a valid delta baseline — drop it or the checkpoint jump
+        would be credited to the new config as reward improvement."""
+        super().register_trial(trial_id, config)
+        self._prev.pop(trial_id, None)
+
+    def _norm(self, key: str, value: float) -> float:
+        lo, hi = self.bounds[key]
+        return min(1.0, max(0.0, (float(value) - lo) / (hi - lo)))
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]):
+        # Record (config, t) -> score-delta datapoints BEFORE the PBT
+        # quantile logic runs (which may replace this trial's config).
+        if self.metric in result:
+            v = float(result[self.metric])
+            s = v if self.mode == "max" else -v
+            t = int(result.get(self.time_attr, 0))
+            prev = self._prev.get(trial_id)
+            if prev is None:
+                self._prev[trial_id] = (t, s)
+            elif t - prev[0] >= self.interval:
+                cfg = self._configs.get(trial_id)
+                if cfg is not None and all(k in cfg
+                                           for k in self._keys):
+                    x = [self._norm(k, cfg[k]) for k in self._keys]
+                    self._X.append(x + [float(t)])
+                    self._y.append(s - prev[1])
+                    if len(self._y) > self._max_points:
+                        self._X = self._X[-self._max_points:]
+                        self._y = self._y[-self._max_points:]
+                self._prev[trial_id] = (t, s)
+        return super().on_result(trial_id, result)
+
+    @staticmethod
+    def _rbf(A, B, ell: float):
+        import numpy as np
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (ell * ell))
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """GP-UCB explore step (replaces PBT's random perturbation)."""
+        import numpy as np
+        out = dict(config)
+        if len(self._y) < 4:
+            for k in self._keys:              # cold start: random
+                lo, hi = self.bounds[k]
+                out[k] = self._rng.uniform(lo, hi)
+            return out
+        X = np.asarray(self._X, dtype=np.float64)
+        tmax = max(float(X[:, -1].max()), 1.0)
+        Xn = X.copy()
+        Xn[:, -1] /= tmax                     # config dims already 0-1
+        y = np.asarray(self._y, dtype=np.float64)
+        y_std = float(y.std()) or 1.0
+        yn = (y - y.mean()) / y_std
+        ell, noise = 0.25, 1e-2
+        K = self._rbf(Xn, Xn, ell) + noise * np.eye(len(Xn))
+        alpha = np.linalg.solve(K, yn)
+        # Candidates: uniform in bounds + jitter around the rows with
+        # the best observed improvement (exploit the GP's evidence).
+        cands = [[self._rng.random() for _ in self._keys]
+                 for _ in range(64)]
+        for row in Xn[np.argsort(yn)[-8:], :-1]:
+            cands.append([min(1.0, max(0.0,
+                                       float(v) + self._rng.gauss(0, 0.1)))
+                          for v in row])
+        C = np.asarray(cands, dtype=np.float64)
+        t_now = float(X[:, -1].max()) / tmax
+        Cfull = np.concatenate(
+            [C, np.full((len(C), 1), t_now)], axis=1)
+        Kc = self._rbf(Cfull, Xn, ell)
+        mu = Kc @ alpha
+        var = 1.0 + noise - np.einsum(
+            "ij,ji->i", Kc, np.linalg.solve(K, Kc.T))
+        ucb = mu + self.kappa * np.sqrt(np.maximum(var, 1e-9))
+        best = C[int(np.argmax(ucb))]
+        for k, v in zip(self._keys, best):
+            lo, hi = self.bounds[k]
+            out[k] = lo + float(v) * (hi - lo)
+        return out

@@ -246,7 +246,11 @@ class TPESearcher:
     def suggest(self, space: Dict[str, Any]) -> Dict[str, Any]:
         if len(self._obs) < self.n_startup:
             return self._random(space)
-        ranked = sorted(self._obs, key=lambda t: -t[1])
+        return self._suggest_from(self._obs, space)
+
+    def _suggest_from(self, obs: List[tuple],
+                      space: Dict[str, Any]) -> Dict[str, Any]:
+        ranked = sorted(obs, key=lambda t: -t[1])
         n_good = max(1, int(len(ranked) * self.gamma))
         good = [c for c, _ in ranked[:n_good]]
         bad = [c for c, _ in ranked[n_good:]] or good
@@ -297,7 +301,7 @@ class TPESearcher:
                         # Annealed floor: wide early (escape local
                         # clusters), tightening as evidence accumulates
                         # so late trials refine instead of wandering.
-                        floor = span / (8.0 + len(self._obs) / 2.0)
+                        floor = span / (8.0 + len(obs) / 2.0)
                         sigma = max(spread / max(len(gv), 1) ** 0.5,
                                     floor)
                         w += self._rng.gauss(0, sigma)
@@ -325,14 +329,71 @@ class TPESearcher:
                     span = (dom._hi - dom._lo) or 1.0
                 else:
                     span = (dom.high - dom.low) or 1.0
-                dmin = min((abs(xv - w) for c, _ in self._obs
+                dmin = min((abs(xv - w) for c, _ in obs
                             if _has(c, path)
                             and (w := self._safe_warp(
                                 dom, _get(c, path))) is not None),
                            default=span)
-                scale = span / (8.0 + len(self._obs) / 2.0)
+                scale = span / (8.0 + len(obs) / 2.0)
                 novelty *= min(dmin / scale, 1.0) + 0.05
             ratio *= novelty
             if ratio > best_ratio:
                 best_ratio, best_cfg = ratio, cand
         return best_cfg if best_cfg is not None else self._random(space)
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB-style budget-aware model-based search (reference:
+    tune/search/bohb/bohb_search.py TuneBOHB paired with
+    tune/schedulers/hb_bohb.py HyperBandForBOHB).
+
+    The BOHB rule (Falkner et al., ICML'18): observations are grouped
+    by the budget they were measured at (`time_attr`, i.e. the ASHA
+    rung a trial reached before being stopped or finishing), and the
+    TPE good/bad density model is fitted on the LARGEST budget that has
+    at least `min_points` observations.  Cheap low-rung results guide
+    the model early; as full-budget results accumulate they take over.
+    Scores from different budgets are never mixed into one model —
+    that's the part plain TPE gets wrong under early stopping.
+
+    Pair with ASHAScheduler over the same `time_attr`:
+
+        TuneConfig(search_alg=BOHBSearcher("loss", mode="min"),
+                   scheduler=ASHAScheduler("loss", mode="min"))
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 min_points: int = 6, n_startup: int = 5,
+                 gamma: float = 0.25, n_candidates: int = 32,
+                 seed: int = 0) -> None:
+        super().__init__(metric, mode, n_startup=n_startup, gamma=gamma,
+                         n_candidates=n_candidates, seed=seed)
+        self.time_attr = time_attr
+        self.min_points = min_points
+        self._by_budget: Dict[int, List[tuple]] = {}
+
+    def record(self, config: Dict[str, Any],
+               metrics: Dict[str, Any]) -> None:
+        if self.metric not in metrics:
+            return
+        score = float(metrics[self.metric])
+        if self.mode == "min":
+            score = -score
+        budget = int(metrics.get(self.time_attr, 0))
+        self._by_budget.setdefault(budget, []).append((config, score))
+        self._obs.append((config, score))   # drives n_startup gate only
+
+    def suggest(self, space: Dict[str, Any]) -> Dict[str, Any]:
+        if len(self._obs) < self.n_startup:
+            return self._random(space)
+        eligible = [b for b, o in self._by_budget.items()
+                    if len(o) >= self.min_points]
+        if not eligible:
+            # Not enough points at any single budget yet: model the
+            # most-populated budget rather than mixing scales.
+            budget = max(self._by_budget,
+                         key=lambda b: (len(self._by_budget[b]), b))
+        else:
+            budget = max(eligible)
+        return self._suggest_from(self._by_budget[budget], space)
