@@ -77,15 +77,64 @@ def block_from_pandas(df) -> Block:
 
 
 def block_to_arrow(block: Block):
+    """Tensor columns ([N, d0, ...]) become FixedSizeList arrays over a
+    flat values buffer — zero-copy from the numpy view — with the inner
+    shape recorded in field metadata so >2-D tensors round-trip
+    (reference: ArrowTensorArray, data/_internal/arrow_block.py)."""
+    import json
     import pyarrow as pa
-    return pa.table({k: (v.tolist() if v.ndim > 1 else v)
-                     for k, v in block.items()})
+    arrays, fields = [], []
+    for k, v in block.items():
+        if getattr(v, "ndim", 1) > 1 and v.dtype != object:
+            flat = pa.array(np.ascontiguousarray(v).reshape(-1))
+            width = int(np.prod(v.shape[1:]))
+            arr = pa.FixedSizeListArray.from_arrays(flat, width)
+            meta = {b"rtpu_tensor_shape":
+                    json.dumps(list(v.shape[1:])).encode()}
+            fields.append(pa.field(k, arr.type, metadata=meta))
+            arrays.append(arr)
+        elif getattr(v, "ndim", 1) > 1:
+            arr = pa.array(v.tolist())
+            fields.append(pa.field(k, arr.type))
+            arrays.append(arr)
+        else:
+            arr = pa.array(v)
+            fields.append(pa.field(k, arr.type))
+            arrays.append(arr)
+    return pa.table(arrays, schema=pa.schema(fields))
 
 
 def block_from_arrow(table) -> Block:
+    """FixedSizeList and uniform-length list columns reconstruct as
+    tensors (zero-copy for fixed-size lists over primitive values);
+    the inner shape comes from field metadata when present."""
+    import json
+    import pyarrow as pa
     out = {}
     for name in table.column_names:
-        col = table.column(name)
+        field = table.schema.field(name)
+        col = table.column(name).combine_chunks()
+        if isinstance(col, pa.ChunkedArray):    # zero chunks
+            col = pa.concat_arrays(col.chunks) if col.chunks \
+                else pa.array([], type=col.type)
+        if pa.types.is_fixed_size_list(col.type):
+            width = col.type.list_size
+            vals = col.values.to_numpy(zero_copy_only=False)
+            inner = [width]
+            meta = field.metadata or {}
+            if b"rtpu_tensor_shape" in meta:
+                inner = json.loads(meta[b"rtpu_tensor_shape"])
+            out[name] = vals.reshape(len(col), *inner)
+            continue
+        if pa.types.is_list(col.type) or \
+                pa.types.is_large_list(col.type):
+            offsets = col.offsets.to_numpy(zero_copy_only=False)
+            widths = np.diff(offsets)
+            if len(widths) and (widths == widths[0]).all() \
+                    and not pa.types.is_nested(col.type.value_type):
+                vals = col.flatten().to_numpy(zero_copy_only=False)
+                out[name] = vals.reshape(len(col), int(widths[0]))
+                continue
         try:
             out[name] = col.to_numpy(zero_copy_only=False)
         except Exception:

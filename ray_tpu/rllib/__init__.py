@@ -1,15 +1,32 @@
-"""RL library: PPO on actor-parallel rollouts, jit'd learner.
+"""RL library: actor-parallel rollouts, jit'd learners.
 
-Reference surface: ray/rllib (algorithms/ppo, evaluation/
-rollout_worker.py, env vectorization).  See ppo.py for the TPU-first
-design notes.
+Reference surface: ray/rllib (algorithms/ppo, algorithms/dqn,
+algorithms/impala, algorithms/sac, algorithms/bc + offline/,
+connectors/, evaluation/rollout_worker.py, env vectorization).
+See ppo.py for the TPU-first design notes shared by every algorithm:
+host actors sample, ONE compiled XLA program learns.
 """
 
+from ray_tpu.rllib.connectors import (ClipActions, ClipObs,
+                                      ConnectedEnv, Connector,
+                                      ConnectorPipeline, FlattenObs,
+                                      FrameStack, NormalizeObs,
+                                      UnsquashActions)
 from ray_tpu.rllib.dqn import DQN, DQNConfig
-from ray_tpu.rllib.env import CartPoleEnv, PixelCartPoleEnv, VectorEnv
+from ray_tpu.rllib.env import (CartPoleEnv, PendulumEnv,
+                               PixelCartPoleEnv, VectorEnv)
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.offline import (BC, BCConfig,
+                                   collect_expert_episodes,
+                                   log_transitions)
 from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker
+from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
-           "IMPALAConfig", "RolloutWorker", "CartPoleEnv",
-           "PixelCartPoleEnv", "VectorEnv"]
+           "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
+           "collect_expert_episodes", "log_transitions",
+           "RolloutWorker", "CartPoleEnv", "PendulumEnv",
+           "PixelCartPoleEnv", "VectorEnv", "Connector",
+           "ConnectorPipeline", "ClipObs", "NormalizeObs",
+           "FrameStack", "FlattenObs", "ClipActions",
+           "UnsquashActions", "ConnectedEnv"]

@@ -68,9 +68,67 @@ class CartPoleEnv:
         return self.state.astype(np.float32), 1.0, done, {}
 
 
+class PendulumEnv:
+    """Classic underactuated pendulum swing-up (the canonical
+    continuous-action benchmark, same dynamics/constants as the
+    standard Pendulum-v1).  Observation [cos th, sin th, th_dot];
+    action: torque in [-2, 2] (continuous); reward
+    -(angle^2 + 0.1 th_dot^2 + 0.001 torque^2); fixed-length episodes.
+    """
+
+    GRAVITY = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+
+    observation_size = 3
+    action_size = 1
+    continuous_actions = True
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, max_steps: int = 200,
+                 seed: Optional[int] = None) -> None:
+        self.max_steps = max_steps
+        self.rng = np.random.RandomState(seed)
+        self.th = 0.0
+        self.th_dot = 0.0
+        self.steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([math.cos(self.th), math.sin(self.th),
+                         self.th_dot], np.float32)
+
+    def reset(self) -> np.ndarray:
+        self.th = self.rng.uniform(-math.pi, math.pi)
+        self.th_dot = self.rng.uniform(-1.0, 1.0)
+        self.steps = 0
+        return self._obs()
+
+    def step(self, action
+             ) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.MAX_TORQUE, self.MAX_TORQUE))
+        th_norm = ((self.th + math.pi) % (2 * math.pi)) - math.pi
+        cost = th_norm ** 2 + 0.1 * self.th_dot ** 2 + 0.001 * u ** 2
+        g, m, L, dt = self.GRAVITY, self.MASS, self.LENGTH, self.DT
+        self.th_dot += (3 * g / (2 * L) * math.sin(self.th)
+                        + 3.0 / (m * L * L) * u) * dt
+        self.th_dot = float(np.clip(self.th_dot, -self.MAX_SPEED,
+                                    self.MAX_SPEED))
+        self.th += self.th_dot * dt
+        self.steps += 1
+        done = self.steps >= self.max_steps
+        return self._obs(), -cost, done, {}
+
+
 class VectorEnv:
     """N independent env instances, stepped as a batch; auto-resets
-    finished episodes (rllib vector_env semantics)."""
+    finished episodes (rllib vector_env semantics).  Continuous-action
+    envs (declaring `continuous_actions = True`) receive their action
+    row as-is; discrete envs get a python int."""
 
     def __init__(self, make_env, num_envs: int,
                  seed: int = 0) -> None:
@@ -87,7 +145,9 @@ class VectorEnv:
              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         obs, rews, dones = [], [], []
         for i, (env, a) in enumerate(zip(self.envs, actions)):
-            o, r, d, _ = env.step(int(a))
+            o, r, d, _ = env.step(
+                a if getattr(env, "continuous_actions", False)
+                else int(a))
             self.episode_returns[i] += r
             if d:
                 self.completed_returns.append(self.episode_returns[i])

@@ -1,0 +1,198 @@
+"""Offline RL: trajectory logging + behavior cloning over ray_tpu.data.
+
+Reference surface: rllib/offline/ — JsonWriter/DatasetWriter log
+SampleBatches from rollouts (rllib/offline/json_writer.py), and
+DatasetReader feeds algorithms from logged data through Ray Data
+(rllib/offline/dataset_reader.py); BC is the canonical offline
+algorithm (rllib/algorithms/bc/).
+
+Here the interchange format is columnar parquet via ray_tpu.data:
+one row per transition with columns obs (list<float>), action
+(int or list<float>), reward, done.  BC maximizes log pi(a|s) with a
+jit'd minibatched update; evaluation rolls the greedy policy in a live
+env — training itself never touches an environment (the point of the
+offline path).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
+from ray_tpu.rllib.ppo import init_policy, policy_forward
+
+
+def log_transitions(path: str, obs: np.ndarray, actions: np.ndarray,
+                    rewards: np.ndarray, dones: np.ndarray,
+                    block_rows: int = 4096) -> List[str]:
+    """Write transition columns as a parquet dataset (the
+    DatasetWriter role, rllib/offline/dataset_writer.py)."""
+    from ray_tpu import data as rdata
+    ds = rdata.from_numpy({
+        "obs": np.asarray(obs, np.float32),
+        "action": np.asarray(actions),
+        "reward": np.asarray(rewards, np.float32),
+        "done": np.asarray(dones).astype(np.float32),
+    }, block_rows=block_rows)
+    return ds.write_parquet(path)
+
+
+def collect_expert_episodes(policy_fn: Callable[[np.ndarray], Any],
+                            env_maker: Callable[[int], Any],
+                            num_episodes: int, seed: int = 0
+                            ) -> Dict[str, np.ndarray]:
+    """Roll a scripted/learned policy and return transition columns
+    (host-side helper for building offline datasets in tests/demos)."""
+    obs_b, act_b, rew_b, done_b = [], [], [], []
+    for ep in range(num_episodes):
+        env = env_maker(seed + ep)
+        o = env.reset()
+        done = False
+        while not done:
+            a = policy_fn(o)
+            obs_b.append(o)
+            act_b.append(a)
+            o, r, done, _ = env.step(a)
+            rew_b.append(r)
+            done_b.append(done)
+    return {"obs": np.asarray(obs_b, np.float32),
+            "actions": np.asarray(act_b),
+            "rewards": np.asarray(rew_b, np.float32),
+            "dones": np.asarray(done_b, np.bool_)}
+
+
+def make_bc_update_fn(optimizer, batch_size: int, num_grad_steps: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        logits, _ = policy_forward(params, batch["obs"])
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, batch["action"][:, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        return nll.mean()
+
+    @jax.jit
+    def update(params, opt_state, data, rng):
+        n = data["obs"].shape[0]
+
+        def step(carry, key):
+            params, opt_state = carry
+            ix = jax.random.randint(key, (batch_size,), 0, n)
+            batch = {k: v[ix] for k, v in data.items()}
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        keys = jax.random.split(rng, num_grad_steps)
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), keys)
+        return params, opt_state, losses.mean()
+
+    return update
+
+
+class BCConfig:
+    def __init__(self) -> None:
+        self.input_path: Optional[str] = None
+        self.obs_size = CartPoleEnv.observation_size
+        self.num_actions = CartPoleEnv.num_actions
+        self.lr = 1e-3
+        self.batch_size = 128
+        self.num_grad_steps = 64
+        self.read_batch_size = 4096
+        self.hidden = 64
+        self.seed = 0
+
+    def offline_data(self, **kw) -> "BCConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown BC config option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    training = offline_data
+    environment = offline_data
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    """Behavior cloning from logged parquet transitions (reference:
+    rllib/algorithms/bc/bc.py trained purely from offline data via
+    the Data-backed reader, rllib/offline/dataset_reader.py)."""
+
+    def __init__(self, config: BCConfig) -> None:
+        import jax
+        import optax
+
+        if not config.input_path:
+            raise ValueError("BCConfig.input_path is required "
+                             "(offline_data(input_path=...))")
+        self.config = config
+        from ray_tpu import data as rdata
+        self._dataset = rdata.read_parquet(config.input_path)
+        rng = jax.random.PRNGKey(config.seed)
+        self._rng, init_rng = jax.random.split(rng)
+        self.params = init_policy(init_rng, config.obs_size,
+                                  config.num_actions,
+                                  hidden=config.hidden)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_bc_update_fn(
+            self.optimizer, config.batch_size, config.num_grad_steps)
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        """One pass over the offline dataset (streamed in read-batches;
+        each read-batch gets num_grad_steps compiled SGD steps)."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        losses = []
+        rows = 0
+        for batch in self._dataset.iter_batches(
+                batch_size=self.config.read_batch_size):
+            data = {"obs": jnp.asarray(batch["obs"], jnp.float32),
+                    "action": jnp.asarray(batch["action"])}
+            rows += int(data["obs"].shape[0])
+            self._rng, key = jax.random.split(self._rng)
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, data, key)
+            losses.append(float(loss))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "loss": float(np.mean(losses)) if losses else
+                float("nan"),
+                "rows_this_iter": rows,
+                "time_this_iter_s": time.time() - t0}
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        import jax.numpy as jnp
+        logits, _ = policy_forward(self.params,
+                                   jnp.asarray(obs, jnp.float32))
+        return int(np.argmax(np.asarray(logits)))
+
+    def evaluate(self, env_maker: Optional[Callable] = None,
+                 num_episodes: int = 5, seed: int = 100) -> float:
+        """Greedy-policy rollouts in a live env; returns mean return."""
+        maker = env_maker or (lambda s: CartPoleEnv(seed=s))
+        total = 0.0
+        for ep in range(num_episodes):
+            env = maker(seed + ep)
+            o = env.reset()
+            done = False
+            while not done:
+                o, r, done, _ = env.step(self.compute_action(o))
+                total += r
+        return total / num_episodes

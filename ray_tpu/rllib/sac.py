@@ -1,0 +1,375 @@
+"""SAC: soft actor-critic for continuous actions, TPU-first.
+
+Reference surface: rllib/algorithms/sac/sac.py:29 (SACConfig: twin Q
+networks, tanh-squashed gaussian policy, entropy temperature
+auto-tuning against a target entropy) + sac.py:561 (training_step:
+replay sampling, critic/actor/alpha updates, polyak target sync).
+
+TPU-first split mirrors dqn.py: host actors collect transitions with
+the stochastic policy; learning is ONE jit'd update running
+`num_grad_steps` minibatched SGD steps inside a compiled `lax.scan`,
+each step updating twin critics (soft Bellman target with the min of
+the target critics minus alpha*logpi), the squashed-gaussian actor
+(reparameterized), the temperature alpha (gradient on
+-alpha*(logpi + target_entropy)), and polyak-averaging the target
+critics — so the whole learner phase is a single XLA program.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.dqn import ReplayBuffer
+from ray_tpu.rllib.env import PendulumEnv, VectorEnv
+
+LOG_STD_MIN = -10.0
+LOG_STD_MAX = 2.0
+
+
+# ---------------------------------------------------------------------------
+# networks: squashed-gaussian actor + twin Q critics (plain-jax MLPs)
+# ---------------------------------------------------------------------------
+def _dense(key, n_in, n_out):
+    import jax
+    import jax.numpy as jnp
+    scale = jnp.sqrt(2.0 / n_in)
+    return {"w": jax.random.normal(key, (n_in, n_out)) * scale,
+            "b": jnp.zeros((n_out,))}
+
+
+def init_sac(rng, obs_size: int, act_size: int, hidden: int = 128):
+    import jax
+    import jax.numpy as jnp
+    k = jax.random.split(rng, 10)
+    actor = {"l1": _dense(k[0], obs_size, hidden),
+             "l2": _dense(k[1], hidden, hidden),
+             "mu": _dense(k[2], hidden, act_size),
+             "log_std": _dense(k[3], hidden, act_size)}
+    q1 = {"l1": _dense(k[4], obs_size + act_size, hidden),
+          "l2": _dense(k[5], hidden, hidden),
+          "q": _dense(k[6], hidden, 1)}
+    q2 = {"l1": _dense(k[7], obs_size + act_size, hidden),
+          "l2": _dense(k[8], hidden, hidden),
+          "q": _dense(k[9], hidden, 1)}
+    return {"actor": actor, "q1": q1, "q2": q2,
+            "log_alpha": jnp.zeros(())}
+
+
+def actor_forward(actor, obs):
+    import jax.numpy as jnp
+    x = jnp.tanh(obs @ actor["l1"]["w"] + actor["l1"]["b"])
+    x = jnp.tanh(x @ actor["l2"]["w"] + actor["l2"]["b"])
+    mu = x @ actor["mu"]["w"] + actor["mu"]["b"]
+    log_std = jnp.clip(x @ actor["log_std"]["w"]
+                       + actor["log_std"]["b"],
+                       LOG_STD_MIN, LOG_STD_MAX)
+    return mu, log_std
+
+
+def sample_action(actor, obs, key, action_scale: float):
+    """Reparameterized tanh-gaussian sample + its log-prob (the change
+    of variables adds -log(1 - tanh(u)^2) per dim; reference:
+    rllib SquashedGaussian distribution)."""
+    import jax
+    import jax.numpy as jnp
+    mu, log_std = actor_forward(actor, obs)
+    std = jnp.exp(log_std)
+    u = mu + std * jax.random.normal(key, mu.shape)
+    logp = (-0.5 * ((u - mu) / std) ** 2 - log_std
+            - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
+    a = jnp.tanh(u)
+    # stable log(1 - tanh(u)^2) = 2*(log2 - u - softplus(-2u))
+    logp -= (2.0 * (jnp.log(2.0) - u
+                    - jax.nn.softplus(-2.0 * u))).sum(-1)
+    return a * action_scale, logp
+
+
+def q_value(q, obs, act):
+    import jax.numpy as jnp
+    x = jnp.concatenate([obs, act], axis=-1)
+    x = jnp.tanh(x @ q["l1"]["w"] + q["l1"]["b"])
+    x = jnp.tanh(x @ q["l2"]["w"] + q["l2"]["b"])
+    return (x @ q["q"]["w"] + q["q"]["b"])[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# rollout worker
+# ---------------------------------------------------------------------------
+@ray_tpu.remote
+class SACWorker:
+    """Stochastic-policy transition collector (reference: off-policy
+    EnvRunner sampling)."""
+
+    def __init__(self, worker_index: int, num_envs: int,
+                 rollout_len: int, env_maker=None,
+                 max_steps: int = 200,
+                 action_scale: float = 2.0) -> None:
+        import jax
+
+        maker = env_maker or (
+            lambda seed: PendulumEnv(max_steps=max_steps, seed=seed))
+        self.vec = VectorEnv(maker, num_envs,
+                             seed=9000 * (worker_index + 1))
+        self.rollout_len = rollout_len
+        self.obs = self.vec.reset()
+        self.rng = jax.random.PRNGKey(1234 + worker_index)
+        self._action_scale = action_scale
+        self._sample = jax.jit(
+            lambda actor, obs, key: sample_action(actor, obs, key,
+                                                  action_scale))
+
+    def sample(self, actor, uniform_random: bool = False
+               ) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        T, N = self.rollout_len, self.vec.num_envs
+        obs_b, act_b, rew_b, nobs_b, done_b = [], [], [], [], []
+        for _ in range(T):
+            if uniform_random:       # warmup: cover the action space
+                self.rng, key = jax.random.split(self.rng)
+                action = np.asarray(jax.random.uniform(
+                    key, (N, self.vec.envs[0].action_size),
+                    minval=-self._action_scale,
+                    maxval=self._action_scale))
+            else:
+                self.rng, key = jax.random.split(self.rng)
+                action, _ = self._sample(actor, jnp.asarray(self.obs),
+                                         key)
+                action = np.asarray(action)
+            prev = self.obs
+            self.obs, rew, done = self.vec.step(action)
+            obs_b.append(prev)
+            act_b.append(action)
+            rew_b.append(rew)
+            nobs_b.append(self.obs)
+            done_b.append(done)
+        return {"obs": np.concatenate(obs_b),
+                "actions": np.concatenate(act_b),
+                "rewards": np.concatenate(rew_b),
+                "next_obs": np.concatenate(nobs_b),
+                "dones": np.concatenate(done_b),
+                "episode_returns": self.vec.drain_episode_returns()}
+
+
+# ---------------------------------------------------------------------------
+# jit'd learner
+# ---------------------------------------------------------------------------
+def make_update_fn(actor_opt, critic_opt, alpha_opt, gamma: float,
+                   tau: float, target_entropy: float,
+                   num_grad_steps: int, batch_size: int,
+                   action_scale: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def critic_loss(qs, actor, target_qs, log_alpha, batch, key):
+        next_a, next_logp = sample_action(actor, batch["next_obs"],
+                                          key, action_scale)
+        tq = jnp.minimum(
+            q_value(target_qs["q1"], batch["next_obs"], next_a),
+            q_value(target_qs["q2"], batch["next_obs"], next_a))
+        alpha = jnp.exp(log_alpha)
+        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * (
+            tq - alpha * next_logp)
+        target = jax.lax.stop_gradient(target)
+        l1 = ((q_value(qs["q1"], batch["obs"], batch["actions"])
+               - target) ** 2).mean()
+        l2 = ((q_value(qs["q2"], batch["obs"], batch["actions"])
+               - target) ** 2).mean()
+        return l1 + l2
+
+    def actor_loss(actor, qs, log_alpha, batch, key):
+        a, logp = sample_action(actor, batch["obs"], key, action_scale)
+        q = jnp.minimum(q_value(qs["q1"], batch["obs"], a),
+                        q_value(qs["q2"], batch["obs"], a))
+        alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+        return (alpha * logp - q).mean(), logp
+
+    def alpha_loss(log_alpha, logp):
+        # Gradient on alpha pushes entropy toward target_entropy
+        # (reference: sac.py entropy temperature optimization).
+        return (-jnp.exp(log_alpha)
+                * (jax.lax.stop_gradient(logp)
+                   + target_entropy)).mean()
+
+    @jax.jit
+    def update(state, data, rng):
+        n = data["obs"].shape[0]
+
+        def step(carry, key):
+            (actor, qs, target_qs, log_alpha, a_opt, c_opt,
+             al_opt) = carry
+            k1, k2, k3 = jax.random.split(key, 3)
+            ix = jax.random.randint(k1, (batch_size,), 0, n)
+            batch = {k: v[ix] for k, v in data.items()}
+
+            closs, cgrad = jax.value_and_grad(critic_loss)(
+                qs, actor, target_qs, log_alpha, batch, k2)
+            cup, c_opt = critic_opt.update(cgrad, c_opt, qs)
+            qs = optax.apply_updates(qs, cup)
+
+            (aloss, logp), agrad = jax.value_and_grad(
+                actor_loss, has_aux=True)(actor, qs, log_alpha,
+                                          batch, k3)
+            aup, a_opt = actor_opt.update(agrad, a_opt, actor)
+            actor = optax.apply_updates(actor, aup)
+
+            alloss, algrad = jax.value_and_grad(alpha_loss)(
+                log_alpha, logp)
+            alup, al_opt = alpha_opt.update(algrad, al_opt, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, alup)
+
+            target_qs = jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target_qs, qs)
+            return (actor, qs, target_qs, log_alpha, a_opt, c_opt,
+                    al_opt), (closs, aloss, -logp.mean())
+
+        keys = jax.random.split(rng, num_grad_steps)
+        state, (closses, alosses, entropies) = jax.lax.scan(
+            step, state, keys)
+        return state, closses.mean(), alosses.mean(), entropies.mean()
+
+    return update
+
+
+# ---------------------------------------------------------------------------
+# config + algorithm
+# ---------------------------------------------------------------------------
+class SACConfig:
+    def __init__(self) -> None:
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 4
+        self.rollout_len = 32
+        self.env_maker: Optional[Callable] = None
+        self.env_max_steps = 200
+        self.obs_size = PendulumEnv.observation_size
+        self.action_size = PendulumEnv.action_size
+        self.action_scale = PendulumEnv.action_high
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.target_entropy: Optional[float] = None   # -action_size
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1_000
+        self.batch_size = 128
+        self.num_grad_steps = 64
+        self.hidden = 128
+        self.seed = 0
+
+    def rollouts(self, **kw) -> "SACConfig":
+        for k, v in kw.items():
+            if k == "max_steps":
+                k = "env_max_steps"
+            if not hasattr(self, k):
+                raise ValueError(f"unknown SAC config option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    training = rollouts
+    environment = rollouts
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    def __init__(self, config: SACConfig) -> None:
+        import jax
+        import optax
+
+        self.config = config
+        c = config
+        rng = jax.random.PRNGKey(c.seed)
+        self._rng, init_rng = jax.random.split(rng)
+        params = init_sac(init_rng, c.obs_size, c.action_size,
+                          hidden=c.hidden)
+        self.actor = params["actor"]
+        self.qs = {"q1": params["q1"], "q2": params["q2"]}
+        self.target_qs = self.qs        # arrays are immutable
+        self.log_alpha = params["log_alpha"]
+        self.actor_opt = optax.adam(c.actor_lr)
+        self.critic_opt = optax.adam(c.critic_lr)
+        self.alpha_opt = optax.adam(c.alpha_lr)
+        self._a_opt = self.actor_opt.init(self.actor)
+        self._c_opt = self.critic_opt.init(self.qs)
+        self._al_opt = self.alpha_opt.init(self.log_alpha)
+        target_ent = (c.target_entropy if c.target_entropy is not None
+                      else -float(c.action_size))
+        self._update = make_update_fn(
+            self.actor_opt, self.critic_opt, self.alpha_opt, c.gamma,
+            c.tau, target_ent, c.num_grad_steps, c.batch_size,
+            c.action_scale)
+        # Replay stores flat continuous actions; reuse the DQN ring
+        # buffer with an action matrix instead of an int vector.
+        self.buffer = ReplayBuffer(c.buffer_capacity, c.obs_size)
+        self.buffer.actions = np.zeros(
+            (c.buffer_capacity, c.action_size), np.float32)
+        self.workers = [
+            SACWorker.remote(i, c.num_envs_per_worker, c.rollout_len,
+                             c.env_maker, c.env_max_steps,
+                             c.action_scale)
+            for i in range(c.num_rollout_workers)]
+        self._np_rng = np.random.RandomState(c.seed)
+        self.iteration = 0
+        self._reward_window: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        warmup = self.buffer.size < self.config.learning_starts
+        actor_ref = ray_tpu.put(jax.device_get(self.actor))
+        samples = ray_tpu.get(
+            [w.sample.remote(actor_ref, uniform_random=warmup)
+             for w in self.workers])
+        episode_returns = []
+        for s in samples:
+            self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
+                                  s["next_obs"], s["dones"])
+            episode_returns.extend(s["episode_returns"])
+        self._reward_window.extend(episode_returns)
+        self._reward_window = self._reward_window[-50:]
+
+        closs = aloss = entropy = float("nan")
+        if self.buffer.size >= self.config.learning_starts:
+            slab = self.buffer.sample(
+                self._np_rng,
+                self.config.batch_size * self.config.num_grad_steps)
+            self._rng, key = jax.random.split(self._rng)
+            state = (self.actor, self.qs, self.target_qs,
+                     self.log_alpha, self._a_opt, self._c_opt,
+                     self._al_opt)
+            state, closs, aloss, entropy = self._update(
+                state, {k: jnp.asarray(v) for k, v in slab.items()},
+                key)
+            (self.actor, self.qs, self.target_qs, self.log_alpha,
+             self._a_opt, self._c_opt, self._al_opt) = state
+            closs, aloss = float(closs), float(aloss)
+            entropy = float(entropy)
+        self.iteration += 1
+        steps = sum(len(s["actions"]) for s in samples)
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(self._reward_window))
+                                    if self._reward_window else 0.0),
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_this_iter": steps,
+            "buffer_size": self.buffer.size,
+            "critic_loss": closs,
+            "actor_loss": aloss,
+            "alpha": float(jnp.exp(self.log_alpha)),
+            "entropy": entropy,
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def stop(self) -> None:
+        for w in self.workers:
+            ray_tpu.kill(w)

@@ -1,0 +1,176 @@
+"""SAC (continuous control), offline BC, and connector pipelines.
+
+Reference analogs: rllib/algorithms/sac/sac.py:29 (twin critics,
+squashed gaussian, entropy tuning), rllib/algorithms/bc + offline/
+dataset_reader.py (offline pipeline over Data), rllib/connectors/
+(obs/action preprocessing).
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (BCConfig, CartPoleEnv, ConnectedEnv,
+                           ConnectorPipeline, FrameStack,
+                           NormalizeObs, PendulumEnv, SACConfig,
+                           UnsquashActions, VectorEnv,
+                           collect_expert_episodes, log_transitions)
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_pendulum_env_sanity():
+    env = PendulumEnv(max_steps=30, seed=0)
+    obs = env.reset()
+    assert obs.shape == (3,)
+    assert abs(float(np.linalg.norm(obs[:2])) - 1.0) < 1e-5
+    total, steps, done = 0.0, 0, False
+    while not done:
+        obs, r, done, _ = env.step(np.array([0.5]))
+        assert r <= 0.0          # reward is a negative cost
+        total += r
+        steps += 1
+    assert steps == 30           # fixed-length episodes
+
+    # VectorEnv passes continuous action rows through un-cast.
+    vec = VectorEnv(lambda s: PendulumEnv(max_steps=10, seed=s), 2)
+    obs = vec.reset()
+    assert obs.shape == (2, 3)
+    for _ in range(12):
+        obs, r, d = vec.step(np.array([[0.3], [-1.7]]))
+    assert len(vec.drain_episode_returns()) >= 2
+
+
+def test_sac_smoke_and_machinery(rt):
+    """SAC end-to-end plumbing on a small budget: replay fills, the
+    compiled update runs, entropy temperature moves."""
+    algo = (SACConfig()
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_len=16)
+            .training(learning_starts=128, num_grad_steps=8,
+                      batch_size=32, hidden=32, max_steps=60)
+            .build())
+    r1 = algo.train()
+    assert r1["timesteps_this_iter"] == 16 * 4
+    for _ in range(3):
+        r = algo.train()
+    assert r["buffer_size"] > 128
+    assert math.isfinite(r["critic_loss"])
+    assert math.isfinite(r["actor_loss"])
+    assert r["alpha"] > 0
+    algo.stop()
+
+
+def test_sac_learns_pendulum(rt):
+    """SAC solves Pendulum-class swing-up: from a random-policy floor
+    around -1150, the 50-episode reward window must clear -400
+    (reference parity: SAC is THE Pendulum baseline, sac.py:29;
+    calibrated: seed 0 reaches ~-320 by iteration 75)."""
+    algo = (SACConfig()
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_len=32)
+            .training(learning_starts=1000, num_grad_steps=128,
+                      batch_size=128, seed=0)
+            .build())
+    best = -float("inf")
+    for i in range(110):
+        r = algo.train()
+        if r["episodes_this_iter"]:
+            best = max(best, r["episode_reward_mean"])
+        if best > -400.0:
+            break
+    algo.stop()
+    assert best > -400.0, best
+
+
+def _expert(obs: np.ndarray) -> int:
+    """Scripted CartPole expert: push toward the pole's fall."""
+    return 1 if obs[2] + 0.5 * obs[3] > 0 else 0
+
+
+def test_bc_recovers_scripted_policy(rt, tmp_path):
+    """Offline path end-to-end: scripted expert -> parquet logs via
+    ray_tpu.data -> BC training (never touches an env) -> the cloned
+    policy matches the expert and balances the pole."""
+    cols = collect_expert_episodes(
+        _expert, lambda s: CartPoleEnv(max_steps=200, seed=s),
+        num_episodes=30, seed=0)
+    assert cols["obs"].shape[0] > 2000     # expert survives long
+    path = str(tmp_path / "expert")
+    files = log_transitions(path, cols["obs"], cols["actions"],
+                            cols["rewards"], cols["dones"],
+                            block_rows=1024)
+    assert files and all(os.path.exists(f) for f in files)
+
+    bc = (BCConfig()
+          .offline_data(input_path=path)
+          .training(lr=3e-3, num_grad_steps=128, batch_size=128)
+          .build())
+    for _ in range(6):
+        res = bc.train()
+    assert res["rows_this_iter"] == cols["obs"].shape[0]
+    assert res["loss"] < 0.1, res
+
+    # Agreement with the expert on held-out states.
+    probe = collect_expert_episodes(
+        _expert, lambda s: CartPoleEnv(max_steps=120, seed=1000 + s),
+        num_episodes=3, seed=0)
+    agree = np.mean([bc.compute_action(o) == a
+                     for o, a in zip(probe["obs"], probe["actions"])])
+    assert agree > 0.95, agree
+    # And the cloned policy actually balances.
+    assert bc.evaluate(num_episodes=3) > 150.0
+
+
+def test_connector_pipeline_units():
+    from ray_tpu.rllib import ClipObs, FlattenObs
+
+    pipe = ConnectorPipeline([ClipObs(-1, 1), FlattenObs()])
+    out = pipe(np.array([[2.0, -3.0], [0.5, 0.25]]))
+    assert out.shape == (4,)
+    assert out.tolist() == [1.0, -1.0, 0.5, 0.25]
+
+    norm = NormalizeObs()
+    rng = np.random.RandomState(0)
+    data = rng.normal(5.0, 2.0, size=(500, 3)).astype(np.float32)
+    out = norm(data)
+    assert abs(float(out.mean())) < 0.1
+    assert abs(float(out.std()) - 1.0) < 0.15
+
+    fs = FrameStack(k=3)
+    a = fs(np.zeros((2, 2)))
+    assert a.shape == (2, 2, 3)
+    b = fs(np.ones((2, 2)))
+    assert b[..., -1].max() == 1.0 and b[..., 0].max() == 0.0
+    fs.reset()
+    assert fs(np.ones((2, 2)))[..., 0].min() == 1.0
+
+    us = UnsquashActions(-2.0, 2.0)
+    assert us(np.array([-1.0, 0.0, 1.0])).tolist() == [-2.0, 0.0, 2.0]
+
+
+def test_connected_env_preprocessing():
+    """ConnectedEnv applies obs/action pipelines transparently: a
+    policy emitting [-1, 1] actions drives a [-2, 2]-torque env."""
+    env = ConnectedEnv(
+        PendulumEnv(max_steps=15, seed=3),
+        obs_connectors=[NormalizeObs()],
+        action_connectors=[UnsquashActions(PendulumEnv.action_low,
+                                           PendulumEnv.action_high)])
+    assert env.continuous_actions and env.observation_size == 3
+    o = env.reset()
+    assert o.shape == (3,)
+    done = False
+    while not done:
+        o, r, done, _ = env.step(np.array([1.0]))   # max torque
+    # The wrapped env saw torque 2.0, not 1.0: the episode ran fine
+    # and normalized observations stay bounded.
+    assert np.isfinite(o).all()
