@@ -54,22 +54,8 @@ class PlacementGroupMixin:
                 self._register_object(rec["ready_oid"], "error", blob,
                                       len(blob), state=FAILED)
             nodes = rec["nodes"] or []
-            local = [(i, n) for i, n in enumerate(nodes)
-                     if n == self.node_id]
-            remote = [(i, n) for i, n in enumerate(nodes)
-                      if n != self.node_id]
-            for i, _ in local:
-                self._return_bundle_local(pg_id, i)
             self._schedule()
-        for i, nid in remote:
-            ninfo = self._node_info(nid)
-            if ninfo is not None:
-                try:
-                    self._peer_conn_to(ninfo).notify(
-                        {"type": "return_bundle", "pg_id": pg_id,
-                         "bundle_index": i})
-                except Exception:
-                    pass
+        self._release_bundles(pg_id, list(enumerate(nodes)))
         ctx.reply(m, {"ok": True})
 
     def _h_pg_state(self, ctx: _ConnCtx, m: dict) -> None:
@@ -88,6 +74,61 @@ class PlacementGroupMixin:
         with self.lock:
             self._return_bundle_local(m["pg_id"], m["bundle_index"])
             self._schedule()
+
+    def _h_revoke_bundle(self, ctx: _ConnCtx, m: dict) -> None:
+        self._revoke_bundle_local(m["pg_id"], m["bundle_index"])
+
+    def _revoke_bundle_local(self, pg_id: bytes, idx: int) -> None:
+        """Return a bundle AND kill the actors created in it (the
+        re-placement path: the gang is moving, so members left on
+        surviving nodes must die — reference: GCS destroys actors on
+        rescheduled bundles)."""
+        with self.lock:
+            victims = [
+                a for a in self.actors.values()
+                if a.state != "dead"
+                and (a.spec.get("pg") or {}).get("id") == pg_id
+                and (a.spec.get("pg") or {}).get("bundle") == idx]
+            for a in victims:
+                a.restarts_left = 0
+                a.state = "dead"
+                a.death_reason = ("placement group bundle revoked "
+                                  "(gang re-placed after a member "
+                                  "node died)")
+                self.gcs.drop_named_actor(a.actor_id)
+                self._release_actor_holds(a)
+                self._fail_actor_queue(a)
+                if a.worker is not None:
+                    self._teardown_worker(a.worker)
+            self._return_bundle_local(pg_id, idx)
+            self._schedule()
+
+    def _release_bundles(self, pg_id: bytes,
+                         entries: List[Tuple[int, bytes]],
+                         revoke: bool = False) -> None:
+        """Release bundles across nodes: local ones directly, remote
+        ones via return_bundle/revoke_bundle notifies (best-effort —
+        an unreachable node's bundles die with it).  Never called
+        under self.lock."""
+        msg_type = "revoke_bundle" if revoke else "return_bundle"
+        for idx, nid in entries:
+            if nid == self.node_id:
+                if revoke:
+                    self._revoke_bundle_local(pg_id, idx)
+                else:
+                    with self.lock:
+                        self._return_bundle_local(pg_id, idx)
+                        self._schedule()
+                continue
+            ninfo = self._node_info(nid)
+            if ninfo is None:
+                continue
+            try:
+                self._peer_conn_to(ninfo).notify(
+                    {"type": msg_type, "pg_id": pg_id,
+                     "bundle_index": idx})
+            except Exception:
+                pass
 
     def _reserve_bundle_local(self, pg_id: bytes, idx: int,
                               res: Dict[str, float]) -> bool:
@@ -184,17 +225,8 @@ class PlacementGroupMixin:
                 break
             reserved.append((idx, target))
         if not ok:
-            for idx, target in reserved:
-                if target.get("self"):
-                    with self.lock:
-                        self._return_bundle_local(pg_id, idx)
-                else:
-                    try:
-                        self._peer_conn_to(target).notify(
-                            {"type": "return_bundle", "pg_id": pg_id,
-                             "bundle_index": idx})
-                    except Exception:
-                        pass
+            self._release_bundles(
+                pg_id, [(i, t["node_id"]) for i, t in reserved])
             return False
         blob = ser.dumps(True)
         rollback: List[Tuple[int, dict]] = []
@@ -209,17 +241,8 @@ class PlacementGroupMixin:
                 self._register_object(rec["ready_oid"], "inline", blob,
                                       len(blob))
                 self._schedule()
-        for idx, target in rollback:
-            if target.get("self"):
-                with self.lock:
-                    self._return_bundle_local(pg_id, idx)
-            else:
-                try:
-                    self._peer_conn_to(target).notify(
-                        {"type": "return_bundle", "pg_id": pg_id,
-                         "bundle_index": idx})
-                except Exception:
-                    pass
+        self._release_bundles(
+            pg_id, [(i, t["node_id"]) for i, t in rollback])
         return True
 
     def _create_actor_with_pg(self, ctx: _ConnCtx, m: dict) -> None:
@@ -274,6 +297,33 @@ class PlacementGroupMixin:
             with self.lock:
                 self.forwarded.pop(crec.task_id, None)
             ctx.reply(m, {"__error__": e})
+
+    def _pg_on_node_dead(self, nid: bytes) -> None:
+        """Re-place committed placement groups that had bundles on a
+        dead node (reference: gcs_placement_group_manager.cc
+        OnNodeDead -> reschedule path).  Gang semantics: release every
+        SURVIVING bundle and redo the whole 2PC placement — a partial
+        gang is useless to its SPMD consumers (a TPU slice with a dead
+        host has no ICI ring), and the autoscaler/slice-reconciler will
+        produce replacement nodes the retry loop then lands on."""
+        to_repair: List[Tuple[bytes, List[Tuple[int, bytes]]]] = []
+        with self.lock:
+            for pg_id, rec in self.pgs.items():
+                if rec["state"] != "created" or not rec["nodes"] \
+                        or nid not in rec["nodes"]:
+                    continue
+                nodes = rec["nodes"]
+                rec["state"] = "pending"
+                rec["nodes"] = None
+                to_repair.append((pg_id, [
+                    (i, n) for i, n in enumerate(nodes) if n != nid]))
+        for pg_id, survivors in to_repair:
+            # Revoke (return + kill actors): gang members stranded on
+            # surviving nodes must not outlive the re-placement.
+            self._release_bundles(pg_id, survivors, revoke=True)
+            threading.Thread(target=self._pg_create_loop,
+                             args=(pg_id,), daemon=True,
+                             name="rtpu-pg-repair").start()
 
     def _pg_bundle_node(self, pg: dict) -> Optional[bytes]:
         """Home node of a pg bundle, from the coordinator record.  Caller

@@ -523,6 +523,12 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 pass
         self._cluster_view = [n for n in self._cluster_view
                               if n["node_id"] != nid]
+        # Committed placement groups with bundles on the dead node get
+        # re-placed whole (node_pg.py _pg_on_node_dead).
+        try:
+            self._pg_on_node_dead(nid)
+        except Exception:
+            pass
         # Tombstone every actor the GCS knew lived there, plus our hints.
         dead_reason = f"node {nid.hex()[:8]} died: " \
                       f"{info.get('reason') or 'lost heartbeats'}"

@@ -60,7 +60,8 @@ def pack(runtime_env: Optional[dict]) -> Optional[dict]:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(bad)} (supported: "
             f"{sorted(_ALLOWED)}; pip/conda are rejected — this "
-            f"deployment bakes dependencies into the image)")
+            f"deployment bakes dependencies into the image; see "
+            f"README 'Isolation boundary')")
     import ray_tpu
     from ray_tpu._private.client import get_global_client
 

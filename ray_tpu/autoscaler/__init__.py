@@ -7,6 +7,12 @@ monitor.py (the reconcile loop fed by raylet load reports).
 
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
 from ray_tpu.autoscaler.node_provider import (LocalNodeProvider,
-                                              NodeProvider)
+                                              NodeProvider,
+                                              TpuSliceProvider)
+from ray_tpu.autoscaler.tpu_provider import (LocalQueuedResourcesApi,
+                                             QueuedResourcesApi,
+                                             QueuedResourcesSliceProvider)
 
-__all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider"]
+__all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider",
+           "TpuSliceProvider", "QueuedResourcesApi",
+           "LocalQueuedResourcesApi", "QueuedResourcesSliceProvider"]

@@ -175,7 +175,18 @@ class StandardAutoscaler:
         if time.time() - self._last_launch >= self.launch_cooldown_s:
             # Gang demand on a slice provider: whole slices, atomically.
             if isinstance(self.provider, TpuSliceProvider):
-                pending_ids = set()
+                live_slices = set(self.provider.list_slices())
+                # A gang stays pinned to its slice for as long as the
+                # slice EXISTS — not merely while the gang is pending.
+                # A committed gang whose slice dies goes pending again
+                # (PG repair) while a reconciling provider re-provisions
+                # the same slice; forgetting the pin here would
+                # double-provision (one slice from the reconciler, one
+                # from this loop).  The pin clears when the slice is
+                # deleted (idle-reap or reconciler give-up).
+                for pg_id in list(self._slices_for_pg):
+                    if self._slices_for_pg[pg_id] not in live_slices:
+                        del self._slices_for_pg[pg_id]
                 for d in pg_demand:
                     head = next(
                         (k for b in d["bundles"] for k in b
@@ -184,7 +195,6 @@ class StandardAutoscaler:
                     if head is None:
                         continue
                     pg_id = d.get("pg_id", "")
-                    pending_ids.add(pg_id)
                     if pg_id in self._slices_for_pg:
                         continue       # already provisioning this gang
                     hosts = len(d["bundles"])
@@ -197,10 +207,6 @@ class StandardAutoscaler:
                     self._slices_for_pg[pg_id] = name
                     self._last_launch = time.time()
                     actions["launched"] += hosts
-                # Gangs no longer pending free their tracking entry.
-                for pg_id in list(self._slices_for_pg):
-                    if pg_id not in pending_ids:
-                        del self._slices_for_pg[pg_id]
                 pg_demand = [d for d in pg_demand
                              if not any(k.startswith("TPU-")
                                         and k.endswith("-head")

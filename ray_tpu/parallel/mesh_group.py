@@ -109,17 +109,35 @@ class MeshGroup:
                  platform: str = "cpu",
                  resources_per_host: Optional[Dict[str, float]] = None,
                  strategy: str = "PACK",
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None,
+                 slice_type: Optional[str] = None,
+                 pg_timeout_s: float = 60.0) -> None:
         if platform not in ("cpu", "tpu"):
             raise ValueError("platform must be 'cpu' or 'tpu'")
         self.num_hosts = num_hosts
-        res = dict(resources_per_host
-                   or ({"CPU": 1} if platform == "cpu"
-                       else {"TPU": float(devices_per_host or 4)}))
+        if slice_type is not None:
+            # Gang the group onto ONE whole TPU slice: tpu_slice_bundles
+            # marks bundle 0 with the TPU-<type>-head resource, which is
+            # both the one-gang-per-slice exclusivity claim and the
+            # demand signal a slice-provider autoscaler provisions from
+            # (autoscaler/autoscaler.py TPU-head gang path).
+            from ray_tpu.util.placement_group import tpu_slice_bundles
+            bundles = tpu_slice_bundles(
+                slice_type, num_hosts,
+                chips_per_host=devices_per_host or 4)
+            res = dict(bundles[1] if num_hosts > 1 else bundles[0])
+            # One rank per host is the gang's whole point: PACK would
+            # happily co-locate two bundles on one host (only bundle 0
+            # carries the slice-head pin), splitting the ICI ring.
+            strategy = "STRICT_SPREAD"
+        else:
+            res = dict(resources_per_host
+                       or ({"CPU": 1} if platform == "cpu"
+                           else {"TPU": float(devices_per_host or 4)}))
+            bundles = [dict(res) for _ in range(num_hosts)]
         self.pg: PlacementGroup = placement_group(
-            [dict(res) for _ in range(num_hosts)], strategy=strategy,
-            name=name)
-        if not self.pg.wait(timeout_seconds=60):
+            bundles, strategy=strategy, name=name)
+        if not self.pg.wait(timeout_seconds=pg_timeout_s):
             remove_placement_group(self.pg)
             raise TimeoutError(
                 f"MeshGroup placement group ({num_hosts} x {res}, "
@@ -152,19 +170,38 @@ class MeshGroup:
                     timeout=300)
 
     # -- elasticity (reference: backend_executor.py restart paths) ------
-    def rebuild(self) -> None:
+    def rebuild(self, retry_timeout_s: float = 180.0) -> None:
         """Tear down and re-rendezvous the whole gang.  One dead member
         poisons jax.distributed for everyone (the survivors hang in
         collectives against the dead peer), so recovery is always
         all-ranks: kill, respawn on the SAME placement-group bundles,
-        re-initialize."""
+        re-initialize.
+
+        The respawn retries: when the gang died WITH its nodes (slice
+        preemption), actor creation races node-death detection and PG
+        repair — the bundle map may still point at dead nodes for a few
+        heartbeats, and replacement nodes may still be provisioning."""
+        import time as _time
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
             except Exception:
                 pass
         self.restarts += 1
-        self._spawn_gang()
+        deadline = _time.monotonic() + retry_timeout_s
+        while True:
+            try:
+                self._spawn_gang()
+                return
+            except Exception:
+                for w in getattr(self, "workers", []):
+                    try:
+                        ray_tpu.kill(w)
+                    except Exception:
+                        pass
+                if _time.monotonic() > deadline:
+                    raise
+                _time.sleep(1.0)
 
     def run_elastic(self, fn: Callable, *args,
                     max_restarts: int = 2,
