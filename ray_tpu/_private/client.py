@@ -207,6 +207,14 @@ class CoreClient:
             loc2, data2, _ = reply["results"][oid]
             return self._materialize(oid, loc2, data2)
 
+    def object_sizes(self, refs: Sequence[ObjectRef]
+                     ) -> List[Optional[int]]:
+        """Known byte sizes of objects (None while pending/unknown)."""
+        reply = self.conn.call(
+            {"type": "object_sizes",
+             "object_ids": [r.binary() for r in refs]})
+        return reply["sizes"]
+
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None
              ) -> Tuple[List[ObjectRef], List[ObjectRef]]:

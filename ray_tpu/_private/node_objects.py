@@ -383,6 +383,18 @@ class ObjectPlaneMixin:
         freed = self._spill_objects(int(m.get("bytes", 0)))
         ctx.reply(m, {"freed": freed})
 
+    def _h_object_sizes(self, ctx: _ConnCtx, m: dict) -> None:
+        """Known byte sizes of objects (None while pending/unknown) —
+        feeds the Data executor's byte-budget backpressure (reference
+        role: object store usage in Data's ResourceManager)."""
+        sizes = []
+        with self.lock:
+            for oid in m["object_ids"]:
+                e = self.objects.get(oid)
+                sizes.append(e.size if e is not None and e.size else
+                             None)
+        ctx.reply(m, {"sizes": sizes})
+
     _proactive_spilling = False
 
     def _maybe_proactive_spill(self) -> None:

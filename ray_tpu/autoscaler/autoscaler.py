@@ -151,9 +151,12 @@ class StandardAutoscaler:
         workers = self.provider.non_terminated_nodes()
         actions = {"launched": 0, "terminated": 0}
 
-        # min_workers floor
+        # min_workers floor (pure-slice pools don't do per-host create)
         while len(workers) < self.min_workers:
-            self.provider.create_node(self.worker_resources)
+            try:
+                self.provider.create_node(self.worker_resources)
+            except NotImplementedError:
+                break
             workers = self.provider.non_terminated_nodes()
             actions["launched"] += 1
 
@@ -216,7 +219,10 @@ class StandardAutoscaler:
             needed = self._bin_pack_new_nodes(unfulfilled, pg_demand,
                                               nodes, budget)
             for _ in range(needed):
-                self.provider.create_node(self.worker_resources)
+                try:
+                    self.provider.create_node(self.worker_resources)
+                except NotImplementedError:
+                    break   # pure-slice pool: gangs-only provisioning
                 self._last_launch = time.time()
                 actions["launched"] += 1
 

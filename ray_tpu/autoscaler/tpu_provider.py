@@ -14,7 +14,10 @@ Reference analogs:
 "provisions" slice hosts as local node-service subprocesses (the CI
 fake — same mechanics as a real slice modulo the machines being
 remote), with failure injection for chaos tests.  A GKE/GCP
-implementation plugs in by speaking the same four methods over HTTP.
+implementation plugs in by implementing the full seam over HTTP: the
+four queued-resource calls (create/get/delete/list) plus the host
+surface (`non_terminated_nodes`, `node_cluster_id`, `shutdown`) the
+autoscaler polls every reconcile tick.
 
 `QueuedResourcesSliceProvider` implements the autoscaler's
 TpuSliceProvider contract on top of the API: `create_slice` records
@@ -41,8 +44,10 @@ FAILED = "FAILED"
 
 
 class QueuedResourcesApi:
-    """The four-call cloud surface (mock seam).  Names are caller-chosen
-    and unique per attempt; `get` returns None for unknown names."""
+    """The cloud seam.  Names are caller-chosen and unique per attempt;
+    `get` returns None for unknown names.  Implementations must also
+    provide the host surface (non_terminated_nodes / node_cluster_id /
+    shutdown) — the autoscaler reads it every tick."""
 
     def create_queued_resource(self, name: str, slice_type: str,
                                num_hosts: int) -> None:
@@ -56,6 +61,19 @@ class QueuedResourcesApi:
         raise NotImplementedError
 
     def list_names(self) -> List[str]:
+        raise NotImplementedError
+
+    # -- host surface ------------------------------------------------------
+    def non_terminated_nodes(self) -> List[str]:
+        """Provider node names of every live slice host."""
+        raise NotImplementedError
+
+    def node_cluster_id(self, node_name: str):
+        """GCS node_id of a host once registered, else None."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        """Release every host this API provisioned."""
         raise NotImplementedError
 
 
@@ -219,7 +237,7 @@ class QueuedResourcesSliceProvider(TpuSliceProvider):
             name = f"slice-{self._seq}"
             self._desired[name] = {"slice_type": slice_type,
                                    "num_hosts": num_hosts,
-                                   "attempt": 0, "given_up": False}
+                                   "attempt": 0}
         # Kick convergence, but never let a transient API error escape
         # AFTER desired state is recorded: the caller must get the name
         # (and record its gang pin) or the background loop's eventual
@@ -240,8 +258,7 @@ class QueuedResourcesSliceProvider(TpuSliceProvider):
 
     def list_slices(self) -> List[str]:
         with self._lock:
-            return [n for n, d in self._desired.items()
-                    if not d["given_up"]]
+            return list(self._desired)
 
     def slice_nodes(self, name: str) -> List[str]:
         with self._lock:
@@ -287,8 +304,6 @@ class QueuedResourcesSliceProvider(TpuSliceProvider):
             desired = {n: dict(d) for n, d in self._desired.items()}
         # 1) drive each desired slice toward one ACTIVE attempt
         for name, d in desired.items():
-            if d["given_up"]:
-                continue
             attempt = d["attempt"]
             attempt_name = f"{name}--a{attempt}" if attempt else None
             info = self.api.get(attempt_name) if attempt_name else None

@@ -1,10 +1,14 @@
 """ray_tpu.data: streaming distributed datasets (reference: Ray Data)."""
 
 from ray_tpu.data.block import Block
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (Dataset, from_items, from_numpy,
                                   from_pandas, read_csv, read_json,
                                   read_parquet)
 range = Dataset.range  # noqa: A001 — mirrors ray.data.range
+read_images = Dataset.read_images
+read_tfrecords = Dataset.read_tfrecords
 
-__all__ = ["Block", "Dataset", "from_items", "from_numpy", "from_pandas",
-           "read_csv", "read_json", "read_parquet", "range"]
+__all__ = ["Block", "Dataset", "DataContext", "from_items",
+           "from_numpy", "from_pandas", "read_csv", "read_json",
+           "read_parquet", "read_images", "read_tfrecords", "range"]

@@ -204,18 +204,78 @@ class _MapActor:
 # ---------------------------------------------------------------------------
 # operators
 # ---------------------------------------------------------------------------
+class MemoryBudget:
+    """Byte-budget backpressure state shared by one operator's stream
+    (reference role: ResourceManager object-store budgeting +
+    backpressure_policy/).  Tracks the mean size of COMPLETED blocks
+    (sizes come from the node's object directory, no fetch) and turns
+    the byte budget into an effective window size.  `peak_bytes` is
+    observable for tests/ops dashboards."""
+
+    def __init__(self, max_bytes: Optional[int]) -> None:
+        self.max_bytes = max_bytes
+        self._sized: Dict[bytes, int] = {}
+        self.avg_block_bytes: float = 0.0
+        self._n = 0
+        self.peak_bytes = 0
+        self.throttled = 0          # submissions deferred by the budget
+
+    def observe(self, window: List[ray_tpu.ObjectRef]) -> None:
+        if self.max_bytes is None or not window:
+            return
+        unknown = [r for r in window if r.binary() not in self._sized]
+        if unknown:
+            try:
+                client = ray_tpu._ensure_connected()
+                for r, s in zip(unknown, client.object_sizes(unknown)):
+                    if s:
+                        self._sized[r.binary()] = s
+                        self._n += 1
+                        self.avg_block_bytes += (
+                            s - self.avg_block_bytes) / self._n
+            except Exception:
+                return
+        held = sum(self._sized.get(r.binary(), 0) for r in window)
+        self.peak_bytes = max(self.peak_bytes, held)
+
+    def effective_cap(self, cap: int) -> int:
+        if self.max_bytes is None:
+            return cap
+        if self.avg_block_bytes <= 0:
+            # Cold start: no completed block has told us sizes yet.
+            # Ramp conservatively so one window of surprise-fat blocks
+            # can't blow the budget; the window widens as soon as the
+            # first (fast, small) completions prove blocks are skinny.
+            return min(cap, 2)
+        by_bytes = max(int(self.max_bytes // self.avg_block_bytes), 1)
+        if by_bytes < cap:
+            self.throttled += 1
+        return min(cap, by_bytes)
+
+    def forget(self, ref: ray_tpu.ObjectRef) -> None:
+        self._sized.pop(ref.binary(), None)
+
+
 def _windowed(upstream: Iterator[ray_tpu.ObjectRef],
               submit: Callable[[ray_tpu.ObjectRef], ray_tpu.ObjectRef],
-              cap: int, preserve_order: bool
+              cap: int, preserve_order: bool,
+              budget: Optional[MemoryBudget] = None
               ) -> Iterator[ray_tpu.ObjectRef]:
     """Shared operator inner loop: keep up to `cap` submitted refs in
-    flight (concurrency-cap backpressure), yield in submission order or
-    whichever completes first."""
+    flight (concurrency-cap backpressure), shrunk further so in-flight
+    block BYTES stay under the DataContext budget (byte backpressure),
+    yielding in submission order or whichever completes first."""
+    from ray_tpu.data.context import DataContext
+    if budget is None:
+        budget = MemoryBudget(
+            DataContext.get_current().max_bytes_in_flight)
     window: List[ray_tpu.ObjectRef] = []
     up = iter(upstream)
     exhausted = False
     while not exhausted or window:
-        while not exhausted and len(window) < cap:
+        budget.observe(window)
+        while not exhausted \
+                and len(window) < budget.effective_cap(cap):
             try:
                 ref = next(up)
             except StopIteration:
@@ -225,12 +285,15 @@ def _windowed(upstream: Iterator[ray_tpu.ObjectRef],
         if not window:
             continue
         if preserve_order:
-            yield window.pop(0)
+            got = window.pop(0)
         else:
             ready, _ = ray_tpu.wait(window, num_returns=1,
                                     timeout=None)
             window.remove(ready[0])
-            yield ready[0]
+            got = ready[0]
+        budget.observe([got])
+        budget.forget(got)
+        yield got
 
 
 class FusedMapOp:
@@ -239,6 +302,7 @@ class FusedMapOp:
 
     def __init__(self, stages: Optional[List[Callable]] = None) -> None:
         self.stages = list(stages or [])
+        self.last_budget: Optional[MemoryBudget] = None  # observable
 
     def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
                preserve_order: bool = True
@@ -246,10 +310,14 @@ class FusedMapOp:
         if not self.stages:
             yield from upstream
             return
+        from ray_tpu.data.context import DataContext
+        ctx = DataContext.get_current()
+        self.last_budget = MemoryBudget(ctx.max_bytes_in_flight)
         yield from _windowed(
             upstream,
             lambda ref: _apply_stages.remote(ref, self.stages),
-            MAX_IN_FLIGHT, preserve_order)
+            min(MAX_IN_FLIGHT, ctx.max_blocks_in_flight),
+            preserve_order, self.last_budget)
 
 
 class ActorPoolMapOp:

@@ -113,10 +113,14 @@ def block_from_arrow(table) -> Block:
     out = {}
     for name in table.column_names:
         field = table.schema.field(name)
-        col = table.column(name).combine_chunks()
-        if isinstance(col, pa.ChunkedArray):    # zero chunks
-            col = pa.concat_arrays(col.chunks) if col.chunks \
-                else pa.array([], type=col.type)
+        col = table.column(name)
+        if col.num_chunks == 1:
+            col = col.chunk(0)      # zero-copy; combine_chunks copies
+        else:
+            col = col.combine_chunks()
+            if isinstance(col, pa.ChunkedArray):    # zero chunks
+                col = pa.concat_arrays(col.chunks) if col.chunks \
+                    else pa.array([], type=col.type)
         if pa.types.is_fixed_size_list(col.type):
             width = col.type.list_size
             vals = col.values.to_numpy(zero_copy_only=False)

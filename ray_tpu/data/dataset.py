@@ -32,6 +32,11 @@ Batch = Dict[str, np.ndarray]
 _DEFAULT_BLOCK_ROWS = 4096
 
 
+def _ctx_block_rows() -> int:
+    from ray_tpu.data.context import DataContext
+    return DataContext.get_current().block_rows or _DEFAULT_BLOCK_ROWS
+
+
 # Block-transform stages are plain functions Block -> List[Block]
 # (list so filter/flat ops can drop/split).
 Stage = Callable[[B.Block], List[B.Block]]
@@ -60,7 +65,8 @@ class Dataset:
     # ------------------------------------------------------------------
     @staticmethod
     def from_items(items: Sequence[Any],
-                   block_rows: int = _DEFAULT_BLOCK_ROWS) -> "Dataset":
+                   block_rows: Optional[int] = None) -> "Dataset":
+        block_rows = block_rows or _ctx_block_rows()
         refs = []
         for i in range(0, len(items), block_rows):
             refs.append(ray_tpu.put(
@@ -68,7 +74,8 @@ class Dataset:
         return Dataset(refs, [])
 
     @staticmethod
-    def range(n: int, block_rows: int = _DEFAULT_BLOCK_ROWS) -> "Dataset":
+    def range(n: int, block_rows: Optional[int] = None) -> "Dataset":
+        block_rows = block_rows or _ctx_block_rows()
         refs = []
         for i in range(0, n, block_rows):
             hi = min(i + block_rows, n)
@@ -77,7 +84,8 @@ class Dataset:
 
     @staticmethod
     def from_numpy(arrays: Dict[str, np.ndarray],
-                   block_rows: int = _DEFAULT_BLOCK_ROWS) -> "Dataset":
+                   block_rows: Optional[int] = None) -> "Dataset":
+        block_rows = block_rows or _ctx_block_rows()
         n = len(next(iter(arrays.values())))
         refs = []
         for i in range(0, n, block_rows):
@@ -86,7 +94,7 @@ class Dataset:
         return Dataset(refs, [])
 
     @staticmethod
-    def from_pandas(df, block_rows: int = _DEFAULT_BLOCK_ROWS) -> "Dataset":
+    def from_pandas(df, block_rows: Optional[int] = None) -> "Dataset":
         return Dataset.from_numpy(B.block_from_pandas(df), block_rows)
 
     @staticmethod
@@ -130,6 +138,79 @@ class Dataset:
                 from ray_tpu.data.filesystem import open_file
                 with open_file(path, "rb") as f:
                     return B.block_from_arrow(pajson.read_json(f))
+            return read
+
+        return Dataset([make_reader(f) for f in files], [])
+
+    @staticmethod
+    def read_images(paths: Union[str, List[str]],
+                    size: Optional[Tuple[int, int]] = None,
+                    mode: Optional[str] = None,
+                    include_paths: bool = False,
+                    files_per_block: Optional[int] = None) -> "Dataset":
+        """Decode an image directory/glob into blocks (reference:
+        read_images, data/read_api.py:775 over ImageDatasource).
+
+        `size=(h, w)` resizes at decode time; with a fixed size the
+        `image` column is one dense [N, h, w, C] uint8 tensor (the
+        TPU input-pipeline shape), otherwise a per-row object array.
+        `mode` is a PIL conversion ("RGB", "L", ...).
+        """
+        from ray_tpu.data.context import DataContext
+        files = _expand_paths(
+            paths, (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp"))
+        per = files_per_block or DataContext.get_current().\
+            images_per_block
+
+        def make_reader(chunk):
+            def read():
+                from PIL import Image
+                from ray_tpu.data.filesystem import open_file
+                imgs, kept = [], []
+                for p in chunk:
+                    with open_file(p, "rb") as f:
+                        im = Image.open(f)
+                        im.load()
+                    if mode:
+                        im = im.convert(mode)
+                    if size is not None:
+                        im = im.resize((size[1], size[0]))
+                    imgs.append(np.asarray(im))
+                    kept.append(p)
+                if size is not None:
+                    col = np.stack(imgs) if imgs else \
+                        np.zeros((0,) + tuple(size), np.uint8)
+                else:
+                    col = np.empty(len(imgs), dtype=object)
+                    for i, im in enumerate(imgs):
+                        col[i] = im
+                out = {"image": col}
+                if include_paths:
+                    out["path"] = np.asarray(kept)
+                return out
+            return read
+
+        chunks = [files[i:i + per] for i in range(0, len(files), per)]
+        return Dataset([make_reader(c) for c in chunks], [])
+
+    @staticmethod
+    def read_tfrecords(paths: Union[str, List[str]]) -> "Dataset":
+        """Read TFRecord files of tf.train.Example protos (reference:
+        read_tfrecords, data/read_api.py).  The record framing
+        (length + crc) and the Example wire format are parsed natively
+        — no tensorflow dependency; bytes/int64/float features become
+        columns (scalar features unwrap, fixed-width lists become 2-D
+        columns, ragged ones object arrays)."""
+        files = _expand_paths(paths, (".tfrecord", ".tfrecords"))
+
+        def make_reader(path):
+            def read():
+                from ray_tpu.data import tfrecords as T
+                from ray_tpu.data.filesystem import open_file
+                with open_file(path, "rb") as f:
+                    return T.examples_to_block(
+                        T.parse_example(rec)
+                        for rec in T.read_records(f))
             return read
 
         return Dataset([make_reader(f) for f in files], [])
@@ -701,3 +782,5 @@ from_pandas = Dataset.from_pandas
 read_parquet = Dataset.read_parquet
 read_csv = Dataset.read_csv
 read_json = Dataset.read_json
+read_images = Dataset.read_images
+read_tfrecords = Dataset.read_tfrecords
