@@ -151,6 +151,8 @@ class CoreClient:
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
+        if not refs:
+            return []      # no RPC — hot on the worker arg-unpack path
         oids = [r.binary() for r in refs]
         reply = self._blocking_call(
             {"type": "get_objects", "object_ids": oids, "timeout": timeout})

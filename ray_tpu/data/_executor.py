@@ -248,9 +248,12 @@ class MemoryBudget:
             # first (fast, small) completions prove blocks are skinny.
             return min(cap, 2)
         by_bytes = max(int(self.max_bytes // self.avg_block_bytes), 1)
-        if by_bytes < cap:
-            self.throttled += 1
         return min(cap, by_bytes)
+
+    def note_deferred(self) -> None:
+        """A submission was actually held back by the byte cap (the
+        count cap alone would have admitted it)."""
+        self.throttled += 1
 
     def forget(self, ref: ray_tpu.ObjectRef) -> None:
         self._sized.pop(ref.binary(), None)
@@ -274,14 +277,17 @@ def _windowed(upstream: Iterator[ray_tpu.ObjectRef],
     exhausted = False
     while not exhausted or window:
         budget.observe(window)
-        while not exhausted \
-                and len(window) < budget.effective_cap(cap):
+        ecap = budget.effective_cap(cap)
+        while not exhausted and len(window) < ecap:
             try:
                 ref = next(up)
             except StopIteration:
                 exhausted = True
                 break
             window.append(submit(ref))
+        if not exhausted and budget.avg_block_bytes > 0 \
+                and ecap <= len(window) < cap:
+            budget.note_deferred()      # byte cap is limiting the window
         if not window:
             continue
         if preserve_order:
@@ -348,10 +354,15 @@ class ActorPoolMapOp:
         # Observable pool size (peak within the last stream()).
         self.current_size = 0
         self.peak_size = 0
+        self.last_budget: Optional[MemoryBudget] = None
 
     def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
                preserve_order: bool = True
                ) -> Iterator[ray_tpu.ObjectRef]:
+        from ray_tpu.data.context import DataContext
+        budget = MemoryBudget(
+            DataContext.get_current().max_bytes_in_flight)
+        self.last_budget = budget
         cls = ray_tpu.remote(_MapActor)
         actors: List[Any] = []
 
@@ -379,13 +390,19 @@ class ActorPoolMapOp:
 
         try:
             while not exhausted or window:
-                while not exhausted and len(window) < 2 * len(actors):
+                budget.observe(window)
+                cap = 2 * len(actors)
+                ecap = budget.effective_cap(cap)
+                while not exhausted and len(window) < ecap:
                     try:
                         ref = next(up)
                     except StopIteration:
                         exhausted = True
                         break
                     submit(ref)
+                if not exhausted and budget.avg_block_bytes > 0 \
+                        and ecap <= len(window) < cap:
+                    budget.note_deferred()
                 if not window:
                     continue
                 targets = [window[0]] if preserve_order else window
@@ -414,6 +431,8 @@ class ActorPoolMapOp:
                     window.remove(ready[0])
                     got = ready[0]
                 owner.pop(got.binary(), None)
+                budget.observe([got])
+                budget.forget(got)
                 yield got
                 # Sustained instant completions: the pool is oversized;
                 # retire an actor that owns none of the in-flight work.

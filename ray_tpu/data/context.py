@@ -17,7 +17,7 @@ few blocks while skinny token blocks keep the full window).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import ClassVar, Optional
 
 
 @dataclass
@@ -32,7 +32,7 @@ class DataContext:
     # Files decoded per read_images block.
     images_per_block: int = 64
 
-    _current: "Optional[DataContext]" = None
+    _current: ClassVar[Optional["DataContext"]] = None
 
     @classmethod
     def get_current(cls) -> "DataContext":

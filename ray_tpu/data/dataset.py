@@ -177,10 +177,15 @@ class Dataset:
                         im = im.resize((size[1], size[0]))
                     imgs.append(np.asarray(im))
                     kept.append(p)
-                if size is not None:
+                shapes = {im.shape for im in imgs}
+                if size is not None and len(shapes) <= 1:
                     col = np.stack(imgs) if imgs else \
                         np.zeros((0,) + tuple(size), np.uint8)
                 else:
+                    # Mixed channel layouts (RGB vs L vs RGBA) resize
+                    # to the same H,W but different channel counts —
+                    # fall back to per-row arrays; pass mode= to get
+                    # one dense tensor.
                     col = np.empty(len(imgs), dtype=object)
                     for i, im in enumerate(imgs):
                         col[i] = im

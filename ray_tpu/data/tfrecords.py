@@ -47,8 +47,10 @@ def read_records(f) -> Iterator[bytes]:
     """Yield raw record payloads from a TFRecord stream."""
     while True:
         header = f.read(12)
-        if len(header) < 12:
+        if not header:
             return
+        if len(header) < 12:
+            raise ValueError("truncated TFRecord header")
         (length,) = struct.unpack("<Q", header[:8])
         data = f.read(length)
         if len(data) < length:

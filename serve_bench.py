@@ -3,56 +3,76 @@
 Analog of BASELINE.json config #5 ("Llama Ray Serve continuous
 batching") scaled to the attached single chip: a GPT-2-small-class
 model served through the ContinuousBatcher engine, closed-loop clients
-firing short prompts.  Writes SERVE_BENCH_r03.json and prints one JSON
+firing short prompts.  Writes SERVE_BENCH_r04.json and prints one JSON
 line.  The reference publishes no serving numbers (BASELINE.md
 "published": {}), so the recorded numbers ARE the baseline this repo
 must beat in later rounds.
 
-Round-2 numbers (SERVE_BENCH_r02.json, the bar to beat): 920 decode
-tok/s aggregate, 28.8 req/s, TTFT p50 172 ms / p99 239 ms.  Round-3
-targets (VERDICT): >= 5000 decode tok/s, TTFT p50 < 50 ms,
-p99 < 150 ms — reached by the pipelined engine (in-flight dispatches +
-async device->host token copies, serve/llm.py).
+History: r02 920 tok/s (sync loop); r03 recorded 4,351 tok/s from a
+pre-pipelined engine (the shipped engine measured 4.6-4.7k in tuning).
+Round-4 target: >= 5,000 decode tok/s with TTFT p50 <= 50 ms.  The
+measured dispatch ceiling on this tunnel was ~6.1k at chunk 16, so the
+default config is chunk 16 / depth 4; env knobs let the driver sweep:
+
+  SERVE_SLOTS / SERVE_CHUNK / SERVE_DEPTH / SERVE_MAX_NEW — one run
+  SERVE_SWEEP=1 — try several (chunk, depth) points with a short run
+                  each, then measure the best at full length
+  SERVE_MODEL=llama-1b — the ~1B-param serving config
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 
 
-def main() -> None:
-    import numpy as np
+def _build(cfg_name: str):
     import jax
     from ray_tpu.models import transformer
+    if cfg_name == "llama-1b":
+        cfg = transformer.TransformerConfig(
+            vocab_size=32_000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5504, max_seq=1024,
+            dtype=jax.numpy.bfloat16, remat=False)
+        label = "llama-1b-class (~1.1B)"
+    else:
+        cfg = transformer.TransformerConfig(
+            vocab_size=50_304, d_model=768, n_layers=12, n_heads=12,
+            d_ff=3072, max_seq=1024, arch="gpt2",
+            dtype=jax.numpy.bfloat16, remat=False)
+        label = "gpt2-small (124M)"
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params, label
+
+
+def _run_once(cfg, params, *, num_slots, decode_chunk, pipeline_depth,
+              max_new, n_requests, max_len=256, prompt_pad=64):
+    import numpy as np
     from ray_tpu.serve.llm import ContinuousBatcher
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
-    cfg = transformer.TransformerConfig(
-        vocab_size=50_304, d_model=768, n_layers=12, n_heads=12,
-        d_ff=3072, max_seq=1024, arch="gpt2",
-        dtype=jax.numpy.bfloat16, remat=False)
-    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-    num_slots = 16 if on_tpu else 4
-    max_new = 64 if on_tpu else 8
-    n_requests = 256 if on_tpu else 12
     bat = ContinuousBatcher(params, cfg, num_slots=num_slots,
-                            max_len=256, prompt_pad=64,
-                            decode_chunk=8 if on_tpu else 4,
-                            pipeline_depth=3 if on_tpu else 2)
+                            max_len=max_len, prompt_pad=prompt_pad,
+                            decode_chunk=decode_chunk,
+                            pipeline_depth=pipeline_depth)
+    try:
+        return _measure(bat, cfg, num_slots=num_slots,
+                        decode_chunk=decode_chunk,
+                        pipeline_depth=pipeline_depth,
+                        max_new=max_new, n_requests=n_requests)
+    finally:
+        bat.stop()
 
+
+def _measure(bat, cfg, *, num_slots, decode_chunk, pipeline_depth,
+             max_new, n_requests):
+    import numpy as np
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, size=(16,)).tolist()
                for _ in range(n_requests)]
+    bat.generate(prompts[0], max_new=4)       # compile warmup
 
-    # Warmup: compile prefill + decode paths.
-    bat.generate(prompts[0], max_new=4)
-
-    # Closed loop at concurrency == num_slots: every slot stays busy but
-    # requests don't pile up in the admission queue (queue wait would
-    # dominate TTFT and measure the backlog, not the system).
     results = []
     lock = threading.Lock()
     work = list(prompts)
@@ -78,22 +98,19 @@ def main() -> None:
 
     # Streaming check: time-to-first-token through the stream path.
     st0 = time.time()
-    stream_iter = bat.generate_stream(prompts[0], max_new=8)
     first_tok_s = None
     streamed = []
-    for tok in stream_iter:
+    for tok in bat.generate_stream(prompts[0], max_new=8):
         if first_tok_s is None:
             first_tok_s = time.time() - st0
         streamed.append(tok)
-    bat.stop()
 
     ttfts = sorted(r["ttft_s"] for r in results)
     total_tokens = sum(len(r["tokens"]) for r in results)
-    out = {
-        "metric": "serve_continuous_batching",
-        "model": "gpt2-small (124M)",
-        "device": str(getattr(dev, "device_kind", dev.platform)),
+    return {
         "num_slots": num_slots,
+        "decode_chunk": decode_chunk,
+        "pipeline_depth": pipeline_depth,
         "requests": len(results),
         "max_new_tokens": max_new,
         "req_per_s": round(len(results) / wall, 2),
@@ -103,9 +120,65 @@ def main() -> None:
         "stream_first_token_ms": round((first_tok_s or 0) * 1e3, 1),
         "stream_tokens": len(streamed),
         "wall_s": round(wall, 2),
-        "vs_r02_decode_tps": round(total_tokens / wall / 920.0, 2),
     }
-    with open("SERVE_BENCH_r03.json", "w") as f:
+
+
+def main() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    model = os.environ.get("SERVE_MODEL", "gpt2s")
+    cfg, params, label = _build(model)
+
+    slots = int(os.environ.get("SERVE_SLOTS", 16 if on_tpu else 4))
+    chunk = int(os.environ.get("SERVE_CHUNK", 16 if on_tpu else 4))
+    depth = int(os.environ.get("SERVE_DEPTH", 4 if on_tpu else 2))
+    max_new = int(os.environ.get("SERVE_MAX_NEW",
+                                 64 if on_tpu else 8))
+    n_requests = 256 if on_tpu else 12
+
+    sweep_on = os.environ.get("SERVE_SWEEP", "").lower() \
+        not in ("", "0", "false")
+    if sweep_on and on_tpu:
+        # Short runs over the grid, then the winner at full length.
+        best, best_cfg = -1.0, None
+        grid = [(8, 3), (16, 3), (16, 4), (24, 4), (32, 4)]
+        sweep_log = []
+        for c, d in grid:
+            r = _run_once(cfg, params, num_slots=slots,
+                          decode_chunk=c, pipeline_depth=d,
+                          max_new=max_new, n_requests=64)
+            sweep_log.append({"chunk": c, "depth": d,
+                              "tps": r["decode_tokens_per_s"],
+                              "ttft_p50_ms": r["ttft_p50_ms"]})
+            # Constraint from the round target: TTFT p50 <= 50 ms.
+            if r["decode_tokens_per_s"] > best \
+                    and r["ttft_p50_ms"] <= 50.0:
+                best, best_cfg = r["decode_tokens_per_s"], (c, d)
+        if best_cfg is None:                    # nothing met the TTFT bar
+            best_cfg = max(sweep_log,
+                           key=lambda e: e["tps"])
+            best_cfg = (best_cfg["chunk"], best_cfg["depth"])
+        chunk, depth = best_cfg
+    else:
+        sweep_log = None
+
+    r = _run_once(cfg, params, num_slots=slots, decode_chunk=chunk,
+                  pipeline_depth=depth, max_new=max_new,
+                  n_requests=n_requests)
+    out = {
+        "metric": "serve_continuous_batching",
+        "model": label,
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+        **r,
+        "vs_r02_decode_tps": round(
+            r["decode_tokens_per_s"] / 920.0, 2),
+    }
+    if sweep_log:
+        out["sweep"] = sweep_log
+    suffix = "" if model == "gpt2s" else f"_{model.replace('-', '_')}"
+    with open(f"SERVE_BENCH_r04{suffix}.json", "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
 

@@ -1,0 +1,131 @@
+"""ON-DEVICE runtime validation: the TPU-specific hot paths that the
+CPU suite can only approximate — the serving engine's pipelined
+decode (copy_to_host_async through the real transfer engine), the
+CompiledTrainStep (donation + bf16 on real HBM), and the
+iter_device_batches host->HBM prefetch pipeline.
+
+    python -m pytest tests_tpu/ -q        # skips cleanly without a TPU
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+if not any(d.platform == "tpu" for d in jax.devices()):
+    pytest.skip("no TPU attached", allow_module_level=True)
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def _tiny_cfg(dtype=None):
+    from ray_tpu.models.transformer import TransformerConfig
+    return TransformerConfig(vocab_size=97, d_model=64, n_heads=4,
+                             n_kv_heads=2, n_layers=2, d_ff=128,
+                             max_seq=128,
+                             dtype=dtype or jnp.float32, remat=False)
+
+
+def test_engine_decode_matches_full_forward_on_tpu():
+    """The continuous-batching engine (pipelined dispatches, async
+    device->host copies) decodes EXACTLY what repeated full forward
+    passes produce — on the real chip, where dispatch/copy overlap is
+    real concurrency, not interpreter sequencing."""
+    from ray_tpu.models import transformer
+    from ray_tpu.serve.llm import ContinuousBatcher
+
+    cfg = _tiny_cfg()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    bat = ContinuousBatcher(params, cfg, num_slots=4, max_len=64,
+                            prompt_pad=16, decode_chunk=4,
+                            pipeline_depth=3)
+    prompts = [[5, 9, 11], [3], [60, 2, 8, 40, 7], [1, 2]]
+    try:
+        reqs = [bat.submit(p, max_new=8) for p in prompts]
+        for r in reqs:
+            assert r.done.wait(300), "engine stalled on TPU"
+    finally:
+        bat.stop()
+    for prompt, req in zip(prompts, reqs):
+        seq = list(prompt)
+        want = []
+        for _ in range(8):
+            logits = transformer.forward(
+                params, np.asarray([seq], np.int32), cfg)
+            nxt = int(np.argmax(np.asarray(logits[0, -1],
+                                           np.float32)))
+            want.append(nxt)
+            seq.append(nxt)
+        assert req.tokens == want, (prompt, req.tokens, want)
+
+
+def test_compiled_train_step_on_tpu():
+    """CompiledTrainStep on real HBM: loss decreases over steps, state
+    donation doesn't corrupt, metrics are finite bf16-safe numbers."""
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.train.train_step import CompiledTrainStep
+
+    cfg = _tiny_cfg(dtype=jnp.bfloat16)
+    mesh = make_mesh(MeshSpec(), devices=jax.devices()[:1])
+    step = CompiledTrainStep(cfg, mesh)
+    state = step.init_state(seed=0)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (4, 65)).astype(np.int32)
+    losses = []
+    for _ in range(40):
+        state, metrics = step(state, step.shard_batch(tokens))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    # Same batch every step: the model must be memorizing it (the lr
+    # schedule warms up, so early deltas are tiny — measured 0.40 over
+    # 40 steps in fp32; bf16 on-chip tracks within noise).
+    assert losses[-1] < losses[0] - 0.2, losses
+
+
+def test_iter_device_batches_prefetch_on_tpu():
+    """Data's host->HBM pipeline lands jax Arrays ON THE TPU with the
+    right shapes/values, with prefetch in flight."""
+    import ray_tpu
+    from ray_tpu import data as rdata
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        n = 64
+        ds = rdata.from_numpy(
+            {"x": np.arange(n * 8, dtype=np.float32).reshape(n, 8),
+             "y": np.arange(n, dtype=np.int32)},
+            block_rows=16)
+        seen = 0
+        for batch in ds.iter_device_batches(batch_size=16,
+                                            prefetch=2):
+            assert isinstance(batch["x"], jax.Array)
+            assert batch["x"].devices() == {jax.devices()[0]}
+            assert batch["x"].shape == (16, 8)
+            row0 = int(np.asarray(batch["y"])[0])
+            np.testing.assert_array_equal(
+                np.asarray(batch["x"][0]),
+                np.arange(row0 * 8, row0 * 8 + 8, dtype=np.float32))
+            seen += 1
+        assert seen == 4
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_engine_streaming_on_tpu():
+    """Streaming consumer receives tokens incrementally while the
+    pipelined engine keeps dispatching (SSE data-plane path)."""
+    from ray_tpu.models import transformer
+    from ray_tpu.serve.llm import ContinuousBatcher
+
+    cfg = _tiny_cfg()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(1))
+    bat = ContinuousBatcher(params, cfg, num_slots=2, max_len=64,
+                            prompt_pad=16, decode_chunk=4,
+                            pipeline_depth=2)
+    try:
+        toks = list(bat.generate_stream([7, 8, 9], max_new=12))
+        assert len(toks) == 12
+        out = bat.generate([7, 8, 9], max_new=12)
+        assert out["tokens"] == toks     # stream == non-stream
+    finally:
+        bat.stop()
