@@ -255,6 +255,28 @@ class MemoryBudget:
         count cap alone would have admitted it)."""
         self.throttled += 1
 
+    def refill(self, window: List[ray_tpu.ObjectRef], up,
+               submit: Callable[[ray_tpu.ObjectRef], None],
+               cap: int) -> tuple:
+        """Shared refill stanza: observe sizes, top the window up to
+        the byte-limited cap, account real deferrals.  Returns
+        (exhausted, effective_cap) — effective_cap < cap tells callers
+        the BYTE budget (not capacity) is the current limiter."""
+        self.observe(window)
+        ecap = self.effective_cap(cap)
+        exhausted = False
+        while len(window) < ecap:
+            try:
+                ref = next(up)
+            except StopIteration:
+                exhausted = True
+                break
+            submit(ref)
+        if not exhausted and self.avg_block_bytes > 0 \
+                and ecap <= len(window) < cap:
+            self.note_deferred()
+        return exhausted, ecap
+
     def forget(self, ref: ray_tpu.ObjectRef) -> None:
         self._sized.pop(ref.binary(), None)
 
@@ -276,18 +298,10 @@ def _windowed(upstream: Iterator[ray_tpu.ObjectRef],
     up = iter(upstream)
     exhausted = False
     while not exhausted or window:
-        budget.observe(window)
-        ecap = budget.effective_cap(cap)
-        while not exhausted and len(window) < ecap:
-            try:
-                ref = next(up)
-            except StopIteration:
-                exhausted = True
-                break
-            window.append(submit(ref))
-        if not exhausted and budget.avg_block_bytes > 0 \
-                and ecap <= len(window) < cap:
-            budget.note_deferred()      # byte cap is limiting the window
+        if not exhausted:
+            exhausted, _ = budget.refill(
+                window, up, lambda ref: window.append(submit(ref)),
+                cap)
         if not window:
             continue
         if preserve_order:
@@ -389,20 +403,12 @@ class ActorPoolMapOp:
             window.append(out)
 
         try:
+            ecap = 2 * len(actors)
             while not exhausted or window:
-                budget.observe(window)
                 cap = 2 * len(actors)
-                ecap = budget.effective_cap(cap)
-                while not exhausted and len(window) < ecap:
-                    try:
-                        ref = next(up)
-                    except StopIteration:
-                        exhausted = True
-                        break
-                    submit(ref)
-                if not exhausted and budget.avg_block_bytes > 0 \
-                        and ecap <= len(window) < cap:
-                    budget.note_deferred()
+                if not exhausted:
+                    exhausted, ecap = budget.refill(window, up, submit,
+                                                    cap)
                 if not window:
                     continue
                 targets = [window[0]] if preserve_order else window
@@ -420,9 +426,13 @@ class ActorPoolMapOp:
                         timeout=self.scale_up_after_s)
                 if not ready:
                     # Saturated and stalled: add an actor (helps the
-                    # blocks still waiting in the upstream).
+                    # blocks still waiting in the upstream) — but only
+                    # when CAPACITY is the limiter; a byte-capped
+                    # window (ecap < cap) can't feed more actors, so
+                    # growing the pool would just park idle actors on
+                    # reserved CPUs.
                     if (len(actors) < self.max_size
-                            and not exhausted):
+                            and not exhausted and ecap >= cap):
                         spawn()
                     continue
                 if preserve_order:

@@ -151,9 +151,11 @@ class Dataset:
         """Decode an image directory/glob into blocks (reference:
         read_images, data/read_api.py:775 over ImageDatasource).
 
-        `size=(h, w)` resizes at decode time; with a fixed size the
-        `image` column is one dense [N, h, w, C] uint8 tensor (the
-        TPU input-pipeline shape), otherwise a per-row object array.
+        `size=(h, w)` resizes at decode time; with BOTH size and mode
+        set the `image` column is one dense [N, h, w, C] uint8 tensor
+        (the TPU input-pipeline shape) — mode pins the channel count,
+        so every block of the dataset has the same representation.
+        Without both, rows are per-image arrays (object column).
         `mode` is a PIL conversion ("RGB", "L", ...).
         """
         from ray_tpu.data.context import DataContext
@@ -177,15 +179,14 @@ class Dataset:
                         im = im.resize((size[1], size[0]))
                     imgs.append(np.asarray(im))
                     kept.append(p)
-                shapes = {im.shape for im in imgs}
-                if size is not None and len(shapes) <= 1:
+                # Dense iff size AND mode are both pinned: the decision
+                # must be DATASET-level (mode fixes channels), or two
+                # blocks of one dataset could disagree on the column
+                # representation and break cross-block concatenation.
+                if size is not None and mode is not None:
                     col = np.stack(imgs) if imgs else \
                         np.zeros((0,) + tuple(size), np.uint8)
                 else:
-                    # Mixed channel layouts (RGB vs L vs RGBA) resize
-                    # to the same H,W but different channel counts —
-                    # fall back to per-row arrays; pass mode= to get
-                    # one dense tensor.
                     col = np.empty(len(imgs), dtype=object)
                     for i, im in enumerate(imgs):
                         col[i] = im

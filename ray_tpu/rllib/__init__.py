@@ -16,6 +16,9 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import (CartPoleEnv, PendulumEnv,
                                PixelCartPoleEnv, VectorEnv)
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.multi_agent import (MultiAgentCartPole,
+                                       MultiAgentEnv, MultiAgentPPO,
+                                       MultiAgentPPOConfig)
 from ray_tpu.rllib.offline import (BC, BCConfig,
                                    collect_expert_episodes,
                                    log_transitions)
@@ -29,4 +32,6 @@ __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
            "PixelCartPoleEnv", "VectorEnv", "Connector",
            "ConnectorPipeline", "ClipObs", "NormalizeObs",
            "FrameStack", "FlattenObs", "ClipActions",
-           "UnsquashActions", "ConnectedEnv"]
+           "UnsquashActions", "ConnectedEnv", "MultiAgentEnv",
+           "MultiAgentCartPole", "MultiAgentPPO",
+           "MultiAgentPPOConfig"]

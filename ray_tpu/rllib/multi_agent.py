@@ -1,0 +1,306 @@
+"""Multi-agent RL: shared-env per-agent policies over the PPO learner.
+
+Reference surface: rllib/env/multi_agent_env.py (MultiAgentEnv:
+dict-keyed obs/action/reward/done per agent), the `policies` +
+`policy_mapping_fn` config (rllib/algorithms/algorithm_config.py
+multi_agent()), and per-policy train batches in the learner group.
+
+TPU-first shape: each policy's rollout is a rectangular [T, lanes]
+tensor (lanes = its agents x envs), so every policy update is the SAME
+compiled PPO program ppo.make_update_fn builds — one jit per policy,
+no ragged per-agent paths inside jit.  Agents auto-reset individually
+(their done flags delimit episodes inside the lane), which keeps the
+tensors dense while preserving per-agent episode semantics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import CartPoleEnv
+from ray_tpu.rllib.ppo import (init_policy, make_update_fn,
+                               policy_forward)
+
+
+class MultiAgentEnv:
+    """Dict-keyed multi-agent env (reference:
+    env/multi_agent_env.py).  Subclasses define `agent_ids` and the
+    dict-valued reset/step."""
+
+    agent_ids: List[str] = []
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        """-> (obs_dict, reward_dict, done_dict, info).  Agents
+        auto-reset individually; done=True marks the step that closed
+        that agent's episode."""
+        raise NotImplementedError
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """N independent CartPoles sharing one env step (the reference's
+    canonical multi-agent test env, env/tests/test_multi_agent_env.py
+    MultiAgentCartPole).  Each agent auto-resets on its own fall."""
+
+    def __init__(self, num_agents: int = 2, max_steps: int = 200,
+                 seed: Optional[int] = None) -> None:
+        self.agent_ids = [f"agent_{i}" for i in range(num_agents)]
+        self._envs = {
+            aid: CartPoleEnv(max_steps=max_steps,
+                             seed=None if seed is None else seed + i)
+            for i, aid in enumerate(self.agent_ids)}
+        self.episode_returns: Dict[str, float] = {}
+        self.completed: List[float] = []
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        self.episode_returns = {aid: 0.0 for aid in self.agent_ids}
+        return {aid: e.reset() for aid, e in self._envs.items()}
+
+    def step(self, action_dict: Dict[str, Any]):
+        obs, rews, dones = {}, {}, {}
+        for aid, env in self._envs.items():
+            o, r, d, _ = env.step(int(action_dict[aid]))
+            self.episode_returns[aid] += r
+            if d:
+                self.completed.append(self.episode_returns[aid])
+                self.episode_returns[aid] = 0.0
+                o = env.reset()
+            obs[aid], rews[aid], dones[aid] = o, r, d
+        return obs, rews, dones, {}
+
+    def drain_episode_returns(self) -> List[float]:
+        out, self.completed = self.completed, []
+        return out
+
+
+@ray_tpu.remote
+class MultiAgentWorker:
+    """Rollout collector over dict-keyed envs: per POLICY, transitions
+    stack into [T, lanes] arrays (lanes = that policy's agents x this
+    worker's envs) — the shape ppo.make_update_fn consumes."""
+
+    def __init__(self, worker_index: int, num_envs: int,
+                 rollout_len: int, env_maker, policy_mapping: Dict[str,
+                                                                   str]
+                 ) -> None:
+        import jax
+
+        self.envs = [env_maker(4000 * (worker_index + 1) + i)
+                     for i in range(num_envs)]
+        self.rollout_len = rollout_len
+        self.mapping = dict(policy_mapping)
+        # Stable lane order: (env_index, agent_id) per policy.
+        self.lanes: Dict[str, List[tuple]] = {}
+        for e, env in enumerate(self.envs):
+            for aid in env.agent_ids:
+                if aid not in self.mapping:
+                    raise ValueError(
+                        f"env agent {aid!r} has no entry in "
+                        f"policy_mapping {sorted(self.mapping)}")
+                self.lanes.setdefault(self.mapping[aid], []).append(
+                    (e, aid))
+        self.obs = [env.reset() for env in self.envs]
+        self.rng = jax.random.PRNGKey(worker_index)
+        self._infer = jax.jit(policy_forward)
+
+    def sample(self, policy_params: Dict[str, Any]) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        T = self.rollout_len
+        out: Dict[str, dict] = {}
+        for pid, lanes in self.lanes.items():
+            L = len(lanes)
+            obs_size = len(self.obs[lanes[0][0]][lanes[0][1]])
+            out[pid] = {
+                "obs": np.zeros((T, L, obs_size), np.float32),
+                "actions": np.zeros((T, L), np.int32),
+                "logp": np.zeros((T, L), np.float32),
+                "values": np.zeros((T + 1, L), np.float32),
+                "rewards": np.zeros((T, L), np.float32),
+                "dones": np.zeros((T, L), np.bool_),
+            }
+
+        def act(pid, lane_obs, t):
+            logits, value = self._infer(policy_params[pid],
+                                        jnp.asarray(lane_obs))
+            self.rng, key = jax.random.split(self.rng)
+            action = jax.random.categorical(key, logits)
+            L = lane_obs.shape[0]
+            logp = jax.nn.log_softmax(logits)[jnp.arange(L), action]
+            out[pid]["obs"][t] = lane_obs
+            out[pid]["actions"][t] = np.asarray(action)
+            out[pid]["logp"][t] = np.asarray(logp)
+            out[pid]["values"][t] = np.asarray(value)
+            return np.asarray(action)
+
+        for t in range(T):
+            actions_by_env: List[Dict[str, int]] = [
+                {} for _ in self.envs]
+            for pid, lanes in self.lanes.items():
+                lane_obs = np.stack([self.obs[e][aid]
+                                     for e, aid in lanes])
+                acts = act(pid, lane_obs, t)
+                for (e, aid), a in zip(lanes, acts):
+                    actions_by_env[e][aid] = int(a)
+            for e, env in enumerate(self.envs):
+                obs, rews, dones, _ = env.step(actions_by_env[e])
+                self.obs[e] = obs
+                for pid, lanes in self.lanes.items():
+                    for li, (ei, aid) in enumerate(lanes):
+                        if ei != e:
+                            continue
+                        out[pid]["rewards"][t, li] = rews[aid]
+                        out[pid]["dones"][t, li] = dones[aid]
+        # Bootstrap values for the final observation.
+        for pid, lanes in self.lanes.items():
+            lane_obs = np.stack([self.obs[e][aid] for e, aid in lanes])
+            _, value = self._infer(policy_params[pid],
+                                   jnp.asarray(lane_obs))
+            out[pid]["values"][T] = np.asarray(value)
+        returns = []
+        for env in self.envs:
+            returns.extend(env.drain_episode_returns())
+        return {"per_policy": out, "episode_returns": returns}
+
+
+class MultiAgentPPOConfig:
+    """Builder config (reference: AlgorithmConfig.multi_agent(policies,
+    policy_mapping_fn))."""
+
+    def __init__(self) -> None:
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 2
+        self.rollout_len = 128
+        self.env_maker: Optional[Callable] = None
+        self.policies: Dict[str, dict] = {}
+        self.policy_mapping: Dict[str, str] = {}
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.clip = 0.2
+        self.vf_coef = 0.5
+        self.ent_coef = 0.01
+        self.num_minibatches = 4
+        self.num_epochs = 4
+        self.hidden = 64
+        self.seed = 0
+
+    def rollouts(self, **kw) -> "MultiAgentPPOConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    training = rollouts
+    environment = rollouts
+
+    def multi_agent(self, *, policies: Dict[str, dict],
+                    policy_mapping: Dict[str, str]
+                    ) -> "MultiAgentPPOConfig":
+        """policies: {policy_id: {"obs_size": int, "num_actions": int}};
+        policy_mapping: {agent_id: policy_id}."""
+        self.policies = dict(policies)
+        self.policy_mapping = dict(policy_mapping)
+        return self
+
+    def build(self) -> "MultiAgentPPO":
+        if not self.policies or not self.policy_mapping:
+            raise ValueError("multi_agent(policies=..., "
+                             "policy_mapping=...) is required")
+        missing = set(self.policy_mapping.values()) - set(self.policies)
+        if missing:
+            raise ValueError(f"mapping targets unknown policies "
+                             f"{sorted(missing)}")
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """One jit'd PPO update per policy over its own [T, lanes] batch;
+    rollouts come from dict-keyed env workers."""
+
+    def __init__(self, config: MultiAgentPPOConfig) -> None:
+        import jax
+        import optax
+
+        self.config = config
+        c = config
+        maker = c.env_maker or (
+            lambda seed: MultiAgentCartPole(num_agents=2, seed=seed))
+        rng = jax.random.PRNGKey(c.seed)
+        self.params: Dict[str, Any] = {}
+        self.opt_states: Dict[str, Any] = {}
+        self._updates: Dict[str, Callable] = {}
+        self.optimizer = optax.adam(c.lr)
+        for pid, spec in sorted(c.policies.items()):
+            rng, k = jax.random.split(rng)
+            self.params[pid] = init_policy(
+                k, spec["obs_size"], spec["num_actions"],
+                hidden=spec.get("hidden", c.hidden))
+            self.opt_states[pid] = self.optimizer.init(self.params[pid])
+            self._updates[pid] = make_update_fn(
+                self.optimizer, c.clip, c.vf_coef, c.ent_coef,
+                c.gamma, c.lam, c.num_minibatches, c.num_epochs)
+        self._rng = rng
+        self.workers = [
+            MultiAgentWorker.remote(i, c.num_envs_per_worker,
+                                    c.rollout_len, maker,
+                                    c.policy_mapping)
+            for i in range(c.num_rollout_workers)]
+        self.iteration = 0
+        self._reward_window: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        params_ref = ray_tpu.put(
+            {pid: jax.device_get(p) for pid, p in self.params.items()})
+        samples = ray_tpu.get([w.sample.remote(params_ref)
+                               for w in self.workers])
+        episode_returns = []
+        for s in samples:
+            episode_returns.extend(s["episode_returns"])
+        self._reward_window.extend(episode_returns)
+        self._reward_window = self._reward_window[-100:]
+
+        metrics: Dict[str, Any] = {}
+        for pid in self.params:
+            # Concatenate workers' lanes for this policy.
+            rollout = {}
+            parts = [s["per_policy"][pid] for s in samples
+                     if pid in s["per_policy"]]
+            if not parts:
+                continue
+            for key in parts[0]:
+                rollout[key] = jnp.asarray(
+                    np.concatenate([p[key] for p in parts], axis=1))
+            self._rng, key = jax.random.split(self._rng)
+            self.params[pid], self.opt_states[pid], m = \
+                self._updates[pid](self.params[pid],
+                                   self.opt_states[pid], rollout, key)
+            metrics[pid] = {k: float(v) for k, v in m.items()}
+        self.iteration += 1
+        steps = sum(p["actions"].size for s in samples
+                    for p in s["per_policy"].values())
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(self._reward_window))
+                                    if self._reward_window else 0.0),
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_this_iter": steps,
+            "per_policy": metrics,
+            "time_this_iter_s": time.time() - t0,
+        }
+
+    def stop(self) -> None:
+        for w in self.workers:
+            ray_tpu.kill(w)

@@ -10,7 +10,8 @@ train/base_trainer.py:693).
 """
 
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
-                                     PB2, PopulationBasedTraining)
+                                     MedianStoppingRule, PB2,
+                                     PopulationBasedTraining)
 from ray_tpu.tune.search import (BOHBSearcher, TPESearcher, choice,
                                  grid_search, loguniform, randint,
                                  uniform)
@@ -18,7 +19,7 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "ASHAScheduler",
-    "PopulationBasedTraining", "PB2",
+    "PopulationBasedTraining", "PB2", "MedianStoppingRule",
     "FIFOScheduler", "grid_search", "uniform", "loguniform", "randint",
     "choice", "TPESearcher", "BOHBSearcher",
 ]

@@ -293,3 +293,49 @@ class PB2(PopulationBasedTraining):
             lo, hi = self.bounds[k]
             out[k] = lo + float(v) * (hi - lo)
         return out
+
+
+class MedianStoppingRule:
+    """Median stopping (reference: tune/schedulers/
+    median_stopping_rule.py MedianStoppingRule — the Vizier rule): a
+    trial is stopped at step t when its best result so far is worse
+    than the median of the OTHER trials' running means up to t, after
+    `grace_period` steps and once `min_samples_required` trials have
+    reported."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1,
+                 min_samples_required: int = 3) -> None:
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[tuple]] = {}   # trial -> (t, score)
+
+    def _running_mean(self, trial_id: str, t: int) -> float:
+        pts = [s for tt, s in self._history.get(trial_id, ()) if tt <= t]
+        return sum(pts) / len(pts) if pts else float("-inf")
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        if self.metric not in result:
+            return CONTINUE
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        t = int(result.get(self.time_attr, 0))
+        self._history.setdefault(trial_id, []).append((t, score))
+        if t < self.grace_period:
+            return CONTINUE
+        others = [self._running_mean(tid, t) for tid in self._history
+                  if tid != trial_id and self._history[tid]]
+        others = [m for m in others if m != float("-inf")]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        best = max(s for _, s in self._history[trial_id])
+        return STOP if best < median else CONTINUE

@@ -1,0 +1,82 @@
+"""Multi-agent RL: dict-keyed envs, per-policy PPO learners
+(reference: rllib/env/multi_agent_env.py + AlgorithmConfig.multi_agent).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.multi_agent import (MultiAgentCartPole,
+                                       MultiAgentPPO,
+                                       MultiAgentPPOConfig)
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_multi_agent_env_protocol():
+    env = MultiAgentCartPole(num_agents=3, max_steps=25, seed=0)
+    obs = env.reset()
+    assert set(obs) == {"agent_0", "agent_1", "agent_2"}
+    assert all(o.shape == (4,) for o in obs.values())
+    for _ in range(30):      # beyond max_steps: per-agent auto-reset
+        obs, rews, dones, _ = env.step(
+            {aid: i % 2 for i, aid in enumerate(env.agent_ids)})
+    assert set(rews) == set(obs)
+    assert len(env.drain_episode_returns()) >= 3
+
+
+def test_multi_agent_config_validation():
+    with pytest.raises(ValueError):
+        MultiAgentPPOConfig().build()           # no policies
+    with pytest.raises(ValueError):
+        (MultiAgentPPOConfig()
+         .multi_agent(policies={"p0": {"obs_size": 4,
+                                       "num_actions": 2}},
+                      policy_mapping={"agent_0": "nope"})
+         .build())                              # unknown mapping target
+
+
+def test_two_policies_learn_independently(rt):
+    """Two agents in one env, two SEPARATE policies: both reward
+    streams improve (each policy only ever sees its own lanes)."""
+    algo = (MultiAgentPPOConfig()
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_len=128)
+            .multi_agent(
+                policies={"p0": {"obs_size": 4, "num_actions": 2},
+                          "p1": {"obs_size": 4, "num_actions": 2}},
+                policy_mapping={"agent_0": "p0", "agent_1": "p1"})
+            .build())
+    first = algo.train()
+    assert first["timesteps_this_iter"] == 128 * 2 * 2 * 2
+    assert set(first["per_policy"]) == {"p0", "p1"}
+    rewards = [first["episode_reward_mean"]]
+    for _ in range(17):
+        rewards.append(algo.train()["episode_reward_mean"])
+    algo.stop()
+    # Untrained agents survive ~20 steps; learning should roughly
+    # triple the window mean (calibrated: 12 -> 78 in 15 iters).
+    assert max(rewards[-3:]) > max(rewards[0], 15.0) * 2.0, rewards
+
+
+def test_shared_policy_mapping(rt):
+    """Both agents mapped to ONE policy: experience pools across
+    agents (parameter sharing, the other canonical multi-agent mode)."""
+    algo = (MultiAgentPPOConfig()
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=2,
+                      rollout_len=64)
+            .multi_agent(
+                policies={"shared": {"obs_size": 4, "num_actions": 2}},
+                policy_mapping={"agent_0": "shared",
+                                "agent_1": "shared"})
+            .build())
+    r = algo.train()
+    # One policy, 4 lanes (2 agents x 2 envs) on the single worker.
+    assert list(r["per_policy"]) == ["shared"]
+    assert r["timesteps_this_iter"] == 64 * 4
+    algo.stop()

@@ -202,3 +202,22 @@ def test_bohb_tuner_restore_mid_sweep(tmp_path):
         assert grid.get_best_result("loss", "min").metrics["loss"] < 1.6
     finally:
         ray_tpu.shutdown()
+
+
+def test_median_stopping_rule_unit():
+    """Median stopping (reference: median_stopping_rule.py): a trial
+    whose best lags the median of peer running means is stopped after
+    grace; leaders continue."""
+    from ray_tpu.tune.schedulers import MedianStoppingRule
+    msr = MedianStoppingRule("score", mode="max", grace_period=2,
+                             min_samples_required=3)
+    # 4 trials: three strong (8, 9, 10 per step), one weak (1 per step).
+    for t in range(1, 4):
+        decisions = {}
+        for tid, base in (("a", 8), ("b", 9), ("c", 10), ("weak", 1)):
+            decisions[tid] = msr.on_result(
+                tid, {"score": base * t, "training_iteration": t})
+        if t < 2:
+            assert all(d == "CONTINUE" for d in decisions.values())
+    assert decisions["weak"] == "STOP"
+    assert all(decisions[t] == "CONTINUE" for t in ("a", "b", "c"))
