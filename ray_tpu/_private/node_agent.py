@@ -1,0 +1,162 @@
+"""Per-node dashboard agent: local stats + log collection at the node.
+
+Reference analog: the dashboard's per-node agent process
+(python/ray/dashboard/agent.py:25) that collects logs and metrics ON
+EACH NODE so the head never has to scrape raw state from every worker
+— the head aggregates compact per-node summaries and proxies log
+reads to the owning node on demand.
+
+Here the agent is a thread inside each node service (one fewer
+process per node than the reference, same data flow):
+
+* every `interval` it samples /proc for this node's process tree
+  (cpu ticks, RSS), the shm store, and worker states, and publishes
+  ONE compact JSON blob to the GCS KV (`dashboard_agents/<node_id>`)
+  — the head's /api/agents reads those blobs, never the node;
+* `node_stats` / `list_logs` / `tail_log` RPCs serve live detail and
+  log tails from the node's own disk when the dashboard drills in —
+  log bytes only ever move when a human asks for them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+_KV_NS = "dashboard_agents"
+_CLK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
+
+
+def _proc_sample(pid: int) -> Optional[Dict[str, float]]:
+    """cpu ticks + rss for one pid from /proc (linux)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            parts = f.read().split(b") ", 1)[1].split()
+        utime, stime = int(parts[11]), int(parts[12])
+        rss_pages = int(parts[21])
+        return {"ticks": utime + stime,
+                "rss": rss_pages * os.sysconf("SC_PAGE_SIZE")}
+    except Exception:
+        return None
+
+
+class NodeAgentMixin:
+    """Mixed into NodeService (same pattern as the object/pg mixins)."""
+
+    def _start_agent(self, interval: float = 2.0) -> None:
+        import threading
+        self._agent_interval = interval
+        self._agent_last: Dict[str, float] = {}   # pid -> ticks
+        self._agent_last_t = 0.0
+        self._agent_stats: dict = {}
+        # The cpu-tick baseline is read-modify-write state shared by
+        # the loop thread and node_stats RPC handlers.
+        self._agent_lock = threading.Lock()
+        threading.Thread(target=self._agent_loop, daemon=True,
+                         name="rtpu-node-agent").start()
+
+    # -- sampling ----------------------------------------------------------
+    def _agent_sample(self) -> dict:
+        with self._agent_lock:
+            return self._agent_sample_locked()
+
+    def _agent_sample_locked(self) -> dict:
+        now = time.time()
+        pids = {"node": os.getpid()}
+        with self.lock:
+            workers = [(w.pid, w.state, w.actor_id)
+                       for w in self.workers.values()
+                       if w.state != "dead" and w.pid]
+        for pid, _, _ in workers:
+            pids[str(pid)] = pid
+        total_rss = 0
+        total_ticks = 0
+        per_worker = []
+        for label, pid in pids.items():
+            s = _proc_sample(pid)
+            if s is None:
+                continue
+            total_rss += s["rss"]
+            total_ticks += s["ticks"]
+            if label != "node":
+                per_worker.append({"pid": pid, "rss": s["rss"]})
+        dt = now - self._agent_last_t if self._agent_last_t else 0.0
+        prev = self._agent_last.get("total", 0.0)
+        cpu_pct = 0.0
+        if dt > 0 and prev:
+            cpu_pct = max(
+                (total_ticks - prev) / _CLK / dt * 100.0, 0.0)
+        self._agent_last["total"] = total_ticks
+        self._agent_last_t = now
+        try:
+            store = self._store().stats()
+        except Exception:
+            store = {}
+        stats = {
+            "node_id": self.node_id.hex(),
+            "ts": now,
+            "cpu_percent": round(cpu_pct, 1),
+            "rss_bytes": total_rss,
+            "num_workers": len(workers),
+            "workers_busy": sum(1 for _, st, _ in workers
+                                if st in ("busy", "blocked")),
+            "actors": sum(1 for _, _, aid in workers if aid),
+            "store_used_bytes": store.get("used_bytes", 0),
+            "store_capacity_bytes": store.get("capacity_bytes", 0),
+            "log_files": len(self._agent_log_files()),
+        }
+        self._agent_stats = stats
+        return stats
+
+    def _agent_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                stats = self._agent_sample()
+                self.gcs.kv_put(_KV_NS, self.node_id,
+                                json.dumps(stats).encode())
+            except Exception:
+                pass
+            time.sleep(self._agent_interval)
+
+    def _agent_log_files(self) -> List[str]:
+        try:
+            return sorted(f for f in os.listdir(self._log_dir)
+                          if f.endswith(".log"))
+        except OSError:
+            return []
+
+    # -- RPC surface (head drill-down) ------------------------------------
+    def _h_node_stats(self, ctx, m: dict) -> None:
+        stats = dict(self._agent_sample())   # drill-down: always fresh
+        with self.lock:
+            stats["workers"] = [
+                {"pid": w.pid, "state": w.state,
+                 "actor": bool(w.actor_id),
+                 "task": (w.current_task.spec.get("name")
+                          if w.current_task else None)}
+                for w in self.workers.values() if w.state != "dead"]
+        ctx.reply(m, {"stats": stats})
+
+    def _h_list_logs(self, ctx, m: dict) -> None:
+        ctx.reply(m, {"files": self._agent_log_files()})
+
+    def _h_tail_log(self, ctx, m: dict) -> None:
+        """Last `lines` lines of one worker log — read here, on the
+        node that owns the file (reference: log proxying through the
+        per-node agent, dashboard/modules/log/)."""
+        name = os.path.basename(m["file"])       # no path escapes
+        lines = min(int(m.get("lines", 100)), 10_000)
+        path = os.path.join(self._log_dir, name)
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(size - 256 * 1024, 0))
+                data = f.read()
+        except OSError as e:
+            ctx.reply(m, {"__error__": FileNotFoundError(str(e))})
+            return
+        tail = b"\n".join(data.splitlines()[-lines:])
+        ctx.reply(m, {"file": name, "data": tail.decode("utf-8",
+                                                        "replace")})

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import config
 from ray_tpu._private.gcs import GlobalControlState
+from ray_tpu._private.node_agent import NodeAgentMixin
 from ray_tpu._private.node_objects import ObjectPlaneMixin
 from ray_tpu._private.node_pg import PlacementGroupMixin
 from ray_tpu._private.node_streams import StreamChannelMixin
@@ -41,7 +42,7 @@ from ray_tpu._private.node_state import (  # noqa: F401
     _place_bundles, _uncharge, _unregister_waiter)
 
 class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
-                  StreamChannelMixin):
+                  StreamChannelMixin, NodeAgentMixin):
     """Per-node daemon: scheduler, worker pool, object directory.
 
     Single-node: runs inside the driver process (threads) with an
@@ -190,6 +191,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             self._log_tail_thread.start()
         if self.multinode:
             self._start_multinode()
+        self._start_agent()     # per-node dashboard agent (node_agent)
         for _ in range(config.worker_pool_prestart):
             self._spawn_worker(tpu=False)
 

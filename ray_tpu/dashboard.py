@@ -16,6 +16,16 @@ exists here as JSON):
                         the dashboard-metrics role without Grafana)
     GET /api/metrics.json   metric series as JSON
 
+Per-node agent plane (reference: dashboard/agent.py — stats and logs
+are collected ON each node by _private/node_agent.py; the head reads
+compact per-node summaries from the GCS KV and proxies drill-downs to
+the owning node, so raw logs/state never funnel through one process):
+
+    GET /api/agents                       every node's agent summary
+    GET /api/node/<id>/stats              live stats from that node
+    GET /api/node/<id>/logs               that node's worker log files
+    GET /api/node/<id>/logs/<file>?lines=N   tail of one log file
+
 Runs as a daemon thread inside whichever process calls `serve()` — the
 CLI head process by default."""
 
@@ -80,6 +90,76 @@ tick();
 </script></body></html>"""
 
 
+def _agents_summary(max_age_s: float = 30.0) -> list:
+    """Every node's latest agent blob from the GCS KV.  Agents publish
+    every ~2s; blobs older than `max_age_s` belong to dead/removed
+    nodes (nothing deletes them) and are filtered out."""
+    import time
+    import ray_tpu
+    from ray_tpu._private.node_agent import _KV_NS
+    client = ray_tpu._ensure_connected()
+    out = []
+    now = time.time()
+    for key in client.kv_keys(_KV_NS):
+        blob = client.kv_get(_KV_NS, key)
+        if not blob:
+            continue
+        try:
+            entry = json.loads(blob)
+        except ValueError:
+            continue
+        if now - entry.get("ts", 0) <= max_age_s:
+            out.append(entry)
+    return out
+
+
+_node_conns: dict = {}
+_node_conns_lock = threading.Lock()
+
+
+def _node_rpc(node_id_hex: str, msg: dict) -> dict:
+    """Proxy one RPC to the owning node's control port (reference: the
+    head proxying log/stat reads to per-node agents)."""
+    import ray_tpu
+    from ray_tpu._private.protocol import Connection, connect_tcp
+    from ray_tpu.util import state
+    client = ray_tpu._ensure_connected()
+    info = next((n for n in state.list_nodes()
+                 if n.get("node_id") == node_id_hex
+                 and n.get("control_port")), None)
+    if info is None:
+        # Single-node mode (no TCP control port): the head IS the node.
+        local = getattr(getattr(ray_tpu, "_session", None),
+                        "node_service", None)
+        if local is not None and local.node_id.hex() == node_id_hex:
+            return client.conn.call(msg, timeout=15.0)
+        raise KeyError(f"unknown node {node_id_hex[:12]}")
+    with _node_conns_lock:
+        conn = _node_conns.get(node_id_hex)
+    if conn is None or conn._closed:
+        # Dial OUTSIDE the lock: one unreachable node's 5s connect
+        # timeout must not stall drill-downs to healthy nodes.  A
+        # racing duplicate dial is harmless — last one wins the cache.
+        sock = connect_tcp(info["host"], info["control_port"],
+                           deadline_s=5.0)
+        conn = Connection(sock)
+        with _node_conns_lock:
+            _node_conns[node_id_hex] = conn
+    try:
+        return conn.call(msg, timeout=15.0)
+    except Exception:
+        # Evict the (likely dead) cached connection so the next
+        # request re-dials instead of failing forever.
+        with _node_conns_lock:
+            if _node_conns.get(node_id_hex) is conn:
+                del _node_conns[node_id_hex]
+        try:
+            conn.close()
+        except Exception:
+            pass
+        raise
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):     # silence per-request stderr lines
         pass
@@ -116,6 +196,30 @@ class _Handler(BaseHTTPRequestHandler):
                            "text/plain; version=0.0.4")
             elif self.path == "/graphs":
                 self._send(200, _GRAPHS.encode(), "text/html")
+            elif self.path == "/api/agents":
+                self._send(200, json.dumps(_agents_summary()).encode())
+            elif self.path.startswith("/api/node/"):
+                from urllib.parse import parse_qs, urlparse
+                parsed = urlparse(self.path)
+                parts = parsed.path.split("/")[3:]   # <id>, rest...
+                nid = parts[0]
+                rest = parts[1:]
+                if rest == ["stats"]:
+                    reply = _node_rpc(nid, {"type": "node_stats"})
+                    self._send(200, json.dumps(
+                        reply["stats"], default=str).encode())
+                elif rest == ["logs"]:
+                    reply = _node_rpc(nid, {"type": "list_logs"})
+                    self._send(200, json.dumps(reply["files"]).encode())
+                elif len(rest) == 2 and rest[0] == "logs":
+                    q = parse_qs(parsed.query)
+                    reply = _node_rpc(nid, {
+                        "type": "tail_log", "file": rest[1],
+                        "lines": int(q.get("lines", ["100"])[0])})
+                    self._send(200, reply["data"].encode(),
+                               "text/plain; charset=utf-8")
+                else:
+                    self._send(404, b'{"error": "not found"}')
             elif self.path == "/api/metrics.json":
                 import ray_tpu
                 series = ray_tpu._ensure_connected().metrics_scrape()

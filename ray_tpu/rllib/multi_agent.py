@@ -105,6 +105,13 @@ class MultiAgentWorker:
                         f"policy_mapping {sorted(self.mapping)}")
                 self.lanes.setdefault(self.mapping[aid], []).append(
                     (e, aid))
+        # env index -> [(policy_id, lane_index, agent_id)]: the
+        # reward/done scatter is one pass per env step, not a rescan
+        # of every policy's full lane list per env.
+        self._env_lanes: Dict[int, List[tuple]] = {}
+        for pid, lanes in self.lanes.items():
+            for li, (e, aid) in enumerate(lanes):
+                self._env_lanes.setdefault(e, []).append((pid, li, aid))
         self.obs = [env.reset() for env in self.envs]
         self.rng = jax.random.PRNGKey(worker_index)
         self._infer = jax.jit(policy_forward)
@@ -152,12 +159,9 @@ class MultiAgentWorker:
             for e, env in enumerate(self.envs):
                 obs, rews, dones, _ = env.step(actions_by_env[e])
                 self.obs[e] = obs
-                for pid, lanes in self.lanes.items():
-                    for li, (ei, aid) in enumerate(lanes):
-                        if ei != e:
-                            continue
-                        out[pid]["rewards"][t, li] = rews[aid]
-                        out[pid]["dones"][t, li] = dones[aid]
+                for pid, li, aid in self._env_lanes.get(e, ()):
+                    out[pid]["rewards"][t, li] = rews[aid]
+                    out[pid]["dones"][t, li] = dones[aid]
         # Bootstrap values for the final observation.
         for pid, lanes in self.lanes.items():
             lane_obs = np.stack([self.obs[e][aid] for e, aid in lanes])

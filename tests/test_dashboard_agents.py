@@ -1,0 +1,97 @@
+"""Per-node dashboard agents (reference: dashboard/agent.py — per-node
+stat/log collection, head aggregation + drill-down proxying)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    ray_tpu.init(num_cpus=2, gcs_address=c.gcs_address)
+    c.add_node({"CPU": 2})
+    c.wait_for_nodes(2)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.read()
+
+
+def test_agents_publish_and_head_aggregates(cluster):
+    """Every node's agent publishes compact stats; the head's
+    /api/agents aggregates them without touching the nodes."""
+    from ray_tpu import dashboard
+    httpd = dashboard.serve(port=0)
+    port = httpd.server_address[1]
+    try:
+        # Generate some work so workers exist + stats move.
+        @ray_tpu.remote
+        def burn(n):
+            return sum(range(n))
+        ray_tpu.get([burn.remote(10_000) for _ in range(8)])
+
+        deadline = time.time() + 30
+        agents = []
+        while time.time() < deadline:
+            agents = json.loads(_get(
+                f"http://127.0.0.1:{port}/api/agents"))
+            if len(agents) >= 2:
+                break
+            time.sleep(0.5)
+        assert len(agents) >= 2, agents      # head + worker node
+        for a in agents:
+            assert a["rss_bytes"] > 0
+            assert "cpu_percent" in a and "store_used_bytes" in a
+            assert time.time() - a["ts"] < 60
+
+        # Drill-down: live stats + worker log listing + a log tail,
+        # proxied to the OWNING node.
+        nid = agents[0]["node_id"]
+        stats = json.loads(_get(
+            f"http://127.0.0.1:{port}/api/node/{nid}/stats"))
+        assert stats["node_id"] == nid
+        assert isinstance(stats["workers"], list)
+        files = json.loads(_get(
+            f"http://127.0.0.1:{port}/api/node/{nid}/logs"))
+        assert isinstance(files, list)
+        if files:
+            tail = _get(f"http://127.0.0.1:{port}/api/node/{nid}"
+                        f"/logs/{files[0]}?lines=5")
+            assert isinstance(tail, bytes)
+    finally:
+        httpd.shutdown()
+
+
+def test_node_stats_rpc_single_node():
+    """Single-node mode: the agent runs and node_stats serves through
+    the driver's own connection (no TCP control plane)."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def nop():
+            return 1
+        ray_tpu.get(nop.remote())
+        client = ray_tpu._ensure_connected()
+        reply = client.conn.call({"type": "node_stats"}, timeout=15)
+        stats = reply["stats"]
+        assert stats["rss_bytes"] > 0
+        assert stats["num_workers"] >= 1
+        files = client.conn.call({"type": "list_logs"},
+                                 timeout=15)["files"]
+        assert any(f.startswith("worker-") for f in files)
+        tail = client.conn.call(
+            {"type": "tail_log", "file": files[0], "lines": 3},
+            timeout=15)
+        assert "data" in tail
+    finally:
+        ray_tpu.shutdown()
