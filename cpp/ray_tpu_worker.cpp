@@ -1,0 +1,245 @@
+// Implementation of the ray_tpu C++ worker (see ray_tpu_worker.hpp).
+// Framing + pickle codecs come from ray_tpu_client.cpp; the socket
+// plumbing is intentionally re-stated here (the Client keeps its fd
+// private, and the worker's serve loop owns its connection lifecycle).
+
+#include "ray_tpu_worker.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <random>
+#include <stdexcept>
+
+namespace ray_tpu {
+
+namespace {
+std::vector<uint8_t> random_id16() {
+  std::random_device rd;
+  std::vector<uint8_t> id(16);
+  for (auto &b : id) b = static_cast<uint8_t>(rd());
+  return id;
+}
+
+std::string hex(const std::vector<uint8_t> &b) {
+  static const char *d = "0123456789abcdef";
+  std::string out;
+  for (uint8_t x : b) {
+    out.push_back(d[x >> 4]);
+    out.push_back(d[x & 15]);
+  }
+  return out;
+}
+}  // namespace
+
+Worker::Worker(const std::string &host, int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) throw std::runtime_error("socket() failed");
+  int one = 1;
+  ::setsockopt(fd_, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    hostent *he = ::gethostbyname(host.c_str());
+    if (he == nullptr)
+      throw std::runtime_error("resolve failed: " + host);
+    std::memcpy(&addr.sin_addr, he->h_addr, sizeof(addr.sin_addr));
+  }
+  if (::connect(fd_, reinterpret_cast<sockaddr *>(&addr),
+                sizeof(addr)) != 0)
+    throw std::runtime_error("connect failed");
+  Value reply = Call(Value::dict({
+      {Value::str("type"), Value::str("register_client")},
+      {Value::str("kind"), Value::str("driver")},
+      {Value::str("client_id"), Value::bytes(random_id16())},
+      {Value::str("pid"), Value::integer(::getpid())},
+  }));
+  if (reply.dict_get("session_dir") == nullptr)
+    throw std::runtime_error("register_client: unexpected reply");
+}
+
+Worker::~Worker() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+void Worker::RegisterFunction(const std::string &name, NativeFn fn) {
+  fns_[name] = std::move(fn);
+}
+
+void Worker::RegisterActorClass(const std::string &name,
+                                ActorFactory f) {
+  factories_[name] = std::move(f);
+}
+
+void Worker::SendFrame(const std::vector<uint8_t> &payload) {
+  uint64_t n = payload.size();
+  uint8_t hdr[8];
+  std::memcpy(hdr, &n, 8);
+  std::vector<uint8_t> buf(hdr, hdr + 8);
+  buf.insert(buf.end(), payload.begin(), payload.end());
+  size_t off = 0;
+  while (off < buf.size()) {
+    ssize_t w = ::send(fd_, buf.data() + off, buf.size() - off, 0);
+    if (w <= 0) throw std::runtime_error("send failed");
+    off += static_cast<size_t>(w);
+  }
+}
+
+std::vector<uint8_t> Worker::RecvFrame() {
+  uint8_t hdr[8];
+  size_t got = 0;
+  while (got < 8) {
+    ssize_t r = ::recv(fd_, hdr + got, 8 - got, 0);
+    if (r <= 0) throw std::runtime_error("connection closed");
+    got += static_cast<size_t>(r);
+  }
+  uint64_t n;
+  std::memcpy(&n, hdr, 8);
+  std::vector<uint8_t> out(n);
+  got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd_, out.data() + got, n - got, 0);
+    if (r <= 0) throw std::runtime_error("connection closed");
+    got += static_cast<size_t>(r);
+  }
+  return out;
+}
+
+Value Worker::Call(Value msg) {
+  int64_t req = ++next_req_;
+  std::get<8>(msg.v)->emplace_back(Value::str("__req_id__"),
+                                   Value::integer(req));
+  SendFrame(pickle_dumps(msg));
+  for (;;) {
+    std::vector<uint8_t> frame = RecvFrame();
+    Value reply;
+    try {
+      reply = pickle_loads(frame.data(), frame.size());
+    } catch (const PickleError &) {
+      continue;  // unsolicited/undecodable push during handshake
+    }
+    if (reply.v.index() != 8) continue;
+    const Value *rid = reply.dict_get("__reply_to__");
+    if (rid == nullptr || rid->as_int() != req) {
+      // A task can land BEFORE the registration reply (the node
+      // publishes names under its lock, then replies): buffer it for
+      // Run() instead of dropping it on the floor.
+      const Value *type = reply.dict_get("type");
+      if (type != nullptr && type->is_str() &&
+          (type->as_str() == "native_task" ||
+           type->as_str() == "native_actor_release"))
+        pending_.push_back(std::move(reply));
+      continue;
+    }
+    const Value *err = reply.dict_get("__error__");
+    if (err != nullptr)
+      throw std::runtime_error(
+          "rpc error: " + (err->is_str() ? err->as_str()
+                                         : std::string("<exception>")));
+    return reply;
+  }
+}
+
+void Worker::Execute(const Value &task) {
+  const Value *tid = task.dict_get("task_id");
+  if (tid == nullptr) return;
+  ValueDict done{{Value::str("type"), Value::str("native_done")},
+                 {Value::str("task_id"), Value::bytes(tid->as_bytes())}};
+  try {
+    const std::string kind = task.dict_get("kind")->as_str();
+    ValueList args;
+    const Value *a = task.dict_get("args");
+    if (a != nullptr && (a->v.index() == 6 || a->v.index() == 7))
+      args = a->as_list();
+    Value result = Value::none();
+    if (kind == "fn") {
+      const std::string name = task.dict_get("name")->as_str();
+      auto it = fns_.find(name);
+      if (it == fns_.end())
+        throw std::runtime_error("unknown native function: " + name);
+      result = it->second(args);
+    } else if (kind == "actor_create") {
+      const std::string name = task.dict_get("name")->as_str();
+      auto it = factories_.find(name);
+      if (it == factories_.end())
+        throw std::runtime_error("unknown native actor class: " + name);
+      std::string iid = hex(task.dict_get("instance")->as_bytes());
+      instances_[iid] = it->second(args);
+      result = Value::none();
+    } else if (kind == "actor_method") {
+      std::string iid = hex(task.dict_get("instance")->as_bytes());
+      auto it = instances_.find(iid);
+      if (it == instances_.end())
+        throw std::runtime_error("unknown native actor instance");
+      result = it->second->Call(task.dict_get("method")->as_str(),
+                                args);
+    } else {
+      throw std::runtime_error("unknown native task kind: " + kind);
+    }
+    done.emplace_back(Value::str("value"), result);
+  } catch (const std::exception &e) {
+    done.emplace_back(Value::str("error"),
+                      Value::str(std::string(e.what())));
+  }
+  SendFrame(pickle_dumps(Value::dict(std::move(done))));
+}
+
+void Worker::Announce() {
+  if (announced_) return;
+  ValueList fn_names, actor_names;
+  for (const auto &kv : fns_) fn_names.push_back(Value::str(kv.first));
+  for (const auto &kv : factories_)
+    actor_names.push_back(Value::str(kv.first));
+  Call(Value::dict({
+      {Value::str("type"), Value::str("register_native_worker")},
+      {Value::str("language"), Value::str("cpp")},
+      {Value::str("functions"), Value::list(std::move(fn_names))},
+      {Value::str("actors"), Value::list(std::move(actor_names))},
+  }));
+  announced_ = true;
+}
+
+void Worker::Run(int max_tasks) {
+  Announce();
+  int executed = 0;
+  auto handle = [&](const Value &msg) -> bool {
+    const Value *type = msg.dict_get("type");
+    if (type == nullptr || !type->is_str()) return false;
+    if (type->as_str() == "native_actor_release") {
+      const Value *inst = msg.dict_get("instance");
+      if (inst != nullptr) instances_.erase(hex(inst->as_bytes()));
+      return false;
+    }
+    if (type->as_str() != "native_task") return false;
+    Execute(msg);
+    return true;
+  };
+  for (const Value &msg : pending_)   // buffered during registration
+    if (handle(msg) && max_tasks > 0 && ++executed >= max_tasks)
+      return;
+  pending_.clear();
+  for (;;) {
+    std::vector<uint8_t> frame;
+    try {
+      frame = RecvFrame();
+    } catch (const std::exception &) {
+      return;  // node gone: a worker's lifetime is its connection's
+    }
+    Value msg;
+    try {
+      msg = pickle_loads(frame.data(), frame.size());
+    } catch (const PickleError &) {
+      continue;  // non-plain push (log batch etc.): not for us
+    }
+    if (msg.v.index() != 8) continue;
+    if (handle(msg) && max_tasks > 0 && ++executed >= max_tasks)
+      return;
+  }
+}
+
+}  // namespace ray_tpu

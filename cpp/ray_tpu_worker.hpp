@@ -1,0 +1,78 @@
+// ray_tpu C++ WORKER API: register native functions/actors and execute
+// tasks submitted from Python (reference: the worker side of the C++
+// API, cpp/src/ray/runtime/task/task_executor.cc — native processes
+// aren't just drivers).
+//
+// Values cross the boundary as the plain-value subset (the same
+// contract as the reference's msgpack cross-language layer): None,
+// bool, int, float, str, bytes, list, dict.  State for native actors
+// lives in this process; one connection processes its frames in
+// order, so actor-method ordering matches Python actor semantics.
+//
+// Usage:
+//   ray_tpu::Worker w(host, port);
+//   w.RegisterFunction("vec_sum", [](const ray_tpu::ValueList &args) {
+//     ...; return ray_tpu::Value::integer(total); });
+//   w.RegisterActorClass("Counter", [](const ray_tpu::ValueList &args) {
+//     return std::make_shared<MyCounter>(args); });
+//   w.Run();   // announce + serve until the node goes away
+//
+// Python side (ray_tpu.util.native):
+//   add = native.cpp_function("vec_sum"); ray_tpu.get(add.remote([1,2]))
+//   h = native.cpp_actor("Counter").remote(10); h.add.remote(5)
+
+#pragma once
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "ray_tpu_client.hpp"
+
+namespace ray_tpu {
+
+using NativeFn = std::function<Value(const ValueList &)>;
+
+class NativeActor {
+ public:
+  virtual ~NativeActor() = default;
+  virtual Value Call(const std::string &method,
+                     const ValueList &args) = 0;
+};
+
+using ActorFactory =
+    std::function<std::shared_ptr<NativeActor>(const ValueList &)>;
+
+class Worker {
+ public:
+  Worker(const std::string &host, int port);
+  ~Worker();
+
+  void RegisterFunction(const std::string &name, NativeFn fn);
+  void RegisterActorClass(const std::string &name, ActorFactory f);
+
+  // Announce the registered names to the node (idempotent; Run calls
+  // it if needed).  After it returns, Python submits will route here.
+  void Announce();
+  // Serve tasks until the connection closes (node shutdown) or
+  // `max_tasks` tasks have been executed (max_tasks <= 0: forever).
+  void Run(int max_tasks = 0);
+
+ private:
+  Value Call(Value msg);
+  void SendFrame(const std::vector<uint8_t> &payload);
+  std::vector<uint8_t> RecvFrame();
+  void Execute(const Value &task);
+
+  int fd_ = -1;
+  int64_t next_req_ = 0;
+  std::map<std::string, NativeFn> fns_;
+  std::map<std::string, ActorFactory> factories_;
+  std::map<std::string, std::shared_ptr<NativeActor>> instances_;
+  // Tasks that raced the registration reply; drained by Run().
+  std::vector<Value> pending_;
+  bool announced_ = false;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,97 @@
+// Demo/test binary for the C++ worker API: registers a native
+// function and a stateful native actor, then serves tasks.
+// Driven end-to-end by tests/test_cpp_worker.py.
+
+#include <cstdio>
+#include <cstdlib>
+#include <numeric>
+
+#include "ray_tpu_worker.hpp"
+
+using ray_tpu::NativeActor;
+using ray_tpu::Value;
+using ray_tpu::ValueList;
+
+namespace {
+
+// Sum a list of ints/floats plus an optional scalar bias.
+Value VecSum(const ValueList &args) {
+  double total = 0;
+  bool all_int = true;
+  if (!args.empty()) {
+    for (const Value &v : args[0].as_list()) {
+      if (v.v.index() == 2) {
+        total += static_cast<double>(v.as_int());
+      } else {
+        total += v.as_float();
+        all_int = false;
+      }
+    }
+  }
+  if (args.size() > 1) {
+    if (args[1].v.index() == 2) {
+      total += static_cast<double>(args[1].as_int());
+    } else {
+      total += args[1].as_float();
+      all_int = false;
+    }
+  }
+  if (all_int) return Value::integer(static_cast<int64_t>(total));
+  return Value::real(total);
+}
+
+Value Describe(const ValueList &args) {
+  const std::string &name = args[0].as_str();
+  return Value::dict({
+      {Value::str("greeting"), Value::str("hello " + name)},
+      {Value::str("lang"), Value::str("cpp")},
+      {Value::str("args_seen"),
+       Value::integer(static_cast<int64_t>(args.size()))},
+  });
+}
+
+class Counter : public NativeActor {
+ public:
+  explicit Counter(int64_t start) : total_(start) {}
+
+  Value Call(const std::string &method,
+             const ValueList &args) override {
+    if (method == "add") {
+      total_ += args[0].as_int();
+      return Value::integer(total_);
+    }
+    if (method == "total") return Value::integer(total_);
+    throw std::runtime_error("Counter has no method: " + method);
+  }
+
+ private:
+  int64_t total_;
+};
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <host> <port> [max_tasks]\n",
+                 argv[0]);
+    return 2;
+  }
+  int max_tasks = argc > 3 ? std::atoi(argv[3]) : 0;
+  try {
+    ray_tpu::Worker w(argv[1], std::atoi(argv[2]));
+    w.RegisterFunction("vec_sum", VecSum);
+    w.RegisterFunction("describe", Describe);
+    w.RegisterActorClass("Counter", [](const ValueList &args) {
+      int64_t start = args.empty() ? 0 : args[0].as_int();
+      return std::make_shared<Counter>(start);
+    });
+    w.Announce();
+    std::printf("CPP-WORKER-READY\n");
+    std::fflush(stdout);
+    w.Run(max_tasks);
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "worker failed: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

@@ -147,7 +147,7 @@ class NodeAgentMixin:
         node that owns the file (reference: log proxying through the
         per-node agent, dashboard/modules/log/)."""
         name = os.path.basename(m["file"])       # no path escapes
-        lines = min(int(m.get("lines", 100)), 10_000)
+        lines = max(1, min(int(m.get("lines", 100)), 10_000))
         path = os.path.join(self._log_dir, name)
         try:
             size = os.path.getsize(path)

@@ -1,0 +1,223 @@
+"""Native (C++) worker-side execution: registration + task routing.
+
+Reference analog: the C++ worker API (reference cpp/src/ray/runtime/
+task/task_executor.cc — native processes REGISTER functions/actors and
+EXECUTE tasks, they aren't just drivers).  TPU-first scope: the
+compute path is JAX, so native workers exist for the runtime around it
+(feature extractors, protocol bridges, legacy C++ services) and speak
+the cross-language plain-value contract (ints/floats/bools/str/bytes/
+lists/dicts — the same boundary as the reference's msgpack
+cross-language layer).
+
+Flow:
+  1. a C++ process (cpp/ray_tpu_worker.hpp) connects to the node's
+     control port and sends `register_native_worker` with the function
+     and actor-class names it serves;
+  2. Python calls route through `submit_native` (util/native.py
+     proxies): the node allocates the return object, pushes a
+     `native_task` frame to the owning worker connection, and replies
+     with the return id immediately (async, like any task submit);
+  3. the worker executes and sends `native_done`; the node registers
+     the (plain) result — failures and worker death surface as typed
+     errors on the return object, exactly like Python task failures.
+
+Native actors: `actor_create` instantiates a registered class in the
+worker process (state lives there); `actor_method` routes by instance
+id.  One connection processes its frames in order, so native-actor
+method ordering matches Python actor semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.node_state import (FAILED, ObjectEntry,
+                                         _ConnCtx)
+
+_PLAIN = (type(None), bool, int, float, str, bytes, bytearray)
+
+
+def _check_plain(v, depth: int = 0):
+    if depth > 16:
+        raise ValueError("cross-language value nests too deep")
+    if isinstance(v, _PLAIN):
+        return
+    if isinstance(v, (list, tuple)):
+        for x in v:
+            _check_plain(x, depth + 1)
+        return
+    if isinstance(v, dict):
+        for k, x in v.items():
+            _check_plain(k, depth + 1)
+            _check_plain(x, depth + 1)
+        return
+    raise ValueError(
+        f"cross-language values must be plain "
+        f"(None/bool/int/float/str/bytes/list/dict); got "
+        f"{type(v).__name__}")
+
+
+class NativeWorkerMixin:
+    """Mixed into NodeService."""
+
+    def _native_init(self) -> None:
+        # name -> ctx for functions; class name -> ctx; instance -> ctx
+        self._native_fns: Dict[str, _ConnCtx] = {}
+        self._native_actor_classes: Dict[str, _ConnCtx] = {}
+        self._native_instances: Dict[bytes, _ConnCtx] = {}
+        # task_id -> (return oid, ctx that submitted)
+        self._native_pending: Dict[bytes, bytes] = {}
+        self._native_seq = 0
+
+    # -- worker registration ----------------------------------------------
+    def _h_register_native_worker(self, ctx: _ConnCtx, m: dict) -> None:
+        fns = [str(n) for n in (m.get("functions") or [])]
+        classes = [str(n) for n in (m.get("actors") or [])]
+        with self.lock:
+            taken = [n for n in fns if n in self._native_fns] + \
+                    [n for n in classes
+                     if n in self._native_actor_classes]
+            if taken:
+                ctx.reply(m, {"__error__": ValueError(
+                    f"native names already registered: {taken}")})
+                return
+            ctx.kind = "native_worker"
+            for n in fns:
+                self._native_fns[n] = ctx
+            for n in classes:
+                self._native_actor_classes[n] = ctx
+        ctx.reply(m, {"ok": True, "node_id": self.node_id})
+
+    def _native_on_disconnect(self, ctx: _ConnCtx) -> None:
+        """Fail everything the dead worker owed; free its names."""
+        if ctx.kind != "native_worker":
+            return
+        dead: List[Tuple[bytes, bytes]] = []
+        with self.lock:
+            self._native_fns = {n: c for n, c in
+                                self._native_fns.items() if c is not ctx}
+            self._native_actor_classes = {
+                n: c for n, c in self._native_actor_classes.items()
+                if c is not ctx}
+            self._native_instances = {
+                i: c for i, c in self._native_instances.items()
+                if c is not ctx}
+            for tid, (oid, owner, _inst) in list(
+                    self._native_pending.items()):
+                if owner is ctx:
+                    dead.append((tid, oid))
+                    del self._native_pending[tid]
+        err = exc.WorkerCrashedError("native worker connection lost")
+        blob = ser.dumps(err)
+        with self.lock:
+            for _, oid in dead:
+                self._register_object(oid, "error", blob, len(blob),
+                                      state=FAILED)
+
+    # -- submission (python/driver side) ----------------------------------
+    def _h_submit_native(self, ctx: _ConnCtx, m: dict) -> None:
+        kind = m.get("kind", "fn")
+        name = m.get("name", "")
+        args = m.get("args") or []
+        with self.lock:
+            if kind == "fn":
+                target = self._native_fns.get(name)
+            elif kind == "actor_create":
+                target = self._native_actor_classes.get(name)
+            elif kind == "actor_method":
+                inst = m.get("instance")
+                target = self._native_instances.get(inst)
+                if target is None:
+                    # Constructor still in flight: route to its owner —
+                    # in-order connection delivery runs the create
+                    # before this method in the worker (Python actor
+                    # semantics: calls queue behind creation).
+                    for _oid, owner, pinst in \
+                            self._native_pending.values():
+                        if pinst is not None and pinst == inst:
+                            target = owner
+                            break
+            else:
+                target = None
+            if target is None:
+                ctx.reply(m, {"__error__": ValueError(
+                    f"no native {kind} registered for "
+                    f"{name or m.get('instance', b'').hex()!r}")})
+                return
+            self._native_seq += 1
+            tid = os.urandom(12) + self._native_seq.to_bytes(4, "big")
+            oid = os.urandom(16)
+            e = self.objects.setdefault(oid, ObjectEntry())
+            e.refcount = max(e.refcount, 1)
+            instance = None
+            if kind == "actor_create":
+                # The instance routes only once the constructor
+                # SUCCEEDS (native_done without error) — a failed
+                # factory must not leave a permanently-routed entry.
+                instance = os.urandom(16)
+            self._native_pending[tid] = (oid, target, instance)
+        push = {"type": "native_task", "task_id": tid, "kind": kind,
+                "name": name, "args": args}
+        if kind == "actor_create":
+            push["instance"] = instance
+        elif kind == "actor_method":
+            push["instance"] = m["instance"]
+            push["method"] = m.get("method", "")
+        target.send(push)
+        reply = {"return_id": oid}
+        if instance is not None:
+            reply["instance"] = instance
+        ctx.reply(m, reply)
+
+    # -- completion (native worker side) ----------------------------------
+    def _h_native_done(self, ctx: _ConnCtx, m: dict) -> None:
+        tid = m["task_id"]
+        with self.lock:
+            entry = self._native_pending.pop(tid, None)
+        if entry is None:
+            return                       # duplicate/late reply
+        oid, owner, instance = entry
+        if m.get("error"):
+            err = RuntimeError(f"native task failed: {m['error']}")
+            blob = ser.dumps(err)
+            with self.lock:
+                self._register_object(oid, "error", blob, len(blob),
+                                      state=FAILED)
+            return
+        try:
+            value = m.get("value")
+            _check_plain(value)
+            blob = ser.dumps(value)
+            with self.lock:
+                if instance is not None:     # constructor succeeded
+                    self._native_instances[instance] = owner
+                self._register_object(oid, "inline", blob, len(blob))
+        except Exception as e:           # unserializable/deep value
+            blob = ser.dumps(RuntimeError(
+                f"native result rejected: {e}"))
+            with self.lock:
+                self._register_object(oid, "error", blob, len(blob),
+                                      state=FAILED)
+
+    def _h_kill_native_actor(self, ctx: _ConnCtx, m: dict) -> None:
+        """Release a native actor instance: unroute it and tell the
+        worker to drop its state (no kill/GC would grow both maps
+        unboundedly on long-lived workers)."""
+        instance = m.get("instance")
+        with self.lock:
+            target = self._native_instances.pop(instance, None)
+        if target is not None:
+            target.send({"type": "native_actor_release",
+                         "instance": instance})
+        ctx.reply(m, {"ok": target is not None})
+
+    def _h_list_native(self, ctx: _ConnCtx, m: dict) -> None:
+        with self.lock:
+            ctx.reply(m, {
+                "functions": sorted(self._native_fns),
+                "actors": sorted(self._native_actor_classes),
+                "instances": len(self._native_instances)})

@@ -31,6 +31,7 @@ from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import config
 from ray_tpu._private.gcs import GlobalControlState
 from ray_tpu._private.node_agent import NodeAgentMixin
+from ray_tpu._private.node_native import NativeWorkerMixin
 from ray_tpu._private.node_objects import ObjectPlaneMixin
 from ray_tpu._private.node_pg import PlacementGroupMixin
 from ray_tpu._private.node_streams import StreamChannelMixin
@@ -42,7 +43,8 @@ from ray_tpu._private.node_state import (  # noqa: F401
     _place_bundles, _uncharge, _unregister_waiter)
 
 class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
-                  StreamChannelMixin, NodeAgentMixin):
+                  StreamChannelMixin, NodeAgentMixin,
+                  NativeWorkerMixin):
     """Per-node daemon: scheduler, worker pool, object directory.
 
     Single-node: runs inside the driver process (threads) with an
@@ -174,6 +176,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         if config.object_store_prefault:
             self._prefault_store()
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._native_init()     # C++ worker registry (node_native) —
+                                # before any conn can register
         self._listener.bind(self.socket_path)
         self._listener.listen(128)
         self._accept_thread = threading.Thread(
@@ -344,6 +348,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 ctx.reply(msg, {"__error__": e})
 
     def _on_disconnect(self, ctx: _ConnCtx) -> None:
+        self._native_on_disconnect(ctx)
         with self.lock:
             if ctx in self._conns:
                 self._conns.remove(ctx)

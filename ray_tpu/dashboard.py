@@ -144,7 +144,16 @@ def _node_rpc(node_id_hex: str, msg: dict) -> dict:
                            deadline_s=5.0)
         conn = Connection(sock)
         with _node_conns_lock:
-            _node_conns[node_id_hex] = conn
+            existing = _node_conns.get(node_id_hex)
+            if existing is not None and not existing._closed:
+                # Lost the dial race: use the winner, close ours.
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                conn = existing
+            else:
+                _node_conns[node_id_hex] = conn
     try:
         return conn.call(msg, timeout=15.0)
     except Exception:

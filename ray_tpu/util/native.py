@@ -1,0 +1,104 @@
+"""Python-side proxies for NATIVE (C++) worker functions and actors.
+
+Reference analog: calling C++ tasks/actors from Python
+(python/ray/cross_language.py `ray.cross_language.cpp_function` /
+`cpp_actor_class`).  The C++ side registers names via
+cpp/ray_tpu_worker.hpp; these proxies submit against those names with
+plain-value args and return ordinary ObjectRefs — `ray_tpu.get`
+works unchanged, and native failures surface as typed errors.
+
+    from ray_tpu.util import native
+    add = native.cpp_function("vec_add")
+    ref = add.remote([1, 2], [3, 4])            # -> ObjectRef
+    counter = native.cpp_actor("Counter").remote(10)
+    counter.add.remote(5)
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import ray_tpu
+from ray_tpu._private.node_native import _check_plain
+from ray_tpu.object_ref import ObjectRef
+
+
+def _submit(payload: dict) -> dict:
+    client = ray_tpu._ensure_connected()
+    for a in payload.get("args", ()):
+        _check_plain(a)
+    return client.conn.call(payload, timeout=30.0)
+
+
+def list_native() -> dict:
+    """Registered native functions/actor classes on this node."""
+    client = ray_tpu._ensure_connected()
+    return client.conn.call({"type": "list_native"}, timeout=15.0)
+
+
+class NativeFunction:
+    def __init__(self, name: str) -> None:
+        self._name = name
+
+    def remote(self, *args: Any) -> ObjectRef:
+        reply = _submit({"type": "submit_native", "kind": "fn",
+                         "name": self._name, "args": list(args)})
+        return ObjectRef(reply["return_id"], owned=True)
+
+
+def cpp_function(name: str) -> NativeFunction:
+    return NativeFunction(name)
+
+
+class _NativeMethod:
+    def __init__(self, handle: "NativeActorHandle",
+                 method: str) -> None:
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args: Any) -> ObjectRef:
+        reply = _submit({"type": "submit_native",
+                         "kind": "actor_method",
+                         "instance": self._handle._instance,
+                         "method": self._method,
+                         "args": list(args)})
+        return ObjectRef(reply["return_id"], owned=True)
+
+
+class NativeActorHandle:
+    def __init__(self, instance: bytes, create_ref: ObjectRef) -> None:
+        self._instance = instance
+        # The constructor's return object: get() it to surface init
+        # errors (mirrors Python actor creation semantics).
+        self.ready_ref = create_ref
+
+    def kill(self) -> bool:
+        """Release the instance's state in the worker (the native
+        analog of ray_tpu.kill on an actor handle)."""
+        client = ray_tpu._ensure_connected()
+        return client.conn.call(
+            {"type": "kill_native_actor", "instance": self._instance},
+            timeout=15.0)["ok"]
+
+    def __getattr__(self, name: str) -> _NativeMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _NativeMethod(self, name)
+
+
+class NativeActorClass:
+    def __init__(self, class_name: str) -> None:
+        self._class_name = class_name
+
+    def remote(self, *args: Any) -> NativeActorHandle:
+        reply = _submit({"type": "submit_native",
+                         "kind": "actor_create",
+                         "name": self._class_name,
+                         "args": list(args)})
+        return NativeActorHandle(
+            reply["instance"],
+            ObjectRef(reply["return_id"], owned=True))
+
+
+def cpp_actor(class_name: str) -> NativeActorClass:
+    return NativeActorClass(class_name)
