@@ -53,13 +53,19 @@ def main() -> None:
     if on_tpu and model == "llama-1b":
         # Round-2 judge: gpt2s (d=768) under-stresses the MXU; a ~1B
         # config with real layer shapes (d=2048, GQA, dff=8192) makes
-        # the MFU representative.  Fits 16 GB HBM with bf16 Adam first
-        # moment at seq 2048.
+        # the MFU representative.  The r3 "dots"-policy guess OOMed
+        # (21.5 GB: dots saves every [L,B,S,dff] FFN intermediate =
+        # 8 GB, and AdamW state is 12.4 GB for 1.24B params); fits via
+        # the "names" remat policy (save d_model-sized outputs only)
+        # + Adafactor (factored second moment, T5/PaLM TPU recipe).
         cfg = dataclasses.replace(tfm.PRESETS["llama-1b"],
                                   max_seq=2048, remat=True,
-                                  remat_policy="dots",
+                                  remat_policy="names",
                                   xent_chunk=2048, attn_block_k=1024)
-        batch, seq, steps = 8, 2048, 6
+        # batch 8 peaks at 16.30 GB (> the v5e's HBM) — a 1 GB f32
+        # optimizer-side broadcast temp tips it over; batch 4 runs at
+        # 0.589 MFU (measured r4), already above the gpt2s config.
+        batch, seq, steps = 4, 2048, 6
     elif on_tpu:
         # Measured sweep on v5e (see git history): dots-policy remat (saves
         # matmul + flash outputs incl. lse, recomputes elementwise only)
@@ -82,8 +88,11 @@ def main() -> None:
         cfg = dataclasses.replace(cfg, xent_chunk=c if c > 0 else None)
 
     mesh = make_mesh(MeshSpec(), devices=[dev])
+    opt_kind = "adafactor" if model == "llama-1b" else "adamw"
+    opt_kind = os.environ.get("BENCH_OPT", opt_kind)
     step = CompiledTrainStep(
-        cfg, mesh, optimizer=make_optimizer(total_steps=1000),
+        cfg, mesh, optimizer=make_optimizer(total_steps=1000,
+                                            kind=opt_kind),
         donate_state=True)
     state = step.init_state(seed=0)
     n_params = tfm.num_params(

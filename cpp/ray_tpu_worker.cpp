@@ -121,7 +121,20 @@ Value Worker::Call(Value msg) {
     try {
       reply = pickle_loads(frame.data(), frame.size());
     } catch (const PickleError &) {
-      continue;  // unsolicited/undecodable push during handshake
+      // Undecodable frame: if it carries "__reply_to__" it is a
+      // solicited reply holding a rich Python object — on this plane
+      // that means {"__error__": Exception} (e.g. duplicate function
+      // registration).  Call() is one-request-at-a-time, so that
+      // reply is ours: fail loudly instead of hanging in RecvFrame()
+      // for a reply that already arrived.  Marker-less frames are
+      // unsolicited pushes: skip them.
+      static const std::string marker = "__reply_to__";
+      if (std::search(frame.begin(), frame.end(), marker.begin(),
+                      marker.end()) != frame.end())
+        throw std::runtime_error(
+            "rpc failed with a Python exception (reply not "
+            "plain-value decodable; see node logs)");
+      continue;
     }
     if (reply.v.index() != 8) continue;
     const Value *rid = reply.dict_get("__reply_to__");
@@ -219,10 +232,15 @@ void Worker::Run(int max_tasks) {
     Execute(msg);
     return true;
   };
-  for (const Value &msg : pending_)   // buffered during registration
+  // Buffered during registration.  Consume entries as they execute:
+  // an early max_tasks return must not leave executed tasks in
+  // pending_, or the next Run() would replay their side effects.
+  while (!pending_.empty()) {
+    Value msg = std::move(pending_.front());
+    pending_.erase(pending_.begin());
     if (handle(msg) && max_tasks > 0 && ++executed >= max_tasks)
       return;
-  pending_.clear();
+  }
   for (;;) {
     std::vector<uint8_t> frame;
     try {

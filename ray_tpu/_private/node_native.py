@@ -69,8 +69,10 @@ class NativeWorkerMixin:
         self._native_fns: Dict[str, _ConnCtx] = {}
         self._native_actor_classes: Dict[str, _ConnCtx] = {}
         self._native_instances: Dict[bytes, _ConnCtx] = {}
-        # task_id -> (return oid, ctx that submitted)
-        self._native_pending: Dict[bytes, bytes] = {}
+        # task_id -> (return oid, submitting ctx, actor instance id or
+        # None for plain functions)
+        self._native_pending: Dict[
+            bytes, Tuple[bytes, _ConnCtx, Optional[bytes]]] = {}
         self._native_seq = 0
 
     # -- worker registration ----------------------------------------------

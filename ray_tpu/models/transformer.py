@@ -356,6 +356,7 @@ def _layer_body(cfg: TransformerConfig, mesh, x, p, positions):
     down = jnp.einsum("bsf,fd->bsd", act, p["w_down"].astype(act.dtype))
     if cfg.arch == "gpt2":
         down = down + p["b_down"].astype(down.dtype)
+    down = jax.ad_checkpoint.checkpoint_name(down, "ffn_out")
     x = x + constrain(down, ("batch", "seq", "embed"), mesh=mesh)
     return x, jnp.zeros((), jnp.float32)
 
@@ -363,6 +364,14 @@ def _layer_body(cfg: TransformerConfig, mesh, x, p, positions):
 def _remat_policy(cfg: TransformerConfig):
     if cfg.remat_policy == "nothing":
         return jax.checkpoint_policies.nothing_saveable
+    if cfg.remat_policy == "names":
+        # Save only the d_model-sized per-layer outputs; recompute the
+        # d_ff-sized gate/up/act tensors (and qkv projections) in the
+        # backward pass.  At d_ff=4*d this trades ~+12% step FLOPs for a
+        # ~4x cut in saved-activation HBM vs "dots" — the policy that
+        # lets ~1B-param configs train on a single 16 GB v5e chip.
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse", "ffn_out")
     # "dots": save matmul outputs (qkv/wo/mlp projections — no batch dims
     # in those dot_generals) plus the flash-attention output, so the bwd
     # pass recomputes only cheap elementwise/norm work.

@@ -34,9 +34,19 @@ def make_optimizer(learning_rate: float = 3e-4, warmup_steps: int = 100,
                    total_steps: int = 10_000, weight_decay: float = 0.1,
                    b1: float = 0.9, b2: float = 0.95,
                    grad_clip: float = 1.0,
-                   mu_dtype="bfloat16") -> optax.GradientTransformation:
+                   mu_dtype="bfloat16",
+                   kind: str = "adamw") -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    if kind == "adafactor":
+        # Factored second moment, no first moment: ~4 bytes/param of
+        # optimizer state vs AdamW's 10 (f32 master + bf16 mu + f32 nu).
+        # The T5/PaLM-lineage TPU optimizer — what lets a ~1.2B-param
+        # model train on one 16 GB v5e chip, where AdamW's 12.4 GB of
+        # state alone would blow HBM.  Adafactor does its own
+        # update-magnitude clipping; no global-norm clip in the chain.
+        return optax.adafactor(learning_rate=schedule,
+                               weight_decay_rate=weight_decay or None)
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
         # bf16 first moment: halves mu's HBM traffic+footprint (~5% step
