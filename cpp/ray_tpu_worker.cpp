@@ -127,7 +127,12 @@ Value Worker::Call(Value msg) {
       // registration).  Call() is one-request-at-a-time, so that
       // reply is ours: fail loudly instead of hanging in RecvFrame()
       // for a reply that already arrived.  Marker-less frames are
-      // unsolicited pushes: skip them.
+      // unsolicited pushes: skip them.  (The request id inside an
+      // undecodable frame cannot be checked; a stale abandoned reply
+      // could in principle fail the NEXT call — but an abandoned
+      // reply only exists if a previous Call already threw here, so
+      // the connection is degraded either way and a loud error beats
+      // a silent deadlock.)
       static const std::string marker = "__reply_to__";
       if (std::search(frame.begin(), frame.end(), marker.begin(),
                       marker.end()) != frame.end())

@@ -12,6 +12,8 @@ from ray_tpu.rllib.connectors import (ClipActions, ClipObs,
                                       ConnectorPipeline, FlattenObs,
                                       FrameStack, NormalizeObs,
                                       UnsquashActions)
+from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import (CartPoleEnv, PendulumEnv,
                                PixelCartPoleEnv, VectorEnv)
@@ -26,7 +28,9 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker
 from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
-           "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
+           "IMPALAConfig", "APPO", "APPOConfig",
+           "CQL", "CQLConfig",
+           "SAC", "SACConfig", "BC", "BCConfig",
            "collect_expert_episodes", "log_transitions",
            "RolloutWorker", "CartPoleEnv", "PendulumEnv",
            "PixelCartPoleEnv", "VectorEnv", "Connector",

@@ -1,0 +1,145 @@
+"""APPO: asynchronous PPO — IMPALA's actor-learner architecture with
+PPO's clipped surrogate objective and a target value network.
+
+Reference surface: rllib/algorithms/appo/appo.py (APPOConfig: IMPALA
+subclass adding `clip_param`, `use_kl_loss`, target-network update
+every `target_network_update_freq`) + appo_learner / the torch policy's
+surrogate loss.  The acting side is IDENTICAL to IMPALA here (stale
+policies streaming rollouts through the streaming-generator plane —
+see impala.py); only the learner changes:
+
+  * advantages come from V-trace, but bootstrapped with the TARGET
+    network's values (stability under async staleness);
+  * the policy gradient is PPO's clipped surrogate on the
+    importance ratio current/behavior instead of IMPALA's
+    rho-clipped score-function estimator;
+  * the target network refreshes from the live params every
+    `target_update_freq` learner steps.
+
+TPU-first detail: the target refresh is data-dependent control flow,
+so it lives INSIDE the jitted update as a `jnp.where` on a step
+counter — one compiled XLA program, no host branching.  The
+(opt_state, target_params, step) triple is packed where IMPALA's
+driver keeps its opt_state, so the async driver loop is reused
+verbatim.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+
+
+def make_appo_update(forward, optimizer, gamma: float,
+                     rho_clip: float, c_clip: float,
+                     clip_param: float, vf_coef: float,
+                     ent_coef: float, target_update_freq: int):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, target_params, batch):
+        obs = batch["obs"]                    # [T, N, ...]
+        T = obs.shape[0]
+        all_obs = jnp.concatenate([obs, batch["last_obs"][None]], 0)
+        logits, values = forward(params, all_obs)
+        # Bootstrap values from the TARGET network; learn the live
+        # value head toward the resulting V-trace targets.
+        _, tvalues = forward(target_params, all_obs)
+        logits = logits[:T]
+        logp_all = jax.nn.log_softmax(logits)
+        tgt_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        rho = jnp.exp(tgt_logp - batch["logp"])
+        rho_c = jnp.minimum(rho, rho_clip)
+        cs = jnp.minimum(rho, c_clip)
+        not_done = 1.0 - batch["dones"].astype(jnp.float32)
+        tv, tv_next = tvalues[:-1], tvalues[1:]
+        deltas = rho_c * (batch["rewards"] + gamma * not_done * tv_next
+                          - tv)
+
+        def back(carry, inp):
+            delta, c_t, nd = inp
+            acc = delta + gamma * nd * c_t * carry
+            return acc, acc
+
+        _, adv_v = jax.lax.scan(back, jnp.zeros_like(deltas[0]),
+                                (deltas, cs, not_done), reverse=True)
+        vs = tv + adv_v
+        vs_next = jnp.concatenate([vs[1:], tvalues[-1][None]], 0)
+        pg_adv = rho_c * (batch["rewards"]
+                          + gamma * not_done * vs_next - tv)
+        pg_adv = jax.lax.stop_gradient(
+            (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8))
+        vs = jax.lax.stop_gradient(vs)
+
+        # PPO clipped surrogate on the current/behavior ratio.
+        surr = jnp.minimum(
+            rho * pg_adv,
+            jnp.clip(rho, 1.0 - clip_param, 1.0 + clip_param) * pg_adv)
+        pg_loss = -jnp.mean(surr)
+        v = values[:-1]
+        vf_loss = 0.5 * jnp.mean((v - vs) ** 2)
+        probs = jax.nn.softmax(logits)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+        total = pg_loss + vf_coef * vf_loss - ent_coef * entropy
+        return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy, "mean_rho": jnp.mean(rho)}
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def update(params, wrapped, batch):
+        import optax
+        opt_state, target_params, step = wrapped
+        (l, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, target_params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        step = step + 1
+        refresh = (step % target_update_freq == 0)
+        target_params = jax.tree.map(
+            lambda p, t: jnp.where(refresh, p, t),
+            params, target_params)
+        metrics["loss"] = l
+        return params, (opt_state, target_params, step), metrics
+
+    return update
+
+
+@dataclass
+class APPOConfig(IMPALAConfig):
+    clip_param: float = 0.3
+    target_update_freq: int = 4
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    """IMPALA driver + PPO surrogate learner (see module docstring).
+
+    The async loop, streaming workers, and broadcast cadence are
+    inherited; only the compiled update (and the state packed next to
+    the optimizer state) differ.
+    """
+
+    def __init__(self, config: APPOConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        super().__init__(config)
+        from ray_tpu.rllib.impala import conv_policy_forward
+        from ray_tpu.rllib.ppo import policy_forward
+        forward = (conv_policy_forward if config.network == "conv"
+                   else policy_forward)
+        self._update = make_appo_update(
+            forward, self.optimizer, config.gamma, config.rho_clip,
+            config.c_clip, config.clip_param, config.vf_coef,
+            config.ent_coef, config.target_update_freq)
+        # Pack (opt_state, target_params, step) where the driver keeps
+        # opt_state — train_async stays byte-identical to IMPALA's.
+        self.opt_state = (self.opt_state,
+                          jax.tree.map(jnp.array, self.params),
+                          jnp.zeros((), jnp.int32))

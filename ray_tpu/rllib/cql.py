@@ -1,0 +1,287 @@
+"""CQL: conservative Q-learning — offline RL for continuous actions.
+
+Reference surface: rllib/algorithms/cql/cql.py (CQLConfig: SAC
+subclass adding `min_q_weight`, `bc_iters`, lagrange options) +
+cql_torch_policy's conservative critic loss.  CQL trains entirely from
+a logged dataset (no environment interaction): it is SAC's update with
+one extra critic term that pushes Q DOWN on out-of-distribution
+actions and UP on dataset actions,
+
+    L_cons = E_s[ logsumexp_a Q(s, a) - E_{a~data} Q(s, a) ]
+
+estimated with sampled uniform-random + current-policy actions (the
+CQL(H) estimator).  Without it, offline SAC overestimates unseen
+actions and the policy exploits phantom Q-mass.
+
+TPU-first shape: the whole learner phase — minibatch sampling, twin
+critics with the conservative term, actor, temperature, polyak
+targets — is ONE jitted `lax.scan` over grad steps, same as sac.py;
+the dataset is a device-resident columnar batch loaded once from
+parquet through ray_tpu.data (rllib/offline/dataset_reader.py role).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env import PendulumEnv
+from ray_tpu.rllib.sac import (actor_forward, init_sac, q_value,
+                               sample_action)
+
+
+def make_cql_update_fn(actor_opt, critic_opt, alpha_opt, gamma: float,
+                       tau: float, target_entropy: float,
+                       num_grad_steps: int, batch_size: int,
+                       action_scale: float, min_q_weight: float,
+                       num_cql_actions: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def _conservative_term(qs, actor, batch, key):
+        """logsumexp over sampled actions minus the dataset-action Q
+        (per critic) — the CQL(H) penalty."""
+        B = batch["obs"].shape[0]
+        k_unif, k_pi, k_pi2 = jax.random.split(key, 3)
+        A = batch["actions"].shape[-1]
+        # Uniform proposals with their (constant) log-density, plus
+        # policy proposals at s and s' with theirs — importance
+        # weighting per the CQL(H) estimator.
+        unif = jax.random.uniform(
+            k_unif, (num_cql_actions, B, A),
+            minval=-action_scale, maxval=action_scale)
+        logp_unif = jnp.full((num_cql_actions, B),
+                             -A * jnp.log(2 * action_scale))
+        pi_a, pi_logp = sample_action(
+            actor, jnp.broadcast_to(batch["obs"],
+                                    (num_cql_actions,) +
+                                    batch["obs"].shape),
+            k_pi, action_scale)
+        pi2_a, pi2_logp = sample_action(
+            actor, jnp.broadcast_to(batch["next_obs"],
+                                    (num_cql_actions,) +
+                                    batch["next_obs"].shape),
+            k_pi2, action_scale)
+        cat_a = jnp.concatenate([unif, pi_a, pi2_a], 0)
+        cat_logp = jnp.concatenate(
+            [logp_unif, pi_logp, pi2_logp], 0)
+        obs_rep = jnp.broadcast_to(
+            batch["obs"], (cat_a.shape[0],) + batch["obs"].shape)
+        out = []
+        for name in ("q1", "q2"):
+            qvals = q_value(qs[name], obs_rep, cat_a)   # [K, B]
+            lse = jax.nn.logsumexp(
+                qvals - jax.lax.stop_gradient(cat_logp), axis=0) \
+                - jnp.log(cat_a.shape[0])
+            data_q = q_value(qs[name], batch["obs"], batch["actions"])
+            out.append((lse - data_q).mean())
+        return out[0] + out[1]
+
+    def critic_loss(qs, actor, target_qs, log_alpha, batch, key):
+        k_t, k_c = jax.random.split(key)
+        next_a, next_logp = sample_action(actor, batch["next_obs"],
+                                          k_t, action_scale)
+        tq = jnp.minimum(
+            q_value(target_qs["q1"], batch["next_obs"], next_a),
+            q_value(target_qs["q2"], batch["next_obs"], next_a))
+        alpha = jnp.exp(log_alpha)
+        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * (
+            tq - alpha * next_logp)
+        target = jax.lax.stop_gradient(target)
+        l1 = ((q_value(qs["q1"], batch["obs"], batch["actions"])
+               - target) ** 2).mean()
+        l2 = ((q_value(qs["q2"], batch["obs"], batch["actions"])
+               - target) ** 2).mean()
+        cons = _conservative_term(qs, actor, batch, k_c)
+        return l1 + l2 + min_q_weight * cons, cons
+
+    def actor_loss(actor, qs, log_alpha, batch, key):
+        a, logp = sample_action(actor, batch["obs"], key, action_scale)
+        q = jnp.minimum(q_value(qs["q1"], batch["obs"], a),
+                        q_value(qs["q2"], batch["obs"], a))
+        alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+        return (alpha * logp - q).mean(), logp
+
+    def alpha_loss(log_alpha, logp):
+        return (-jnp.exp(log_alpha)
+                * (jax.lax.stop_gradient(logp)
+                   + target_entropy)).mean()
+
+    @jax.jit
+    def update(state, data, rng):
+        n = data["obs"].shape[0]
+
+        def step(carry, key):
+            (actor, qs, target_qs, log_alpha, a_opt, c_opt,
+             al_opt) = carry
+            k1, k2, k3 = jax.random.split(key, 3)
+            ix = jax.random.randint(k1, (batch_size,), 0, n)
+            batch = {k: v[ix] for k, v in data.items()}
+
+            (closs, cons), cgrad = jax.value_and_grad(
+                critic_loss, has_aux=True)(
+                qs, actor, target_qs, log_alpha, batch, k2)
+            cup, c_opt = critic_opt.update(cgrad, c_opt, qs)
+            qs = optax.apply_updates(qs, cup)
+
+            (aloss, logp), agrad = jax.value_and_grad(
+                actor_loss, has_aux=True)(actor, qs, log_alpha,
+                                          batch, k3)
+            aup, a_opt = actor_opt.update(agrad, a_opt, actor)
+            actor = optax.apply_updates(actor, aup)
+
+            alloss, algrad = jax.value_and_grad(alpha_loss)(
+                log_alpha, logp)
+            alup, al_opt = alpha_opt.update(algrad, al_opt, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, alup)
+
+            target_qs = jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target_qs, qs)
+            return (actor, qs, target_qs, log_alpha, a_opt, c_opt,
+                    al_opt), (closs, aloss, cons)
+
+        keys = jax.random.split(rng, num_grad_steps)
+        state, (closses, alosses, conss) = jax.lax.scan(
+            step, state, keys)
+        return state, closses.mean(), alosses.mean(), conss.mean()
+
+    return update
+
+
+class CQLConfig:
+    def __init__(self) -> None:
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.min_q_weight = 5.0
+        self.num_cql_actions = 4
+        self.num_grad_steps = 256
+        self.batch_size = 256
+        self.hidden = 128
+        self.action_scale = 2.0
+        self.seed = 0
+        self.input_path: Optional[str] = None   # parquet dir
+        self.data: Optional[Dict[str, np.ndarray]] = None
+
+    def offline_data(self, **kw) -> "CQLConfig":
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def training(self, **kw) -> "CQLConfig":
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL:
+    """Offline learner: parquet transitions in, policy out — no env.
+
+    Continuous-action transitions need columns obs / action
+    (list<float>), reward, next_obs, done (the interchange schema of
+    offline.log_transitions extended with next_obs).
+    """
+
+    def __init__(self, config: CQLConfig) -> None:
+        import jax
+        import optax
+
+        self.config = config
+        data = config.data
+        if data is None:
+            if not config.input_path:
+                raise ValueError("CQLConfig needs input_path or data")
+            from ray_tpu import data as rdata
+            tbl = rdata.read_parquet(config.input_path).to_pandas()
+            data = {
+                "obs": np.stack(tbl["obs"].to_numpy()).astype(
+                    np.float32),
+                "actions": np.stack(tbl["action"].to_numpy()).astype(
+                    np.float32),
+                "rewards": tbl["reward"].to_numpy(np.float32),
+                "next_obs": np.stack(
+                    tbl["next_obs"].to_numpy()).astype(np.float32),
+                "dones": tbl["done"].to_numpy(np.float32),
+            }
+        self.data = {k: jax.numpy.asarray(v) for k, v in data.items()}
+        obs_size = int(self.data["obs"].shape[-1])
+        act_size = int(self.data["actions"].shape[-1])
+
+        rng = jax.random.PRNGKey(config.seed)
+        self._rng, init_rng = jax.random.split(rng)
+        params = init_sac(init_rng, obs_size, act_size,
+                          hidden=config.hidden)
+        self.actor = params["actor"]
+        self.qs = {"q1": params["q1"], "q2": params["q2"]}
+        self.target_qs = jax.tree.map(jax.numpy.array, self.qs)
+        self.log_alpha = params["log_alpha"]
+        self._aopt = optax.adam(config.lr)
+        self._copt = optax.adam(config.lr)
+        self._alopt = optax.adam(config.lr)
+        self._state = (self.actor, self.qs, self.target_qs,
+                       self.log_alpha, self._aopt.init(self.actor),
+                       self._copt.init(self.qs),
+                       self._alopt.init(self.log_alpha))
+        self._update = make_cql_update_fn(
+            self._aopt, self._copt, self._alopt, config.gamma,
+            config.tau, target_entropy=-float(act_size),
+            num_grad_steps=config.num_grad_steps,
+            batch_size=config.batch_size,
+            action_scale=config.action_scale,
+            min_q_weight=config.min_q_weight,
+            num_cql_actions=config.num_cql_actions)
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.time()
+        self._rng, key = jax.random.split(self._rng)
+        self._state, closs, aloss, cons = self._update(
+            self._state, self.data, key)
+        self.actor = self._state[0]
+        self.qs = self._state[1]
+        self.iteration += 1
+        return {
+            "iteration": self.iteration,
+            "critic_loss": float(closs),
+            "actor_loss": float(aloss),
+            "conservative_gap": float(cons),
+            "alpha": float(jax.numpy.exp(self._state[3])),
+            "grad_steps": self.config.num_grad_steps,
+            "wall_s": round(time.time() - t0, 2),
+        }
+
+    def compute_action(self, obs: np.ndarray) -> np.ndarray:
+        """Deterministic (tanh of the mean) action for eval."""
+        import jax.numpy as jnp
+        mu, _ = actor_forward(self.actor, jnp.asarray(obs))
+        return np.asarray(jnp.tanh(mu) * self.config.action_scale)
+
+    def mean_q(self, obs: np.ndarray, actions: np.ndarray) -> float:
+        import jax.numpy as jnp
+        return float(jnp.minimum(
+            q_value(self.qs["q1"], jnp.asarray(obs),
+                    jnp.asarray(actions)),
+            q_value(self.qs["q2"], jnp.asarray(obs),
+                    jnp.asarray(actions))).mean())
+
+    def evaluate(self, env_maker: Optional[Callable] = None,
+                 num_episodes: int = 3, seed: int = 77
+                 ) -> Dict[str, float]:
+        maker = env_maker or (lambda s: PendulumEnv(seed=s))
+        rets = []
+        for ep in range(num_episodes):
+            env = maker(seed + ep)
+            o, done, total = env.reset(), False, 0.0
+            while not done:
+                o, r, done, _ = env.step(self.compute_action(o))
+                total += r
+            rets.append(total)
+        return {"evaluation_reward_mean": float(np.mean(rets))}

@@ -123,6 +123,13 @@ class ContinuousBatcher:
         self._shutdown = False
         self._work = threading.Event()
         self.steps = 0
+        # Device-resident active-mask cache: uploading the [B] bool mask
+        # on EVERY decode dispatch costs a host->device transaction that
+        # serializes with result reads on a tunneled chip (~tens of ms).
+        # In steady state the mask rarely changes (drained-readmission
+        # keeps slots full), so key the device array by the mask bytes.
+        self._active_key: Optional[bytes] = None
+        self._active_dev = None
         # Dispatcher/processor split: dispatch SUBMISSION itself costs
         # tens of ms through a tunneled chip, so it must not serialize
         # with result processing.  _state_lock guards _owner/_disp_len
@@ -337,13 +344,17 @@ class ContinuousBatcher:
             pairs = live + [(slot, req) for _, slot, req in admitted]
             entry = ("fused", (first, dtoks), (admitted, pairs))
         else:
+            key = active.tobytes()
+            if key != self._active_key:
+                self._active_key = key
+                self._active_dev = jnp.asarray(active)
             if chunk > 1:
                 self.caches, dtoks = self._dec.decode_steps(
-                    self.params, self.caches, jnp.asarray(active),
+                    self.params, self.caches, self._active_dev,
                     self.cfg, chunk)
             else:
                 self.caches, tok = self._dec.decode_step(
-                    self.params, self.caches, jnp.asarray(active),
+                    self.params, self.caches, self._active_dev,
                     self.cfg)
                 dtoks = tok[None]
             entry = ("decode", (dtoks,), (None, live))

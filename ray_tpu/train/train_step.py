@@ -45,8 +45,12 @@ def make_optimizer(learning_rate: float = 3e-4, warmup_steps: int = 100,
         # model train on one 16 GB v5e chip, where AdamW's 12.4 GB of
         # state alone would blow HBM.  Adafactor does its own
         # update-magnitude clipping; no global-norm clip in the chain.
-        return optax.adafactor(learning_rate=schedule,
-                               weight_decay_rate=weight_decay or None)
+        # NOTE: no weight decay here.  optax.adafactor applies
+        # `weight_decay_rate` per step WITHOUT lr-scaling (a flat
+        # multiplicative shrink), so the AdamW-style 0.1 would shrink
+        # every weight 10%/step and destroy training; the classic
+        # T5-lineage Adafactor recipe runs without decoupled decay.
+        return optax.adafactor(learning_rate=schedule)
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
         # bf16 first moment: halves mu's HBM traffic+footprint (~5% step

@@ -21,6 +21,11 @@ if "--xla_force_host_platform_device_count" not in os.environ.get(
 # already be imported (TPU plugin sitecustomize hooks), so the env var
 # alone is too late — update the live config too.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Drop the tunnel pool entirely: axon's get_backend hook initializes its
+# remote client even under jax_platforms=cpu, and a wedged tunnel then
+# hangs the whole CPU suite at the first backend touch (observed: PRNGKey
+# blocked in make_pjrt_c_api_client while the chip was unreachable).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 import jax  # noqa: E402
 
 try:
