@@ -10,7 +10,6 @@ layers in the subpackages.
 from __future__ import annotations
 
 import atexit
-import glob
 import os
 import time
 import threading
@@ -41,32 +40,10 @@ class _Session:
 
 
 def _detect_tpu_chips() -> int:
-    """TPU chip count via device files (reference:
-    _private/accelerators/tpu.py:107-117 reads /dev/accel* and vfio)."""
-    env = os.environ.get("RAY_TPU_NUM_TPUS")
-    if env is not None:
-        return int(env)
-    chips = len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/*"))
-    if chips:
-        return chips
-    # Fall back to asking jax — but ONLY if a backend is already
-    # initialized in this process.  Merely-imported jax (axon's
-    # sitecustomize imports it in every interpreter) must not be probed:
-    # jax.devices() would *initialize* the tunneled TPU backend here in
-    # the driver — seconds of startup, and a deadlock when another
-    # process holds the tunnel.
-    import sys
-    jax = sys.modules.get("jax")
-    if jax is not None:
-        try:
-            from jax._src import xla_bridge as xb
-            if not xb.backends_are_initialized():
-                return 0
-            return len([d for d in jax.devices()
-                        if d.platform not in ("cpu",)])
-        except Exception:
-            return 0
-    return 0
+    """TPU chip count (delegates to the accelerator manager,
+    _private/accelerators.py — reference: accelerators/tpu.py:107)."""
+    from ray_tpu._private.accelerators import detect_num_chips
+    return detect_num_chips()
 
 
 def init(num_cpus: Optional[float] = None,
@@ -121,16 +98,13 @@ def init(num_cpus: Optional[float] = None,
         tpus = float(num_tpus if num_tpus is not None
                      else _detect_tpu_chips())
         if tpus:
+            # Typed slice resources + the worker-0 gang marker
+            # (reference: accelerators/tpu.py:360-362 "TPU-{type}-head"
+            # — exactly one placement group head bundle per slice).
+            from ray_tpu._private.accelerators import tpu_resources
+            for k, v in tpu_resources(int(tpus)).items():
+                res.setdefault(k, v)
             res["TPU"] = tpus
-            # Slice-head marker for gang scheduling whole TPU slices
-            # (reference: accelerators/tpu.py:360-362 "TPU-{type}-head"):
-            # worker 0 of a slice advertises it so exactly one placement
-            # group head bundle lands per slice.
-            acc_type = (os.environ.get("TPU_ACCELERATOR_TYPE")
-                        or os.environ.get("RAY_TPU_ACCELERATOR_TYPE"))
-            worker_id = os.environ.get("TPU_WORKER_ID", "0")
-            if acc_type and worker_id == "0":
-                res.setdefault(f"TPU-{acc_type}-head", 1.0)
         store_capacity = object_store_memory or config.object_store_memory
         store_path = os.path.join("/dev/shm", f"rtpu_{os.getpid()}_"
                                   f"{int(time.time()*1000) % 100000}")

@@ -1,0 +1,104 @@
+"""TPU accelerator management: chip detection, typed slice resources,
+and per-worker chip visibility.
+
+Reference surface: python/ray/_private/accelerators/tpu.py —
+`TPUAcceleratorManager` detects chips via /dev/accel* device files and
+GCE metadata (tpu.py:107-117), advertises the pod-slice gang resource
+`TPU-{type}-head` on worker 0 (tpu.py:360-362), and pins workers to
+their allocation by exporting `TPU_VISIBLE_CHIPS`.
+
+This build keeps the same three capabilities but node-native: the node
+service owns a chip-id pool sized by the node's TPU resource; each TPU
+worker process leases chips at spawn and the pool is repaid when the
+worker dies.  Detection never initializes a jax backend (merely-imported
+jax is probed via xla_bridge state only) — touching the tunneled TPU
+from the driver would serialize seconds of startup into `init()` and
+deadlock when another process holds the tunnel.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from typing import Dict, List, Optional
+
+
+def detect_num_chips() -> int:
+    """Chip count: env override, then device files, then an
+    already-initialized jax backend."""
+    env = os.environ.get("RAY_TPU_NUM_TPUS")
+    if env is not None:
+        return int(env)
+    chips = len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/*"))
+    if chips:
+        return chips
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            from jax._src import xla_bridge as xb
+            if xb.backends_are_initialized():
+                return sum(1 for d in jax.devices()
+                           if d.platform != "cpu")
+        except Exception:
+            pass
+    return 0
+
+
+def detect_accelerator_type() -> Optional[str]:
+    """Slice type, e.g. "v5litepod-8" (reference: GCE instance metadata;
+    here the standard TPU VM env vars)."""
+    return (os.environ.get("TPU_ACCELERATOR_TYPE")
+            or os.environ.get("RAY_TPU_ACCELERATOR_TYPE"))
+
+
+def tpu_resources(num_chips: int) -> Dict[str, float]:
+    """The resource dict a TPU host advertises: plain TPU chips, the
+    typed per-chip resource, and — on slice worker 0 — the slice-head
+    gang marker."""
+    if not num_chips:
+        return {}
+    res: Dict[str, float] = {"TPU": float(num_chips)}
+    acc_type = detect_accelerator_type()
+    if acc_type:
+        res[f"TPU-{acc_type}"] = float(num_chips)
+        if os.environ.get("TPU_WORKER_ID", "0") == "0":
+            res[f"TPU-{acc_type}-head"] = 1.0
+    return res
+
+
+class ChipAllocator:
+    """Free-list of local chip ids; TPU workers lease
+    `RAY_TPU_CHIPS_PER_WORKER` (default 1) chips at spawn."""
+
+    def __init__(self, num_chips: int) -> None:
+        self._free: List[int] = list(range(int(num_chips)))
+        self._held: Dict[bytes, List[int]] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, worker_id: bytes,
+                count: Optional[int] = None) -> List[int]:
+        want = count if count is not None else int(
+            os.environ.get("RAY_TPU_CHIPS_PER_WORKER", "1"))
+        with self._lock:
+            take = self._free[:want]
+            self._free = self._free[want:]
+            if take:
+                self._held[worker_id] = take
+            return take
+
+    def release(self, worker_id: bytes) -> None:
+        with self._lock:
+            chips = self._held.pop(worker_id, None)
+            if chips:
+                # Repay in sorted order so reuse is deterministic.
+                self._free = sorted(self._free + chips)
+
+    def visible_env(self, chips: List[int]) -> Dict[str, str]:
+        """Env pinning a worker to its lease (reference:
+        tpu.py set_current_process_visible_accelerator_ids)."""
+        if not chips:
+            return {}
+        ids = ",".join(str(c) for c in chips)
+        return {"TPU_VISIBLE_CHIPS": ids}

@@ -115,6 +115,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.resources_total = dict(resources)
         self.resources_avail = dict(resources)
+        from ray_tpu._private.accelerators import ChipAllocator
+        self._chip_alloc = ChipAllocator(int(resources.get("TPU", 0)))
         self._conns: List[_ConnCtx] = []
         self._conn_threads: List[threading.Thread] = []
         self._pull_threads: List[threading.Thread] = []
@@ -1836,6 +1838,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         reaping a live process (connection lost, SIGTERM still in
         flight) would release pins it is still using.  Caller holds the
         lock."""
+        # Chip leases come back immediately: both death paths funnel
+        # here, and a replacement TPU worker may spawn this tick.
+        self._chip_alloc.release(w.worker_id)
         if not w.pid:
             return
         if w.proc is not None and w.proc.poll() is None:
@@ -2000,6 +2005,13 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._next_worker_seq += 1
         worker_id = os.urandom(16)
         env = dict(os.environ)
+        if tpu:
+            # Lease chip ids so concurrent TPU workers don't fight over
+            # the same device (reference: TPU_VISIBLE_CHIPS pinning,
+            # accelerators/tpu.py).  An empty lease (more workers than
+            # chips) spawns unpinned rather than blocking.
+            chips = self._chip_alloc.acquire(worker_id)
+            env.update(self._chip_alloc.visible_env(chips))
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_NODE_SOCKET"] = self.socket_path
         env["RAY_TPU_STORE_PATH"] = self.store_path

@@ -21,7 +21,8 @@ from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.multi_agent import (MultiAgentCartPole,
                                        MultiAgentEnv, MultiAgentPPO,
                                        MultiAgentPPOConfig)
-from ray_tpu.rllib.offline import (BC, BCConfig,
+from ray_tpu.rllib.offline import (BC, BCConfig, MARWIL,
+                                   MARWILConfig,
                                    collect_expert_episodes,
                                    log_transitions)
 from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker
@@ -29,7 +30,7 @@ from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
            "IMPALAConfig", "APPO", "APPOConfig",
-           "CQL", "CQLConfig",
+           "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
            "SAC", "SACConfig", "BC", "BCConfig",
            "collect_expert_episodes", "log_transitions",
            "RolloutWorker", "CartPoleEnv", "PendulumEnv",

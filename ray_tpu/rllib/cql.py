@@ -65,6 +65,13 @@ def make_cql_update_fn(actor_opt, critic_opt, alpha_opt, gamma: float,
                                     (num_cql_actions,) +
                                     batch["next_obs"].shape),
             k_pi2, action_scale)
+        # sample_action's logp is the density of the UNSCALED tanh
+        # variable; the uniform density above lives in the scaled
+        # action space.  Add the |da/du|=action_scale Jacobian so both
+        # sets of importance weights share one measure.
+        jac = A * jnp.log(action_scale)
+        pi_logp = pi_logp - jac
+        pi2_logp = pi2_logp - jac
         cat_a = jnp.concatenate([unif, pi_a, pi2_a], 0)
         cat_logp = jnp.concatenate(
             [logp_unif, pi_logp, pi2_logp], 0)
@@ -168,13 +175,12 @@ class CQLConfig:
 
     def offline_data(self, **kw) -> "CQLConfig":
         for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown CQL config option {k!r}")
             setattr(self, k, v)
         return self
 
-    def training(self, **kw) -> "CQLConfig":
-        for k, v in kw.items():
-            setattr(self, k, v)
-        return self
+    training = offline_data
 
     def build(self) -> "CQL":
         return CQL(self)

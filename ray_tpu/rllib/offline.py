@@ -100,6 +100,177 @@ def make_bc_update_fn(optimizer, batch_size: int, num_grad_steps: int):
     return update
 
 
+def make_marwil_update_fn(optimizer, batch_size: int,
+                          num_grad_steps: int, beta: float,
+                          vf_coef: float):
+    """MARWIL loss: exponentially advantage-weighted log-likelihood +
+    value regression toward the empirical returns (reference:
+    rllib/algorithms/marwil/marwil.py — beta=0 degenerates to BC)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        logits, v = policy_forward(params, batch["obs"])
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, batch["action"][:, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        adv = batch["returns"] - v
+        # Batch-normalized advantages inside the exp keep the weights
+        # scale-free (the reference maintains a running c^2 moment for
+        # the same purpose); clip the exponent for stability.
+        adv_n = (adv - adv.mean()) / (adv.std() + 1e-6)
+        w = jnp.exp(jnp.clip(beta * jax.lax.stop_gradient(adv_n),
+                             -5.0, 5.0))
+        actor = (w * nll).mean()
+        critic = (adv ** 2).mean()
+        return actor + vf_coef * critic, (actor, critic)
+
+    @jax.jit
+    def update(params, opt_state, data, rng):
+        n = data["obs"].shape[0]
+
+        def step(carry, key):
+            params, opt_state = carry
+            ix = jax.random.randint(key, (batch_size,), 0, n)
+            batch = {k: v[ix] for k, v in data.items()}
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), (loss, *aux)
+
+        keys = jax.random.split(rng, num_grad_steps)
+        (params, opt_state), (losses, actors, critics) = jax.lax.scan(
+            step, (params, opt_state), keys)
+        return (params, opt_state, losses.mean(), actors.mean(),
+                critics.mean())
+
+    return update
+
+
+def compute_returns(rewards: np.ndarray, dones: np.ndarray,
+                    gamma: float) -> np.ndarray:
+    """Per-transition discounted return-to-go within each episode
+    (host-side; logged data is episode-ordered)."""
+    out = np.zeros_like(rewards, np.float32)
+    acc = 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        if dones[i]:
+            acc = 0.0
+        acc = rewards[i] + gamma * acc
+        out[i] = acc
+    return out
+
+
+class MARWILConfig:
+    def __init__(self) -> None:
+        self.input_path: Optional[str] = None
+        self.data: Optional[Dict[str, np.ndarray]] = None
+        self.obs_size = CartPoleEnv.observation_size
+        self.num_actions = CartPoleEnv.num_actions
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.beta = 1.0            # 0.0 => plain BC
+        self.vf_coef = 1.0
+        self.batch_size = 256
+        self.num_grad_steps = 256
+        self.hidden = 64
+        self.seed = 0
+
+    def offline_data(self, **kw) -> "MARWILConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown MARWIL option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    training = offline_data
+    environment = offline_data
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+class MARWIL:
+    """Monotonic advantage re-weighted imitation learning from logged
+    transitions — imitates GOOD actions more than bad ones, so it
+    beats BC on mixed-quality data (reference:
+    rllib/algorithms/marwil)."""
+
+    def __init__(self, config: MARWILConfig) -> None:
+        import jax
+        import optax
+
+        self.config = config
+        data = config.data
+        if data is None:
+            if not config.input_path:
+                raise ValueError("MARWILConfig needs input_path or "
+                                 "data")
+            from ray_tpu import data as rdata
+            tbl = rdata.read_parquet(config.input_path).to_pandas()
+            data = {
+                "obs": np.stack(tbl["obs"].to_numpy()).astype(
+                    np.float32),
+                "action": tbl["action"].to_numpy(),
+                "reward": tbl["reward"].to_numpy(np.float32),
+                "done": tbl["done"].to_numpy(np.float32),
+            }
+        returns = compute_returns(
+            np.asarray(data["reward"], np.float32),
+            np.asarray(data["done"]).astype(bool), config.gamma)
+        import jax.numpy as jnp
+        self.data = {"obs": jnp.asarray(data["obs"], jnp.float32),
+                     "action": jnp.asarray(data["action"]),
+                     "returns": jnp.asarray(returns)}
+        rng = jax.random.PRNGKey(config.seed)
+        self._rng, init_rng = jax.random.split(rng)
+        self.params = init_policy(init_rng, config.obs_size,
+                                  config.num_actions,
+                                  hidden=config.hidden)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_marwil_update_fn(
+            self.optimizer, config.batch_size, config.num_grad_steps,
+            config.beta, config.vf_coef)
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.time()
+        self._rng, key = jax.random.split(self._rng)
+        (self.params, self.opt_state, loss, actor,
+         critic) = self._update(self.params, self.opt_state,
+                                self.data, key)
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "loss": float(loss), "actor_loss": float(actor),
+                "critic_loss": float(critic),
+                "time_this_iter_s": round(time.time() - t0, 2)}
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        import jax.numpy as jnp
+        logits, _ = policy_forward(self.params,
+                                   jnp.asarray(obs, jnp.float32))
+        return int(np.argmax(np.asarray(logits)))
+
+    def evaluate(self, env_maker: Optional[Callable] = None,
+                 num_episodes: int = 5, seed: int = 100) -> float:
+        maker = env_maker or (lambda s: CartPoleEnv(seed=s))
+        total = 0.0
+        for ep in range(num_episodes):
+            env = maker(seed + ep)
+            o, done = env.reset(), False
+            while not done:
+                o, r, done, _ = env.step(self.compute_action(o))
+                total += r
+        return total / num_episodes
+
+
 class BCConfig:
     def __init__(self) -> None:
         self.input_path: Optional[str] = None

@@ -10,6 +10,7 @@ train/base_trainer.py:693).
 """
 
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     HyperBandScheduler,
                                      MedianStoppingRule, PB2,
                                      PopulationBasedTraining)
 from ray_tpu.tune.search import (BOHBSearcher, TPESearcher, choice,
@@ -19,7 +20,7 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "ASHAScheduler",
-    "PopulationBasedTraining", "PB2", "MedianStoppingRule",
-    "FIFOScheduler", "grid_search", "uniform", "loguniform", "randint",
-    "choice", "TPESearcher", "BOHBSearcher",
+    "HyperBandScheduler", "PopulationBasedTraining", "PB2",
+    "MedianStoppingRule", "FIFOScheduler", "grid_search", "uniform",
+    "loguniform", "randint", "choice", "TPESearcher", "BOHBSearcher",
 ]

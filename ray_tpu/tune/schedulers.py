@@ -339,3 +339,146 @@ class MedianStoppingRule:
         median = others[len(others) // 2]
         best = max(s for _, s in self._history[trial_id])
         return STOP if best < median else CONTINUE
+
+
+PAUSE = "PAUSE"
+
+
+class HyperBandScheduler:
+    """Synchronous HyperBand / successive halving (reference:
+    tune/schedulers/hyperband.py HyperBandScheduler).
+
+    Where ASHA decides from whatever is recorded at a rung so far
+    (asynchronous, never waits), HyperBand SYNCHRONIZES each rung:
+    every member of a bracket pauses at the milestone, and only when
+    the whole bracket has arrived does the top 1/reduction_factor
+    resume — the rest stop.  That needs runner support for pausing
+    (checkpoint, release the slot, resume later), which the Tuner
+    provides via the PAUSE decision + `pop_runnable()` poll.
+
+    Brackets have FIXED capacity rf^depth and fill in registration
+    order; a new bracket opens when the current one is full (the
+    reference's incremental bracket construction).  With
+    `num_brackets > 1` consecutive brackets drop their first rungs,
+    trading early-stopping aggressiveness for protection of slow
+    starters — the HyperBand paper's s-sweep.  `seal()` (called by the
+    runner when no further trials will ever register) closes the last
+    under-full bracket so its rungs release on whoever arrived.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, grace_period: int = 1,
+                 reduction_factor: int = 3,
+                 num_brackets: int = 1) -> None:
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.rf = reduction_factor
+        ladder = []
+        t = grace_period
+        while t < max_t:
+            ladder.append(t)
+            t *= reduction_factor
+        self._ladders = [ladder[b:] or [max_t]
+                         for b in range(max(num_brackets, 1))]
+        # Bracket instances: {"ladder", "cap", "members", "sealed"}
+        self._brackets: List[dict] = []
+        self._bracket_of: Dict[str, int] = {}      # trial -> index
+        self._rung: Dict[tuple, Dict[str, float]] = {}
+        self._released: set = set()                # (bracket_ix, m)
+        self._dead: set = set()
+        self._release: Dict[str, str] = {}         # tid -> verdict
+        self._sealed_all = False
+
+    def _new_bracket(self) -> dict:
+        ladder = self._ladders[len(self._brackets) % len(self._ladders)]
+        br = {"ladder": ladder, "cap": self.rf ** len(ladder),
+              "members": [], "sealed": False}
+        self._brackets.append(br)
+        return br
+
+    def register_trial(self, trial_id: str,
+                       config: Dict[str, Any]) -> None:
+        if trial_id in self._bracket_of:
+            return          # rung resume re-launch, not a new trial
+        br = self._brackets[-1] if self._brackets else None
+        if br is None or len(br["members"]) >= br["cap"]:
+            br = self._new_bracket()
+        br["members"].append(trial_id)
+        self._bracket_of[trial_id] = len(self._brackets) - 1
+
+    def seal(self) -> None:
+        """No further registrations will come: under-full brackets
+        release on whoever arrived."""
+        if self._sealed_all:
+            return
+        self._sealed_all = True
+        for ix, br in enumerate(self._brackets):
+            br["sealed"] = True
+            for m in br["ladder"]:
+                self._maybe_release(ix, m)
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        if self.metric not in result:
+            return CONTINUE
+        ix = self._bracket_of.get(trial_id)
+        if ix is None:
+            return CONTINUE
+        t = int(result.get(self.time_attr, 0))
+        for m in self._brackets[ix]["ladder"]:
+            rung = self._rung.setdefault((ix, m), {})
+            if t >= m and trial_id not in rung:
+                rung[trial_id] = self._score(result)
+                self._maybe_release(ix, m)
+                # Pause at the first newly-reached rung; if this was
+                # the last arriver the verdicts are already queued in
+                # _release and the runner applies them post-pause.
+                return PAUSE
+        return CONTINUE
+
+    def on_trial_remove(self, trial_id: str) -> None:
+        """Trial finished/errored outside scheduler control: bracket
+        peers must not wait for it."""
+        self._dead.add(trial_id)
+        ix = self._bracket_of.get(trial_id)
+        if ix is None:
+            return
+        for m in self._brackets[ix]["ladder"]:
+            self._maybe_release(ix, m)
+
+    def _maybe_release(self, ix: int, m: int) -> None:
+        if (ix, m) in self._released:
+            return
+        br = self._brackets[ix]
+        full = br["sealed"] or len(br["members"]) >= br["cap"]
+        rung = self._rung.get((ix, m), {})
+        live = [tid for tid in br["members"] if tid not in self._dead]
+        if not full or not rung \
+                or any(tid not in rung for tid in live):
+            return
+        self._released.add((ix, m))
+        arrived = [tid for tid in rung if tid not in self._dead]
+        if not arrived:
+            return
+        k = max(len(arrived) // self.rf, 1)
+        ranked = sorted(arrived, key=lambda tid: rung[tid],
+                        reverse=True)
+        for i, tid in enumerate(ranked):
+            keep = i < k
+            self._release[tid] = "RESUME" if keep else "STOP"
+            if not keep:
+                # Stopped members must not hold up higher rungs.
+                self._dead.add(tid)
+
+    def pop_runnable(self) -> Dict[str, str]:
+        """Runner poll: {trial_id: RESUME|STOP} decided since the last
+        call."""
+        out, self._release = self._release, {}
+        return out

@@ -24,7 +24,8 @@ import ray_tpu
 from ray_tpu import exceptions as exc
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train import session as session_mod
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import (CONTINUE, PAUSE, STOP,
+                                     FIFOScheduler)
 from ray_tpu.tune.search import generate_variants
 
 
@@ -233,7 +234,41 @@ class Tuner:
         last_snapshot = 0.0
 
         trials_by_id = {t.trial_id: t for t in trials}
-        while pending or running or remaining_suggestions:
+        paused: Dict[str, TrialResult] = {}
+        pause_epochs: Dict[str, int] = {}     # resume incarnation count
+        stale_ns: Dict[str, List[str]] = {}   # ns of killed incarnations
+        while pending or running or paused or remaining_suggestions:
+            if not pending and not remaining_suggestions \
+                    and hasattr(scheduler, "seal"):
+                # Every trial that will ever exist is registered:
+                # under-full HyperBand brackets may now release.
+                scheduler.seal()
+            # Synchronous schedulers (HyperBand) release paused trials
+            # in batches once a rung fills.
+            if hasattr(scheduler, "pop_runnable"):
+                for tid, verdict in scheduler.pop_runnable().items():
+                    t = paused.pop(tid, None)
+                    if t is None:
+                        continue
+
+                    if verdict == "STOP":
+                        t.status = "EARLY_STOPPED"
+                        for ns in stale_ns.pop(tid, []):
+                            for key in client.kv_keys(ns):
+                                client.kv_del(ns, key)
+                        if searcher is not None and t.metrics:
+                            searcher.record(t.config, t.metrics)
+                    else:
+                        t.status = "PENDING"
+                        pending.insert(0, t)
+                # Liveness valve: if everything sits paused and the
+                # scheduler has nothing to say (e.g. a bracket whose
+                # peers all errored), resume rather than spin forever.
+                if paused and not pending and not running \
+                        and not remaining_suggestions:
+                    for tid, t in list(paused.items()):
+                        t.status = "PENDING"
+                        pending.append(paused.pop(tid))
             while len(running) < tc.max_concurrent_trials:
                 if pending:
                     t = pending.pop(0)
@@ -249,7 +284,14 @@ class Tuner:
                 else:
                     break
                 os.makedirs(t.path, exist_ok=True)
-                ns = f"tune_reports/{exp_dir}/{t.trial_id}"
+                # Pause-resumed incarnations get a fresh namespace (a
+                # report the dying actor landed after our drain must
+                # not be consumed as if from the new run — same race
+                # _exploit_restart rotates ns for) and continue the
+                # iteration count from recorded history.
+                p_epoch = pause_epochs.get(t.trial_id, 0)
+                ns = f"tune_reports/{exp_dir}/{t.trial_id}" + (
+                    f"/p{p_epoch}" if p_epoch else "")
                 resume = (t.checkpoint.path
                           if t.checkpoint is not None else None)
                 actor = _TrialActor.remote(t.trial_id, t.path, t.config,
@@ -257,8 +299,11 @@ class Tuner:
                 ref = actor.run.remote(self._fn)
                 t.status = "RUNNING"
                 running[t.trial_id] = {"trial": t, "actor": actor,
-                                       "ref": ref, "ns": ns, "iter": 0,
-                                       "epoch": 0}
+                                       "ref": ref, "ns": ns,
+                                       "iter": len(t.history),
+                                       "epoch": 0,
+                                       "old_ns": stale_ns.pop(
+                                           t.trial_id, [])}
                 if hasattr(scheduler, "register_trial"):
                     scheduler.register_trial(t.trial_id, t.config)
             refs = [info["ref"] for info in running.values()]
@@ -269,11 +314,12 @@ class Tuner:
                 info = running[tid]
                 t = info["trial"]
                 stop = False
+                pause = False
                 exploit = None
                 for key in sorted(client.kv_keys(info["ns"])):
                     blob = client.kv_get(info["ns"], key)
                     client.kv_del(info["ns"], key)
-                    if blob is None or stop or exploit:
+                    if blob is None or stop or pause or exploit:
                         continue   # post-decision reports don't count
                     metrics, ckpt_path = pickle.loads(blob)
                     info["iter"] += 1
@@ -286,8 +332,21 @@ class Tuner:
                     decision = scheduler.on_result(tid, metrics)
                     if decision == STOP:
                         stop = True
+                    elif decision == PAUSE:
+                        pause = True
                     elif isinstance(decision, dict):
                         exploit = decision
+                if pause and not stop:
+                    # Rung checkpoint: release the slot; the scheduler
+                    # resumes (or stops) the trial via pop_runnable.
+                    t.status = "PAUSED"
+                    self._stop_trial(info)
+                    pause_epochs[tid] = pause_epochs.get(tid, 0) + 1
+                    stale_ns[tid] = (info.get("old_ns") or []) \
+                        + [info["ns"]]
+                    del running[tid]
+                    paused[tid] = t
+                    continue
                 if stop:
                     t.status = "EARLY_STOPPED"
                     self._stop_trial(info)
@@ -322,6 +381,9 @@ class Tuner:
                 self._drain_final(client, info, t, scheduler)
                 self._stop_trial(info)
                 del running[tid]
+                if hasattr(scheduler, "on_trial_remove"):
+                    # Bracket peers must not wait on a finished trial.
+                    scheduler.on_trial_remove(tid)
                 # Only completed runs inform the model: an ERROR
                 # trial's last metric never finished.
                 if searcher is not None and t.status == "TERMINATED" \

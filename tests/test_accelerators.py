@@ -1,0 +1,65 @@
+"""TPU accelerator manager: detection, typed slice resources, chip
+pinning (reference: _private/accelerators/tpu.py
+TPUAcceleratorManager)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.accelerators import (ChipAllocator,
+                                           detect_num_chips,
+                                           tpu_resources)
+
+
+def test_detection_env_override(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_NUM_TPUS", "4")
+    assert detect_num_chips() == 4
+
+
+def test_typed_slice_resources(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    res = tpu_resources(4)
+    assert res["TPU"] == 4.0
+    assert res["TPU-v5litepod-8"] == 4.0
+    assert res["TPU-v5litepod-8-head"] == 1.0
+    # Non-head slice workers advertise chips but no gang marker.
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    res = tpu_resources(4)
+    assert "TPU-v5litepod-8-head" not in res
+    assert tpu_resources(0) == {}
+
+
+def test_chip_allocator_lease_cycle():
+    alloc = ChipAllocator(2)
+    a = alloc.acquire(b"w1", count=1)
+    b = alloc.acquire(b"w2", count=1)
+    assert sorted(a + b) == [0, 1]
+    # Exhausted pool: unpinned spawn, no env.
+    c = alloc.acquire(b"w3", count=1)
+    assert c == [] and alloc.visible_env(c) == {}
+    # Death repays the lease; reuse is deterministic.
+    alloc.release(b"w1")
+    assert alloc.acquire(b"w4", count=1) == a
+    assert alloc.visible_env([1, 3]) == {"TPU_VISIBLE_CHIPS": "1,3"}
+    alloc.release(b"unknown")            # no-op, never raises
+
+
+def test_workers_pinned_to_distinct_chips(monkeypatch):
+    """Two concurrent TPU tasks land on workers whose
+    TPU_VISIBLE_CHIPS leases don't overlap."""
+    monkeypatch.setenv("RAY_TPU_CHIPS_PER_WORKER", "1")
+    ray_tpu.init(num_cpus=2, num_tpus=2)
+    try:
+        @ray_tpu.remote(resources={"TPU": 1})
+        def which_chip(delay):
+            import time
+            time.sleep(delay)      # hold the worker so both spawn
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+
+        refs = [which_chip.remote(0.5), which_chip.remote(0.5)]
+        chips = ray_tpu.get(refs)
+        assert sorted(chips) == ["0", "1"], chips
+    finally:
+        ray_tpu.shutdown()

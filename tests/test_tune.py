@@ -139,3 +139,54 @@ def test_trial_error_reported(ray_start, tmp_path):
     statuses = sorted(r.status for r in grid)
     assert statuses == ["ERROR", "TERMINATED"]
     assert any("exploded" in e for e in grid.errors)
+
+
+def test_hyperband_sync_rungs(ray_start, tmp_path):
+    """Synchronous HyperBand: the whole cohort pauses at each rung;
+    only the top 1/rf resume from their checkpoints (reference:
+    tune/schedulers/hyperband.py — vs ASHA's no-wait rule)."""
+    import json
+
+    def trainable(config):
+        from ray_tpu.train import Checkpoint
+        ctx = session.get_context()
+        start = 0
+        ck = ctx.get_checkpoint()
+        if ck is not None:
+            with open(os.path.join(ck.path, "s.json")) as f:
+                start = json.load(f)["step"]
+        for step in range(start + 1, 9):
+            d = os.path.join(ctx.get_trial_dir(), f"ck{step}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "s.json"), "w") as f:
+                json.dump({"step": step}, f)
+            session.report({"acc": config["q"] * 10 + step,
+                            "training_iteration": step},
+                           checkpoint=Checkpoint(d))
+
+    sched = tune.HyperBandScheduler(
+        metric="acc", mode="max", max_t=8, grace_period=2,
+        reduction_factor=3)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search(list(range(9)))},
+        tune_config=tune.TuneConfig(scheduler=sched,
+                                    max_concurrent_trials=4),
+        run_config=RunConfig(name="hb", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert not grid.errors
+    by_q = {r.config["q"]: r for r in grid}
+    # The best config survives every rung and finishes all 8 steps.
+    best = by_q[8]
+    assert best.metrics["training_iteration"] == 8, best.metrics
+    # Rung 1 (t=2) keeps 9//3=3 of 9; rung 2 (t=6) keeps 1 of 3: at
+    # least 6 trials were early-stopped, and stopped trials are frozen
+    # at a rung milestone, not at max_t.
+    stopped = [r for r in grid
+               if r.metrics.get("training_iteration", 0) < 8]
+    assert len(stopped) >= 6
+    assert {r.metrics["training_iteration"]
+            for r in stopped} <= {2, 6}
+    # Budget actually saved vs running all 9 trials 8 steps.
+    total = sum(r.metrics.get("training_iteration", 0) for r in grid)
+    assert total <= 9 * 8 * 0.6, total
