@@ -102,7 +102,7 @@ def init(num_cpus: Optional[float] = None,
             # (reference: accelerators/tpu.py:360-362 "TPU-{type}-head"
             # — exactly one placement group head bundle per slice).
             from ray_tpu._private.accelerators import tpu_resources
-            for k, v in tpu_resources(int(tpus)).items():
+            for k, v in tpu_resources(tpus).items():
                 res.setdefault(k, v)
             res["TPU"] = tpus
         store_capacity = object_store_memory or config.object_store_memory

@@ -53,10 +53,11 @@ def detect_accelerator_type() -> Optional[str]:
             or os.environ.get("RAY_TPU_ACCELERATOR_TYPE"))
 
 
-def tpu_resources(num_chips: int) -> Dict[str, float]:
+def tpu_resources(num_chips: float) -> Dict[str, float]:
     """The resource dict a TPU host advertises: plain TPU chips, the
     typed per-chip resource, and — on slice worker 0 — the slice-head
-    gang marker."""
+    gang marker.  Fractional chip counts (a shared-chip node) still
+    advertise the typed resources and the gang marker."""
     if not num_chips:
         return {}
     res: Dict[str, float] = {"TPU": float(num_chips)}
@@ -82,6 +83,11 @@ class ChipAllocator:
         want = count if count is not None else int(
             os.environ.get("RAY_TPU_CHIPS_PER_WORKER", "1"))
         with self._lock:
+            if len(self._free) < want:
+                # All-or-nothing: a partial lease would pin a
+                # multi-chip worker to fewer devices than it was
+                # sized for.  Empty lease => spawn unpinned.
+                return []
             take = self._free[:want]
             self._free = self._free[want:]
             if take:

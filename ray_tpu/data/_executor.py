@@ -20,6 +20,8 @@ from __future__ import annotations
 from typing import (Any, Callable, Dict, Iterator, List, Optional,
                     Sequence, Tuple)
 
+import itertools
+
 import numpy as np
 
 import ray_tpu
@@ -31,12 +33,25 @@ MAX_IN_FLIGHT = 8
 # ---------------------------------------------------------------------------
 # remote kernels
 # ---------------------------------------------------------------------------
-@ray_tpu.remote
-def _apply_stages(block: B.Block, stages: List[Callable]) -> B.Block:
+def _apply_stages_local(block: B.Block, stages: List[Callable],
+                        index: int = 0) -> B.Block:
     for stage in stages:
-        outs = stage(block)
+        # Stages tagged _wants_index receive the block's position in
+        # the stream (e.g. random_sample decorrelates per-block RNG
+        # streams positionally — content-identical blocks must not
+        # share a keep mask).
+        if getattr(stage, "_wants_index", False):
+            outs = stage(block, index)
+        else:
+            outs = stage(block)
         block = B.block_concat(outs) if len(outs) != 1 else outs[0]
     return block
+
+
+@ray_tpu.remote
+def _apply_stages(block: B.Block, stages: List[Callable],
+                  index: int = 0) -> B.Block:
+    return _apply_stages_local(block, stages, index)
 
 
 @ray_tpu.remote
@@ -156,6 +171,36 @@ def _block_rows_of(block: B.Block) -> int:
 
 
 @ray_tpu.remote
+def _slice_block(block: B.Block, start: int, end: int) -> B.Block:
+    return B.block_slice(block, start, end)
+
+
+@ray_tpu.remote
+def _reduce_group_mapped(key: str, fn, *parts: B.Block) -> B.Block:
+    """Apply a user fn to each key-group of one hash partition
+    (reference: grouped_data.py map_groups).  Every row of a key lives
+    in exactly one partition, so per-partition grouping is globally
+    correct.  fn: columnar group batch -> columnar batch (scalars are
+    broadcast to length-1 columns)."""
+    whole = [p for p in parts if p and B.block_num_rows(p)]
+    if not whole:
+        return {}
+    blk = B.block_concat(whole)
+    keys = np.asarray(blk[key])
+    order = np.argsort(keys, kind="stable")
+    keys_sorted = keys[order]
+    boundaries = np.nonzero(keys_sorted[1:] != keys_sorted[:-1])[0] + 1
+    out_blocks: list = []
+    for ix in np.split(order, boundaries):
+        group = B.block_take(blk, ix)
+        res = fn(group)
+        out_blocks.append({
+            k: (np.asarray(v) if np.ndim(v) else np.asarray([v]))
+            for k, v in res.items()})
+    return B.block_concat(out_blocks)
+
+
+@ray_tpu.remote
 def _zip_blocks(left_refs, right_refs) -> B.Block:
     """Row-aligned column merge of two block lists (Dataset.zip).
     Duplicate right-side column names get a `_1` suffix."""
@@ -193,10 +238,7 @@ class _MapActor:
 
     def apply(self, block: B.Block, stages_before: List[Callable]
               ) -> B.Block:
-        for stage in stages_before:
-            outs = stage(block)
-            block = (B.block_concat(outs) if len(outs) != 1
-                     else outs[0])
+        block = _apply_stages_local(block, stages_before)
         out = self._fn(block)
         return out
 
@@ -333,9 +375,11 @@ class FusedMapOp:
         from ray_tpu.data.context import DataContext
         ctx = DataContext.get_current()
         self.last_budget = MemoryBudget(ctx.max_bytes_in_flight)
+        counter = itertools.count()
         yield from _windowed(
             upstream,
-            lambda ref: _apply_stages.remote(ref, self.stages),
+            lambda ref: _apply_stages.remote(ref, self.stages,
+                                             next(counter)),
             min(MAX_IN_FLIGHT, ctx.max_blocks_in_flight),
             preserve_order, self.last_budget)
 
@@ -475,14 +519,15 @@ class ShuffleOp:
     def __init__(self, kind: str, num_partitions: Optional[int] = None,
                  key: Optional[str] = None, descending: bool = False,
                  seed: Optional[int] = None,
-                 aggs: Optional[List[Tuple[str, str, str]]] = None
-                 ) -> None:
+                 aggs: Optional[List[Tuple[str, str, str]]] = None,
+                 group_fn=None) -> None:
         self.kind = kind
         self.P = num_partitions
         self.key = key
         self.descending = descending
         self.seed = seed          # None => fresh randomness per run
         self.aggs = aggs or []
+        self.group_fn = group_fn  # kind="groupmap": per-group batch fn
 
     def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
                preserve_order: bool = True
@@ -509,7 +554,8 @@ class ShuffleOp:
                 ix = (np.arange(1, P) * len(allv)) // P
                 bounds = allv[np.minimum(ix, len(allv) - 1)]
         mode = {"random": "random", "sort": "range",
-                "groupby": "hash", "repartition": "rr"}[self.kind]
+                "groupby": "hash", "groupmap": "hash",
+                "repartition": "rr"}[self.kind]
         if P == 1:
             # Single output partition: no exchange needed — every input
             # block IS that partition's shard.
@@ -537,6 +583,10 @@ class ShuffleOp:
             elif self.kind == "groupby":
                 yield _reduce_grouped.remote(self.key, self.aggs,
                                              *shard)
+            elif self.kind == "groupmap":
+                yield _reduce_group_mapped.remote(self.key,
+                                                  self.group_fn,
+                                                  *shard)
             else:
                 yield _reduce_concat.remote(*shard)
 

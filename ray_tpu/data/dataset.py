@@ -276,6 +276,36 @@ class Dataset:
             return [B.block_take(b, np.nonzero(keep)[0])]
         return self._with_stage(stage)
 
+    def flat_map(self, fn: Callable[[Dict[str, Any]],
+                                    List[Dict[str, Any]]]) -> "Dataset":
+        """Row -> list of rows (reference: Dataset.flat_map)."""
+        def stage(b: B.Block) -> List[B.Block]:
+            rows: List[Dict[str, Any]] = []
+            for r in B.block_rows(b):
+                rows.extend(fn(r))
+            return [B.block_from_rows(rows)]
+        return self._with_stage(stage)
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample).
+        Unseeded sampling differs per execution, like random_shuffle."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        base = seed if seed is not None else np.random.randint(1 << 31)
+
+        def stage(b: B.Block, index: int) -> List[B.Block]:
+            n = B.block_num_rows(b)
+            # Positional per-block stream: content-identical blocks
+            # must not share a keep mask (the executor passes each
+            # block's stream index to _wants_index stages).
+            rng = np.random.RandomState(
+                (base + index * 2654435761) & 0x7FFFFFFF)
+            keep = rng.random_sample(n) < fraction
+            return [B.block_take(b, np.nonzero(keep)[0])]
+        stage._wants_index = True
+        return self._with_stage(stage)
+
     def add_column(self, name: str,
                    fn: Callable[[Batch], np.ndarray]) -> "Dataset":
         def stage(b: B.Block) -> List[B.Block]:
@@ -616,6 +646,85 @@ class Dataset:
                 break
         return out
 
+    def take_all(self, limit: Optional[int] = 100_000
+                 ) -> List[Dict[str, Any]]:
+        """Every row as a list (reference: Dataset.take_all — the
+        limit guards against accidentally materializing a huge
+        dataset in the driver)."""
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if limit is not None and len(out) > limit:
+                raise ValueError(
+                    f"take_all: dataset exceeds limit={limit}; raise "
+                    f"the limit or use iter_rows()")
+        return out
+
+    def take_batch(self, batch_size: int = 20) -> Batch:
+        """First `batch_size` rows as one columnar batch (reference:
+        Dataset.take_batch)."""
+        blocks: List[B.Block] = []
+        got = 0
+        for b in self._iter_blocks():
+            n = B.block_num_rows(b)
+            if not n:
+                continue
+            take = min(n, batch_size - got)
+            blocks.append(B.block_slice(b, 0, take))
+            got += take
+            if got >= batch_size:
+                break
+        if not blocks:
+            return {}
+        return B.block_concat(blocks)
+
+    def show(self, limit: int = 20) -> None:
+        """Print rows (reference: Dataset.show)."""
+        for row in self.take(limit):
+            print(row)
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Row-exact splits at global row offsets (reference:
+        Dataset.split_at_indices): len(indices)+1 datasets."""
+        if any(i < 0 for i in indices) or list(indices) != sorted(
+                indices):
+            raise ValueError("indices must be sorted and non-negative")
+        refs = self._block_refs()
+        rows = ray_tpu.get([X._block_rows_of.remote(r) for r in refs])
+        starts = np.cumsum([0] + rows[:-1]).tolist()
+        out: List[List[ray_tpu.ObjectRef]] = [
+            [] for _ in range(len(indices) + 1)]
+        bounds = [0] + list(indices) + [sum(rows)]
+        for ref, n, s in zip(refs, rows, starts):
+            e = s + n
+            for part in range(len(bounds) - 1):
+                lo, hi = max(s, bounds[part]), min(e, bounds[part + 1])
+                if lo >= hi:
+                    continue
+                if lo == s and hi == e:
+                    out[part].append(ref)        # whole block, no copy
+                else:
+                    out[part].append(X._slice_block.remote(
+                        ref, lo - s, hi - s))
+        return [Dataset([], [], materialized=p) for p in out]
+
+    @staticmethod
+    def from_arrow(table) -> "Dataset":
+        """One pyarrow Table -> one-block dataset (reference:
+        data/read_api.py from_arrow)."""
+        return Dataset([], [], materialized=[
+            ray_tpu.put(B.block_from_arrow(table))])
+
+    def to_arrow(self):
+        """Materialize into one pyarrow Table (reference:
+        Dataset.to_arrow_refs + concat; driver-side, test-scale)."""
+        blocks = [b for b in self._iter_blocks()
+                  if B.block_num_rows(b)]
+        if not blocks:
+            import pyarrow as pa
+            return pa.table({})
+        return B.block_to_arrow(B.block_concat(blocks))
+
     def to_pandas(self):
         """Materialize into one pandas DataFrame (reference:
         Dataset.to_pandas).  Pulls every block to the driver — for
@@ -767,6 +876,12 @@ class GroupedData:
 
     def std(self, col: str) -> Dataset:
         return self._agg([("std", col, f"std({col})")])
+
+    def map_groups(self, fn: Callable[[Batch], Batch]) -> Dataset:
+        """Apply `fn` to each key-group as one columnar batch
+        (reference: grouped_data.py GroupedData.map_groups)."""
+        return self._ds._with_op(X.ShuffleOp(
+            "groupmap", key=self._key, group_fn=fn))
 
     def aggregate(self, **aggs: Tuple[str, str]) -> Dataset:
         """aggregate(out_name=("sum", "col"), ...)"""
