@@ -242,6 +242,28 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     _ensure_connected().kill_actor(actor._actor_id, no_restart)
 
 
+def exit_actor() -> None:
+    """Terminate the CURRENT actor after this method call completes
+    (reference: ray.actor.exit_actor).  The in-flight call returns
+    normally (value None); the actor then dies permanently — no
+    restart is attempted regardless of max_restarts."""
+    from ray_tpu.runtime_context import _current_spec
+    spec = _current_spec.get(None)
+    if not spec or spec.get("actor_id") is None:
+        raise RuntimeError("exit_actor() called outside an actor "
+                           "method")
+    raise exceptions.ActorExitRequest()
+
+
+def get_tpu_ids() -> List[int]:
+    """Chip ids leased to this worker (reference: ray.get_gpu_ids /
+    get_tpu_ids — reads the TPU_VISIBLE_CHIPS pin the node's chip
+    allocator exported at worker spawn).  Empty in the driver or on
+    unpinned workers."""
+    raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
+    return [int(c) for c in raw.split(",") if c != ""]
+
+
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     client = _ensure_connected()
     reply = client.lookup_named_actor(name, namespace)

@@ -83,11 +83,13 @@ class ChipAllocator:
         want = count if count is not None else int(
             os.environ.get("RAY_TPU_CHIPS_PER_WORKER", "1"))
         with self._lock:
-            if len(self._free) < want:
-                # All-or-nothing: a partial lease would pin a
-                # multi-chip worker to fewer devices than it was
-                # sized for.  Empty lease => spawn unpinned.
-                return []
+            # Prefer a full-size lease; fall back to whatever is free.
+            # A partial lease may undersize a multi-chip worker, but an
+            # UNPINNED worker would initialize every chip on the node —
+            # colliding with live exclusive leases (libtpu device
+            # locks).  Only a fully-drained pool spawns unpinned, and
+            # then node resource accounting (TPU: n) is what bounds how
+            # many TPU tasks actually run concurrently.
             take = self._free[:want]
             self._free = self._free[want:]
             if take:

@@ -1553,6 +1553,19 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             self._fail_task_returns(rec, err)
         actor.in_flight.clear()
 
+    def _h_actor_exiting(self, ctx: _ConnCtx, m: dict) -> None:
+        """Worker announces an INTENTIONAL exit (ray_tpu.exit_actor())
+        before its process dies: zero the restart budget so the
+        imminent worker death is permanent, and record the reason so
+        callers see 'exited' rather than a crash (reference:
+        ray.actor.exit_actor semantics)."""
+        with self.lock:
+            actor = self.actors.get(m["actor_id"])
+            if actor is not None and actor.state != "dead":
+                actor.restarts_left = 0
+                actor.intentional_exit = True
+                actor.death_reason = "exited via exit_actor()"
+
     def _h_kill_actor(self, ctx: _ConnCtx, m: dict) -> None:
         with self.lock:
             actor = self.actors.get(m["actor_id"])
@@ -2146,7 +2159,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 self._on_actor_worker_death(actor, reason)
 
     def _on_actor_worker_death(self, actor: ActorRecord, reason: str) -> None:
-        # Fail in-flight calls; restart if budget remains.
+        # Fail in-flight calls; restart if budget remains.  An exit
+        # announced via exit_actor() keeps its intentional reason.
+        if actor.intentional_exit:
+            reason = actor.death_reason
         err = exc.ActorDiedError(actor.actor_id.hex(), reason)
         for rec in list(actor.in_flight.values()):
             self._fail_task_returns(rec, err)

@@ -74,7 +74,8 @@ class TaskRecord:
 class ActorRecord:
     __slots__ = ("actor_id", "spec", "state", "worker", "queue",
                  "restarts_left", "name", "namespace", "detached",
-                 "in_flight", "death_reason", "holds_released")
+                 "in_flight", "death_reason", "holds_released",
+                 "intentional_exit")
 
     def __init__(self, actor_id: bytes, spec: dict) -> None:
         self.actor_id = actor_id
@@ -88,6 +89,9 @@ class ActorRecord:
         self.namespace = spec.get("namespace", "default")
         self.detached = spec.get("detached", False)
         self.death_reason = ""
+        # Worker announced exit_actor(): the coming death is
+        # deliberate — never restart, report "exited" not "crashed".
+        self.intentional_exit = False
         # Creation-task embedded ref holds live as long as the actor can
         # restart (the spec is replayed); released exactly once at
         # permanent death via _release_actor_holds.

@@ -280,6 +280,17 @@ class WorkerRuntime:
 
     def _report_error(self, spec: dict, error: BaseException,
                       start: Optional[float] = None) -> None:
+        if isinstance(error, exc.ActorExitRequest) \
+                and spec.get("actor_id") is not None:
+            # Intentional exit (ray_tpu.exit_actor): the in-flight call
+            # SUCCEEDS with None, the node is told the coming death is
+            # deliberate (no restart), then the process ends.  Message
+            # order on the connection guarantees task_done and
+            # actor_exiting land before the disconnect.
+            self._report_value(spec, None, start=start)
+            self.client.conn.notify({"type": "actor_exiting",
+                                     "actor_id": spec["actor_id"]})
+            os._exit(0)
         name = spec.get("name", "<task>")
         if isinstance(error, exc.TaskError):
             task_err: Exception = error  # propagate nested task errors as-is

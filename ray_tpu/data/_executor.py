@@ -236,9 +236,9 @@ class _MapActor:
         else:
             self._fn = fn_or_cls
 
-    def apply(self, block: B.Block, stages_before: List[Callable]
-              ) -> B.Block:
-        block = _apply_stages_local(block, stages_before)
+    def apply(self, block: B.Block, stages_before: List[Callable],
+              index: int = 0) -> B.Block:
+        block = _apply_stages_local(block, stages_before, index)
         out = self._fn(block)
         return out
 
@@ -441,8 +441,11 @@ class ActorPoolMapOp:
 
         def submit(ref) -> None:
             actor = actors[counter[0] % len(actors)]
+            # counter doubles as the block's stream index for
+            # _wants_index stages (random_sample decorrelation).
+            out = actor.apply.remote(ref, self.stages_before,
+                                     counter[0])
             counter[0] += 1
-            out = actor.apply.remote(ref, self.stages_before)
             owner[out.binary()] = actor
             window.append(out)
 

@@ -292,15 +292,24 @@ class Dataset:
         Unseeded sampling differs per execution, like random_shuffle."""
         if not 0.0 <= fraction <= 1.0:
             raise ValueError("fraction must be in [0, 1]")
-        base = seed if seed is not None else np.random.randint(1 << 31)
 
         def stage(b: B.Block, index: int) -> List[B.Block]:
+            import os as _os
             n = B.block_num_rows(b)
-            # Positional per-block stream: content-identical blocks
-            # must not share a keep mask (the executor passes each
-            # block's stream index to _wants_index stages).
-            rng = np.random.RandomState(
-                (base + index * 2654435761) & 0x7FFFFFFF)
+            if seed is None:
+                # Fresh entropy per task => different sample every
+                # execution of the same lazy dataset (epoch semantics,
+                # like an unseeded random_shuffle).
+                rng = np.random.RandomState(
+                    int.from_bytes(_os.urandom(4), "little") &
+                    0x7FFFFFFF)
+            else:
+                # Positional per-block stream: content-identical
+                # blocks must not share a keep mask (the executor
+                # passes each block's stream index to _wants_index
+                # stages).
+                rng = np.random.RandomState(
+                    (seed + index * 2654435761) & 0x7FFFFFFF)
             keep = rng.random_sample(n) < fraction
             return [B.block_take(b, np.nonzero(keep)[0])]
         stage._wants_index = True

@@ -111,3 +111,10 @@ class NodeDiedError(RayTpuError):
 class NodeAffinityError(RayTpuError):
     """Hard node-affinity target is gone (reference:
     NodeAffinitySchedulingStrategy with soft=False)."""
+
+
+class ActorExitRequest(BaseException):
+    """Raised by ray_tpu.exit_actor() inside an actor method to
+    terminate the actor intentionally after the current call completes
+    (reference: ray.actor.exit_actor, actor.py).  BaseException so a
+    user `except Exception` cannot swallow the exit."""

@@ -32,7 +32,8 @@ import ray_tpu
 from ray_tpu.remote_function import RemoteFunction
 
 __all__ = ["run", "run_async", "resume", "get_status", "get_output",
-           "list_all", "delete", "FunctionNode"]
+           "list_all", "delete", "FunctionNode", "EventNode",
+           "wait_for_event"]
 
 RUNNING = "RUNNING"
 SUCCEEDED = "SUCCEEDED"
@@ -135,6 +136,8 @@ class _Execution:
         """Post-order DAG execution with per-step checkpointing."""
         if counter is None:
             counter = [0]
+        if isinstance(node, EventNode):
+            return self._exec_event(node, prefix, counter)
         if not isinstance(node, FunctionNode):
             return node                     # constant argument
         if id(node) in self._memo:
@@ -151,14 +154,68 @@ class _Execution:
             value = cached["value"]
         else:
             value = ray_tpu.get(node.rf.remote(*args, **kwargs))
-            if isinstance(value, FunctionNode):
-                # Continuation: the step produced a sub-DAG; its result
-                # IS this step's result (nested key space).
+            if isinstance(value, (FunctionNode, EventNode)):
+                # Continuation: the step produced a sub-DAG (or an
+                # event wait); its result IS this step's result
+                # (nested key space).
                 value = self.exec_node(value, prefix=f"{prefix}/{key}",
                                        counter=[0])
             self._store(key, value)
         self._memo[id(node)] = (node, value)
         return value
+
+    def _exec_event(self, node: "EventNode", prefix: str,
+                    counter: List[int]) -> Any:
+        """Durable external event: poll the listener until it yields a
+        non-None payload, checkpoint it — a resumed workflow that
+        already observed the event NEVER waits again (reference:
+        workflow/api.py wait_for_event + event listeners)."""
+        if id(node) in self._memo:
+            return self._memo[id(node)][1]
+        my_index = counter[0]
+        counter[0] += 1
+        name = getattr(node.listener, "__name__", "event")
+        raw = f"{prefix}/{my_index}/event/{name}"
+        key = (f"event-{name}-"
+               f"{hashlib.sha256(raw.encode()).hexdigest()[:12]}")
+        cached = self._load(key)
+        if cached is not None:
+            value = cached["value"]
+        else:
+            while True:
+                value = node.listener(*node.args, **node.kwargs)
+                if value is not None:
+                    break
+                time.sleep(node.poll_interval_s)
+            self._store(key, value)
+        self._memo[id(node)] = (node, value)
+        return value
+
+
+class EventNode:
+    """DAG node for an external event: `listener(*args)` is polled
+    until it returns non-None; the payload becomes the node's value
+    and is checkpointed durably."""
+
+    def __init__(self, listener, args: tuple, kwargs: dict,
+                 poll_interval_s: float) -> None:
+        self.listener = listener
+        self.args = args
+        self.kwargs = kwargs
+        self.poll_interval_s = poll_interval_s
+
+    def __repr__(self) -> str:
+        name = getattr(self.listener, "__name__", "event")
+        return f"EventNode({name})"
+
+
+def wait_for_event(listener, *args, poll_interval_s: float = 0.1,
+                   **kwargs) -> EventNode:
+    """Bind an external-event step into a workflow DAG (reference:
+    workflow.wait_for_event).  `listener` is a plain callable returning
+    None while the event is pending and the (picklable) payload once
+    fired; the payload is durable — resume never re-waits."""
+    return EventNode(listener, args, kwargs, poll_interval_s)
 
 
 def run(dag: FunctionNode, workflow_id: Optional[str] = None) -> Any:

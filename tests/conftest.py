@@ -52,6 +52,17 @@ def ray_start():
     ray_tpu.shutdown()
 
 
+@pytest.fixture
+def ray_start_tpu(monkeypatch):
+    """Runtime advertising 2 fake TPU chips with 1-chip worker leases
+    (chip-pinning tests; no hardware touched)."""
+    monkeypatch.setenv("RAY_TPU_CHIPS_PER_WORKER", "1")
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, num_tpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
 @pytest.fixture(scope="session")
 def ray_shared():
     """Session-shared runtime (reference: ray_start_shared)."""

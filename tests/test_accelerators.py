@@ -39,6 +39,11 @@ def test_chip_allocator_lease_cycle():
     # Exhausted pool: unpinned spawn, no env.
     c = alloc.acquire(b"w3", count=1)
     assert c == [] and alloc.visible_env(c) == {}
+    # Partial availability leases what exists (contention-free beats
+    # an unpinned worker colliding with live exclusive leases).
+    alloc3 = ChipAllocator(3)
+    assert alloc3.acquire(b"x1", count=2) == [0, 1]
+    assert alloc3.acquire(b"x2", count=2) == [2]
     # Death repays the lease; reuse is deterministic.
     alloc.release(b"w1")
     assert alloc.acquire(b"w4", count=1) == a

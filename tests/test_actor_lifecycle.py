@@ -136,3 +136,60 @@ def test_wait_polling_does_not_leak_waiters(ray_start):
         entry = sess.node_service.objects.get(ref.binary())
         n_waiters = len(entry.waiters) if entry else 0
     assert n_waiters <= 2, f"waiter leak: {n_waiters} stale waiters"
+
+
+def test_exit_actor_intentional_no_restart(ray_start, tmp_path):
+    """ray_tpu.exit_actor(): the exiting call returns normally, the
+    actor dies permanently (no restart even with budget), and later
+    calls fail with the 'exited' reason (reference:
+    ray.actor.exit_actor)."""
+    import time
+
+    marker = str(tmp_path / "inits")
+
+    @ray_tpu.remote(max_restarts=3)
+    class Quitter:
+        def __init__(self):
+            with open(marker, "a") as f:
+                f.write("x")
+
+        def leave(self):
+            ray_tpu.exit_actor()
+
+        def ping(self):
+            return "pong"
+
+    a = Quitter.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    assert ray_tpu.get(a.leave.remote()) is None   # call itself succeeds
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            ray_tpu.get(a.ping.remote(), timeout=5)
+        except ray_tpu.exceptions.ActorDiedError as e:
+            assert "exit_actor" in str(e)
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("actor never died after exit_actor()")
+    time.sleep(0.5)                       # any restart would re-init
+    assert open(marker).read() == "x"     # __init__ ran exactly once
+
+
+def test_exit_actor_outside_actor_errors(ray_start):
+    with __import__("pytest").raises(RuntimeError):
+        ray_tpu.exit_actor()
+
+    @ray_tpu.remote
+    def not_an_actor():
+        ray_tpu.exit_actor()
+
+    with __import__("pytest").raises(ray_tpu.exceptions.TaskError):
+        ray_tpu.get(not_an_actor.remote())
+
+
+def test_get_tpu_ids_in_pinned_worker(ray_start_tpu):
+    @ray_tpu.remote(resources={"TPU": 1})
+    def ids():
+        return ray_tpu.get_tpu_ids()
+    assert ray_tpu.get(ids.remote()) in ([0], [1])

@@ -94,3 +94,39 @@ def test_delete_and_missing(rt):
     workflow.delete("w4")
     with pytest.raises(ValueError):
         workflow.get_status("w4")
+
+
+def test_wait_for_event_durable(rt, tmp_path):
+    """workflow.wait_for_event: the DAG blocks until the listener
+    yields a payload, the payload checkpoints durably, and resume()
+    returns it WITHOUT re-waiting (reference: workflow/api.py
+    wait_for_event)."""
+    import time
+    from ray_tpu import workflow
+
+    flag = str(tmp_path / "fired")
+
+    def file_event(path):
+        if os.path.exists(path):
+            return open(path).read()
+        return None
+
+    @ray_tpu.remote
+    def combine(payload, suffix):
+        return payload + suffix
+
+    dag = combine.bind(workflow.wait_for_event(file_event, flag,
+                                               poll_interval_s=0.05),
+                       "!")
+    t = workflow.run_async(dag, workflow_id="evt1")
+    time.sleep(0.4)
+    assert workflow.get_status("evt1") == "RUNNING"
+    with open(flag, "w") as f:
+        f.write("ding")
+    t.join(timeout=30)
+    assert workflow.get_status("evt1") == "SUCCEEDED"
+    assert workflow.get_output("evt1") == "ding!"
+
+    # Durable: remove the trigger file; resume must NOT re-wait.
+    os.remove(flag)
+    assert workflow.resume("evt1") == "ding!"
