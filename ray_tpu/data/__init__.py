@@ -8,7 +8,13 @@ from ray_tpu.data.dataset import (Dataset, from_items, from_numpy,
 range = Dataset.range  # noqa: A001 — mirrors ray.data.range
 read_images = Dataset.read_images
 read_tfrecords = Dataset.read_tfrecords
+read_text = Dataset.read_text
+read_binary_files = Dataset.read_binary_files
+read_sql = Dataset.read_sql
+from_arrow = Dataset.from_arrow
 
 __all__ = ["Block", "Dataset", "DataContext", "from_items",
-           "from_numpy", "from_pandas", "read_csv", "read_json",
-           "read_parquet", "read_images", "read_tfrecords", "range"]
+           "from_numpy", "from_pandas", "from_arrow", "read_csv",
+           "read_json", "read_parquet", "read_images",
+           "read_tfrecords", "read_text", "read_binary_files",
+           "read_sql", "range"]

@@ -143,6 +143,98 @@ class Dataset:
         return Dataset([make_reader(f) for f in files], [])
 
     @staticmethod
+    def read_text(paths: Union[str, List[str]],
+                  encoding: str = "utf-8") -> "Dataset":
+        """One row per line, column `text` (reference: read_text,
+        data/read_api.py)."""
+        files = _expand_paths(paths, (".txt", ".text", ".log"))
+
+        def make_reader(path):
+            def read():
+                from ray_tpu.data.filesystem import open_file
+                with open_file(path, "rb") as f:
+                    lines = f.read().decode(encoding).splitlines()
+                return {"text": np.asarray(lines, dtype=object)}
+            return read
+
+        return Dataset([make_reader(f) for f in files], [])
+
+    @staticmethod
+    def read_binary_files(paths: Union[str, List[str]],
+                          include_paths: bool = False) -> "Dataset":
+        """One row per file, column `bytes` (reference:
+        read_binary_files, data/read_api.py) — the raw-ingest path for
+        formats with no dedicated reader (audio, pickles, ...)."""
+        files = _expand_paths(paths, None)
+
+        def make_reader(path):
+            def read():
+                from ray_tpu.data.filesystem import open_file
+                with open_file(path, "rb") as f:
+                    blob = f.read()
+                col = np.empty(1, dtype=object)
+                col[0] = blob
+                out = {"bytes": col}
+                if include_paths:
+                    out["path"] = np.asarray([path])
+                return out
+            return read
+
+        return Dataset([make_reader(f) for f in files], [])
+
+    @staticmethod
+    def read_sql(sql: str, connection_factory,
+                 rows_per_block: int = 4096) -> "Dataset":
+        """Execute a DBAPI query into a dataset (reference: read_sql,
+        data/read_api.py:523-class readers).  `connection_factory` is a
+        zero-arg callable returning a DBAPI connection (e.g.
+        `lambda: sqlite3.connect(path)`) — it runs INSIDE the read
+        task, so the connection itself never pickles.
+
+        The query executes EXACTLY ONCE, in one read task: SQL result
+        order is not stable across executions (parallel scans, missing
+        ORDER BY) and the data may change between runs, so offset-based
+        multi-task splits silently duplicate/drop rows.  The single
+        result is materialized as rows_per_block-sized blocks via an
+        eager split after the fetch; `.repartition(n)` redistributes
+        if downstream parallelism matters more than ingest locality."""
+        def read():
+            conn = connection_factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                cols = ([d[0] for d in cur.description]
+                        if cur.description else [])
+                rows: List[tuple] = []
+                while True:
+                    chunk = cur.fetchmany(rows_per_block)
+                    if not chunk:
+                        break
+                    rows.extend(chunk)
+            finally:
+                conn.close()
+            if not rows:
+                return {}
+            arrs = list(zip(*rows))
+            return {c: np.asarray(a) for c, a in zip(cols, arrs)}
+
+        ds = Dataset([read], [])
+        # Eager one-pass split so num_blocks reflects rows_per_block.
+        whole = ds._block_refs()
+        import ray_tpu as _rt
+        from ray_tpu.data import _executor as _X
+        counts = _rt.get([_X._block_rows_of.remote(r) for r in whole])
+        out: List[Any] = []
+        for ref, n in zip(whole, counts):
+            if n <= rows_per_block:
+                out.append(ref)
+            else:
+                out.extend(_X._slice_block.remote(
+                    ref, s, min(s + rows_per_block, n))
+                    for s in range(0, n, rows_per_block))
+        return Dataset([], [], materialized=out)
+
+    @staticmethod
     def read_images(paths: Union[str, List[str]],
                     size: Optional[Tuple[int, int]] = None,
                     mode: Optional[str] = None,
@@ -752,6 +844,79 @@ class Dataset:
             if b:
                 return {k: str(v.dtype) for k, v in b.items()}
         return {}
+
+    # Whole-dataset aggregates (reference: Dataset.sum/min/max/mean/std
+    # — streaming per-block partials, no driver materialization).
+    def sum(self, col: str):
+        """Column sum, dtype-preserving: integer columns accumulate as
+        exact Python ints (no 2^53 float truncation, no int64
+        overflow); float columns in float64."""
+        total: Any = None
+        for b in self._iter_blocks():
+            if not B.block_num_rows(b):
+                continue
+            a = np.asarray(b[col])
+            part = (int(np.sum(a, dtype=object))
+                    if a.dtype.kind in "iub"
+                    else float(np.sum(a, dtype=np.float64)))
+            total = part if total is None else total + part
+        return 0 if total is None else total
+
+    def min(self, col: str):
+        """Native-dtype minimum (strings compare lexicographically,
+        like the reference's Dataset.min)."""
+        vals = [np.min(np.asarray(b[col]))
+                for b in self._iter_blocks() if B.block_num_rows(b)]
+        if not vals:
+            raise ValueError("min() on an empty dataset")
+        out = vals[0]
+        for v in vals[1:]:
+            if v < out:
+                out = v
+        return out.item() if hasattr(out, "item") else out
+
+    def max(self, col: str):
+        vals = [np.max(np.asarray(b[col]))
+                for b in self._iter_blocks() if B.block_num_rows(b)]
+        if not vals:
+            raise ValueError("max() on an empty dataset")
+        out = vals[0]
+        for v in vals[1:]:
+            if v > out:
+                out = v
+        return out.item() if hasattr(out, "item") else out
+
+    def _moments(self, col: str):
+        """Chan-style parallel merge of per-block (n, mean, M2): the
+        numerically stable route to mean/std (the naive E[x^2]-mean^2
+        form cancels catastrophically when |mean| >> std, e.g. unix
+        timestamps)."""
+        n, mean, m2 = 0, 0.0, 0.0
+        for b in self._iter_blocks():
+            if not B.block_num_rows(b):
+                continue
+            a = np.asarray(b[col], np.float64)
+            nb = a.size
+            mb = float(np.mean(a))
+            m2b = float(np.sum((a - mb) ** 2))
+            delta = mb - mean
+            tot = n + nb
+            m2 = m2 + m2b + delta * delta * n * nb / tot
+            mean = mean + delta * nb / tot
+            n = tot
+        return n, mean, m2
+
+    def mean(self, col: str) -> float:
+        n, mean, _ = self._moments(col)
+        if not n:
+            raise ValueError("mean() on an empty dataset")
+        return mean
+
+    def std(self, col: str, ddof: int = 1) -> float:
+        n, _, m2 = self._moments(col)
+        if n <= ddof:
+            raise ValueError("std() needs more rows than ddof")
+        return float(np.sqrt(m2 / (n - ddof)))
 
     def num_blocks(self) -> int:
         if self._materialized is not None:

@@ -44,9 +44,17 @@ def expand(paths, exts: Tuple[str, ...]) -> List[str]:
             return proto + f if proto and "://" not in f else f
 
         if fs.isdir(rel):
-            for ext in exts:
-                pat = rel.rstrip("/") + f"/*{ext}"
-                out.extend(sorted(keep(f) for f in fs.glob(pat)))
+            if exts is None:
+                # Untyped listing (read_binary_files): one detailed ls
+                # filters directories without a per-file stat RPC.
+                infos = fs.ls(rel.rstrip("/"), detail=True)
+                out.extend(sorted(
+                    keep(i["name"]) for i in infos
+                    if i.get("type") != "directory"))
+            else:
+                for ext in exts:
+                    pat = rel.rstrip("/") + f"/*{ext}"
+                    out.extend(sorted(keep(f) for f in fs.glob(pat)))
         elif any(ch in rel for ch in "*?["):
             out.extend(sorted(keep(f) for f in fs.glob(rel)))
         else:

@@ -126,3 +126,38 @@ def test_actor_pool(rt):
     assert [pool.get_next(timeout=60) for _ in range(5)]         == [0, 1, 4, 9, 16]
     from ray_tpu.util import ActorPool as CanonicalActorPool
     assert CanonicalActorPool is ActorPool
+
+
+def test_read_text_and_binary(ray_start, tmp_path):
+    (tmp_path / "a.txt").write_text("one\ntwo\nthree")
+    (tmp_path / "b.txt").write_text("four")
+    from ray_tpu import data as rdata
+    ds = rdata.read_text(str(tmp_path))
+    assert sorted(r["text"] for r in ds.take_all()) == [
+        "four", "one", "three", "two"]
+
+    (tmp_path / "blob.bin").write_bytes(b"\x00\x01\x02")
+    bin_ds = rdata.read_binary_files(str(tmp_path / "blob.bin"),
+                                     include_paths=True)
+    rows = bin_ds.take_all()
+    assert rows[0]["bytes"] == b"\x00\x01\x02"
+    assert rows[0]["path"].endswith("blob.bin")
+
+
+def test_read_sql_sqlite(ray_start, tmp_path):
+    import sqlite3
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE pts (x INTEGER, y REAL)")
+    conn.executemany("INSERT INTO pts VALUES (?, ?)",
+                     [(i, i * 0.5) for i in range(10)])
+    conn.commit()
+    conn.close()
+
+    from ray_tpu import data as rdata
+    ds = rdata.read_sql("SELECT x, y FROM pts ORDER BY x",
+                        lambda: sqlite3.connect(db),
+                        rows_per_block=4)
+    assert ds.count() == 10
+    assert ds.num_blocks() == 3           # 4 + 4 + 2
+    assert ds.sum("y") == sum(i * 0.5 for i in range(10))

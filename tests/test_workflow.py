@@ -127,6 +127,37 @@ def test_wait_for_event_durable(rt, tmp_path):
     assert workflow.get_status("evt1") == "SUCCEEDED"
     assert workflow.get_output("evt1") == "ding!"
 
-    # Durable: remove the trigger file; resume must NOT re-wait.
-    os.remove(flag)
-    assert workflow.resume("evt1") == "ding!"
+    # Durable replay: build a SECOND workflow that fails AFTER its
+    # event checkpoint is written, then resume with the trigger gone —
+    # resume must replay the cached payload, not re-wait (a broken
+    # cache key would hang on the now-None listener).
+    flag2 = str(tmp_path / "fired2")
+    fail_once = str(tmp_path / "fail_once")
+    with open(flag2, "w") as f:
+        f.write("dong")
+    with open(fail_once, "w") as f:
+        f.write("1")
+
+    @ray_tpu.remote
+    def fragile(payload, marker):
+        if os.path.exists(marker):
+            os.remove(marker)
+            raise RuntimeError("injected crash after event")
+        return payload + "?"
+
+    dag2 = fragile.bind(workflow.wait_for_event(file_event, flag2,
+                                                poll_interval_s=0.05),
+                        fail_once)
+    t2 = workflow.run_async(dag2, workflow_id="evt2")
+    t2.join(timeout=30)
+    assert workflow.get_status("evt2") == "FAILED"
+    os.remove(flag2)                     # listener would wait forever
+
+    import threading
+    result = []
+    rt_thread = threading.Thread(
+        target=lambda: result.append(workflow.resume("evt2")))
+    rt_thread.start()
+    rt_thread.join(timeout=20)
+    assert not rt_thread.is_alive(), "resume re-waited on the event"
+    assert result == ["dong?"]
