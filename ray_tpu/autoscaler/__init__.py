@@ -12,7 +12,9 @@ from ray_tpu.autoscaler.node_provider import (LocalNodeProvider,
 from ray_tpu.autoscaler.tpu_provider import (LocalQueuedResourcesApi,
                                              QueuedResourcesApi,
                                              QueuedResourcesSliceProvider)
+from ray_tpu.autoscaler import sdk
 
 __all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider",
            "TpuSliceProvider", "QueuedResourcesApi",
-           "LocalQueuedResourcesApi", "QueuedResourcesSliceProvider"]
+           "LocalQueuedResourcesApi", "QueuedResourcesSliceProvider",
+           "sdk"]

@@ -175,6 +175,14 @@ class StandardAutoscaler:
                            for m in nodes):
                     unfulfilled.append(shape)
             pg_demand.extend(load.get("pg_demand") or [])
+        # Programmatic floor (sdk.request_resources): every requested
+        # bundle goes into the pack unfiltered — place() charges
+        # existing capacity bundle by bundle, so N identical bundles
+        # consume N existing slots before any fresh node is counted
+        # (a per-bundle "does it fit somewhere" prefilter would let
+        # one free slot satisfy all N).
+        from ray_tpu.autoscaler.sdk import requested_resources_from_kv
+        unfulfilled.extend(requested_resources_from_kv(self._gcs))
         if time.time() - self._last_launch >= self.launch_cooldown_s:
             # Gang demand on a slice provider: whole slices, atomically.
             if isinstance(self.provider, TpuSliceProvider):

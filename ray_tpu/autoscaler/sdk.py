@@ -1,0 +1,46 @@
+"""Programmatic autoscaler requests (reference:
+ray.autoscaler.sdk.request_resources, python/ray/autoscaler/sdk.py).
+
+`request_resources(bundles)` records a demand FLOOR in the GCS KV; the
+StandardAutoscaler folds it into every reconcile exactly like pending
+task shapes, so capacity can be pre-provisioned before the workload
+that needs it is submitted (e.g. scale a TPU-slice pool ahead of a
+training gang).  Each call REPLACES the previous request; an empty
+list cancels it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+_NS = "autoscaler"
+_KEY = b"requested_resources"
+
+
+def request_resources(bundles: Optional[List[Dict[str, float]]] = None,
+                      num_cpus: Optional[int] = None) -> None:
+    """Ask the autoscaler to provision capacity for `bundles` (list of
+    resource shapes) and/or `num_cpus` 1-CPU bundles."""
+    shapes: List[Dict[str, float]] = list(bundles or [])
+    if num_cpus:
+        shapes.extend({"CPU": 1.0} for _ in range(num_cpus))
+    client = ray_tpu._ensure_connected()
+    client.kv_put(_NS, _KEY, json.dumps(shapes).encode(),
+                  overwrite=True)
+
+
+def requested_resources_from_kv(gcs) -> List[Dict[str, float]]:
+    """Autoscaler-side read of the current request floor."""
+    try:
+        raw = gcs.kv_get(_NS, _KEY)
+    except Exception:
+        return []
+    if not raw:
+        return []
+    try:
+        return [dict(s) for s in json.loads(bytes(raw).decode())]
+    except Exception:
+        return []

@@ -91,6 +91,14 @@ class _LocalFs:
     def glob(self, pat: str) -> List[str]:
         return globlib.glob(pat)
 
+    def ls(self, p: str, detail: bool = False):
+        names = [os.path.join(p, e) for e in os.listdir(p)]
+        if not detail:
+            return names
+        return [{"name": n,
+                 "type": "directory" if os.path.isdir(n) else "file"}
+                for n in names]
+
     def makedirs(self, p: str, exist_ok: bool = True) -> None:
         os.makedirs(p, exist_ok=exist_ok)
 

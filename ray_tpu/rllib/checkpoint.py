@@ -1,0 +1,52 @@
+"""Algorithm checkpointing (reference: rllib Algorithm.save /
+Algorithm.from_checkpoint, algorithms/algorithm.py — what Tune uses to
+pause/clone/restore RL trials).
+
+Each algorithm declares `_ckpt_attrs`: the attribute names that fully
+determine learner state (parameter pytrees, optimizer state, counters).
+save() writes them host-side (device_get) as one pickle; restore()
+loads them back — jit transfers arrays to device on next use.  The
+actor-side rollout workers are NOT checkpointed: they hold no learned
+state beyond the weights the next broadcast resends, matching the
+reference's learner-centric checkpoint layout.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+
+class RLCheckpointMixin:
+    _ckpt_attrs: tuple = ()
+
+    def save(self, path: str) -> str:
+        """Write learner state; `path` is a directory (created)."""
+        import jax
+        os.makedirs(path, exist_ok=True)
+        state = {name: jax.device_get(getattr(self, name))
+                 for name in self._ckpt_attrs}
+        state["__class__"] = type(self).__name__
+        blob = pickle.dumps(state, protocol=5)
+        out = os.path.join(path, "algorithm_state.pkl")
+        tmp = out + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, out)
+        return out
+
+    def restore(self, path: str) -> None:
+        """Load state written by save(); accepts the directory or the
+        state file itself."""
+        if os.path.isdir(path):
+            path = os.path.join(path, "algorithm_state.pkl")
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        cls = state.pop("__class__", type(self).__name__)
+        if cls != type(self).__name__:
+            raise ValueError(
+                f"checkpoint was written by {cls}, not "
+                f"{type(self).__name__}")
+        for name, value in state.items():
+            setattr(self, name, value)

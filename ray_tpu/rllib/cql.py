@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from ray_tpu.rllib.checkpoint import RLCheckpointMixin
+
 from ray_tpu.rllib.env import PendulumEnv
 from ray_tpu.rllib.sac import (actor_forward, init_sac, q_value,
                                sample_action)
@@ -186,13 +188,23 @@ class CQLConfig:
         return CQL(self)
 
 
-class CQL:
+class CQL(RLCheckpointMixin):
     """Offline learner: parquet transitions in, policy out — no env.
 
     Continuous-action transitions need columns obs / action
     (list<float>), reward, next_obs, done (the interchange schema of
     offline.log_transitions extended with next_obs).
     """
+
+    _ckpt_attrs = ("_state", "iteration")
+
+    def restore(self, path: str) -> None:
+        super().restore(path)
+        # actor/qs are derived mirrors of _state (train() refreshes
+        # them); re-derive so compute_action/mean_q work immediately
+        # after restore without one extra train() call.
+        self.actor = self._state[0]
+        self.qs = self._state[1]
 
     def __init__(self, config: CQLConfig) -> None:
         import jax

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.checkpoint import RLCheckpointMixin
 from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
 from ray_tpu.rllib.ppo import init_policy
 
@@ -194,7 +195,9 @@ class DQNConfig:
         return DQN(self)
 
 
-class DQN:
+class DQN(RLCheckpointMixin):
+    _ckpt_attrs = ("params", "target_params", "opt_state",
+                   "iteration")
     def __init__(self, config: DQNConfig) -> None:
         import jax
         import optax

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.checkpoint import RLCheckpointMixin
 from ray_tpu.rllib.env import CartPoleEnv, PixelCartPoleEnv, VectorEnv
 from ray_tpu.rllib.ppo import init_policy, policy_forward
 
@@ -264,10 +265,12 @@ class IMPALAConfig:
         return IMPALA(self)
 
 
-class IMPALA:
+class IMPALA(RLCheckpointMixin):
     """Async actor-learner driver: workers stream rollout batches into
     a learner queue (core streaming generators); the learner applies
     V-trace updates as batches arrive and broadcasts weights back."""
+
+    _ckpt_attrs = ("params", "opt_state", "updates")
 
     def __init__(self, config: IMPALAConfig) -> None:
         import jax

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.checkpoint import RLCheckpointMixin
 from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
 from ray_tpu.rllib.ppo import init_policy, policy_forward
 
@@ -194,11 +195,13 @@ class MARWILConfig:
         return MARWIL(self)
 
 
-class MARWIL:
+class MARWIL(RLCheckpointMixin):
     """Monotonic advantage re-weighted imitation learning from logged
     transitions — imitates GOOD actions more than bad ones, so it
     beats BC on mixed-quality data (reference:
     rllib/algorithms/marwil)."""
+
+    _ckpt_attrs = ("params", "opt_state", "iteration")
 
     def __init__(self, config: MARWILConfig) -> None:
         import jax
@@ -297,10 +300,12 @@ class BCConfig:
         return BC(self)
 
 
-class BC:
+class BC(RLCheckpointMixin):
     """Behavior cloning from logged parquet transitions (reference:
     rllib/algorithms/bc/bc.py trained purely from offline data via
     the Data-backed reader, rllib/offline/dataset_reader.py)."""
+
+    _ckpt_attrs = ("params", "opt_state", "iteration")
 
     def __init__(self, config: BCConfig) -> None:
         import jax

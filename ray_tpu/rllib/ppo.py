@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.checkpoint import RLCheckpointMixin
 from ray_tpu.rllib.env import CartPoleEnv, VectorEnv
 
 
@@ -255,9 +256,11 @@ class PPOConfig:
         return PPO(self)
 
 
-class PPO:
+class PPO(RLCheckpointMixin):
     """Trainer: parallel actor sampling + one jit'd learner update per
     train() (reference: Algorithm.train result dict)."""
+
+    _ckpt_attrs = ("params", "opt_state", "iteration")
 
     def __init__(self, config: PPOConfig) -> None:
         import jax
@@ -317,6 +320,13 @@ class PPO:
             "time_this_iter_s": time.time() - t0,
             **{k: float(v) for k, v in metrics.items()},
         }
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        """Greedy action for one observation (reference:
+        Algorithm.compute_single_action)."""
+        import jax.numpy as jnp
+        logits, _ = policy_forward(self.params, jnp.asarray(obs[None]))
+        return int(np.argmax(np.asarray(logits[0])))
 
     def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
         """Greedy-policy evaluation on a fresh env."""

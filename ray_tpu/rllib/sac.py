@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib.checkpoint import RLCheckpointMixin
 from ray_tpu.rllib.dqn import ReplayBuffer
 from ray_tpu.rllib.env import PendulumEnv, VectorEnv
 
@@ -279,7 +280,10 @@ class SACConfig:
         return SAC(self)
 
 
-class SAC:
+class SAC(RLCheckpointMixin):
+    _ckpt_attrs = ("actor", "qs", "target_qs", "log_alpha",
+                   "_a_opt", "_c_opt", "_al_opt", "iteration")
+
     def __init__(self, config: SACConfig) -> None:
         import jax
         import optax
