@@ -175,14 +175,26 @@ class StandardAutoscaler:
                            for m in nodes):
                     unfulfilled.append(shape)
             pg_demand.extend(load.get("pg_demand") or [])
-        # Programmatic floor (sdk.request_resources): every requested
-        # bundle goes into the pack unfiltered — place() charges
-        # existing capacity bundle by bundle, so N identical bundles
-        # consume N existing slots before any fresh node is counted
-        # (a per-bundle "does it fit somewhere" prefilter would let
-        # one free slot satisfy all N).
+        # Programmatic floor (sdk.request_resources): a CLUSTER-SIZE
+        # floor, so bundles pack against node TOTALS (a busy node still
+        # counts — reference semantics; packing against avail would
+        # over-provision during every busy period), charging pool by
+        # pool so N identical bundles need N slots.  Nodes the floor
+        # occupies are protected from idle scale-down below — without
+        # that, pre-provisioned capacity churns launch/reap forever.
         from ray_tpu.autoscaler.sdk import requested_resources_from_kv
-        unfulfilled.extend(requested_resources_from_kv(self._gcs))
+        floor_protected: set = set()
+        floor_pools = [(bytes(n["node_id"]),
+                        dict(n["resources_total"])) for n in nodes]
+        for shape in sorted(requested_resources_from_kv(self._gcs),
+                            key=lambda s: -sum(s.values())):
+            for nid, pool in floor_pools:
+                if _fits(pool, shape):
+                    _charge(pool, shape)
+                    floor_protected.add(nid)
+                    break
+            else:
+                unfulfilled.append(shape)
         if time.time() - self._last_launch >= self.launch_cooldown_s:
             # Gang demand on a slice provider: whole slices, atomically.
             if isinstance(self.provider, TpuSliceProvider):
@@ -285,6 +297,8 @@ class StandardAutoscaler:
                         <= self.min_workers:
                     break
                 nid = self.provider.node_cluster_id(name)
+                if nid in floor_protected:
+                    continue   # held by a request_resources floor
                 info = by_id.get(nid)
                 if info is None:
                     continue            # not registered yet: young node

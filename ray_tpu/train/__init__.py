@@ -9,7 +9,8 @@ CPU-only trainer workers don't pay the jax import.
 """
 
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
-from ray_tpu.train.session import get_context, report
+from ray_tpu.train.session import (get_context,
+                                   get_dataset_shard, report)
 from ray_tpu.train.trainer import (CheckpointConfig, DataParallelTrainer,
                                    FailureConfig, Result, RunConfig,
                                    ScalingConfig, TpuTrainer)
@@ -25,7 +26,7 @@ def __getattr__(name):
 
 
 __all__ = [
-    "Checkpoint", "CheckpointManager", "get_context", "report",
+    "Checkpoint", "CheckpointManager", "get_context", "get_dataset_shard", "report",
     "CheckpointConfig", "DataParallelTrainer", "FailureConfig", "Result",
     "RunConfig", "ScalingConfig", "TpuTrainer", "CompiledTrainStep",
     "TrainState", "make_optimizer",

@@ -27,7 +27,10 @@ class TrainContext:
     def __init__(self, world_size: int, world_rank: int,
                  trial_dir: str, restore_checkpoint: Optional[str],
                  config: Dict[str, Any],
-                 report_ns: Optional[str] = None) -> None:
+                 report_ns: Optional[str] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None
+                 ) -> None:
+        self._dataset_shards = dict(dataset_shards or {})
         self._world_size = world_size
         self._world_rank = world_rank
         self._trial_dir = trial_dir
@@ -102,3 +105,16 @@ def report(metrics: Dict[str, Any],
            checkpoint: Optional[Checkpoint] = None) -> None:
     """Module-level convenience mirroring ray.train.report."""
     get_context().report(metrics, checkpoint)
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's DataIterator for a Dataset passed to
+    TpuTrainer(datasets={name: ds}) (reference:
+    ray.train.get_dataset_shard)."""
+    ctx = get_context()
+    shards = getattr(ctx, "_dataset_shards", None) or {}
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard named {name!r}; trainer datasets: "
+            f"{sorted(shards)}")
+    return shards[name]

@@ -76,11 +76,13 @@ class _TrainWorker:
     def __init__(self, rank: int, world_size: int, trial_dir: str,
                  config: Dict[str, Any],
                  restore_checkpoint: Optional[str],
-                 report_ns: str) -> None:
+                 report_ns: str,
+                 dataset_shards: Optional[Dict[str, Any]] = None
+                 ) -> None:
         self._ctx = session_mod.TrainContext(
             world_size=world_size, world_rank=rank, trial_dir=trial_dir,
             restore_checkpoint=restore_checkpoint, config=config,
-            report_ns=report_ns)
+            report_ns=report_ns, dataset_shards=dataset_shards)
         session_mod.set_context(self._ctx)
 
     def run(self, fn_and_cfg) -> Optional[str]:
@@ -103,11 +105,17 @@ class TpuTrainer:
                  *,
                  train_loop_config: Optional[Dict[str, Any]] = None,
                  scaling_config: Optional[ScalingConfig] = None,
-                 run_config: Optional[RunConfig] = None) -> None:
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None) -> None:
         self._fn = train_loop_per_worker
         self._config = train_loop_config
         self._scaling = scaling_config or ScalingConfig()
         self._run_config = run_config or RunConfig()
+        # Named Datasets shard to workers via streaming_split; inside
+        # the loop, session.get_dataset_shard(name) yields this rank's
+        # DataIterator (reference: DataParallelTrainer datasets= +
+        # ray.train.get_dataset_shard).
+        self._datasets = datasets or {}
 
     # ------------------------------------------------------------------
     def fit(self) -> Result:
@@ -169,12 +177,24 @@ class TpuTrainer:
             actor_opts["resources"] = resources
         report_ns = f"train_reports/{trial_dir}/{attempt}"
 
+        # One streaming execution per named dataset, n per-rank feeds.
+        # equal=True: SPMD training needs every rank to see the same
+        # number of batches, or the stragglers hang in collectives —
+        # work-stealing (equal=False) is for throughput consumers.
+        shard_lists = {name: ds.streaming_split(s.num_workers,
+                                                equal=True)
+                       for name, ds in self._datasets.items()}
+        coordinators = [its[0]._coord
+                        for its in shard_lists.values() if its]
         workers = []
         for rank in range(s.num_workers):
             cls = (_TrainWorker.options(**actor_opts) if actor_opts
                    else _TrainWorker)
+            shards = {name: its[rank]
+                      for name, its in shard_lists.items()}
             w = cls.remote(rank, s.num_workers, trial_dir,
-                           self._config or {}, restore, report_ns)
+                           self._config or {}, restore, report_ns,
+                           shards)
             workers.append(w)
 
         run_refs = [w.run.remote((self._fn, self._config))
@@ -196,9 +216,12 @@ class TpuTrainer:
             self._drain(report_ns, manager, history)
             raise
         finally:
-            for w in workers:
+            # Coordinators too: each fit attempt spawns one per
+            # dataset, and leaked ones pin their streaming execution's
+            # block refs for the life of the cluster.
+            for a in workers + coordinators:
                 try:
-                    ray_tpu.kill(w)
+                    ray_tpu.kill(a)
                 except Exception:
                     pass
 

@@ -17,7 +17,7 @@ from ray_tpu.train import (Checkpoint, CheckpointManager, FailureConfig,
 
 
 def test_trainer_basic(ray_start, tmp_path):
-    def loop(config):
+    def loop(config=None):
         from ray_tpu.train import session
         ctx = session.get_context()
         assert ctx.get_world_size() == 2
@@ -36,7 +36,7 @@ def test_trainer_basic(ray_start, tmp_path):
 
 
 def test_trainer_checkpointing(ray_start, tmp_path):
-    def loop(config):
+    def loop(config=None):
         from ray_tpu.train import session
         ctx = session.get_context()
         for step in range(3):
@@ -60,7 +60,7 @@ def test_trainer_checkpointing(ray_start, tmp_path):
 
 
 def test_trainer_user_error_surfaces(ray_start, tmp_path):
-    def loop(config):
+    def loop(config=None):
         raise RuntimeError("train loop exploded")
 
     trainer = TpuTrainer(
@@ -75,7 +75,7 @@ def test_trainer_user_error_surfaces(ray_start, tmp_path):
 def test_trainer_failure_restart_from_checkpoint(ray_start, tmp_path):
     marker = str(tmp_path / "crashed_once")
 
-    def loop(config):
+    def loop(config=None):
         from ray_tpu.train import session
         ctx = session.get_context()
         start = 0
@@ -144,3 +144,48 @@ def test_checkpoint_manager_same_path_reregister(tmp_path):
     assert os.path.exists(p)
     assert mgr.latest_checkpoint.path == p
     assert len(mgr.list_checkpoints()) == 1
+
+
+def test_trainer_dataset_shards(ray_start, tmp_path):
+    """TpuTrainer(datasets=...) shards a streaming Dataset across
+    workers; session.get_dataset_shard yields this rank's iterator
+    (reference: DataParallelTrainer datasets= +
+    ray.train.get_dataset_shard)."""
+    import numpy as np
+    from ray_tpu import data as rdata
+    from ray_tpu.train import session
+
+    ds = rdata.range(400, block_rows=50)
+
+    def loop(config=None):
+        import json
+        ctx = session.get_context()
+        it = session.get_dataset_shard("train")
+        ids = []
+        for batch in it.iter_batches(batch_size=32):
+            ids.extend(int(i) for i in batch["id"])
+        # Rank-0 metrics are authoritative in history (reference
+        # semantics); per-rank coverage lands in the trial dir.
+        with open(os.path.join(ctx.get_trial_dir(),
+                               f"rows_{ctx.get_world_rank()}.json"),
+                  "w") as f:
+            json.dump(ids, f)
+        session.report({"rows": len(ids)})
+
+    trainer = TpuTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="shards", storage_path=str(tmp_path)),
+        datasets={"train": ds})
+    result = trainer.fit()
+    assert result.error is None
+    # equal=True: both ranks see exactly half (8 blocks -> 4 + 4).
+    assert result.metrics_dataframe[-1]["rows"] == 200
+    import json
+    all_ids = []
+    for rank in (0, 1):
+        with open(os.path.join(result.path,
+                               f"rows_{rank}.json")) as f:
+            ids = json.load(f)
+        assert len(ids) == 200
+        all_ids.extend(ids)
+    assert sorted(all_ids) == list(__import__("builtins").range(400))
