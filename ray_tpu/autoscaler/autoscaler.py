@@ -256,6 +256,13 @@ class StandardAutoscaler:
             for sname in list(self.provider.list_slices()):
                 members = self.provider.slice_nodes(sname)
                 slice_members.update(members)
+                if any(self.provider.node_cluster_id(m)
+                       in floor_protected for m in members):
+                    # A request_resources floor packed onto this
+                    # slice: hold it even when idle — losing it on a
+                    # gangs-only pool is unrecoverable until new gang
+                    # demand appears.
+                    continue
                 idle = []
                 for m in members:
                     info = by_id.get(self.provider.node_cluster_id(m))

@@ -105,7 +105,9 @@ def deployment(_cls: Optional[type] = None, *,
                num_replicas: int = 1,
                max_concurrent_queries: int = 8,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               autoscaling_config: Optional[Dict[str, Any]] = None):
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               health_check_period_s: float = 10.0,
+               health_check_timeout_s: float = 30.0):
     """@serve.deployment decorator (reference: serve/api.py).
 
     `autoscaling_config` (reference: serve/config.py AutoscalingConfig)
@@ -120,6 +122,8 @@ def deployment(_cls: Optional[type] = None, *,
             "ray_actor_options": dict(ray_actor_options or {}),
             "autoscaling_config": (dict(autoscaling_config)
                                    if autoscaling_config else None),
+            "health_check_period_s": health_check_period_s,
+            "health_check_timeout_s": health_check_timeout_s,
         })
 
     if _cls is not None:
@@ -299,7 +303,9 @@ def _deploy_one(controller, name: str, dep: Deployment,
         name, blob, init_args, init_kwargs,
         opts.get("num_replicas", 1),
         opts.get("max_concurrent_queries", 8),
-        actor_opts, opts.get("autoscaling_config")), timeout=120)
+        actor_opts, opts.get("autoscaling_config"),
+        opts.get("health_check_period_s", 10.0),
+        opts.get("health_check_timeout_s", 30.0)), timeout=120)
 
 
 def run(target: Deployment, *, name: Optional[str] = None

@@ -51,8 +51,9 @@ class ServeController:
                init_kwargs: dict, num_replicas: int,
                max_concurrent_queries: int,
                actor_options: Optional[Dict[str, Any]] = None,
-               autoscaling_config: Optional[Dict[str, Any]] = None
-               ) -> int:
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               health_check_period_s: float = 10.0,
+               health_check_timeout_s: float = 30.0) -> int:
         """Create or update a deployment; reconciles synchronously and
         returns the new version.  Changed code/args/options replace
         every running replica (the reference's version-driven replica
@@ -62,13 +63,16 @@ class ServeController:
             return self._deploy_locked(
                 name, cls_blob, init_args, init_kwargs, num_replicas,
                 max_concurrent_queries, actor_options,
-                autoscaling_config)
+                autoscaling_config, health_check_period_s,
+                health_check_timeout_s)
         finally:
             self._state_lock.release()
 
     def _deploy_locked(self, name, cls_blob, init_args, init_kwargs,
                        num_replicas, max_concurrent_queries,
-                       actor_options, autoscaling_config) -> int:
+                       actor_options, autoscaling_config,
+                       health_check_period_s=10.0,
+                       health_check_timeout_s=30.0) -> int:
         d = self._deployments.get(name)
         if d is None:
             d = {"replicas": [], "version": 0}
@@ -92,9 +96,13 @@ class ServeController:
                                    asc["max_replicas"]))
         d.update(new_state, num_replicas=num_replicas,
                  autoscaling=asc,
+                 health_check_period_s=health_check_period_s,
+                 health_check_timeout_s=health_check_timeout_s,
                  _scale_pressure_since=None)
         if asc is not None:
             self._ensure_autoscale_loop()
+        if health_check_period_s:
+            self._ensure_health_loop()
         if changed and d["replicas"]:
             old, d["replicas"] = d["replicas"], []
             self._stop_replicas(old)
@@ -209,7 +217,13 @@ class ServeController:
                     and v is not None}
             for i in range(want - have):
                 h = cls.options(
-                    max_concurrency=max(d["max_concurrent_queries"], 1),
+                    # +2 headroom over the router's request cap: the
+                    # controller's check_health/queue_len probes must
+                    # never queue behind a saturated request pool, or
+                    # a fully-loaded healthy replica would miss its
+                    # health deadline and be killed at peak load.
+                    max_concurrency=max(d["max_concurrent_queries"], 1)
+                    + 2,
                     max_restarts=2, **opts,
                 ).remote(name, d["blob"], d["init_args"],
                          d["init_kwargs"])
@@ -230,6 +244,88 @@ class ServeController:
     # runs the autoscaling policy (serve/_private/autoscaling_state.py,
     # serve/autoscaling_policy.py): desired = total_ongoing / target,
     # clamped to [min, max], with upscale/downscale smoothing delays.
+    def _ensure_health_loop(self) -> None:
+        """Active replica health probing (reference:
+        deployment_state.py health checking: the controller calls
+        check_health on every replica each period; a probe that errors
+        or times out replaces the replica)."""
+        import threading
+        if getattr(self, "_health_thread", None) is not None:
+            return
+
+        def loop() -> None:
+            import time
+
+            import ray_tpu
+            pending: dict = {}   # (name, actor_id) -> (ref, deadline)
+            while True:
+                try:
+                    self._health_tick(pending)
+                except Exception:
+                    pass   # transient control-plane error: keep probing
+                time.sleep(self._health_period(pending))
+
+        self._health_thread = threading.Thread(
+            target=loop, daemon=True, name="rtpu-serve-health")
+        self._health_thread.start()
+
+    def _health_period(self, pending) -> float:
+        with self._state_lock:
+            periods = [d.get("health_check_period_s")
+                       for d in self._deployments.values()
+                       if d.get("health_check_period_s")]
+        return min(periods) if periods else 10.0
+
+    def _health_tick(self, pending: dict) -> None:
+        """One probe round: launch check_health on unprobed replicas,
+        harvest completions, replace failures/timeouts."""
+        import time
+
+        import ray_tpu
+        with self._state_lock:
+            targets = []
+            for name, d in self._deployments.items():
+                if not d.get("health_check_period_s"):
+                    continue
+                for r in d["replicas"]:
+                    targets.append(
+                        (name, r,
+                         d.get("health_check_timeout_s", 30.0)))
+        now = time.time()
+        for name, r, tmo in targets:
+            key = (name, r._actor_id)
+            if key not in pending:
+                try:
+                    pending[key] = (r.check_health.remote(),
+                                    now + tmo, r)
+                except Exception:
+                    self.report_replica_failure(name, r._actor_id)
+        for key in list(pending):
+            ref, deadline, r = pending[key]
+            ready, _ = ray_tpu.wait([ref], timeout=0)
+            if ready:
+                del pending[key]
+                try:
+                    ok = ray_tpu.get(ref)
+                except Exception:
+                    ok = False
+                if not ok:
+                    self._replace_unhealthy(key[0], r)
+            elif time.time() > deadline:
+                del pending[key]
+                self._replace_unhealthy(key[0], r)
+
+    def _replace_unhealthy(self, name: str, replica) -> None:
+        """Failed health probe: the actor may still be alive (hung or
+        self-reported unhealthy) — kill it so the replacement does not
+        share the chip/port, then backfill."""
+        import ray_tpu
+        try:
+            ray_tpu.kill(replica)
+        except Exception:
+            pass
+        self.report_replica_failure(name, replica._actor_id)
+
     def _ensure_autoscale_loop(self) -> None:
         import threading
         if self._autoscale_thread is not None:

@@ -56,6 +56,18 @@ class Replica:
             self._inflight -= 1
             self._served += 1
 
+    def check_health(self) -> bool:
+        """Controller-probed liveness (reference: replica.py
+        check_health + user-defined check_health on the deployment
+        class).  A user `check_health` that raises or returns False
+        marks the replica unhealthy; absent one, reaching the actor at
+        all is the health signal."""
+        user_check = getattr(self._user, "check_health", None)
+        if user_check is None:
+            return True
+        out = user_check()
+        return True if out is None else bool(out)
+
     def queue_len(self) -> int:
         """Probed by the pow-2 router (reference: replica queue-length
         probing in pow_2_scheduler.py)."""

@@ -385,3 +385,43 @@ def test_declarative_yaml_app_graph(serve_session, tmp_path):
         assert serve.status()["M"]["target_replicas"] == 2
     finally:
         sys.path.remove(str(tmp_path))
+
+
+def test_active_health_check_replaces_replica(serve_session):
+    """Controller-driven health probing: a replica whose check_health
+    turns false is killed and backfilled (reference:
+    deployment_state.py active health checks)."""
+    import time
+
+    @serve.deployment(num_replicas=1, health_check_period_s=0.2,
+                      health_check_timeout_s=5.0)
+    class Flaky:
+        def __init__(self):
+            self.poisoned = False
+
+        def poison(self):
+            self.poisoned = True
+            return "poisoned"
+
+        def check_health(self):
+            return not self.poisoned
+
+        def who(self):
+            return id(self)
+
+    handle = serve.run(Flaky.bind(), name="flaky")
+    first = ray_tpu.get(handle.who.remote())
+    assert ray_tpu.get(handle.poison.remote()) == "poisoned"
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            cur = ray_tpu.get(handle.who.remote())
+            if cur != first:
+                break
+        except Exception:
+            pass          # mid-replacement window
+        time.sleep(0.2)
+    else:
+        raise AssertionError("unhealthy replica never replaced")
+    # The replacement is healthy and stays.
+    assert ray_tpu.get(handle.who.remote()) != first
