@@ -308,8 +308,8 @@ def _deploy_one(controller, name: str, dep: Deployment,
         opts.get("health_check_timeout_s", 30.0)), timeout=120)
 
 
-def run(target: Deployment, *, name: Optional[str] = None
-        ) -> DeploymentHandle:
+def run(target: Deployment, *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
     """Deploy an application — a single Deployment or a whole
     nested-``.bind()`` graph — and return a handle to the root once
     replicas exist (reference: serve.run, serve/api.py:494).
@@ -317,14 +317,22 @@ def run(target: Deployment, *, name: Optional[str] = None
     Bound ``Deployment`` objects anywhere inside the root's init args
     (including in lists/dicts) are deployed first and replaced with
     ``DeploymentHandle``s, so a composed app (ingress -> models) goes
-    up in one call."""
+    up in one call.  ``route_prefix`` claims an HTTP path prefix on
+    the proxy for the root deployment (reference: route_prefix)."""
+    import ray_tpu
+    if route_prefix is not None and not route_prefix.startswith("/"):
+        raise ValueError("route_prefix must start with '/'")
     controller = _get_or_create_controller()
     plan = build(target, name=name)
     for _, dep, _, _ in plan:       # validate before ANY deploy lands
         _validate_opts(dep)
     for dep_name, dep, args, kwargs in plan:
         _deploy_one(controller, dep_name, dep, args, kwargs)
-    return DeploymentHandle(plan[-1][0])
+    root = plan[-1][0]
+    if route_prefix is not None:
+        ray_tpu.get(controller.set_route.remote(route_prefix, root),
+                    timeout=60)
+    return DeploymentHandle(root)
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:

@@ -40,6 +40,9 @@ class ServeController:
         # Guards deployment state: the autoscale daemon thread mutates
         # it concurrently with actor-method execution.
         self._state_lock = threading.RLock()
+        # route prefix -> root deployment (reference: route_prefix on
+        # the ingress deployment, serve/_private/proxy.py routing)
+        self._routes: Dict[str, str] = {}
         # Long-poll push (reference: serve/_private/long_poll.py:64):
         # routers park wait_for_update calls on this condition; every
         # version bump notifies them.  Requires the controller actor to
@@ -112,14 +115,35 @@ class ServeController:
         self._notify_update()
         return d["version"]
 
+    def set_route(self, prefix: str, name: str) -> None:
+        if not prefix.startswith("/"):
+            raise ValueError("route_prefix must start with '/'")
+        with self._state_lock:
+            # One prefix per app root: re-running with a new prefix
+            # must retire the old one, or clients on the stale path
+            # would silently reach the new code.
+            self._drop_routes_locked(name)
+            self._routes[prefix.rstrip("/") or "/"] = name
+            self._version += 1
+            self._notify_update()
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._state_lock:
+            return dict(self._routes)
+
     def delete(self, name: str) -> bool:
         with self._state_lock:
             return self._delete_locked(name)
+
+    def _drop_routes_locked(self, name: str) -> None:
+        for prefix in [p for p, n in self._routes.items() if n == name]:
+            del self._routes[prefix]
 
     def _delete_locked(self, name: str) -> bool:
         d = self._deployments.pop(name, None)
         if d is None:
             return False
+        self._drop_routes_locked(name)
         self._stop_replicas(d["replicas"])
         self._version += 1
         self._notify_update()
@@ -257,19 +281,20 @@ class ServeController:
             import time
 
             import ray_tpu
-            pending: dict = {}   # (name, actor_id) -> (ref, deadline)
+            # (name, actor_id) -> (probe ref, deadline, replica)
+            pending: dict = {}
             while True:
                 try:
                     self._health_tick(pending)
                 except Exception:
                     pass   # transient control-plane error: keep probing
-                time.sleep(self._health_period(pending))
+                time.sleep(self._health_period())
 
         self._health_thread = threading.Thread(
             target=loop, daemon=True, name="rtpu-serve-health")
         self._health_thread.start()
 
-    def _health_period(self, pending) -> float:
+    def _health_period(self) -> float:
         with self._state_lock:
             periods = [d.get("health_check_period_s")
                        for d in self._deployments.values()

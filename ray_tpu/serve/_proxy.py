@@ -25,6 +25,7 @@ deploys with no proxy restart, the LongPoll role)."""
 from __future__ import annotations
 
 import json
+import time as _time
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
@@ -77,7 +78,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self.wfile.write(b"0\r\n\r\n")
         self.wfile.flush()
 
-    def _route(self, arg: Any) -> None:
+    def _route(self, arg: Any) -> None:  # noqa: C901
         import ray_tpu
         from ray_tpu import serve
 
@@ -93,14 +94,43 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                 controller = ray_tpu.get_actor(CONTROLLER_NAME)
                 names = ray_tpu.get(controller.status.remote(),
                                     timeout=30)
+                routes = ray_tpu.get(controller.get_routes.remote(),
+                                     timeout=30)
             except ValueError:
-                names = {}
-            self._send(200, {f"/{name}": name for name in names})
+                names, routes = {}, {}
+            out = {f"/{name}": name for name in names}
+            out.update(routes)
+            self._send(200, out)
             return
-        if not parts:
-            self._send(404, {"error": "no deployment in path"})
-            return
-        name, method = parts[0], (parts[1] if len(parts) > 1 else None)
+        # route_prefix resolution FIRST (it may claim the bare root
+        # path): longest registered prefix wins; the next path segment
+        # (if any) is the method.  A "/" prefix matches only the exact
+        # root path — making it a catch-all would shadow every
+        # name-based route.  Falls through to name routing otherwise.
+        name = method = None
+        routes = _cached_routes()
+        if routes:
+            probe = parsed.path.rstrip("/") or "/"
+            best = None
+            for prefix in routes:
+                norm = prefix.rstrip("/") or "/"
+                if norm == "/":
+                    if probe == "/" and best is None:
+                        best, name, method = norm, routes[prefix], None
+                    continue
+                if (probe == norm or probe.startswith(norm + "/")) \
+                        and len(norm) > len(best or ""):
+                    best = norm
+                    name = routes[prefix]
+                    rest = [p for p in
+                            probe[len(norm):].split("/") if p]
+                    method = rest[0] if rest else None
+        if name is None:
+            if not parts:
+                self._send(404, {"error": "no deployment in path"})
+                return
+            name, method = parts[0], (parts[1] if len(parts) > 1
+                                      else None)
         query = dict(parse_qsl(parsed.query))
         stream = (query.pop("stream", "") in ("1", "true")
                   or "text/event-stream"
@@ -178,3 +208,24 @@ def stop() -> None:
         if _server is not None:
             _server.shutdown()
             _server = None
+
+
+
+_ROUTES_CACHE: dict = {"at": 0.0, "routes": {}}
+
+
+def _cached_routes(ttl: float = 2.0) -> dict:
+    """Proxy-side route table with a short TTL: one controller RPC per
+    TTL window, not per request."""
+    import ray_tpu
+    now = _time.time()
+    if now - _ROUTES_CACHE["at"] < ttl:
+        return _ROUTES_CACHE["routes"]
+    from ray_tpu.serve._controller import CONTROLLER_NAME
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        routes = ray_tpu.get(controller.get_routes.remote(), timeout=10)
+    except Exception:
+        routes = _ROUTES_CACHE["routes"]   # stale beats broken
+    _ROUTES_CACHE.update(at=now, routes=routes)
+    return routes

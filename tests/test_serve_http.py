@@ -105,3 +105,38 @@ def test_grpc_proxy_unary_and_stream(rt):
     assert msgs[:3] == [{"item": 0}, {"item": 7}, {"item": 14}]
     assert msgs[-1] == {"end": True}
     ch.close()
+
+
+def test_route_prefix(rt):
+    """serve.run(..., route_prefix=...) claims an HTTP prefix on the
+    proxy; longest prefix wins and /-/routes lists it (reference:
+    route_prefix routing, serve/_private/proxy.py)."""
+
+    @serve.deployment
+    class Chat:
+        def __call__(self, body):
+            return {"echo": body}
+
+        def info(self, body):
+            return "chat-info"
+
+    serve.run(Chat.bind(), name="chatapp", route_prefix="/api/chat")
+    httpd = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    routes = json.loads(urllib.request.urlopen(
+        base + "/-/routes", timeout=30).read())
+    assert routes.get("/api/chat") == "chatapp"
+
+    req = urllib.request.Request(
+        base + "/api/chat", data=json.dumps({"q": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert out["result"]["echo"] == {"q": 1}
+
+    # Prefix + method segment.
+    req = urllib.request.Request(
+        base + "/api/chat/info", data=b"{}",
+        headers={"Content-Type": "application/json"})
+    out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert out["result"] == "chat-info"
