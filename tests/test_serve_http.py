@@ -128,10 +128,23 @@ def test_route_prefix(rt):
         base + "/-/routes", timeout=30).read())
     assert routes.get("/api/chat") == "chatapp"
 
-    req = urllib.request.Request(
-        base + "/api/chat", data=json.dumps({"q": 1}).encode(),
-        headers={"Content-Type": "application/json"})
-    out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    # Proxy-side route cache (2s TTL) + router replica view are
+    # eventually consistent: first request may land before either
+    # refreshes under CI load — retry briefly.
+    import time
+    deadline = time.time() + 30
+    while True:
+        req = urllib.request.Request(
+            base + "/api/chat", data=json.dumps({"q": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            out = json.loads(
+                urllib.request.urlopen(req, timeout=60).read())
+            break
+        except urllib.error.HTTPError as e:
+            if e.code != 404 or time.time() > deadline:
+                raise
+            time.sleep(0.5)
     assert out["result"]["echo"] == {"q": 1}
 
     # Prefix + method segment.
