@@ -332,6 +332,10 @@ def run(target: Deployment, *, name: Optional[str] = None,
     if route_prefix is not None:
         ray_tpu.get(controller.set_route.remote(route_prefix, root),
                     timeout=60)
+        # An in-process proxy must see the new route NOW, not after
+        # its TTL lapses — a request in that window would 404.
+        from ray_tpu.serve import _proxy
+        _proxy.invalidate_routes_cache()
     return DeploymentHandle(root)
 
 

@@ -214,6 +214,13 @@ def stop() -> None:
 _ROUTES_CACHE: dict = {"at": 0.0, "routes": {}}
 
 
+def invalidate_routes_cache() -> None:
+    """Force the next request to refetch the route table (called by
+    serve.run on route registration so same-process proxies never
+    serve a stale-404 window)."""
+    _ROUTES_CACHE["at"] = 0.0
+
+
 def _cached_routes(ttl: float = 2.0) -> dict:
     """Proxy-side route table with a short TTL: one controller RPC per
     TTL window, not per request."""

@@ -1,6 +1,7 @@
 """Serve HTTP ingress (reference: _private/proxy.py HTTPProxy)."""
 
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -128,18 +129,13 @@ def test_route_prefix(rt):
         base + "/-/routes", timeout=30).read())
     assert routes.get("/api/chat") == "chatapp"
 
-    # Proxy-side route cache (2s TTL) + router replica view are
-    # eventually consistent: first request may land before either
-    # refreshes under CI load — retry briefly.
-    import time
+    # serve.run invalidates the in-process route cache, so the route
+    # is visible immediately; the router's replica view can still be
+    # warming under CI load — retry 404s briefly.
     deadline = time.time() + 30
     while True:
-        req = urllib.request.Request(
-            base + "/api/chat", data=json.dumps({"q": 1}).encode(),
-            headers={"Content-Type": "application/json"})
         try:
-            out = json.loads(
-                urllib.request.urlopen(req, timeout=60).read())
+            out = _post(base + "/api/chat", {"q": 1})
             break
         except urllib.error.HTTPError as e:
             if e.code != 404 or time.time() > deadline:
