@@ -107,7 +107,8 @@ def deployment(_cls: Optional[type] = None, *,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
                health_check_period_s: float = 10.0,
-               health_check_timeout_s: float = 30.0):
+               health_check_timeout_s: float = 30.0,
+               user_config: Any = None):
     """@serve.deployment decorator (reference: serve/api.py).
 
     `autoscaling_config` (reference: serve/config.py AutoscalingConfig)
@@ -124,6 +125,7 @@ def deployment(_cls: Optional[type] = None, *,
                                    if autoscaling_config else None),
             "health_check_period_s": health_check_period_s,
             "health_check_timeout_s": health_check_timeout_s,
+            "user_config": user_config,
         })
 
     if _cls is not None:
@@ -297,6 +299,13 @@ def _deploy_one(controller, name: str, dep: Deployment,
                 init_args, init_kwargs) -> None:
     import ray_tpu
     opts = dep._options
+    if opts.get("user_config") is not None \
+            and not hasattr(dep._cls, "reconfigure"):
+        # Catch it HERE with the class in hand: on the worker this
+        # would be an unattributable replica crash-loop.
+        raise ValueError(
+            f"deployment {name!r} has a user_config but "
+            f"{dep._cls.__name__} defines no reconfigure() method")
     actor_opts = _validate_opts(dep)
     blob = cloudpickle.dumps(dep._cls)
     ray_tpu.get(controller.deploy.remote(
@@ -305,7 +314,8 @@ def _deploy_one(controller, name: str, dep: Deployment,
         opts.get("max_concurrent_queries", 8),
         actor_opts, opts.get("autoscaling_config"),
         opts.get("health_check_period_s", 10.0),
-        opts.get("health_check_timeout_s", 30.0)), timeout=120)
+        opts.get("health_check_timeout_s", 30.0),
+        opts.get("user_config")), timeout=120)
 
 
 def run(target: Deployment, *, name: Optional[str] = None,

@@ -56,18 +56,20 @@ class ServeController:
                actor_options: Optional[Dict[str, Any]] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
                health_check_period_s: float = 10.0,
-               health_check_timeout_s: float = 30.0) -> int:
+               health_check_timeout_s: float = 30.0,
+               user_config: Any = None) -> int:
         """Create or update a deployment; reconciles synchronously and
         returns the new version.  Changed code/args/options replace
         every running replica (the reference's version-driven replica
-        rollout, deployment_state.py)."""
+        rollout, deployment_state.py); a changed user_config alone is
+        pushed live via reconfigure() with NO replica restart."""
         self._state_lock.acquire()
         try:
             return self._deploy_locked(
                 name, cls_blob, init_args, init_kwargs, num_replicas,
                 max_concurrent_queries, actor_options,
                 autoscaling_config, health_check_period_s,
-                health_check_timeout_s)
+                health_check_timeout_s, user_config)
         finally:
             self._state_lock.release()
 
@@ -75,7 +77,8 @@ class ServeController:
                        num_replicas, max_concurrent_queries,
                        actor_options, autoscaling_config,
                        health_check_period_s=10.0,
-                       health_check_timeout_s=30.0) -> int:
+                       health_check_timeout_s=30.0,
+                       user_config=None) -> int:
         d = self._deployments.get(name)
         if d is None:
             d = {"replicas": [], "version": 0}
@@ -97,8 +100,11 @@ class ServeController:
                                min(d.get("num_replicas",
                                          asc["min_replicas"]),
                                    asc["max_replicas"]))
+        old_user_config = d.get("user_config")
+        cfg_changed = _differs(old_user_config, user_config)
         d.update(new_state, num_replicas=num_replicas,
                  autoscaling=asc,
+                 user_config=user_config,
                  health_check_period_s=health_check_period_s,
                  health_check_timeout_s=health_check_timeout_s,
                  _scale_pressure_since=None)
@@ -106,9 +112,27 @@ class ServeController:
             self._ensure_autoscale_loop()
         if health_check_period_s:
             self._ensure_health_loop()
+        if cfg_changed and user_config is None:
+            # Clearing user_config has no live representation (there
+            # is nothing to reconfigure TO): roll the replicas so
+            # every one serves the class's __init__ state — mixed
+            # configs across one version would be worse.
+            changed = True
         if changed and d["replicas"]:
             old, d["replicas"] = d["replicas"], []
             self._stop_replicas(old)
+        elif cfg_changed and d["replicas"]:
+            # user_config-only update: live reconfigure, no restart.
+            # SYNCHRONOUS — deploy() returning must mean the config is
+            # live (or the caller hears why it is not).
+            import ray_tpu
+            refs = [r.reconfigure.remote(user_config)
+                    for r in d["replicas"]]
+            try:
+                ray_tpu.get(refs, timeout=60)
+            except Exception:
+                d["user_config"] = old_user_config
+                raise
         d["version"] += 1
         self._version += 1
         self._reconcile(name)
@@ -250,7 +274,7 @@ class ServeController:
                     + 2,
                     max_restarts=2, **opts,
                 ).remote(name, d["blob"], d["init_args"],
-                         d["init_kwargs"])
+                         d["init_kwargs"], d.get("user_config"))
                 d["replicas"].append(h)
             d["version"] += 1
             self._version += 1

@@ -14,13 +14,27 @@ from typing import Any
 
 class Replica:
     def __init__(self, deployment_name: str, cls_blob: bytes,
-                 init_args: tuple, init_kwargs: dict) -> None:
+                 init_args: tuple, init_kwargs: dict,
+                 user_config=None) -> None:
         import cloudpickle
         self._name = deployment_name
         cls = cloudpickle.loads(cls_blob)
         self._user = cls(*init_args, **(init_kwargs or {}))
         self._inflight = 0
         self._served = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config) -> None:
+        """Live config push WITHOUT a replica restart (reference:
+        user_config + reconfigure(), serve/_private/replica.py) — the
+        user class must define reconfigure(cfg)."""
+        fn = getattr(self._user, "reconfigure", None)
+        if fn is None:
+            raise ValueError(
+                f"deployment class for {self._name!r} got a "
+                f"user_config but defines no reconfigure() method")
+        fn(user_config)
 
     async def handle_request(self, method: str, args: tuple,
                              kwargs: dict,

@@ -425,3 +425,45 @@ def test_active_health_check_replaces_replica(serve_session):
         raise AssertionError("unhealthy replica never replaced")
     # The replacement is healthy and stays.
     assert ray_tpu.get(handle.who.remote()) != first
+
+
+def test_user_config_reconfigure_without_restart(serve_session):
+    """A user_config-only redeploy pushes reconfigure() to live
+    replicas with NO restart; code changes still roll replicas
+    (reference: user_config, serve/_private/replica.py)."""
+    import time
+
+    @serve.deployment(user_config={"threshold": 1})
+    class Tunable:
+        def __init__(self):
+            self.threshold = None
+            self.birth = time.time()
+
+        def reconfigure(self, cfg):
+            self.threshold = cfg["threshold"]
+
+        def __call__(self, x):
+            return {"over": x > self.threshold, "birth": self.birth}
+
+    handle = serve.run(Tunable.bind(), name="tun")
+    first = ray_tpu.get(handle.remote(5))
+    assert first["over"] is True
+    birth = first["birth"]
+
+    # user_config-only update: SYNCHRONOUS — the config is live when
+    # serve.run returns; same instance, new threshold.
+    serve.run(Tunable.options(user_config={"threshold": 10}).bind(),
+              name="tun")
+    out = ray_tpu.get(handle.remote(5))
+    assert out["over"] is False, out
+    assert out["birth"] == birth      # replica was NOT restarted
+
+    # A user_config on a class without reconfigure() fails at deploy
+    # time, client-side, before anything lands.
+    @serve.deployment(user_config={"x": 1})
+    class NoReconf:
+        def __call__(self, v):
+            return v
+
+    with __import__("pytest").raises(ValueError):
+        serve.run(NoReconf.bind(), name="noreconf")
