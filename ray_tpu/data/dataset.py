@@ -661,22 +661,17 @@ class Dataset:
     # ------------------------------------------------------------------
     def iter_batches(self, batch_size: int = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Batch]:
-        carry: Optional[B.Block] = None
-        for blk in self._iter_blocks():
-            if carry is not None:
-                blk = B.block_concat([carry, blk])
-                carry = None
-            n = B.block_num_rows(blk)
-            i = 0
-            while n - i >= batch_size:
-                out = B.block_slice(blk, i, i + batch_size)
-                yield self._format(out, batch_format)
-                i += batch_size
-            if i < n:
-                carry = B.block_slice(blk, i, n)
-        if carry is not None and not drop_last:
-            yield self._format(carry, batch_format)
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Batch]:
+        """Streaming batches; `local_shuffle_buffer_size` maintains a
+        row reservoir and samples each batch from it uniformly
+        (reference: iter_batches local shuffling — randomization
+        without a full distributed shuffle per epoch)."""
+        yield from _batches_over(
+            self._iter_blocks(), batch_size, batch_format, drop_last,
+            local_shuffle_buffer_size, local_shuffle_seed)
 
     @staticmethod
     def _format(blk: B.Block, fmt: str):
@@ -997,22 +992,13 @@ class DataIterator:
 
     def iter_batches(self, batch_size: int = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Batch]:
-        carry: Optional[B.Block] = None
-        for blk in self._iter_blocks():
-            if carry is not None:
-                blk = B.block_concat([carry, blk])
-                carry = None
-            n = B.block_num_rows(blk)
-            i = 0
-            while n - i >= batch_size:
-                yield Dataset._format(
-                    B.block_slice(blk, i, i + batch_size), batch_format)
-                i += batch_size
-            if i < n:
-                carry = B.block_slice(blk, i, n)
-        if carry is not None and not drop_last:
-            yield Dataset._format(carry, batch_format)
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Batch]:
+        yield from _batches_over(
+            self._iter_blocks(), batch_size, batch_format, drop_last,
+            local_shuffle_buffer_size, local_shuffle_seed)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for blk in self._iter_blocks():
@@ -1061,6 +1047,62 @@ class GroupedData:
         """aggregate(out_name=("sum", "col"), ...)"""
         return self._agg([(agg, col, out)
                           for out, (agg, col) in aggs.items()])
+
+
+def _batches_over(blocks: Iterator[B.Block], batch_size: int,
+                  batch_format: str, drop_last: bool,
+                  shuffle_buffer: Optional[int],
+                  shuffle_seed: Optional[int]) -> Iterator[Batch]:
+    """Shared batching core for Dataset.iter_batches and
+    DataIterator.iter_batches (one implementation, two entry points).
+
+    Without shuffling: a carry block re-aligns ragged block
+    boundaries.  With `shuffle_buffer`: a row reservoir emits
+    uniformly-sampled batches once it holds max(buffer, batch) rows,
+    then drains shuffled — exactly-once delivery either way."""
+    if shuffle_buffer:
+        rng = np.random.RandomState(shuffle_seed)
+        buf: Optional[B.Block] = None
+        low = max(shuffle_buffer, batch_size)
+        for blk in blocks:
+            if not B.block_num_rows(blk):
+                continue
+            buf = blk if buf is None else B.block_concat([buf, blk])
+            while B.block_num_rows(buf) >= low:
+                n = B.block_num_rows(buf)
+                pick = rng.choice(n, size=batch_size, replace=False)
+                mask = np.ones(n, bool)
+                mask[pick] = False
+                yield Dataset._format(B.block_take(buf, pick),
+                                      batch_format)
+                buf = B.block_take(buf, np.nonzero(mask)[0])
+        while buf is not None and B.block_num_rows(buf):
+            n = B.block_num_rows(buf)
+            take = min(batch_size, n)
+            if take < batch_size and drop_last:
+                break
+            pick = rng.choice(n, size=take, replace=False)
+            mask = np.ones(n, bool)
+            mask[pick] = False
+            yield Dataset._format(B.block_take(buf, pick),
+                                  batch_format)
+            buf = B.block_take(buf, np.nonzero(mask)[0])
+        return
+    carry: Optional[B.Block] = None
+    for blk in blocks:
+        if carry is not None:
+            blk = B.block_concat([carry, blk])
+            carry = None
+        n = B.block_num_rows(blk)
+        i = 0
+        while n - i >= batch_size:
+            yield Dataset._format(B.block_slice(blk, i, i + batch_size),
+                                  batch_format)
+            i += batch_size
+        if i < n:
+            carry = B.block_slice(blk, i, n)
+    if carry is not None and not drop_last:
+        yield Dataset._format(carry, batch_format)
 
 
 def _expand_paths(paths: Union[str, List[str]],

@@ -65,13 +65,35 @@ class ServeController:
         pushed live via reconfigure() with NO replica restart."""
         self._state_lock.acquire()
         try:
-            return self._deploy_locked(
+            version, push = self._deploy_locked(
                 name, cls_blob, init_args, init_kwargs, num_replicas,
                 max_concurrent_queries, actor_options,
                 autoscaling_config, health_check_period_s,
                 health_check_timeout_s, user_config)
         finally:
             self._state_lock.release()
+        if push:
+            # Synchronous config push OUTSIDE the lock (it blocks on
+            # replica RPCs; holding _state_lock here would stall
+            # health checks, failure reports, and other deploys).
+            import ray_tpu
+            try:
+                ray_tpu.get([r.reconfigure.remote(user_config)
+                             for r in push], timeout=60)
+            except Exception:
+                # Partial application would leave MIXED configs under
+                # one version: roll every replica — fresh ones build
+                # with the recorded (new) user_config, where a failure
+                # is attributable — then surface the push error.
+                with self._state_lock:
+                    d = self._deployments.get(name)
+                    if d is not None:
+                        old, d["replicas"] = d["replicas"], []
+                        self._stop_replicas(old)
+                        self._reconcile(name)
+                        self._notify_update()
+                raise
+        return version
 
     def _deploy_locked(self, name, cls_blob, init_args, init_kwargs,
                        num_replicas, max_concurrent_queries,
@@ -118,26 +140,20 @@ class ServeController:
             # every one serves the class's __init__ state — mixed
             # configs across one version would be worse.
             changed = True
+        push: list = []
         if changed and d["replicas"]:
             old, d["replicas"] = d["replicas"], []
             self._stop_replicas(old)
         elif cfg_changed and d["replicas"]:
             # user_config-only update: live reconfigure, no restart.
-            # SYNCHRONOUS — deploy() returning must mean the config is
-            # live (or the caller hears why it is not).
-            import ray_tpu
-            refs = [r.reconfigure.remote(user_config)
-                    for r in d["replicas"]]
-            try:
-                ray_tpu.get(refs, timeout=60)
-            except Exception:
-                d["user_config"] = old_user_config
-                raise
+            # The blocking push happens in deploy() AFTER the lock is
+            # released.
+            push = list(d["replicas"])
         d["version"] += 1
         self._version += 1
         self._reconcile(name)
         self._notify_update()
-        return d["version"]
+        return d["version"], push
 
     def set_route(self, prefix: str, name: str) -> None:
         if not prefix.startswith("/"):
