@@ -40,35 +40,136 @@ class ReplayBuffer:
         self.actions = np.zeros(capacity, np.int32)
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.bool_)
+        # Per-transition bootstrap discount (gamma for 1-step inserts,
+        # gamma^k for n-step folds; 0 until written).
+        self.discounts = np.zeros(capacity, np.float32)
         self.size = 0
         self._pos = 0
 
-    def add_batch(self, obs, actions, rewards, next_obs, dones) -> None:
+    def add_batch(self, obs, actions, rewards, next_obs, dones,
+                  discounts=None) -> np.ndarray:
         """Vectorized ring insert: at most two slice assignments per
-        array (split at the wrap point)."""
+        array (split at the wrap point).  Returns the written slot
+        indices (subclasses key their side arrays off them)."""
         n = len(actions)
+        if discounts is None:
+            discounts = np.zeros(n, np.float32)
         if n > self.capacity:      # keep only the newest fit
             obs, actions = obs[-self.capacity:], actions[-self.capacity:]
             rewards, dones = (rewards[-self.capacity:],
                               dones[-self.capacity:])
             next_obs = next_obs[-self.capacity:]
+            discounts = discounts[-self.capacity:]
             n = self.capacity
         first = min(n, self.capacity - self._pos)
         for dst, src in ((self.obs, obs), (self.actions, actions),
                          (self.rewards, rewards),
-                         (self.next_obs, next_obs), (self.dones, dones)):
+                         (self.next_obs, next_obs), (self.dones, dones),
+                         (self.discounts, discounts)):
             dst[self._pos:self._pos + first] = src[:first]
             if n > first:
                 dst[:n - first] = src[first:]
+        ix = (self._pos + np.arange(n)) % self.capacity
         self._pos = (self._pos + n) % self.capacity
         self.size = min(self.size + n, self.capacity)
+        return ix
 
     def sample(self, rng: np.random.RandomState, n: int) -> Dict:
         ix = rng.randint(0, self.size, size=n)
         return {"obs": self.obs[ix], "actions": self.actions[ix],
                 "rewards": self.rewards[ix],
                 "next_obs": self.next_obs[ix],
-                "dones": self.dones[ix].astype(np.float32)}
+                "dones": self.dones[ix].astype(np.float32),
+                "discounts": self.discounts[ix]}
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (reference:
+    utils/replay_buffers/prioritized_replay_buffer.py — Schaul et al.):
+    transitions sample with probability p_i^alpha / sum p^alpha, the
+    induced bias is corrected with importance weights (N*P)^-beta
+    normalized by their max, and |TD error| feeds back as the new
+    priority.  New transitions get the current max priority so every
+    transition is seen at least once."""
+
+    def __init__(self, capacity: int, obs_size: int,
+                 alpha: float = 0.6, beta: float = 0.4) -> None:
+        super().__init__(capacity, obs_size)
+        self.alpha = alpha
+        self.beta = beta
+        self.priorities = np.zeros(capacity, np.float64)
+        self._max_priority = 1.0
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones,
+                  discounts=None) -> np.ndarray:
+        ix = super().add_batch(obs, actions, rewards, next_obs, dones,
+                               discounts)
+        self.priorities[ix] = self._max_priority
+        return ix
+
+    def sample(self, rng: np.random.RandomState, n: int) -> Dict:
+        p = self.priorities[:self.size] ** self.alpha
+        total = p.sum()
+        if total <= 0:
+            probs = np.full(self.size, 1.0 / self.size)
+        else:
+            probs = p / total
+        ix = rng.choice(self.size, size=n, p=probs)
+        w = (self.size * probs[ix]) ** (-self.beta)
+        w /= w.max() if w.max() > 0 else 1.0
+        return {"obs": self.obs[ix], "actions": self.actions[ix],
+                "rewards": self.rewards[ix],
+                "next_obs": self.next_obs[ix],
+                "dones": self.dones[ix].astype(np.float32),
+                "discounts": self.discounts[ix],
+                "weights": w.astype(np.float32),
+                "indices": ix}
+
+    def update_priorities(self, ix: np.ndarray,
+                          td_errors: np.ndarray) -> None:
+        pr = np.abs(td_errors) + 1e-6
+        self.priorities[ix] = pr
+        self._max_priority = max(self._max_priority, float(pr.max()))
+
+
+def nstep_transform(sample: Dict[str, np.ndarray], T: int, N: int,
+                    n_step: int, gamma: float) -> Dict[str, np.ndarray]:
+    """Fold a step-major [T*N] rollout into n-step transitions
+    (reference: n_step option on DQN — utils/replay_buffers accum):
+    R_t = sum_k gamma^k r_{t+k} up to n steps or episode end; the
+    bootstrap observation is the last one consumed and the per-sample
+    bootstrap discount is gamma^(steps consumed).  Windows truncate at
+    the rollout boundary."""
+    obs = sample["obs"].reshape(T, N, -1)
+    nobs = sample["next_obs"].reshape(T, N, -1)
+    rew = sample["rewards"].reshape(T, N)
+    done = sample["dones"].reshape(T, N)
+    act = sample["actions"].reshape(T, N)
+    R = np.zeros((T, N), np.float32)
+    disc = np.ones((T, N), np.float32)
+    nxt = np.empty_like(nobs)
+    dn = np.zeros((T, N), bool)
+    for t in range(T):
+        acc = np.zeros(N, np.float32)
+        g = np.ones(N, np.float32)
+        alive = np.ones(N, bool)
+        last_next = nobs[t].copy()
+        terminal = np.zeros(N, bool)
+        for k in range(n_step):
+            if t + k >= T:
+                break
+            acc += g * rew[t + k] * alive
+            last_next[alive] = nobs[t + k][alive]
+            terminal |= (done[t + k] & alive)
+            g = np.where(alive, g * gamma, g)
+            alive &= ~done[t + k]
+        R[t], nxt[t], dn[t], disc[t] = acc, last_next, terminal, g
+    return {"obs": obs.reshape(T * N, -1),
+            "actions": act.reshape(-1),
+            "rewards": R.reshape(-1),
+            "next_obs": nxt.reshape(T * N, -1),
+            "dones": dn.reshape(-1),
+            "discounts": disc.reshape(-1)}
 
 
 @ray_tpu.remote
@@ -122,7 +223,7 @@ def make_update_fn(optimizer, gamma: float, num_grad_steps: int,
     import jax.numpy as jnp
     import optax
 
-    def loss_fn(params, target_params, batch):
+    def td_error(params, target_params, batch):
         q = q_forward(params, batch["obs"])
         q_sa = jnp.take_along_axis(
             q, batch["actions"][:, None], axis=1)[:, 0]
@@ -132,9 +233,21 @@ def make_update_fn(optimizer, gamma: float, num_grad_steps: int,
         a_prime = jnp.argmax(next_online, axis=1)
         q_next = jnp.take_along_axis(
             next_target, a_prime[:, None], axis=1)[:, 0]
-        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) \
+        # n-step aware: per-sample bootstrap discount (gamma for
+        # 1-step inserts, gamma^k for n-step folds) — always present
+        # in sampled batches.
+        target = batch["rewards"] \
+            + batch["discounts"] * (1.0 - batch["dones"]) \
             * jax.lax.stop_gradient(q_next)
-        return optax.huber_loss(q_sa, target).mean()
+        return q_sa - target
+
+    def loss_fn(params, target_params, batch):
+        td = td_error(params, target_params, batch)
+        per = optax.huber_loss(td, jnp.zeros_like(td))
+        w = batch.get("weights")
+        if w is not None:
+            per = per * w        # prioritized-replay IS correction
+        return per.mean()
 
     @jax.jit
     def update(params, target_params, opt_state, data, rng):
@@ -156,7 +269,8 @@ def make_update_fn(optimizer, gamma: float, num_grad_steps: int,
             step, (params, opt_state), keys)
         return params, opt_state, losses.mean()
 
-    return update
+    td_fn = jax.jit(td_error)
+    return update, td_fn
 
 
 class DQNConfig:
@@ -170,6 +284,10 @@ class DQNConfig:
         self.gamma = 0.99
         self.buffer_capacity = 50_000
         self.learning_starts = 500
+        self.prioritized_replay = False
+        self.pr_alpha = 0.6
+        self.pr_beta = 0.4
+        self.n_step = 1
         self.batch_size = 64
         self.num_grad_steps = 32
         self.target_update_interval = 4
@@ -212,11 +330,16 @@ class DQN(RLCheckpointMixin):
         self.target_params = self.params   # arrays are immutable
         self.optimizer = optax.adam(config.lr)
         self.opt_state = self.optimizer.init(self.params)
-        self._update = make_update_fn(
+        self._update, self._td_fn = make_update_fn(
             self.optimizer, config.gamma, config.num_grad_steps,
             config.batch_size)
-        self.buffer = ReplayBuffer(config.buffer_capacity,
-                                   CartPoleEnv.observation_size)
+        if config.prioritized_replay:
+            self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                config.buffer_capacity, CartPoleEnv.observation_size,
+                alpha=config.pr_alpha, beta=config.pr_beta)
+        else:
+            self.buffer = ReplayBuffer(config.buffer_capacity,
+                                       CartPoleEnv.observation_size)
         self.workers = [
             DQNWorker.remote(i, config.num_envs_per_worker,
                              config.rollout_len, config.env_maker,
@@ -241,9 +364,20 @@ class DQN(RLCheckpointMixin):
         samples = ray_tpu.get([w.sample.remote(params_ref, eps)
                                for w in self.workers])
         episode_returns = []
+        c = self.config
         for s in samples:
-            self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
-                                  s["next_obs"], s["dones"])
+            if c.n_step > 1:
+                t = nstep_transform(
+                    s, c.rollout_len, c.num_envs_per_worker,
+                    c.n_step, c.gamma)
+            else:
+                t = dict(s)
+                t["discounts"] = np.full(len(s["actions"]), c.gamma,
+                                         np.float32)
+            self.buffer.add_batch(t["obs"], t["actions"],
+                                  t["rewards"], t["next_obs"],
+                                  t["dones"],
+                                  discounts=t["discounts"])
             episode_returns.extend(s["episode_returns"])
         self._reward_window.extend(episode_returns)
         self._reward_window = self._reward_window[-100:]
@@ -258,11 +392,19 @@ class DQN(RLCheckpointMixin):
             slab = self.buffer.sample(
                 self._np_rng,
                 self.config.batch_size * self.config.num_grad_steps)
+            slab_ix = slab.pop("indices", None)
+            jslab = {k: jnp.asarray(v) for k, v in slab.items()}
             self._rng, key = jax.random.split(self._rng)
             self.params, self.opt_state, loss = self._update(
                 self.params, self.target_params, self.opt_state,
-                {k: jnp.asarray(v) for k, v in slab.items()}, key)
+                jslab, key)
             loss = float(loss)
+            if slab_ix is not None:
+                # Post-update TD errors of the slab become its new
+                # priorities (reference: per-batch priority refresh).
+                td = np.asarray(self._td_fn(
+                    self.params, self.target_params, jslab))
+                self.buffer.update_priorities(slab_ix, td)
         self.iteration += 1
         if self.iteration % self.config.target_update_interval == 0:
             self.target_params = self.params   # arrays are immutable
