@@ -57,6 +57,10 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)
+    # Tune stop condition (reference: RunConfig stop): a dict
+    # {metric: threshold} stopping a trial once result[metric] >=
+    # threshold, or a callable (trial_id, result) -> bool.
+    stop: Any = None
 
 
 @dataclass

@@ -35,6 +35,10 @@ class TuneConfig:
     mode: str = "max"
     num_samples: int = 1
     max_concurrent_trials: int = 4
+    # Whole-experiment wall-clock budget (reference: time_budget_s):
+    # once exceeded, nothing new launches and running trials stop
+    # with their last reported metrics.
+    time_budget_s: Optional[float] = None
     scheduler: Any = None
     # Model-based searcher (e.g. tune.search.TPESearcher): suggests a
     # config per trial and observes completions (reference:
@@ -235,9 +239,49 @@ class Tuner:
 
         trials_by_id = {t.trial_id: t for t in trials}
         paused: Dict[str, TrialResult] = {}
+        loop_t0 = time.time()
+
+        def _stop_hit(tid: str, metrics: Dict[str, Any]) -> bool:
+            cond = getattr(self._run_config, "stop", None)
+            if cond is None:
+                return False
+            if callable(cond):
+                return bool(cond(tid, metrics))
+            return any(k in metrics and metrics[k] >= v
+                       for k, v in cond.items())
         pause_epochs: Dict[str, int] = {}     # resume incarnation count
         stale_ns: Dict[str, List[str]] = {}   # ns of killed incarnations
         while pending or running or paused or remaining_suggestions:
+            if tc.time_budget_s is not None \
+                    and time.time() - loop_t0 > tc.time_budget_s:
+                # Budget exhausted: drop everything not yet running and
+                # stop live trials with their last reported metrics.
+                pending.clear()
+                remaining_suggestions = 0
+                for tid, t in list(paused.items()):
+                    t.status = "TERMINATED"
+                    del paused[tid]
+                    for ns in stale_ns.pop(tid, []):
+                        for key in client.kv_keys(ns):
+                            client.kv_del(ns, key)
+                    if searcher is not None and t.metrics:
+                        searcher.record(t.config, t.metrics)
+                for tid in list(running):
+                    info = running.pop(tid)
+                    info["trial"].status = "TERMINATED"
+                    # Kill FIRST, then drain: a report landing between
+                    # a drain and the kill would orphan in the KV
+                    # forever (the race _exploit_restart documents).
+                    self._stop_trial(info)
+                    self._drain_final(client, info, info["trial"],
+                                      scheduler)
+                    for key in client.kv_keys(info["ns"]):
+                        client.kv_del(info["ns"], key)
+                    if searcher is not None \
+                            and info["trial"].metrics:
+                        searcher.record(info["trial"].config,
+                                        info["trial"].metrics)
+                break
             if not pending and not remaining_suggestions \
                     and hasattr(scheduler, "seal"):
                 # Every trial that will ever exist is registered:
@@ -330,6 +374,8 @@ class Tuner:
                     if ckpt_path:
                         t.checkpoint = Checkpoint(ckpt_path)
                     decision = scheduler.on_result(tid, metrics)
+                    if _stop_hit(tid, metrics):
+                        stop = True
                     if decision == STOP:
                         stop = True
                     elif decision == PAUSE:
@@ -351,6 +397,11 @@ class Tuner:
                     t.status = "EARLY_STOPPED"
                     self._stop_trial(info)
                     del running[tid]
+                    if hasattr(scheduler, "on_trial_remove"):
+                        # Bracket peers must not wait on a stopped
+                        # trial (user stop conditions end trials the
+                        # scheduler did not decide about).
+                        scheduler.on_trial_remove(tid)
                     if searcher is not None and t.metrics:
                         searcher.record(t.config, t.metrics)
                 elif exploit is not None:

@@ -190,3 +190,44 @@ def test_hyperband_sync_rungs(ray_start, tmp_path):
     # Budget actually saved vs running all 9 trials 8 steps.
     total = sum(r.metrics.get("training_iteration", 0) for r in grid)
     assert total <= 9 * 8 * 0.6, total
+
+
+def test_stop_condition_and_time_budget(ray_start, tmp_path):
+    """RunConfig(stop={metric: threshold}) ends a trial the moment it
+    crosses the bar; TuneConfig(time_budget_s) caps the whole sweep
+    (reference: RunConfig stop, time_budget_s)."""
+    def climber(config):
+        for step in range(1, 50):
+            session.report({"score": step * config["rate"],
+                            "training_iteration": step})
+
+    rc = RunConfig(name="stopc", storage_path=str(tmp_path))
+    rc.stop = {"score": 10.0}
+    grid = tune.Tuner(
+        climber,
+        param_space={"rate": tune.grid_search([1.0, 5.0])},
+        run_config=rc).fit()
+    assert not grid.errors
+    for r in grid:
+        # Stopped at (or just past) the threshold, far from 49 steps.
+        assert r.metrics["score"] >= 10.0
+        assert r.metrics["training_iteration"] <= 12
+
+    def slow(config):
+        import time as _t
+        for step in range(1, 1000):
+            session.report({"v": step})
+            _t.sleep(0.05)
+
+    grid = tune.Tuner(
+        slow,
+        param_space={"x": tune.grid_search(list(range(8)))},
+        tune_config=tune.TuneConfig(max_concurrent_trials=2,
+                                    time_budget_s=4.0),
+        run_config=RunConfig(name="budget",
+                             storage_path=str(tmp_path))).fit()
+    # The budget cut the sweep: nothing errored, and at most the two
+    # concurrent trials ever started.
+    assert not grid.errors
+    started = [r for r in grid if r.metrics]
+    assert 1 <= len(started) <= 4
