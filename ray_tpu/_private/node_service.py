@@ -1046,6 +1046,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 actor = self.actors.get(rec.actor_id) if rec.actor_id else None
                 if actor is not None:
                     actor.in_flight.pop(rec.task_id, None)
+                    self._maybe_release_actor(actor)
             if w is not None and w.state == "busy" and w.actor_id is None:
                 self._release_worker(w)
             elif w is not None and w.actor_id is not None:
@@ -1511,6 +1512,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             rec.worker.actor_id = actor.actor_id
             rec.worker.current_task = None
         self._drain_actor_queue(actor)
+        # A handle-GC release that arrived during creation waited for
+        # this moment (releasing earlier would have dropped the
+        # creation args before the constructor ran).
+        self._maybe_release_actor(actor)
 
     def _enqueue_actor_task(self, rec: TaskRecord) -> None:
         actor = self.actors.get(rec.actor_id)
@@ -1552,6 +1557,55 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         for rec in list(actor.in_flight.values()):
             self._fail_task_returns(rec, err)
         actor.in_flight.clear()
+
+    def _h_actor_release_scope(self, ctx: _ConnCtx, m: dict) -> None:
+        """Driver GC: the last in-scope handle to a non-detached,
+        unnamed actor was collected.  The actor dies once its queued
+        and in-flight work drains (reference: actor handle reference
+        counting — out-of-scope actors terminate after pending tasks
+        complete)."""
+        with self.lock:
+            actor = self.actors.get(m["actor_id"])
+        if actor is None and self.multinode:
+            # The actor lives on its home node: one-way forward (the
+            # handler never replies, so a call would park a dispatch
+            # thread until timeout).
+            home = self._actor_homes.get(m["actor_id"])
+            if home is None:
+                try:
+                    home = self.gcs.get_actor_node(m["actor_id"])
+                except Exception:
+                    home = None
+            if home is not None and home != self.node_id:
+                self._peer_notify(home, {
+                    "type": "actor_release_scope",
+                    "actor_id": m["actor_id"]})
+            return
+        with self.lock:
+            actor = self.actors.get(m["actor_id"])
+            if actor is None or actor.state == "dead":
+                return
+            actor.release_on_drain = True
+            actor.restarts_left = 0
+            self._maybe_release_actor(actor)
+
+    def _maybe_release_actor(self, actor: ActorRecord) -> None:
+        """Caller holds the lock: tear the actor down if its release
+        was requested and no work remains.  Only a LIVE actor is
+        eligible — a pending/restarting actor's creation task rides
+        the node's pending_queue (not actor.in_flight), and releasing
+        then would decref the creation args before the constructor
+        ever ran; _on_actor_created re-checks once alive."""
+        if not actor.release_on_drain or actor.state != "alive":
+            return
+        if actor.in_flight or actor.queue:
+            return
+        actor.state = "dead"
+        actor.death_reason = "all handles out of scope"
+        self.gcs.drop_named_actor(actor.actor_id)
+        self._release_actor_holds(actor)
+        if actor.worker is not None:
+            self._teardown_worker(actor.worker)
 
     def _h_actor_exiting(self, ctx: _ConnCtx, m: dict) -> None:
         """Worker announces an INTENTIONAL exit (ray_tpu.exit_actor())

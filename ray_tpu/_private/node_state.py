@@ -75,7 +75,7 @@ class ActorRecord:
     __slots__ = ("actor_id", "spec", "state", "worker", "queue",
                  "restarts_left", "name", "namespace", "detached",
                  "in_flight", "death_reason", "holds_released",
-                 "intentional_exit")
+                 "intentional_exit", "release_on_drain")
 
     def __init__(self, actor_id: bytes, spec: dict) -> None:
         self.actor_id = actor_id
@@ -92,6 +92,9 @@ class ActorRecord:
         # Worker announced exit_actor(): the coming death is
         # deliberate — never restart, report "exited" not "crashed".
         self.intentional_exit = False
+        # Driver GC released the last handle: die once queued +
+        # in-flight work drains (reference handle-GC semantics).
+        self.release_on_drain = False
         # Creation-task embedded ref holds live as long as the actor can
         # restart (the spec is replayed); released exactly once at
         # permanent death via _release_actor_holds.

@@ -81,8 +81,15 @@ class ActorClass:
             runtime_env=rte.pack(self._options.get("runtime_env")),
             affinity=self._options.get("_affinity"))
         method_meta = _method_meta(self._cls)
+        # The creating process's original handle OWNS the actor's
+        # lifetime (reference: actors terminate when every handle is
+        # out of scope) — unless it is named/detached, or the handle
+        # is ever pickled (then ownership can't be tracked locally and
+        # the actor outlives this handle).
+        owns = (not detached and self._options.get("name") is None)
         return ActorHandle(actor_id, class_id, self._cls.__name__,
-                           method_meta, creation_ref=ready_ref)
+                           method_meta, creation_ref=ready_ref,
+                           owns_lifetime=owns)
 
 
 def _method_meta(cls: type) -> Dict[str, int]:
@@ -125,7 +132,8 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: bytes, class_id: bytes, class_name: str,
-                 method_meta: Dict[str, int], creation_ref=None) -> None:
+                 method_meta: Dict[str, int], creation_ref=None,
+                 owns_lifetime: bool = False) -> None:
         self._actor_id = actor_id
         self._class_id = class_id
         self._class_name = class_name
@@ -133,6 +141,8 @@ class ActorHandle:
         # Holding the creation ref lets callers `get` it to await/verify
         # construction; dropping it is harmless.
         self._creation_ref = creation_ref
+        self._owns_lifetime = owns_lifetime
+        self._shared = False
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -147,5 +157,24 @@ class ActorHandle:
                 f"{self._actor_id.hex()[:12]})")
 
     def __reduce__(self):
+        # A pickled handle may outlive this one anywhere in the
+        # cluster: local GC can no longer prove the actor unreachable.
+        self._shared = True
         return (ActorHandle, (self._actor_id, self._class_id,
                               self._class_name, self._method_meta))
+
+    def __del__(self):
+        if not getattr(self, "_owns_lifetime", False) \
+                or getattr(self, "_shared", False):
+            return
+        # Reference GC semantics: the last in-scope handle going away
+        # releases the actor — already-submitted work drains first
+        # (the node defers the teardown until its queue empties).
+        try:
+            import ray_tpu
+            client = ray_tpu._private.client.get_global_client()
+            if client is not None:
+                client.conn.notify({"type": "actor_release_scope",
+                                    "actor_id": self._actor_id})
+        except Exception:
+            pass

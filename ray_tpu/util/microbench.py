@@ -54,6 +54,73 @@ def bench_actor_calls_async(ray_tpu, n: int = 2000) -> float:
     return _rate(n, time.perf_counter() - t0)
 
 
+def bench_actor_calls_concurrent(ray_tpu, n: int = 2000) -> float:
+    """Pipelined calls against a max_concurrency actor (reference:
+    1_1_actor_calls_concurrent — threaded actor, overlapping calls)."""
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return 1
+
+    a = Echo.options(max_concurrency=8).remote()
+    ray_tpu.get(a.ping.remote())
+    t0 = time.perf_counter()
+    refs = [a.ping.remote() for _ in range(n)]
+    ray_tpu.get(refs[-1])
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_one_to_n_actor_calls(ray_tpu, n_actors: int = 4,
+                               calls: int = 500) -> float:
+    """One caller fanning out over N actors (reference:
+    1_n_actor_calls_async)."""
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return 1
+
+    actors = [Echo.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+    t0 = time.perf_counter()
+    refs = [actors[i % n_actors].ping.remote()
+            for i in range(calls * n_actors)]
+    ray_tpu.get(refs)
+    return _rate(calls * n_actors, time.perf_counter() - t0)
+
+
+def bench_n_to_n_actor_calls(ray_tpu, n_pairs: int = 4,
+                             calls: int = 400) -> float:
+    """N caller actors each driving their own callee (reference:
+    n_n_actor_calls_async): measures dispatch-plane aggregate, not a
+    single pair."""
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return 1
+
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, target):
+            self._t = target
+
+        def drive(self, n):
+            import ray_tpu as rt
+            refs = [self._t.ping.remote() for _ in range(n)]
+            rt.get(refs)
+            return n
+
+    # Zero-CPU actors: the bench measures the dispatch plane, and
+    # 2*n_pairs default-CPU actors would deadlock on a small host
+    # (callers hold every slot, callees never schedule).
+    callees = [Echo.options(num_cpus=0).remote()
+               for _ in range(n_pairs)]
+    callers = [Caller.options(num_cpus=0).remote(c) for c in callees]
+    ray_tpu.get([c.drive.remote(5) for c in callers])   # warm
+    t0 = time.perf_counter()
+    done = ray_tpu.get([c.drive.remote(calls) for c in callers])
+    return _rate(sum(done), time.perf_counter() - t0)
+
+
 def bench_tasks_async(ray_tpu, n: int = 500) -> float:
     @ray_tpu.remote
     def nop():
@@ -165,13 +232,22 @@ def run_all(out_path: str | None = None) -> dict:
     # Phase 1: single-node mode — the core hot paths with no GCS hop.
     ray_tpu.init(num_cpus=4, object_store_memory=1 << 30,
                  ignore_reinit_error=True)
+    # Object/task benches FIRST: actor benches release their actors
+    # on return (handle GC) and the resulting worker churn would
+    # contaminate measurements taken while it settles.
     results = {
-        "actor_calls_sync_per_s": bench_actor_calls_sync(ray_tpu),
-        "actor_calls_async_per_s": bench_actor_calls_async(ray_tpu),
         "tasks_async_per_s": bench_tasks_async(ray_tpu),
         "put_small_per_s": bench_put_small(ray_tpu),
         "put_gigabytes_per_s": bench_put_gbps(ray_tpu),
         "get_64kb_median_us": bench_get_latency_us(ray_tpu),
+        "actor_calls_sync_per_s": bench_actor_calls_sync(ray_tpu),
+        "actor_calls_async_per_s": bench_actor_calls_async(ray_tpu),
+        "actor_calls_concurrent_per_s":
+            bench_actor_calls_concurrent(ray_tpu),
+        "one_to_n_actor_calls_per_s":
+            bench_one_to_n_actor_calls(ray_tpu),
+        "n_to_n_actor_calls_per_s":
+            bench_n_to_n_actor_calls(ray_tpu),
     }
     ray_tpu.shutdown()
 
@@ -195,6 +271,9 @@ def run_all(out_path: str | None = None) -> dict:
             # single-core, the reference's are 64-core.
             "actor_calls_sync_per_s": 2033,
             "actor_calls_async_per_s": 8886,
+            "actor_calls_concurrent_per_s": 5095,
+            "one_to_n_actor_calls_per_s": 8570,
+            "n_to_n_actor_calls_per_s": 27667,
             "multi_client_tasks_async_per_s": 25166,
             "put_per_s": 12677,
             "put_gigabytes_per_s": 35.9,

@@ -193,3 +193,43 @@ def test_get_tpu_ids_in_pinned_worker(ray_start_tpu):
     def ids():
         return ray_tpu.get_tpu_ids()
     assert ray_tpu.get(ids.remote()) in ([0], [1])
+
+
+def test_handle_gc_releases_actor(ray_start):
+    """Reference actor-lifetime semantics: the last in-scope handle to
+    an unnamed, non-detached actor releases it AFTER queued work
+    drains; pickled and named handles opt out of local GC."""
+    import gc
+    import time
+
+    @ray_tpu.remote
+    class E:
+        def ping(self):
+            return 1
+
+        def slow(self):
+            time.sleep(0.3)
+            return "done"
+
+    # Queued work drains before the GC kill: submit, drop the handle,
+    # the result still arrives.
+    a = E.options(num_cpus=0).remote()
+    ref = a.slow.remote()
+    del a
+    gc.collect()
+    assert ray_tpu.get(ref, timeout=30) == "done"
+
+    # Sequential leak pattern: far more actors than the worker pool
+    # cap complete because each release returns a worker.
+    for _ in range(12):
+        h = E.options(num_cpus=0).remote()
+        assert ray_tpu.get(h.ping.remote(), timeout=30) == 1
+        del h
+        gc.collect()
+
+    # Named actors are exempt: still reachable after the handle dies.
+    E.options(name="keeper", num_cpus=0).remote()
+    gc.collect()
+    time.sleep(0.5)
+    keeper = ray_tpu.get_actor("keeper")
+    assert ray_tpu.get(keeper.ping.remote(), timeout=30) == 1
