@@ -1498,10 +1498,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 self._teardown_worker(rec.worker)
             return
         if failed:
-            actor.state = "dead"
-            actor.death_reason = "creation task failed"
-            self._release_actor_holds(actor)
-            self._fail_actor_queue(actor)
+            # Worker death runs through _handle_worker_death (it owns
+            # retry/requeue bookkeeping a plain teardown skips).
+            self._mark_actor_dead(actor, "creation task failed",
+                                  teardown_worker=False)
             if actor.worker is not None:
                 self._handle_worker_death(actor.worker, "creation failed",
                                           actor_already_handled=True)
@@ -1589,6 +1589,20 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             actor.restarts_left = 0
             self._maybe_release_actor(actor)
 
+    def _mark_actor_dead(self, actor: ActorRecord, reason: str,
+                         teardown_worker: bool = True) -> None:
+        """Caller holds the lock: THE actor-death bookkeeping sequence
+        (state flip, name drop, hold release, queue failure, worker
+        teardown) — every death path funnels here so the steps can
+        never diverge by cause of death."""
+        actor.state = "dead"
+        actor.death_reason = reason
+        self.gcs.drop_named_actor(actor.actor_id)
+        self._release_actor_holds(actor)
+        self._fail_actor_queue(actor)
+        if teardown_worker and actor.worker is not None:
+            self._teardown_worker(actor.worker)
+
     def _maybe_release_actor(self, actor: ActorRecord) -> None:
         """Caller holds the lock: tear the actor down if its release
         was requested and no work remains.  Only a LIVE actor is
@@ -1600,12 +1614,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             return
         if actor.in_flight or actor.queue:
             return
-        actor.state = "dead"
-        actor.death_reason = "all handles out of scope"
-        self.gcs.drop_named_actor(actor.actor_id)
-        self._release_actor_holds(actor)
-        if actor.worker is not None:
-            self._teardown_worker(actor.worker)
+        self._mark_actor_dead(actor, "all handles out of scope")
 
     def _h_actor_exiting(self, ctx: _ConnCtx, m: dict) -> None:
         """Worker announces an INTENTIONAL exit (ray_tpu.exit_actor())
@@ -1643,13 +1652,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 return
             if m.get("no_restart", True):
                 actor.restarts_left = 0
-            actor.state = "dead"
-            actor.death_reason = "killed via kill()"
-            self.gcs.drop_named_actor(actor.actor_id)
-            self._release_actor_holds(actor)
-            self._fail_actor_queue(actor)
-            if actor.worker is not None:
-                self._teardown_worker(actor.worker)
+            self._mark_actor_dead(actor, "killed via kill()")
         ctx.reply(m, {"ok": True})
 
     def _forward_actor_rpc(self, actor_id: bytes,
@@ -2241,11 +2244,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             self.pending_queue.append(rec)
             self._schedule()
         else:
-            actor.state = "dead"
-            actor.death_reason = reason
-            self.gcs.drop_named_actor(actor.actor_id)
-            self._release_actor_holds(actor)
-            self._fail_actor_queue(actor)
+            # Worker is already gone on this path (actor.worker was
+            # cleared above); no teardown to do.
+            self._mark_actor_dead(actor, reason,
+                                  teardown_worker=False)
 
     def _fail_task_returns(self, rec: TaskRecord, error: Exception) -> None:
         blob = ser.dumps(error)
@@ -2366,14 +2368,12 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 if rec.is_actor_creation:
                     actor = self.actors.get(rec.actor_id)
                     if actor is not None:
-                        actor.state = "dead"
-                        actor.death_reason = f"infeasible: {reason}"
-                        self._release_actor_holds(actor)
-                        # Method calls queued while the actor was
-                        # pending demand must fail too, or their
-                        # callers hang forever (the same queue-failing
-                        # the creation-failed path does).
-                        self._fail_actor_queue(actor)
+                        # Queue failure matters here too: method calls
+                        # queued while the actor was pending demand
+                        # would otherwise hang their callers forever.
+                        self._mark_actor_dead(
+                            actor, f"infeasible: {reason}",
+                            teardown_worker=False)
                 self._fail_task_returns(rec, exc.InfeasibleResourceError(
                     f"task {rec.spec.get('name')!r} is infeasible and "
                     f"no autoscaler is alive to provision it: {reason}"))

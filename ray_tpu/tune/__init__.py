@@ -16,11 +16,13 @@ from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
 from ray_tpu.tune.search import (BOHBSearcher, TPESearcher, choice,
                                  grid_search, loguniform, randint,
                                  uniform)
-from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+from ray_tpu.tune.tuner import (ResultGrid, TuneConfig, Tuner,
+                                with_parameters)
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "ASHAScheduler",
     "HyperBandScheduler", "PopulationBasedTraining", "PB2",
     "MedianStoppingRule", "FIFOScheduler", "grid_search", "uniform",
     "loguniform", "randint", "choice", "TPESearcher", "BOHBSearcher",
+    "with_parameters",
 ]

@@ -527,3 +527,24 @@ def _trainer_trainable(trainer) -> Callable:
                        checkpoint=result.checkpoint)
 
     return run_trainer
+
+
+def with_parameters(fn: Callable, **large_objects) -> Callable:
+    """Attach large constant objects to a trainable WITHOUT copying
+    them into every trial's pickled closure (reference:
+    tune.with_parameters): each object is `put` into the object store
+    ONCE; trials resolve the shared refs at start.
+
+        tuner = Tuner(with_parameters(train, data=big_df),
+                      param_space=...)
+        # train(config, data=...) sees the materialized object.
+    """
+    refs = {k: ray_tpu.put(v) for k, v in large_objects.items()}
+
+    def wrapped(config):
+        keys = list(refs)
+        vals = ray_tpu.get([refs[k] for k in keys])   # one batched get
+        return fn(config, **dict(zip(keys, vals)))
+
+    wrapped.__name__ = getattr(fn, "__name__", "trainable")
+    return wrapped

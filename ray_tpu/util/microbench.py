@@ -21,6 +21,18 @@ def _rate(n: int, dt: float) -> float:
     return round(n / dt, 1)
 
 
+def _settle(ray_tpu, *actors) -> None:
+    """Kill a bench's actors NOW and give teardown a beat — handle-GC
+    release churn (worker kills) must not run inside the next bench's
+    timed window."""
+    for a in actors:
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+    time.sleep(0.2)
+
+
 def bench_actor_calls_sync(ray_tpu, n: int = 300) -> float:
     @ray_tpu.remote
     class Counter:
@@ -36,7 +48,9 @@ def bench_actor_calls_sync(ray_tpu, n: int = 300) -> float:
     t0 = time.perf_counter()
     for _ in range(n):
         ray_tpu.get(a.inc.remote())
-    return _rate(n, time.perf_counter() - t0)
+    rate = _rate(n, time.perf_counter() - t0)
+    _settle(ray_tpu, a)
+    return rate
 
 
 def bench_actor_calls_async(ray_tpu, n: int = 2000) -> float:
@@ -50,8 +64,10 @@ def bench_actor_calls_async(ray_tpu, n: int = 2000) -> float:
     ray_tpu.get(a.ping.remote())
     t0 = time.perf_counter()
     refs = [a.ping.remote() for _ in range(n)]
-    ray_tpu.get(refs[-1])
-    return _rate(n, time.perf_counter() - t0)
+    ray_tpu.get(refs[-1])   # single-threaded actor: strictly in order
+    rate = _rate(n, time.perf_counter() - t0)
+    _settle(ray_tpu, a)
+    return rate
 
 
 def bench_actor_calls_concurrent(ray_tpu, n: int = 2000) -> float:
@@ -66,8 +82,12 @@ def bench_actor_calls_concurrent(ray_tpu, n: int = 2000) -> float:
     ray_tpu.get(a.ping.remote())
     t0 = time.perf_counter()
     refs = [a.ping.remote() for _ in range(n)]
-    ray_tpu.get(refs[-1])
-    return _rate(n, time.perf_counter() - t0)
+    # Wait on ALL refs: a concurrent actor finishes out of order, so
+    # refs[-1] alone would stop the clock with calls still running.
+    ray_tpu.get(refs)
+    rate = _rate(n, time.perf_counter() - t0)
+    _settle(ray_tpu, a)
+    return rate
 
 
 def bench_one_to_n_actor_calls(ray_tpu, n_actors: int = 4,
@@ -85,7 +105,9 @@ def bench_one_to_n_actor_calls(ray_tpu, n_actors: int = 4,
     refs = [actors[i % n_actors].ping.remote()
             for i in range(calls * n_actors)]
     ray_tpu.get(refs)
-    return _rate(calls * n_actors, time.perf_counter() - t0)
+    rate = _rate(calls * n_actors, time.perf_counter() - t0)
+    _settle(ray_tpu, *actors)
+    return rate
 
 
 def bench_n_to_n_actor_calls(ray_tpu, n_pairs: int = 4,
@@ -118,7 +140,9 @@ def bench_n_to_n_actor_calls(ray_tpu, n_pairs: int = 4,
     ray_tpu.get([c.drive.remote(5) for c in callers])   # warm
     t0 = time.perf_counter()
     done = ray_tpu.get([c.drive.remote(calls) for c in callers])
-    return _rate(sum(done), time.perf_counter() - t0)
+    rate = _rate(sum(done), time.perf_counter() - t0)
+    _settle(ray_tpu, *(callers + callees))
+    return rate
 
 
 def bench_tasks_async(ray_tpu, n: int = 500) -> float:

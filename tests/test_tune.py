@@ -231,3 +231,24 @@ def test_stop_condition_and_time_budget(ray_start, tmp_path):
     assert not grid.errors
     started = [r for r in grid if r.metrics]
     assert 1 <= len(started) <= 4
+
+
+def test_with_parameters_shares_objects(ray_start, tmp_path):
+    """tune.with_parameters ships a large constant through the object
+    store once; every trial resolves the same ref (reference:
+    tune.with_parameters)."""
+    import numpy as np
+
+    big = np.arange(20_000, dtype=np.float64)
+
+    def trainable(config, data=None):
+        session.report({"total": float(data.sum()) + config["o"]})
+
+    grid = tune.Tuner(
+        tune.with_parameters(trainable, data=big),
+        param_space={"o": tune.grid_search([0.0, 1.0])},
+        run_config=RunConfig(name="wp",
+                             storage_path=str(tmp_path))).fit()
+    assert not grid.errors
+    got = sorted(r.metrics["total"] for r in grid)
+    assert got == [big.sum(), big.sum() + 1.0]
