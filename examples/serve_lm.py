@@ -1,0 +1,45 @@
+"""Serve a continuous-batched LM behind HTTP with streaming tokens.
+
+Run: python examples/serve_lm.py
+Then: curl -N 'http://127.0.0.1:8000/lm?stream=1' -d '{"prompt": [1,2,3]}'
+"""
+import jax
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models import transformer
+
+
+@serve.deployment(name="lm")
+class LM:
+    def __init__(self):
+        cfg = transformer.TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            max_seq=256, arch="gpt2")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        from ray_tpu.serve.llm import ContinuousBatcher
+        self.engine = ContinuousBatcher(params, cfg, num_slots=8,
+                                        max_len=128, decode_chunk=8,
+                                        pipeline_depth=2)
+
+    def __call__(self, body):
+        out = self.engine.generate(body["prompt"],
+                                   max_new=body.get("max_new", 16))
+        return {"tokens": out["tokens"], "ttft_s": out["ttft_s"]}
+
+    def stream(self, body):
+        yield from self.engine.generate_stream(
+            body["prompt"], max_new=body.get("max_new", 16))
+
+
+def main():
+    ray_tpu.init()
+    serve.run(LM.bind(), name="lm", route_prefix="/lm")
+    httpd = serve.start_http_proxy(port=8000)
+    print(f"serving on http://127.0.0.1:{httpd.server_address[1]}/lm")
+    import threading
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -91,15 +91,9 @@ class PlacementGroupMixin:
                 and (a.spec.get("pg") or {}).get("bundle") == idx]
             for a in victims:
                 a.restarts_left = 0
-                a.state = "dead"
-                a.death_reason = ("placement group bundle revoked "
-                                  "(gang re-placed after a member "
-                                  "node died)")
-                self.gcs.drop_named_actor(a.actor_id)
-                self._release_actor_holds(a)
-                self._fail_actor_queue(a)
-                if a.worker is not None:
-                    self._teardown_worker(a.worker)
+                self._mark_actor_dead(
+                    a, "placement group bundle revoked (gang "
+                       "re-placed after a member node died)")
             self._return_bundle_local(pg_id, idx)
             self._schedule()
 

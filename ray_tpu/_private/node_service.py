@@ -1449,8 +1449,6 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                       else self._infeasible_reason(spec.get("resources")))
             if reason is not None:
                 actor = ActorRecord(actor_id, spec)
-                actor.state = "dead"
-                actor.death_reason = f"infeasible: {reason}"
                 self.actors[actor_id] = actor
                 rec = TaskRecord(spec["creation_task"])
                 self.tasks[rec.task_id] = rec
@@ -1460,12 +1458,11 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     f"actor {spec.get('name') or actor_id.hex()} is "
                     f"infeasible: {reason}"))
                 # _fail_task_returns skips embedded decrefs for creation
-                # tasks (restart replay); this actor will never restart.
-                self._release_actor_holds(actor)
-                if spec.get("name"):
-                    # The name may have been reserved before the cluster
-                    # view changed under us — release it or it leaks.
-                    self.gcs.drop_named_actor(actor_id)
+                # tasks (restart replay); this actor will never restart —
+                # _mark_actor_dead releases the holds and drops any
+                # reserved name (idempotent for unnamed actors).
+                self._mark_actor_dead(actor, f"infeasible: {reason}",
+                                      teardown_worker=False)
                 ctx.reply(m, {"ok": True})
                 return
             actor = ActorRecord(actor_id, spec)
