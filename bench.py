@@ -39,6 +39,18 @@ def main() -> None:
     import dataclasses
     import os
 
+    from ray_tpu.util import hwprobe
+
+    model = os.environ.get("BENCH_MODEL", "gpt2-small")
+    lg_name = hwprobe.lg_name("BENCH", model, "gpt2-small")
+
+    # Probe the backend in a subprocess BEFORE importing jax here: a
+    # wedged tunnel killed the r3 AND r4 driver captures at
+    # jax.devices() (rc=1, no JSON line).  Bounded retries with
+    # backoff; on total failure emit the last-good number marked stale.
+    hwprobe.ensure_backend(
+        lg_name, "fresh capture failed: TPU tunnel never initialized")
+
     import jax
     import numpy as np
 
@@ -48,8 +60,6 @@ def main() -> None:
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-
-    model = os.environ.get("BENCH_MODEL", "gpt2-small")
     if on_tpu and model == "llama-1b":
         # Round-2 judge: gpt2s (d=768) under-stresses the MXU; a ~1B
         # config with real layer shapes (d=2048, GQA, dff=8192) makes
@@ -134,6 +144,8 @@ def main() -> None:
         "step_ms": round(dt / steps * 1000, 1),
         "loss": round(float(metrics["loss"]), 4),
     }
+    if on_tpu:
+        hwprobe.record_last_good(lg_name, result)
     print(json.dumps(result))
 
 

@@ -51,11 +51,13 @@ class CoreClient:
     def __init__(self, socket_path: str, kind: str = "driver",
                  client_id: Optional[bytes] = None,
                  push_handler: Optional[Callable[[dict], None]] = None,
+                 on_disconnect: Optional[Callable[[], None]] = None,
                  ) -> None:
         self.kind = kind
         self.client_id = client_id or os.urandom(16)
         sock = connect_uds(socket_path)
-        self.conn = Connection(sock, push_handler=push_handler)
+        self.conn = Connection(sock, push_handler=push_handler,
+                               on_disconnect=on_disconnect)
         reply = self.conn.call({"type": "register_client", "kind": kind,
                                 "client_id": self.client_id,
                                 "pid": os.getpid()})

@@ -2019,13 +2019,15 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                             and self._try_spill(rec, res)):
                         progressed = True
                     continue
-                w = self._find_idle_worker(tpu=needs_tpu)
+                from ray_tpu._private.container import image_of
+                image = image_of(rec.spec.get("runtime_env"))
+                w = self._find_idle_worker(tpu=needs_tpu, image=image)
                 if w is None:
                     if bundle is not None:
                         _uncharge(bundle.free, res)
                     else:
                         self._give_back(res)
-                    self._maybe_spawn(tpu=needs_tpu)
+                    self._maybe_spawn(tpu=needs_tpu, image=image)
                     continue
                 self.pending_queue.remove(rec)
                 rec.state = "dispatched"
@@ -2047,28 +2049,37 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         else:
             self._give_back(w.resources_held)
 
-    def _find_idle_worker(self, tpu: bool) -> Optional[WorkerHandle]:
+    def _find_idle_worker(self, tpu: bool,
+                          image: Optional[str] = None
+                          ) -> Optional[WorkerHandle]:
         for w in self.workers.values():
-            if w.state == "idle" and w.tpu == tpu and w.actor_id is None:
+            if (w.state == "idle" and w.tpu == tpu
+                    and w.actor_id is None and w.image == image):
                 return w
         return None
 
-    def _maybe_spawn(self, tpu: bool) -> None:
+    def _maybe_spawn(self, tpu: bool,
+                     image: Optional[str] = None) -> None:
+        from ray_tpu._private.container import image_of
         starting = sum(1 for w in self.workers.values()
-                       if w.state == "starting" and w.tpu == tpu)
+                       if w.state == "starting" and w.tpu == tpu
+                       and w.image == image)
         if self._spawn_failures >= self._spawn_failure_limit:
             return
         demand = sum(
             1 for r in self.pending_queue
             if not r.deps
             and (((r.spec.get("resources") or {}).get("TPU", 0) > 0) == tpu)
+            and image_of(r.spec.get("runtime_env")) == image
         ) or 1
         alive = sum(1 for w in self.workers.values() if w.state != "dead")
         want = min(demand - starting, self._max_workers - alive)
         for _ in range(max(want, 0)):
-            self._spawn_worker(tpu)
+            self._spawn_worker(tpu, image=image)
 
-    def _spawn_worker(self, tpu: bool) -> WorkerHandle:
+    def _spawn_worker(self, tpu: bool,
+                      image: Optional[str] = None
+                      ) -> Optional[WorkerHandle]:
         self._next_worker_seq += 1
         worker_id = os.urandom(16)
         env = dict(os.environ)
@@ -2116,14 +2127,38 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             self._log_dir,
             f"worker-{self._next_worker_seq:04d}-{worker_id.hex()[:8]}.log")
         log_f = open(log_path, "ab", buffering=0)
+        if image is not None:
+            # Containerized worker (runtime_env image_uri): same worker
+            # program inside the image, session/state paths mounted
+            # (reference: _private/runtime_env/image_uri.py).
+            from ray_tpu._private import container
+            argv = container.build_worker_argv(
+                image, env,
+                mounts=[self.session_dir,
+                        os.path.dirname(self.socket_path),
+                        os.path.dirname(self.store_path)])
+        else:
+            argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
         try:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_main"],
-                env=env, cwd=os.getcwd(),
-                stdout=log_f, stderr=subprocess.STDOUT)
+            try:
+                proc = subprocess.Popen(
+                    argv, env=env, cwd=os.getcwd(),
+                    stdout=log_f, stderr=subprocess.STDOUT)
+            except OSError as e:
+                # Missing container runtime / bad binary: count it
+                # against the spawn circuit breaker instead of blowing
+                # up the scheduling pass (and every background caller
+                # of _schedule) with FileNotFoundError.
+                self._spawn_failures += 1
+                log_f.write(
+                    f"worker spawn failed: {e} (argv[0]={argv[0]})\n"
+                    .encode())
+                if tpu:
+                    self._chip_alloc.release(worker_id)
+                return None
         finally:
             log_f.close()
-        w = WorkerHandle(worker_id, proc, tpu)
+        w = WorkerHandle(worker_id, proc, tpu, image=image)
         self.workers[worker_id] = w
         return w
 

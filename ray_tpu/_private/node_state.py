@@ -115,15 +115,18 @@ class Bundle:
 class WorkerHandle:
     __slots__ = ("worker_id", "conn_send", "proc", "state", "tpu",
                  "current_task", "actor_id", "resources_held",
-                 "last_idle_time", "pid", "bundle_key")
+                 "last_idle_time", "pid", "bundle_key", "image")
 
     def __init__(self, worker_id: bytes, proc: subprocess.Popen,
-                 tpu: bool) -> None:
+                 tpu: bool, image: Optional[str] = None) -> None:
         self.worker_id = worker_id
         self.conn_send: Optional[Callable[[dict], None]] = None
         self.proc = proc
         self.state = "starting"    # starting | idle | busy | blocked | dead
         self.tpu = tpu
+        # Container image this worker runs inside (runtime_env
+        # image_uri); image workers only take matching tasks.
+        self.image = image
         self.current_task: Optional[TaskRecord] = None
         self.actor_id: Optional[bytes] = None
         self.resources_held: Dict[str, float] = {}

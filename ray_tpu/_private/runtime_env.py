@@ -12,9 +12,14 @@ GCS, download + sys.path injection on workers).  Supported keys:
                <session>/runtime_envs/<hash>/ and prepended to sys.path
                + made the cwd.
   py_modules:  list of directories shipped the same way, sys.path only.
+  image_uri:   container image the task's WORKER runs inside (node
+               service spawns it via _private/container.py — the
+               reference's image_uri plugin role,
+               _private/runtime_env/image_uri.py): dependency isolation
+               for multi-tenant clusters without in-cluster installs.
 
 `pip`/`conda` are rejected: this deployment model forbids installs;
-bake dependencies into the image instead.
+bake dependencies into the image (then pin it with image_uri).
 """
 
 from __future__ import annotations
@@ -28,7 +33,7 @@ import threading
 import zipfile
 from typing import Any, Dict, List, Optional
 
-_ALLOWED = {"env_vars", "working_dir", "py_modules"}
+_ALLOWED = {"env_vars", "working_dir", "py_modules", "image_uri"}
 # content hash -> pinned ObjectRef, scoped to ONE session: refs from a
 # previous init() point into a dead object store.
 _upload_cache: Dict[str, Any] = {}
@@ -75,6 +80,12 @@ def pack(runtime_env: Optional[dict]) -> Optional[dict]:
     env_vars = runtime_env.get("env_vars")
     if env_vars:
         out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+    if runtime_env.get("image_uri"):
+        # Container isolation: the node service runs this task's worker
+        # inside the image (_private/container.py; reference analog
+        # _private/runtime_env/image_uri.py).  Nothing to apply
+        # worker-side — the worker is already in the container.
+        out["image_uri"] = str(runtime_env["image_uri"])
 
     def upload(path: str) -> dict:
         blob = _zip_dir(path)

@@ -69,9 +69,16 @@ class WorkerRuntime:
 
     def run(self) -> None:
         worker_id = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])
+        # Exit the moment the node connection drops: the main thread
+        # blocks on task_queue.get(), so a silent reader-thread death
+        # (driver SIGKILLed -> kernel closes the UDS) would otherwise
+        # leave this process orphaned forever (observed as leaked
+        # worker_main processes after hard driver kills).  A graceful
+        # shutdown still arrives as an explicit "exit" push first.
         self.client = CoreClient(
             os.environ["RAY_TPU_NODE_SOCKET"], kind="worker",
-            client_id=worker_id, push_handler=self.handle_push)
+            client_id=worker_id, push_handler=self.handle_push,
+            on_disconnect=lambda: os._exit(1))
         set_global_client(self.client)
         # Make the worker context importable by user code.
         import ray_tpu

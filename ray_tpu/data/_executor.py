@@ -73,10 +73,15 @@ def _partition_block(block: B.Block, mode: str, P: int,
         if col.dtype.kind in "OUS":
             # Deterministic across worker processes — Python's hash()
             # is salted per interpreter and would scatter one key over
-            # several partitions (silently wrong groupbys).
+            # several partitions (silently wrong groupbys).  crc32 runs
+            # per UNIQUE key, not per row: string columns are usually
+            # low-cardinality and the python-loop hash was the dominant
+            # cost of string groupbys.
             import zlib
-            part = np.asarray(
-                [zlib.crc32(str(x).encode()) % P for x in col])
+            uniq, inv = np.unique(col, return_inverse=True)
+            upart = np.asarray(
+                [zlib.crc32(str(x).encode()) % P for x in uniq])
+            part = upart[inv]
         else:
             part = (col.astype(np.int64, copy=False) % P + P) % P
     elif mode == "range":
@@ -119,15 +124,53 @@ def _reduce_shuffled(seed, *parts: B.Block) -> B.Block:
     return B.block_take(whole, np.random.RandomState(seed).permutation(n))
 
 
+def _arrow_grouped(table, key: str,
+                   aggs: List[Tuple[str, str, str]]):
+    """Arrow-native groupby: C++ hash aggregation (pa.TableGroupBy) —
+    the columnar fast path that skips the numpy-object round-trip for
+    string keys (reference: Arrow-block aggregations,
+    data/_internal/arrow_block.py)."""
+    import pyarrow.compute as pc
+    spec, renames = [], {}
+    for agg, c, out_name in aggs:
+        if agg == "count":
+            spec.append((key, "count"))
+            renames[f"{key}_count"] = out_name
+        elif agg == "std":
+            # ddof=1 to match the numpy path / pandas default.
+            spec.append((c, "stddev", pc.VarianceOptions(ddof=1)))
+            renames[f"{c}_stddev"] = out_name
+        else:
+            spec.append((c, agg))
+            renames[f"{c}_{agg}"] = out_name
+    res = table.group_by(key).aggregate(spec)
+    res = res.sort_by(key)      # numpy path emits sorted-unique keys
+    cols = []
+    names = []
+    for name in res.column_names:
+        col = res[name]
+        out_name = renames.get(name, name)
+        if name.endswith("_stddev"):
+            # Singleton groups: arrow yields null, the numpy path 0.0.
+            col = pc.fill_null(col, 0.0)
+        names.append(out_name)
+        cols.append(col)
+    import pyarrow as pa
+    return pa.table(cols, names=names)
+
+
 @ray_tpu.remote
 def _reduce_grouped(key: str, aggs: List[Tuple[str, str, str]],
                     *parts: B.Block) -> B.Block:
     """Group one hash partition and compute aggregates.
     aggs: [(agg_name, column, out_name)]; every key lands in exactly
-    one partition, so partition-local grouping is globally correct."""
+    one partition, so partition-local grouping is globally correct.
+    Arrow-table partitions take the C++ hash-aggregation path."""
     whole = B.block_concat(list(parts))
-    if not whole:                 # every shard empty for this partition
+    if not B.block_num_rows(whole):  # every shard empty
         return {}
+    if B.is_arrow_block(whole):
+        return _arrow_grouped(whole, key, aggs)
     col = np.asarray(whole[key])
     uniq, inv = np.unique(col, return_inverse=True)
     out: Dict[str, np.ndarray] = {key: uniq}
@@ -192,7 +235,9 @@ def _reduce_group_mapped(key: str, fn, *parts: B.Block) -> B.Block:
     boundaries = np.nonzero(keys_sorted[1:] != keys_sorted[:-1])[0] + 1
     out_blocks: list = []
     for ix in np.split(order, boundaries):
-        group = B.block_take(blk, ix)
+        # User map_groups fns receive the documented dict-of-numpy
+        # batch regardless of the pipeline's block format.
+        group = B.block_to_numpy(B.block_take(blk, ix))
         res = fn(group)
         out_blocks.append({
             k: (np.asarray(v) if np.ndim(v) else np.asarray([v]))
@@ -326,12 +371,13 @@ class MemoryBudget:
 def _windowed(upstream: Iterator[ray_tpu.ObjectRef],
               submit: Callable[[ray_tpu.ObjectRef], ray_tpu.ObjectRef],
               cap: int, preserve_order: bool,
-              budget: Optional[MemoryBudget] = None
-              ) -> Iterator[ray_tpu.ObjectRef]:
+              budget: Optional[MemoryBudget] = None,
+              stats=None) -> Iterator[ray_tpu.ObjectRef]:
     """Shared operator inner loop: keep up to `cap` submitted refs in
     flight (concurrency-cap backpressure), shrunk further so in-flight
     block BYTES stay under the DataContext budget (byte backpressure),
-    yielding in submission order or whichever completes first."""
+    yielding in submission order or whichever completes first.
+    `stats` (data/_stats.OpStats) observes submissions/completions."""
     from ray_tpu.data.context import DataContext
     if budget is None:
         budget = MemoryBudget(
@@ -339,11 +385,15 @@ def _windowed(upstream: Iterator[ray_tpu.ObjectRef],
     window: List[ray_tpu.ObjectRef] = []
     up = iter(upstream)
     exhausted = False
+
+    def _submit(ref) -> None:
+        window.append(submit(ref))
+        if stats is not None:
+            stats.on_submit(len(window))
+
     while not exhausted or window:
         if not exhausted:
-            exhausted, _ = budget.refill(
-                window, up, lambda ref: window.append(submit(ref)),
-                cap)
+            exhausted, _ = budget.refill(window, up, _submit, cap)
         if not window:
             continue
         if preserve_order:
@@ -354,7 +404,15 @@ def _windowed(upstream: Iterator[ray_tpu.ObjectRef],
             window.remove(ready[0])
             got = ready[0]
         budget.observe([got])
+        size = budget._sized.get(got.binary())
         budget.forget(got)
+        if stats is not None:
+            # Only probe the directory for a size when byte
+            # backpressure is on (documented contract; avoids a per-
+            # block RPC on budget-disabled pipelines).
+            stats.on_complete(
+                size, len(window),
+                ref=got if budget.max_bytes is not None else None)
         yield got
 
 
@@ -365,6 +423,7 @@ class FusedMapOp:
     def __init__(self, stages: Optional[List[Callable]] = None) -> None:
         self.stages = list(stages or [])
         self.last_budget: Optional[MemoryBudget] = None  # observable
+        self._stats = None          # OpStats, set by the pipeline
 
     def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
                preserve_order: bool = True
@@ -381,7 +440,7 @@ class FusedMapOp:
             lambda ref: _apply_stages.remote(ref, self.stages,
                                              next(counter)),
             min(MAX_IN_FLIGHT, ctx.max_blocks_in_flight),
-            preserve_order, self.last_budget)
+            preserve_order, self.last_budget, stats=self._stats)
 
 
 class ActorPoolMapOp:
@@ -413,6 +472,7 @@ class ActorPoolMapOp:
         self.current_size = 0
         self.peak_size = 0
         self.last_budget: Optional[MemoryBudget] = None
+        self._stats = None          # OpStats, set by the pipeline
 
     def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
                preserve_order: bool = True
@@ -448,6 +508,8 @@ class ActorPoolMapOp:
             counter[0] += 1
             owner[out.binary()] = actor
             window.append(out)
+            if self._stats is not None:
+                self._stats.on_submit(len(window))
 
         try:
             ecap = 2 * len(actors)
@@ -489,7 +551,13 @@ class ActorPoolMapOp:
                     got = ready[0]
                 owner.pop(got.binary(), None)
                 budget.observe([got])
+                size = budget._sized.get(got.binary())
                 budget.forget(got)
+                if self._stats is not None:
+                    self._stats.on_complete(
+                        size, len(window),
+                        ref=got if budget.max_bytes is not None
+                        else None)
                 yield got
                 # Sustained instant completions: the pool is oversized;
                 # retire an actor that owns none of the in-flight work.
@@ -531,10 +599,13 @@ class ShuffleOp:
         self.seed = seed          # None => fresh randomness per run
         self.aggs = aggs or []
         self.group_fn = group_fn  # kind="groupmap": per-group batch fn
+        self._stats = None        # OpStats, set by the pipeline
 
     def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
                preserve_order: bool = True
                ) -> Iterator[ray_tpu.ObjectRef]:
+        if self._stats is not None:
+            self._stats.on_start()
         inputs = list(upstream)          # stage break: need all blocks
         if not inputs:
             return
@@ -578,20 +649,26 @@ class ShuffleOp:
         for p in order:
             shard = [m[p] for m in parts]
             if self.kind == "sort":
-                yield _reduce_sorted.remote(self.key, self.descending,
+                out = _reduce_sorted.remote(self.key, self.descending,
                                             *shard)
             elif self.kind == "random":
-                yield _reduce_shuffled.remote(
+                out = _reduce_shuffled.remote(
                     (seed + p) & 0x7FFFFFFF, *shard)
             elif self.kind == "groupby":
-                yield _reduce_grouped.remote(self.key, self.aggs,
+                out = _reduce_grouped.remote(self.key, self.aggs,
                                              *shard)
             elif self.kind == "groupmap":
-                yield _reduce_group_mapped.remote(self.key,
+                out = _reduce_group_mapped.remote(self.key,
                                                   self.group_fn,
                                                   *shard)
             else:
-                yield _reduce_concat.remote(*shard)
+                out = _reduce_concat.remote(*shard)
+            if self._stats is not None:
+                # Stage break: reduce refs hand off downstream
+                # immediately; depth tracks un-pulled partitions.
+                self._stats.on_submit(1)
+                self._stats.on_complete(None, 0)
+            yield out
 
 
 @ray_tpu.remote
@@ -600,8 +677,8 @@ def _reduce_join(key: str, n_left: int, *parts: B.Block) -> B.Block:
     left side's shards, the rest the right's (reference:
     data/grouped_data.py join exchange).  Overlapping non-key right
     columns get a `_right` suffix."""
-    left = B.block_concat(list(parts[:n_left]))
-    right = B.block_concat(list(parts[n_left:]))
+    left = B.block_to_numpy(B.block_concat(list(parts[:n_left])))
+    right = B.block_to_numpy(B.block_concat(list(parts[n_left:])))
     if not left or not right:
         return {}
     lk = np.asarray(left[key])
@@ -636,10 +713,13 @@ class JoinOp:
         self.right_ds = right_ds
         self.on = on
         self.P = num_partitions
+        self._stats = None          # OpStats, set by the pipeline
 
     def stream(self, upstream: Iterator[ray_tpu.ObjectRef],
                preserve_order: bool = True
                ) -> Iterator[ray_tpu.ObjectRef]:
+        if self._stats is not None:
+            self._stats.on_start()
         left = list(upstream)
         right = self.right_ds._block_refs()
         if not left or not right:
@@ -656,8 +736,12 @@ class JoinOp:
         for p in range(P):
             lshard = [m[p] for m in lparts]
             rshard = [m[p] for m in rparts]
-            yield _reduce_join.remote(self.on, len(lshard),
+            out = _reduce_join.remote(self.on, len(lshard),
                                       *lshard, *rshard)
+            if self._stats is not None:
+                self._stats.on_submit(1)
+                self._stats.on_complete(None, 0)
+            yield out
 
 
 @ray_tpu.remote

@@ -1,19 +1,35 @@
-"""Block model: a block is a column batch (dict of numpy arrays).
+"""Block model: a block is a column batch — dict-of-numpy OR an Arrow
+Table, accessor-dispatched.
 
 Analog of the reference's Block/BlockAccessor (data/block.py:196/221)
-where a block is an Arrow/Pandas chunk in plasma.  We use dict-of-numpy
-as the canonical in-memory format — it serializes zero-copy through the
-shm object store (pickle-5 buffers) and converts for free to jax device
-arrays; pyarrow/pandas conversions are provided at the edges.
+where a block is an Arrow/Pandas chunk in plasma.  dict-of-numpy is the
+canonical tensor-path format — it serializes zero-copy through the shm
+object store (pickle-5 buffers) and converts for free to jax device
+arrays.  ``pyarrow.Table`` is the native COLUMNAR format
+(DataContext.block_format="arrow" or Dataset.from_arrow): string/nested
+columns skip the numpy-object round-trip, slices are zero-copy views,
+and groupbys run Arrow's C++ hash aggregation (_executor._reduce_grouped
+fast path).  Every ``block_*`` accessor below dispatches on type, so
+operators never care which format flows through (reference:
+BlockAccessor.for_block, data/block.py:221).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
-Block = Dict[str, np.ndarray]
+Block = Union[Dict[str, np.ndarray], "pyarrow.Table"]  # noqa: F821
+
+
+def is_arrow_block(block) -> bool:
+    """True when the block is a pyarrow.Table (cheap: no pyarrow import
+    unless the object plausibly is one)."""
+    if type(block).__module__.split(".")[0] != "pyarrow":
+        return False
+    import pyarrow as pa
+    return isinstance(block, pa.Table)
 
 
 def block_from_rows(rows: Sequence[Dict[str, Any]]) -> Block:
@@ -33,12 +49,16 @@ def block_from_items(items: Sequence[Any]) -> Block:
 
 
 def block_num_rows(block: Block) -> int:
+    if is_arrow_block(block):
+        return block.num_rows
     for v in block.values():
         return len(v)
     return 0
 
 
 def block_slice(block: Block, start: int, end: int) -> Block:
+    if is_arrow_block(block):
+        return block.slice(start, max(end - start, 0))  # zero-copy view
     return {k: v[start:end] for k, v in block.items()}
 
 
@@ -46,15 +66,32 @@ def block_concat(blocks: Sequence[Block]) -> Block:
     blocks = [b for b in blocks if block_num_rows(b) > 0]
     if not blocks:
         return {}
+    if any(is_arrow_block(b) for b in blocks):
+        import pyarrow as pa
+        tables = [b if is_arrow_block(b) else block_to_arrow(b)
+                  for b in blocks]
+        return pa.concat_tables(tables, promote_options="default")
     keys = blocks[0].keys()
     return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
 
 
 def block_take(block: Block, indices: np.ndarray) -> Block:
+    if is_arrow_block(block):
+        return block.take(np.asarray(indices))
     return {k: v[indices] for k, v in block.items()}
 
 
+def block_column(block: Block, key: str) -> np.ndarray:
+    """One column as numpy (object dtype for Arrow strings)."""
+    if is_arrow_block(block):
+        return np.asarray(block[key])
+    return np.asarray(block[key])
+
+
 def block_rows(block: Block) -> Iterator[Dict[str, Any]]:
+    if is_arrow_block(block):
+        yield from block.to_pylist()
+        return
     n = block_num_rows(block)
     keys = list(block.keys())
     for i in range(n):
@@ -62,8 +99,18 @@ def block_rows(block: Block) -> Iterator[Dict[str, Any]]:
 
 
 def block_nbytes(block: Block) -> int:
+    if is_arrow_block(block):
+        return block.nbytes
     return sum(v.nbytes for v in block.values()
                if isinstance(v, np.ndarray))
+
+
+def block_to_numpy(block: Block) -> Dict[str, np.ndarray]:
+    """Canonical dict-of-numpy view of any block format (used where an
+    op's kernel is numpy-specific, e.g. the hash join)."""
+    if is_arrow_block(block):
+        return block_from_arrow(block)
+    return block
 
 
 def block_to_pandas(block: Block):
@@ -80,9 +127,12 @@ def block_to_arrow(block: Block):
     """Tensor columns ([N, d0, ...]) become FixedSizeList arrays over a
     flat values buffer — zero-copy from the numpy view — with the inner
     shape recorded in field metadata so >2-D tensors round-trip
-    (reference: ArrowTensorArray, data/_internal/arrow_block.py)."""
+    (reference: ArrowTensorArray, data/_internal/arrow_block.py).
+    Arrow-native blocks pass through unchanged."""
     import json
     import pyarrow as pa
+    if is_arrow_block(block):
+        return block
     arrays, fields = [], []
     for k, v in block.items():
         if getattr(v, "ndim", 1) > 1 and v.dtype != object:

@@ -29,6 +29,12 @@ class DataContext:
     max_bytes_in_flight: Optional[int] = 256 * 1024 * 1024
     # Default rows per block for constructors (from_numpy etc.).
     block_rows: int = 4096
+    # Block format for columnar readers (read_parquet/csv/json):
+    # "numpy" converts to dict-of-numpy at read time (tensor path);
+    # "arrow" keeps pyarrow Tables end-to-end — string/nested columns
+    # skip the numpy-object round-trip and groupbys run Arrow's C++
+    # hash aggregation (reference: Arrow blocks, data/block.py:196).
+    block_format: str = "numpy"
     # Files decoded per read_images block.
     images_per_block: int = 64
 

@@ -104,12 +104,16 @@ class Dataset:
         data/read_api.py over pyarrow/fsspec filesystems)."""
         files = _expand_paths(paths, (".parquet",))
 
+        from ray_tpu.data.context import DataContext
+        fmt = DataContext.get_current().block_format
+
         def make_reader(path):
             def read():
                 import pyarrow.parquet as pq
                 from ray_tpu.data.filesystem import open_file
                 with open_file(path, "rb") as f:
-                    return B.block_from_arrow(pq.read_table(f))
+                    t = pq.read_table(f)
+                return t if fmt == "arrow" else B.block_from_arrow(t)
             return read
 
         return Dataset([make_reader(f) for f in files], [])
@@ -118,12 +122,16 @@ class Dataset:
     def read_csv(paths: Union[str, List[str]]) -> "Dataset":
         files = _expand_paths(paths, (".csv",))
 
+        from ray_tpu.data.context import DataContext
+        fmt = DataContext.get_current().block_format
+
         def make_reader(path):
             def read():
                 import pyarrow.csv as pacsv
                 from ray_tpu.data.filesystem import open_file
                 with open_file(path, "rb") as f:
-                    return B.block_from_arrow(pacsv.read_csv(f))
+                    t = pacsv.read_csv(f)
+                return t if fmt == "arrow" else B.block_from_arrow(t)
             return read
 
         return Dataset([make_reader(f) for f in files], [])
@@ -335,14 +343,21 @@ class Dataset:
                     concurrency: Union[int, Tuple[int, int]] = 2,
                     num_cpus: float = 1.0,
                     fn_constructor_args: tuple = (),
-                    fn_constructor_kwargs: Optional[dict] = None
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    batch_format: Optional[str] = "numpy"
                     ) -> "Dataset":
         """Per-block batch transform.  compute='actors' (or a class fn)
         runs on a reusable actor pool: stateful/expensive setup happens
         once per actor (reference: actor_pool_map_operator.py).
         `concurrency` may be (min, max) for an autoscaling pool that
         grows on backlog and shrinks when oversized (reference:
-        execution/autoscaler/default_autoscaler.py)."""
+        execution/autoscaler/default_autoscaler.py).
+
+        `batch_format` is what `fn` RECEIVES (reference:
+        map_batches(batch_format=...)): "numpy" (default) a dict of
+        numpy arrays, "pyarrow" an Arrow Table, None the pipeline's
+        native block unconverted.  fn may return either format."""
+        coerce = _coerce_stage(batch_format)
         if compute == "actors" or isinstance(fn, type):
             # Fold any pending fused stages into the actor op so the
             # pool applies them in the same task.
@@ -352,8 +367,11 @@ class Dataset:
                 before = plan.pop().stages
             plan.append(X.ActorPoolMapOp(
                 fn, concurrency, fn_constructor_args,
-                fn_constructor_kwargs, num_cpus, before))
+                fn_constructor_kwargs, num_cpus, before + coerce))
             return Dataset(self._sources, plan, self._materialized)
+        if coerce:
+            conv = coerce[0]
+            return self._with_stage(lambda b: [fn(conv(b)[0])])
         return self._with_stage(lambda b: [fn(b)])
 
     def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]
@@ -472,15 +490,24 @@ class Dataset:
                          ) -> Iterator[ray_tpu.ObjectRef]:
         """Chain every operator's streaming window over the sources —
         the whole pipeline advances by downstream pull (backpressure by
-        laziness + per-op in-flight caps)."""
+        laziness + per-op in-flight caps).  Each execution builds a
+        fresh PipelineStats; per-op counters surface via stats() and
+        util.metrics (reference: data/_internal/stats.py)."""
+        from ray_tpu.data._stats import OpStats, PipelineStats
+
         it: Iterator[ray_tpu.ObjectRef] = self._source_ref_iter()
         if not self._plan:
             # No operator window pulls ahead of the consumer — wrap the
             # sources in a pass-through window so read tasks stay
             # submitted MAX_IN_FLIGHT deep instead of one at a time.
+            ps = PipelineStats(["Read"])
+            self._pipeline_stats = ps
             return X._windowed(it, lambda ref: ref, X.MAX_IN_FLIGHT,
-                               preserve_order)
-        for op in self._plan:
+                               preserve_order, stats=ps.ops[0])
+        ps = PipelineStats([type(op).__name__ for op in self._plan])
+        self._pipeline_stats = ps
+        for op, ost in zip(self._plan, ps.ops):
+            op._stats = ost
             it = op.stream(it, preserve_order=preserve_order)
         return it
 
@@ -508,18 +535,29 @@ class Dataset:
             yield blk
 
     def stats(self) -> str:
-        """Execution summary of the most recent full/partial iteration
-        (reference: Dataset.stats / _internal/stats.py)."""
+        """Execution summary of the most recent full/partial iteration,
+        including per-operator counters (reference: Dataset.stats /
+        _internal/stats.py)."""
         st = getattr(self, "_last_stats", None)
         if st is None:
             return "Dataset has not been executed yet"
         mb = st["bytes"] / 1e6
         thru = st["rows"] / st["wall_s"] if st["wall_s"] > 0 else 0.0
-        return (f"plan: {st['plan']}\n"
-                f"blocks: {st['blocks']}, rows: {st['rows']}, "
-                f"bytes: {mb:.1f} MB\n"
-                f"wall: {st['wall_s']:.3f}s, throughput: "
-                f"{thru:,.0f} rows/s")
+        out = (f"plan: {st['plan']}\n"
+               f"blocks: {st['blocks']}, rows: {st['rows']}, "
+               f"bytes: {mb:.1f} MB\n"
+               f"wall: {st['wall_s']:.3f}s, throughput: "
+               f"{thru:,.0f} rows/s")
+        ps = getattr(self, "_pipeline_stats", None)
+        if ps is not None and ps.ops:
+            out += "\nper-op:\n" + ps.summary()
+        return out
+
+    def stats_dict(self) -> dict:
+        """Machine-readable per-op stats of the most recent execution
+        (the same numbers flow to /api/metrics.json via util.metrics)."""
+        ps = getattr(self, "_pipeline_stats", None)
+        return ps.to_dict() if ps is not None else {}
 
     def materialize(self) -> "Dataset":
         refs = self._block_refs()

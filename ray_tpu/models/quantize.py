@@ -1,0 +1,274 @@
+"""Weight-only int8 quantization for serving large models on one chip.
+
+The BASELINE north star is Llama-8B-shape serving: 8B bf16 weights are
+16 GB — exactly the v5e's HBM, leaving nothing for the KV cache.  Int8
+with per-output-channel scales halves that (~8 GB + ~2 GB KV at 16
+slots x 1k ctx), so the 8B shape fits a single chip with headroom.
+
+Design (TPU-first):
+  * ``QuantizedArray`` is a registered pytree node holding ``q`` (int8)
+    and a broadcast-ready per-output-channel scale ``s`` (f32).  It
+    exposes ``astype``/``__getitem__``/``.T`` — the only three ways
+    model code touches weights — so the *unchanged* decode path
+    (models/decoding.py) runs quantized: ``p["wq"].astype(h.dtype)``
+    dequantizes in-register and XLA fuses the int8 load + convert +
+    scale into the matmul's operand read.  HBM traffic (the decode
+    bottleneck) halves; the MXU still sees bf16.
+  * Scales sit on the non-contracted (output) axes, so accuracy follows
+    the per-channel weight range, and for stacked per-layer weights the
+    scale keeps the leading layer axis — ``lax.scan`` slices q and s
+    together.
+  * ``init_quantized_params`` builds random int8 weights *directly* on
+    device (no f32 stage), so an 8B-shape engine can be stood up for
+    benchmarking on a 16 GB chip that could never hold the f32 tree.
+
+Reference contrast: the reference has no quantization of its own — it
+serves quantized LLMs only by delegating to vLLM on GPU
+(doc/source/serve/doc_code/vllm_example.py).  Here the serving engine
+owns the weights, so quantization is a framework feature.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedArray:
+    """int8 tensor + f32 per-output-channel scale, drop-in for weights.
+
+    ``s`` has the same rank as ``q`` with size 1 on contracted axes, so
+    ``q * s`` broadcasts to the dequantized tensor.
+    """
+
+    __slots__ = ("q", "s")
+
+    def __init__(self, q, s):
+        self.q, self.s = q, s
+
+    # -- the three access patterns model code uses ----------------------
+    def astype(self, dtype):
+        """Dequantize. f32 multiply, then cast: one fused elementwise
+        chain that XLA folds into the consuming matmul's operand load."""
+        return (self.q.astype(jnp.float32) * self.s).astype(dtype)
+
+    def __getitem__(self, idx):
+        """Gather-then-dequantize (embedding lookups). Returns a plain
+        f32 array; callers .astype() it like any other weight."""
+        return self.q[idx].astype(jnp.float32) * self.s[idx]
+
+    @property
+    def T(self) -> "QuantizedArray":
+        return QuantizedArray(self.q.T, self.s.T)
+
+    # -- introspection used by num_params / checkpointing ---------------
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def size(self):
+        return self.q.size
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def nbytes_total(self) -> int:
+        return (self.q.size * self.q.dtype.itemsize
+                + self.s.size * self.s.dtype.itemsize)
+
+    def __repr__(self):
+        return f"QuantizedArray(q={self.q.shape}, s={self.s.shape})"
+
+    # -- pytree protocol -------------------------------------------------
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def quantize(w: jax.Array, contract_axes: Tuple[int, ...]
+             ) -> QuantizedArray:
+    """Symmetric per-output-channel int8 quantization.
+
+    ``contract_axes`` are the axes the consuming matmul sums over (plus
+    any stacked-layer axis is NOT included — scales keep it so scan can
+    slice).  Scale = absmax/127 over those axes.
+    """
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=contract_axes, keepdims=True)
+    s = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return QuantizedArray(q, s)
+
+
+# Per-weight contracted axes, EXCLUDING the leading stacked-layer axis
+# (handled by offset below).  Matches the einsums in transformer.py /
+# decoding.py: e.g. wq [d,h,dh] contracts d; wo [h,dh,d] contracts h,dh.
+_LAYER_CONTRACT = {
+    "wq": (0,), "wk": (0,), "wv": (0,),
+    "wo": (0, 1),
+    "w_gate": (0,), "w_up": (0,),
+    "w_down": (0,),
+}
+# MoE variants carry a leading expert axis [E, ...]:
+_MOE_CONTRACT = {"w_gate": (1,), "w_up": (1,), "w_down": (1,)}
+
+
+def quantize_params(params: Dict[str, Any], cfg: TransformerConfig,
+                    ) -> Dict[str, Any]:
+    """Quantize a full-precision parameter tree for serving.
+
+    Matmul weights (attention + MLP projections, embeddings, lm_head)
+    become QuantizedArray; norms/biases/router stay full precision.
+    The returned tree feeds models/decoding.py unchanged.
+    """
+    moe = cfg.moe_experts > 0
+    layers = dict(params["layers"])
+    for name in _LAYER_CONTRACT:
+        if name not in layers:
+            continue
+        axes = (_MOE_CONTRACT.get(name, _LAYER_CONTRACT[name])
+                if moe and name in _MOE_CONTRACT
+                else _LAYER_CONTRACT[name])
+        # +1: stacked [L, ...] layer axis stays un-reduced so scan
+        # slices q and s in step.
+        layers[name] = quantize(layers[name],
+                                tuple(a + 1 for a in axes))
+    out = dict(params, layers=layers)
+    # tok_embed [V, D]: per-row (vocab) scales — correct for the gather
+    # AND, transposed, per-output-channel for the tied lm_head matmul.
+    out["tok_embed"] = quantize(params["tok_embed"], (1,))
+    if "lm_head" in params:   # [D, V] contracts D
+        out["lm_head"] = quantize(params["lm_head"], (0,))
+    return out
+
+
+def _init_quantized_layer(cfg: TransformerConfig, key: jax.Array,
+                          L: int) -> Dict[str, Any]:
+    d, h, hkv, dh, f = (cfg.d_model, cfg.n_heads, cfg.kv_heads,
+                        cfg.head_dim, cfg.ff_dim)
+    scale_in = 1.0 / math.sqrt(d)
+    scale_out = 1.0 / math.sqrt(d) / math.sqrt(2 * L)
+
+    def rand_q(key, shape, scale, contract_axes):
+        # int8 uniform in [-127, 127]; scale chosen so the dequantized
+        # std ~ the init std (uniform/127 has std ~0.58).
+        q = jax.random.randint(key, shape, -127, 128, jnp.int8)
+        s_shape = tuple(1 if i in contract_axes else n
+                        for i, n in enumerate(shape))
+        s = jnp.full(s_shape, scale / 0.58 / 127.0, jnp.float32)
+        return QuantizedArray(q, s)
+
+    def layer_init(key):
+        ks = jax.random.split(key, 8)
+        p = {
+            "attn_norm": jnp.ones((L, d), cfg.param_dtype),
+            "wq": rand_q(ks[0], (L, d, h, dh), scale_in, (1,)),
+            "wk": rand_q(ks[1], (L, d, hkv, dh), scale_in, (1,)),
+            "wv": rand_q(ks[2], (L, d, hkv, dh), scale_in, (1,)),
+            "wo": rand_q(ks[3], (L, h, dh, d), scale_out, (1, 2)),
+            "mlp_norm": jnp.ones((L, d), cfg.param_dtype),
+            "w_down": rand_q(ks[5], (L, f, d), scale_out, (1,)),
+        }
+        if cfg.arch == "llama":
+            p["w_gate"] = rand_q(ks[4], (L, d, f), scale_in, (1,))
+            p["w_up"] = rand_q(ks[6], (L, d, f), scale_in, (1,))
+        else:
+            p["w_up"] = rand_q(ks[6], (L, d, f), scale_in, (1,))
+            p["b_up"] = jnp.zeros((L, f), cfg.param_dtype)
+            p["b_down"] = jnp.zeros((L, d), cfg.param_dtype)
+            p["attn_norm_b"] = jnp.zeros((L, d), cfg.param_dtype)
+            p["mlp_norm_b"] = jnp.zeros((L, d), cfg.param_dtype)
+        return p
+
+    return layer_init(key)
+
+
+def init_quantized_params(cfg: TransformerConfig,
+                          key: jax.Array) -> Dict[str, Any]:
+    """Random int8-quantized params, built WITHOUT an f32 stage.
+
+    For standing up large-shape serving benchmarks: an 8B f32 tree is
+    32 GB and can never exist on a 16 GB chip; this builds the int8
+    tree (~8 GB for llama-8b) directly.  MoE shapes are for the train
+    path only and are not supported here.
+    """
+    if cfg.moe_experts > 0:
+        raise NotImplementedError("quantized serving is dense-only")
+    keys = jax.random.split(key, 4)
+    d = cfg.d_model
+    params: Dict[str, Any] = {
+        "tok_embed": QuantizedArray(
+            jax.random.randint(keys[1], (cfg.vocab_size, d), -127, 128,
+                               jnp.int8),
+            jnp.full((cfg.vocab_size, 1), 1.0 / 0.58 / 127.0,
+                     jnp.float32)),
+        "layers": _init_quantized_layer(cfg, keys[0], cfg.n_layers),
+        "final_norm": jnp.ones((d,), cfg.param_dtype),
+    }
+    if cfg.arch == "gpt2":
+        params["pos_embed"] = (
+            jax.random.normal(keys[2], (cfg.max_seq, d), jnp.float32)
+            * 0.01).astype(cfg.param_dtype)
+        params["final_norm_b"] = jnp.zeros((d,), cfg.param_dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = QuantizedArray(
+            jax.random.randint(keys[3], (d, cfg.vocab_size), -127, 128,
+                               jnp.int8),
+            jnp.full((1, cfg.vocab_size),
+                     (1.0 / math.sqrt(d)) / 0.58 / 127.0, jnp.float32))
+    return params
+
+
+def param_bytes(params) -> int:
+    """Total parameter-tree bytes (counts q+s for QuantizedArray).
+    Works on concrete arrays AND ShapeDtypeStructs (eval_shape)."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+def kv_cache_bytes(cfg: TransformerConfig, num_slots: int,
+                   max_len: int) -> int:
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return (2 * cfg.n_layers * num_slots * max_len * cfg.kv_heads
+            * cfg.head_dim * itemsize)
+
+
+def serving_memory_report(cfg: TransformerConfig, num_slots: int,
+                          max_len: int,
+                          quantized: bool = True) -> Dict[str, Any]:
+    """Shape-only HBM budget for a serving config (no allocation)."""
+    init = init_quantized_params if quantized else None
+    if quantized:
+        tree = jax.eval_shape(
+            lambda: init(cfg, jax.random.PRNGKey(0)))
+    else:
+        from ray_tpu.models.transformer import init_params
+        tree = jax.eval_shape(
+            lambda: init_params(cfg, jax.random.PRNGKey(0)))
+        # served full-precision weights are cast to cfg.dtype once
+        tree = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, cfg.dtype), tree)
+    pb = param_bytes(tree)
+    kb = kv_cache_bytes(cfg, num_slots, max_len)
+    return {"param_gb": round(pb / 2**30, 2),
+            "kv_cache_gb": round(kb / 2**30, 2),
+            "total_gb": round((pb + kb) / 2**30, 2),
+            "quantized": quantized,
+            "num_slots": num_slots, "max_len": max_len}

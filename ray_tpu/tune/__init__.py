@@ -13,7 +13,8 @@ from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      HyperBandScheduler,
                                      MedianStoppingRule, PB2,
                                      PopulationBasedTraining)
-from ray_tpu.tune.search import (BOHBSearcher, TPESearcher, choice,
+from ray_tpu.tune.search import (BOHBSearcher, ExternalSearcher,
+                                 TPESearcher, choice,
                                  grid_search, loguniform, randint,
                                  uniform)
 from ray_tpu.tune.tuner import (ResultGrid, TuneConfig, Tuner,
@@ -23,6 +24,6 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "ASHAScheduler",
     "HyperBandScheduler", "PopulationBasedTraining", "PB2",
     "MedianStoppingRule", "FIFOScheduler", "grid_search", "uniform",
-    "loguniform", "randint", "choice", "TPESearcher", "BOHBSearcher",
+    "loguniform", "randint", "choice", "TPESearcher", "BOHBSearcher", "ExternalSearcher",
     "with_parameters",
 ]

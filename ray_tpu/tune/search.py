@@ -397,3 +397,96 @@ class BOHBSearcher(TPESearcher):
         else:
             budget = max(eligible)
         return self._suggest_from(self._by_budget[budget], space)
+
+
+def _freeze(obj):
+    """Deterministic hashable key for a (possibly nested) config."""
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    return obj
+
+
+class ExternalSearcher:
+    """Generic ask-tell adapter: plug ANY external optimizer into Tune.
+
+    The reference wraps each library separately (Optuna at
+    tune/search/optuna/optuna_search.py:79, HyperOpt, Ax, HEBO,
+    Nevergrad — one adapter class each); this single seam covers the
+    whole category: the user supplies
+
+        ask(param_space) -> config  |  (config, handle)
+        tell(handle_or_config, score) -> None        (optional)
+
+    and the adapter does the bookkeeping Tune needs: it extracts the
+    objective from reported metrics, flips the sign so the external
+    optimizer always sees a MAXIMIZATION problem (``mode="min"``
+    negates), and routes each completion back to the ask() that
+    produced it (configs are keyed structurally, so duplicate configs
+    resolve FIFO to their own handles).
+
+    Optuna example (works with any study — see ``from_optuna``)::
+
+        study = optuna.create_study(direction="maximize")
+        searcher = ExternalSearcher.from_optuna(
+            study,
+            lambda trial: {"lr": trial.suggest_float(
+                "lr", 1e-5, 1e-1, log=True)},
+            metric="acc")
+        Tuner(train_fn, param_space={},  # space lives in suggest_fn
+              tune_config=TuneConfig(search_alg=searcher,
+                                     num_samples=20)).fit()
+    """
+
+    def __init__(self, ask, tell=None, metric: str = "score",
+                 mode: str = "max") -> None:
+        if mode not in ("max", "min"):
+            raise ValueError(f"mode must be max|min, got {mode!r}")
+        self.ask = ask
+        self.tell = tell
+        self.metric = metric
+        self.mode = mode
+        self._handles: Dict[Any, List[Any]] = {}
+
+    # -- Tune searcher contract (same as TPESearcher) -------------------
+    def suggest(self, space: Dict[str, Any]) -> Dict[str, Any]:
+        out = self.ask(space)
+        if isinstance(out, tuple) and len(out) == 2:
+            config, handle = out
+        else:
+            config, handle = out, None
+        if handle is not None:
+            self._handles.setdefault(_freeze(config), []).append(handle)
+        return config
+
+    def record(self, config: Dict[str, Any],
+               metrics: Dict[str, Any]) -> None:
+        if self.tell is None or self.metric not in metrics:
+            return
+        score = float(metrics[self.metric])
+        if self.mode == "min":
+            score = -score
+        handles = self._handles.get(_freeze(config))
+        handle = handles.pop(0) if handles else config
+        try:
+            self.tell(handle, score)
+        except Exception:
+            # An external optimizer that rejects a duplicate/stale
+            # report must not kill the sweep loop.
+            pass
+
+    @classmethod
+    def from_optuna(cls, study, suggest_fn, metric: str,
+                    mode: str = "max") -> "ExternalSearcher":
+        """Adapter over an optuna Study: ``suggest_fn(trial) -> config``
+        defines the space via optuna's native suggest_* calls."""
+
+        def ask(_space):
+            trial = study.ask()
+            return suggest_fn(trial), trial
+
+        def tell(handle, score):
+            study.tell(handle, score)
+
+        return cls(ask, tell, metric=metric, mode=mode)

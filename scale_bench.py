@@ -1,0 +1,149 @@
+"""Scalability-envelope harness: control-plane throughput vs node count.
+
+Analog of the reference's standing envelope suite
+(release/benchmarks/README.md:7-12 — many_nodes/many_actors/many_pgs —
+with results checked into release/release_logs/<version>/benchmarks/).
+Runs against the in-process virtual cluster (cluster_utils.Cluster: a
+real GCS + N real node-service subprocesses on this host), so the
+numbers measure the CONTROL PLANE — scheduling, dispatch, GCS, PG 2PC
+— not worker compute.
+
+Measures, at 1/2/4/8 virtual nodes:
+  * tasks/s          — drain N no-op tasks spread over the cluster
+  * actors/s         — create+ping K actors, then kill
+  * pg create/remove — sequential placement-group 2PC latency
+plus a 200-actor churn (create/kill loop) at the largest size.
+
+Writes SCALE_<round>.json (SCALE_ROUND env, default r05) and prints
+one JSON line.  tests/test_scale_envelope.py runs a shrunk version as
+the CI regression gate.  Reference baselines for orientation (64-node
+cluster, BASELINE.md): 334-589 tasks/s, 580 actors/s, PG 0.91/0.86 ms.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List
+
+
+def measure_tasks(ray_tpu, n: int) -> float:
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    # warm the worker pools
+    ray_tpu.get([noop.remote(i) for i in range(8)])
+    t0 = time.perf_counter()
+    ray_tpu.get([noop.remote(i) for i in range(n)])
+    return n / (time.perf_counter() - t0)
+
+
+def measure_actors(ray_tpu, k: int) -> float:
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(k)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+    rate = k / (time.perf_counter() - t0)
+    for a in actors:
+        ray_tpu.kill(a)
+    return rate
+
+
+def measure_pg(ray_tpu, n: int) -> Dict[str, float]:
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    create_s = 0.0
+    remove_s = 0.0
+    for _ in range(n):
+        t0 = time.perf_counter()
+        pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+        ray_tpu.get(pg.ready())
+        create_s += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        remove_placement_group(pg)
+        remove_s += time.perf_counter() - t0
+    return {"pg_create_ms": round(create_s / n * 1e3, 2),
+            "pg_remove_ms": round(remove_s / n * 1e3, 2)}
+
+
+def measure_actor_churn(ray_tpu, total: int, batch: int = 50) -> float:
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    done = 0
+    while done < total:
+        k = min(batch, total - done)
+        actors = [A.remote() for _ in range(k)]
+        ray_tpu.get([a.ping.remote() for a in actors])
+        for a in actors:
+            ray_tpu.kill(a)
+        done += k
+    return total / (time.perf_counter() - t0)
+
+
+def run_envelope(node_counts: List[int], n_tasks: int, n_actors: int,
+                 n_pgs: int, churn: int) -> dict:
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    results = []
+    for nodes in node_counts:
+        cluster = Cluster()
+        extra = nodes - 1
+        for _ in range(extra):
+            cluster.add_node(resources={"CPU": 2.0})
+        ray_tpu.init(num_cpus=2, gcs_address=cluster.gcs_address)
+        try:
+            cluster.wait_for_nodes(nodes)
+            row = {
+                "nodes": nodes,
+                "tasks_per_s": round(measure_tasks(ray_tpu, n_tasks), 1),
+                "actors_per_s": round(
+                    measure_actors(ray_tpu, n_actors), 1),
+                **measure_pg(ray_tpu, n_pgs),
+            }
+            if nodes == node_counts[-1]:
+                row["actor_churn_per_s"] = round(
+                    measure_actor_churn(ray_tpu, churn), 1)
+            results.append(row)
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+    return {
+        "metric": "scale_envelope",
+        "host_cpus": os.cpu_count(),
+        "n_tasks": n_tasks, "n_actors": n_actors, "n_pgs": n_pgs,
+        "churn_actors": churn,
+        "levels": results,
+        "reference": {"tasks_per_s_64node": 589,
+                      "actors_per_s_64node": 580,
+                      "pg_create_ms": 0.91, "pg_remove_ms": 0.86,
+                      "source": "BASELINE.md (64x64-core cluster)"},
+    }
+
+
+def main() -> None:
+    quick = os.environ.get("SCALE_QUICK", "") not in ("", "0", "false")
+    if quick:
+        out = run_envelope([1, 2], n_tasks=60, n_actors=8, n_pgs=5,
+                           churn=20)
+    else:
+        out = run_envelope([1, 2, 4, 8], n_tasks=400, n_actors=40,
+                           n_pgs=20, churn=200)
+    rnd = os.environ.get("SCALE_ROUND", "r05")
+    with open(f"SCALE_{rnd}.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

