@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import serialization as ser
+from ray_tpu._private import tracing
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.protocol import (Connection, connect_tcp,
@@ -312,6 +313,8 @@ class CoreClient:
             "pg": pg,
             "runtime_env": runtime_env,
             "affinity": affinity,
+            "submit_ts": time.time(),
+            "trace_ctx": tracing.for_submit(),
         }
         if actor_spec_extra:
             spec.update(actor_spec_extra)
@@ -319,7 +322,7 @@ class CoreClient:
         # back via spec.get(...) server-side, so absent == default.
         # (actor_id/pg/resources are accessed directly and must stay.)
         for k in ("method_name", "runtime_env", "affinity",
-                  "is_actor_creation"):
+                  "is_actor_creation", "trace_ctx"):
             if not spec.get(k):
                 del spec[k]
         # One-way submit: return ids are generated client-side and any
@@ -500,6 +503,8 @@ class CoreClient:
             "owner": self.client_id,
             "pg": pg,
             "runtime_env": runtime_env,
+            "submit_ts": time.time(),
+            "trace_ctx": tracing.for_submit(),
         }
         spec = {
             "actor_id": actor_id,

@@ -779,6 +779,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             # Drop deps that are already ready.
             rec.deps = {d for d in rec.deps
                         if not self._object_ready(d)}
+            if rec.had_deps and not rec.deps:
+                rec.stages.setdefault("deps_fetched", time.time())
             if self.multinode:
                 # Deps produced on other nodes (earlier spills, remote
                 # actors) must be pulled or this task waits forever;
@@ -886,13 +888,19 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         for wake in waiters:
             wake()
         # Unblock tasks waiting on this object.
+        now = time.time()
         for rec in list(self.pending_queue):
-            rec.deps.discard(oid)
+            if oid in rec.deps:
+                rec.deps.discard(oid)
+                if not rec.deps:
+                    rec.stages.setdefault("deps_fetched", now)
         for actor in self.actors.values():
             touched = False
             for rec in actor.queue:
                 if oid in rec.deps:
                     rec.deps.discard(oid)
+                    if not rec.deps:
+                        rec.stages.setdefault("deps_fetched", now)
                     touched = True
             if touched:
                 self._drain_actor_queue(actor)
@@ -1020,6 +1028,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     self.finish_stream(oid)   # wake parked consumers
             if rec is not None:
                 rec.state = "done"
+                self._emit_lifecycle(rec, prof=prof,
+                                     failed=m.get("failed", False))
                 # Lineage for reconstruction: remember how each return
                 # was produced (plain tasks only — actor calls depend on
                 # actor state and are not replayable).
@@ -1473,6 +1483,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 e = self.objects.setdefault(oid, ObjectEntry())
                 e.producing_task = rec.task_id
             rec.deps = {d for d in rec.deps if not self._object_ready(d)}
+            if rec.had_deps and not rec.deps:
+                rec.stages.setdefault("deps_fetched", time.time())
             for d in rec.deps:
                 self._ensure_pull(d)
             self.pending_queue.append(rec)
@@ -1533,6 +1545,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         while actor.queue and not actor.queue[0].deps:
             rec = actor.queue.popleft()
             rec.state = "dispatched"
+            now = time.time()
+            if rec.had_deps:
+                rec.stages.setdefault("deps_fetched", now)
+            rec.stages["worker_assigned"] = now
             actor.in_flight[rec.task_id] = rec
             actor.worker.conn_send({"type": "execute_task",
                                     "spec": rec.spec})
@@ -1885,6 +1901,84 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         ctx.reply(m, {"dump": dump})
 
     # ------------------------------------------------------------------
+    # task-lifecycle tracing (reference: task events + state-API task
+    # summaries; chrome-trace via ray.timeline)
+    # ------------------------------------------------------------------
+    def _emit_lifecycle(self, rec: TaskRecord, prof: Optional[dict],
+                        failed: bool) -> None:
+        """Record the task's stage-transition record into the event
+        ring and fold stage durations into the per-stage histograms.
+        Caller holds self.lock."""
+        from ray_tpu._private import tracing
+        st = dict(rec.stages)
+        now = time.time()
+        if prof is not None:
+            st.setdefault("executing", prof["start"])
+            st["finished"] = prof["end"]
+        else:
+            st.setdefault("finished", now)
+        base = rec.spec.get("name") or "<task>"
+        tc = rec.spec.get("trace_ctx") or {}
+        # Actor dispatch never sets rec.worker (the call rides the
+        # actor's resident worker) — resolve the pid through the actor
+        # record so the timeline row matches the execute span's.
+        pid = rec.worker.pid if rec.worker else 0
+        if not pid and rec.actor_id is not None:
+            actor = self.actors.get(rec.actor_id)
+            if actor is not None and actor.worker is not None:
+                pid = actor.worker.pid
+        ev = {
+            "kind": "lifecycle",
+            # ":lifecycle" suffix keeps the record distinct from the
+            # worker's execute span of the same task name.
+            "name": base + ":lifecycle",
+            "task_name": base,
+            "task_id": rec.task_id.hex(),
+            "trace_id": tracing.task_trace_id(rec.spec),
+            "span_id": tracing.lifecycle_span_id(rec.task_id),
+            "parent_span_id": tc.get("parent_span_id"),
+            "start": st.get("submitted", now),
+            "end": st["finished"],
+            "stages": st,
+            "failed": failed,
+            "actor": rec.actor_id is not None,
+            "pid": pid,
+            "node_id": self.node_id.hex(),
+        }
+        self._events.append(ev)
+        self._observe_stage_metrics(st)
+
+    def _observe_stage_metrics(self, stages: Dict[str, float]) -> None:
+        """Fold one task's stage durations into the auto-registered
+        per-stage histograms (ray_tpu_task_stage_duration_seconds,
+        declared in util/metrics.py) so a Prometheus scrape exposes
+        scheduling delay and queue wait without any user code.  Merged
+        directly into the node's aggregate table — same cell layout as
+        _h_metrics_push.  Caller holds self.lock."""
+        from ray_tpu._private.tracing import stage_durations
+        from ray_tpu.util.metrics import (TASK_STAGE_BUCKETS,
+                                          TASK_STAGE_METRIC)
+        for stage, dur in stage_durations(stages).items():
+            key = (TASK_STAGE_METRIC, "histogram", (("stage", stage),))
+            cur = self._metrics.get(key)
+            if cur is None:
+                # Prefill every boundary (like Histogram._new_cell) so
+                # each scrape exposes a stable, uniform bucket set.
+                cur = {"name": TASK_STAGE_METRIC, "kind": "histogram",
+                       "tags": {"stage": stage}, "value": 0.0,
+                       "buckets": {str(b): 0 for b in TASK_STAGE_BUCKETS},
+                       "sum": 0.0, "count": 0.0,
+                       "description": "task lifecycle stage duration"}
+                self._metrics[key] = cur
+            for b in TASK_STAGE_BUCKETS:
+                if dur <= b:
+                    k = str(b)
+                    cur["buckets"][k] = cur["buckets"].get(k, 0) + 1
+                    break
+            cur["sum"] += dur
+            cur["count"] += 1
+
+    # ------------------------------------------------------------------
     # scheduling
     # ------------------------------------------------------------------
     def _take(self, res: Dict[str, float], allow_negative: bool = False) -> bool:
@@ -2031,6 +2125,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     continue
                 self.pending_queue.remove(rec)
                 rec.state = "dispatched"
+                now = time.time()
+                if rec.had_deps:
+                    rec.stages.setdefault("deps_fetched", now)
+                rec.stages["worker_assigned"] = now
                 rec.worker = w
                 w.state = "busy"
                 w.current_task = rec
@@ -2269,6 +2367,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             # Init args produced before the first creation are READY now;
             # without pruning, stale deps would block the restart forever.
             rec.deps = {d for d in rec.deps if not self._object_ready(d)}
+            if rec.had_deps and not rec.deps:
+                rec.stages.setdefault("deps_fetched", time.time())
             self.tasks[rec.task_id] = rec
             for oid in creation["return_ids"]:
                 e = self.objects.setdefault(oid, ObjectEntry())
@@ -2284,6 +2384,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
     def _fail_task_returns(self, rec: TaskRecord, error: Exception) -> None:
         blob = ser.dumps(error)
         rec.state = "done"
+        self._emit_lifecycle(rec, prof=None, failed=True)
         self.tasks.pop(rec.task_id, None)
         try:
             self.pending_queue.remove(rec)

@@ -57,18 +57,31 @@ class ObjectEntry:
 class TaskRecord:
     __slots__ = ("task_id", "spec", "deps", "state", "worker",
                  "retries_left", "is_actor_creation", "actor_id",
-                 "cancelled")
+                 "cancelled", "stages", "had_deps")
 
     def __init__(self, spec: dict) -> None:
         self.task_id: bytes = spec["task_id"]
         self.spec = spec
         self.deps = {a[1] for a in spec["args"] if a[0] == "ref"}
+        # Dep-free tasks must not report a deps_fetch stage (it would
+        # just mirror their queue wait).
+        self.had_deps = bool(self.deps)
         self.state = "pending"     # pending | dispatched | done
         self.worker: Optional[WorkerHandle] = None
         self.retries_left: int = spec.get("retries", 0)
         self.is_actor_creation = spec.get("is_actor_creation", False)
         self.cancelled = False
         self.actor_id: Optional[bytes] = spec.get("actor_id")
+        # Lifecycle checkpoints (reference: task events feeding
+        # ray.util.state task summaries): submitted -> queued ->
+        # [deps_fetched] -> worker_assigned -> executing -> finished.
+        # "submitted" uses the client-stamped submit time when present
+        # (same host in single-node mode); the rest are node-side.
+        now = time.time()
+        self.stages: Dict[str, float] = {
+            "submitted": spec.get("submit_ts") or now,
+            "queued": now,
+        }
 
 
 class ActorRecord:

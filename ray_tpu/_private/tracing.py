@@ -1,0 +1,141 @@
+"""Cross-process trace-context propagation for task-lifecycle tracing.
+
+Reference analog: python/ray/util/tracing/tracing_helper.py — the
+reference injects OpenTelemetry contexts into task specs so a Serve
+request renders as one trace across proxy/router/replica/worker
+processes.  Here the context is a plain dict {trace_id, span_id} held
+in a contextvar:
+
+* the submitting side stamps the outgoing task spec with
+  ``trace_ctx = {trace_id, parent_span_id}`` (client.submit_task);
+* the executing worker activates a child context around the task body
+  (worker_main), so spans opened inside the task — and any tasks IT
+  submits — chain to the same trace;
+* span ids are deterministic where two processes must agree without a
+  handshake: the per-task *lifecycle* span id is derived from the task
+  id, so the node service (which emits the lifecycle record) and the
+  worker (which parents its execute span under it) independently
+  compute the same id.
+
+Ids follow the W3C/OTLP sizes: trace_id = 16 bytes (32 hex chars),
+span_id = 8 bytes (16 hex chars).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+from typing import Dict, Optional
+
+_trace_ctx: "contextvars.ContextVar[Optional[Dict[str, str]]]" = \
+    contextvars.ContextVar("rtpu_trace_ctx", default=None)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def lifecycle_span_id(task_id: bytes) -> str:
+    """The task's lifecycle span id — deterministic so the node service
+    and the executing worker agree on it without coordination."""
+    return task_id[:8].hex()
+
+
+def task_trace_id(spec: dict) -> str:
+    """Trace id for a task with no inherited context: derived from the
+    task id so every process computes the same root."""
+    tc = spec.get("trace_ctx") or {}
+    return tc.get("trace_id") or spec["task_id"].hex()
+
+
+def current() -> Optional[Dict[str, str]]:
+    """The active {trace_id, span_id} context, or None."""
+    return _trace_ctx.get()
+
+
+def set_current(ctx: Optional[Dict[str, str]]):
+    return _trace_ctx.set(ctx)
+
+
+def reset(token) -> None:
+    _trace_ctx.reset(token)
+
+
+def for_submit() -> Optional[Dict[str, str]]:
+    """Wire form attached to an outgoing task spec: the submitter's
+    span becomes the parent of the task's lifecycle span."""
+    ctx = _trace_ctx.get()
+    if ctx is None:
+        return None
+    return {"trace_id": ctx["trace_id"],
+            "parent_span_id": ctx["span_id"]}
+
+
+def child_span() -> Dict[str, str]:
+    """A new span inheriting the ambient trace (or rooting a new one)."""
+    ctx = _trace_ctx.get()
+    if ctx is None:
+        return {"trace_id": new_trace_id(), "span_id": new_span_id(),
+                "parent_span_id": None}
+    return {"trace_id": ctx["trace_id"], "span_id": new_span_id(),
+            "parent_span_id": ctx["span_id"]}
+
+
+def activate_for_task(spec: dict):
+    """Worker-side: activate the execute-span context for a task body.
+
+    Stores the resolved ids on the spec (``spec["_trace"]``) so the
+    completion report can stamp the profile event even after the
+    contextvar is reset (async actor paths report from a callback).
+    Returns the contextvar token for reset().
+    """
+    info = {"trace_id": task_trace_id(spec),
+            "span_id": new_span_id(),
+            "parent_span_id": lifecycle_span_id(spec["task_id"])}
+    spec["_trace"] = info
+    return _trace_ctx.set(info)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle stage arithmetic (shared by node metrics, summarize_tasks,
+# and the chrome-trace expansion in util/profiling.timeline)
+# ---------------------------------------------------------------------------
+
+# (stage label, start checkpoint, end checkpoint).  Checkpoints are the
+# transition timestamps the node service records on each TaskRecord:
+# submitted -> queued -> [deps_fetched] -> worker_assigned ->
+# executing -> finished.
+STAGE_SPANS = (
+    ("submit", "submitted", "queued"),
+    ("queued", "queued", "worker_assigned"),
+    ("dispatch", "worker_assigned", "executing"),
+    ("executing", "executing", "finished"),
+)
+
+STAGE_DURATION_PAIRS = STAGE_SPANS + (
+    ("deps_fetch", "queued", "deps_fetched"),
+    ("total", "submitted", "finished"),
+)
+
+
+def stage_durations(stages: Dict[str, float]) -> Dict[str, float]:
+    """Per-stage wall-clock durations from a checkpoint dict; stages
+    whose checkpoints were never recorded are omitted."""
+    out: Dict[str, float] = {}
+    for label, a, b in STAGE_DURATION_PAIRS:
+        if a in stages and b in stages and stages[b] >= stages[a]:
+            out[label] = stages[b] - stages[a]
+    return out
+
+
+def stage_intervals(stages: Dict[str, float]):
+    """Contiguous (label, start, end) intervals for timeline export."""
+    out = []
+    for label, a, b in STAGE_SPANS:
+        if a in stages and b in stages and stages[b] >= stages[a]:
+            out.append((label, stages[a], stages[b]))
+    return out

@@ -194,18 +194,25 @@ class WorkerRuntime:
             return
 
         if inspect.iscoroutinefunction(method) and self.actor_loop:
+            start_box = {"t": None}
+
             async def run_async() -> Any:
+                import time
+                from ray_tpu._private import tracing
                 from ray_tpu.runtime_context import _current_spec
                 _current_spec.set(spec)   # task-local: no reset needed
+                tracing.activate_for_task(spec)
                 async with self.actor_semaphore:
+                    start_box["t"] = time.time()
                     args, kwargs = self.client.unpack_args(spec["args"])
                     return await method(*args, **kwargs)
 
             def done_cb(fut) -> None:
                 try:
-                    self._report_value(spec, fut.result())
+                    self._report_value(spec, fut.result(),
+                                       start=start_box["t"])
                 except BaseException as e:  # noqa: BLE001
-                    self._report_error(spec, e)
+                    self._report_error(spec, e, start=start_box["t"])
 
             fut = asyncio.run_coroutine_threadsafe(run_async(),
                                                    self.actor_loop)
@@ -236,9 +243,13 @@ class WorkerRuntime:
     # ------------------------------------------------------------------
     def _execute_and_report(self, spec: dict, fn, *args) -> None:
         import time
+        from ray_tpu._private import tracing
         from ray_tpu.runtime_context import _current_spec
         t0 = time.time()
         token = _current_spec.set(spec)
+        # Child trace context: spans opened inside the task — and any
+        # tasks it submits — chain to the inbound trace_ctx.
+        ttoken = tracing.activate_for_task(spec)
         try:
             value = fn(*args)
         except BaseException as e:  # noqa: BLE001
@@ -246,6 +257,7 @@ class WorkerRuntime:
             return
         finally:
             _current_spec.reset(token)
+            tracing.reset(ttoken)
         self._report_value(spec, value, start=t0)
 
     def _profile(self, spec: dict, start: Optional[float],
@@ -255,10 +267,14 @@ class WorkerRuntime:
         if start is None:
             return None
         import time
+        tr = spec.get("_trace") or {}
         return {"start": start, "end": time.time(),
                 "name": spec.get("name") or "<task>",
                 "pid": os.getpid(),
                 "actor": spec.get("actor_id") is not None,
+                "trace_id": tr.get("trace_id"),
+                "span_id": tr.get("span_id"),
+                "parent_span_id": tr.get("parent_span_id"),
                 "failed": failed}
 
     def _report_value(self, spec: dict, value: Any,

@@ -9,7 +9,10 @@ exists here as JSON):
     GET /api/state      full cluster state dump (tasks/actors/workers/
                         objects/placement groups/nodes)
     GET /api/nodes      node table
-    GET /api/summary    task/actor/object rollups
+    GET /api/summary    task/actor/object rollups (incl. per-stage
+                        task-lifecycle latency percentiles)
+    GET /api/timeline   chrome-trace export of the runtime timeline
+                        (lifecycle stages + spans, trace_id-linked)
     GET /metrics        Prometheus exposition (scrape endpoint)
     GET /graphs         self-contained metrics graphs (canvas
                         sparklines over /api/metrics.json samples —
@@ -200,6 +203,10 @@ class _Handler(BaseHTTPRequestHandler):
                     "objects": state.summarize_objects(),
                 }
                 self._send(200, json.dumps(body, default=str).encode())
+            elif self.path == "/api/timeline":
+                from ray_tpu.util import profiling
+                self._send(200, json.dumps(profiling.timeline(),
+                                           default=str).encode())
             elif self.path == "/metrics":
                 self._send(200, metrics.prometheus_text().encode(),
                            "text/plain; version=0.0.4")

@@ -42,6 +42,19 @@ def _ctx_block_rows() -> int:
 Stage = Callable[[B.Block], List[B.Block]]
 
 
+def _coerce_stage(batch_format: Optional[str]) -> List[Stage]:
+    """Stage list converting each block into the form map_batches' fn
+    receives: "numpy" a dict of numpy arrays, "pyarrow" an Arrow
+    Table, None the pipeline's native block unconverted."""
+    if batch_format is None:
+        return []
+    if batch_format == "numpy":
+        return [lambda b: [B.block_to_numpy(b)]]
+    if batch_format == "pyarrow":
+        return [lambda b: [B.block_to_arrow(b)]]
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
 class Dataset:
     """Lazy dataset = input block sources + an operator plan.
 

@@ -105,7 +105,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     `axis_name`.  Returns [B, H, S, D] sharded the same way.
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map           # jax >= 0.8
+    except ImportError:                     # pragma: no cover
+        from jax.experimental.shard_map import shard_map
 
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)

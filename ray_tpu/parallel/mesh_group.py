@@ -38,11 +38,35 @@ class _MeshHostWorker:
                  local_devices: int) -> None:
         self.rank = rank
         self.world = world
-        import jax
         if platform == "cpu":
+            n = max(local_devices, 1)
+            # XLA_FLAGS first: it is read at backend init, so it works
+            # on every jax version as long as this process has not
+            # touched devices yet (a fresh gang worker has not).
+            import os
+            import re
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+            import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices",
-                              max(local_devices, 1))
+            try:
+                jax.config.update("jax_num_cpu_devices", n)
+            except AttributeError:
+                # jax < 0.5 has no jax_num_cpu_devices option; the
+                # XLA_FLAGS override above provides the device count.
+                pass
+            try:
+                # Multi-host CPU collectives need gloo on jax 0.4.x
+                # ("Multiprocess computations aren't implemented on
+                # the CPU backend" otherwise).
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except AttributeError:
+                pass  # newer jax selects CPU collectives itself
 
     def choose_coordinator(self) -> str:
         """Rank 0 picks the coordinator address ON ITS OWN HOST — the

@@ -108,12 +108,20 @@ def pipeline_apply(stage_params, x, mesh, layer_fn: Callable,
         outs = jax.lax.psum(outs, "pp")
         return outs
 
-    fn = shard_map(
-        device_fn, mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: P("pp"), stage_params),
-                  xspec),
-        out_specs=xspec,
-        check_vma=False)
+    try:
+        fn = shard_map(
+            device_fn, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("pp"), stage_params),
+                      xspec),
+            out_specs=xspec,
+            check_vma=False)
+    except TypeError:   # jax < 0.7 spells check_vma as check_rep
+        fn = shard_map(
+            device_fn, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("pp"), stage_params),
+                      xspec),
+            out_specs=xspec,
+            check_rep=False)
     out = fn(stage_params, x_mb)
     return out.reshape(B, S, D)
 

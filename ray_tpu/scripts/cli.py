@@ -202,11 +202,18 @@ def cmd_status(args) -> int:
 # ---------------------------------------------------------------------------
 # state queries (served by the head's dashboard HTTP endpoints)
 # ---------------------------------------------------------------------------
-def _fetch_json(path: str, args) -> Any:
+def _dashboard_url(args) -> str:
     st = _load_state()
     url = getattr(args, "dashboard_url", None) or st.get("dashboard_url")
     if not url:
         raise SystemExit("no dashboard on record; pass --dashboard-url")
+    if "://" not in url:
+        url = f"http://{url}"
+    return url
+
+
+def _fetch_json(path: str, args) -> Any:
+    url = _dashboard_url(args)
     with urllib.request.urlopen(f"{url}{path}", timeout=30) as r:
         return json.loads(r.read())
 
@@ -272,11 +279,21 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_timeline(args) -> int:
+    """Chrome-trace export of the runtime timeline (open the file in
+    chrome://tracing or Perfetto; reference: `ray timeline`)."""
+    events = _fetch_json("/api/timeline", args)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(events, f)
+        print(f"wrote {len(events)} events to {args.out}")
+    else:
+        print(json.dumps(events, indent=1, default=str))
+    return 0
+
+
 def cmd_metrics(args) -> int:
-    st = _load_state()
-    url = getattr(args, "dashboard_url", None) or st.get("dashboard_url")
-    if not url:
-        raise SystemExit("no dashboard on record")
+    url = _dashboard_url(args)
     with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
         sys.stdout.write(r.read().decode())
     return 0
@@ -422,6 +439,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("metrics", help="Prometheus metrics dump")
     p.add_argument("--dashboard-url", default=None)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("timeline",
+                       help="chrome-trace export of the task timeline")
+    p.add_argument("--dashboard-url", default=None)
+    p.add_argument("--out", default=None,
+                   help="write the trace JSON to this file")
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("job", help="job submission")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

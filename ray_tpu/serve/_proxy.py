@@ -78,7 +78,20 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self.wfile.write(b"0\r\n\r\n")
         self.wfile.flush()
 
-    def _route(self, arg: Any) -> None:  # noqa: C901
+    def _route(self, arg: Any) -> None:
+        """Root span of the request's trace: everything below — the
+        router pick, the replica actor call, spans inside user code —
+        chains to this span's trace_id, so `timeline()` renders one
+        flame per HTTP request across processes (reference: Serve
+        request-id propagation through proxy/router/replica)."""
+        import os as _os
+        from ray_tpu.util import profiling
+        request_id = _os.urandom(8).hex()
+        with profiling.span("proxy.request", request_id=request_id,
+                            path=self.path):
+            self._route_traced(arg)
+
+    def _route_traced(self, arg: Any) -> None:  # noqa: C901
         import ray_tpu
         from ray_tpu import serve
 

@@ -43,13 +43,18 @@ class Replica:
         concurrent user methods interleave on the actor's event loop)."""
         from ray_tpu.serve.multiplex import (_current_model_id,
                                              _set_current_model_id)
+        from ray_tpu.util import profiling
         self._inflight += 1
         token = _set_current_model_id(multiplexed_model_id)
         try:
-            target = getattr(self._user, method)
-            out = target(*args, **(kwargs or {}))
-            if inspect.isawaitable(out):
-                out = await out
+            # Child of the execute span the worker opened for this
+            # actor call — the replica-side hop of the request trace.
+            with profiling.span("replica.handle_request",
+                                deployment=self._name, method=method):
+                target = getattr(self._user, method)
+                out = target(*args, **(kwargs or {}))
+                if inspect.isawaitable(out):
+                    out = await out
             return out
         finally:
             _current_model_id.reset(token)
@@ -61,14 +66,35 @@ class Replica:
         """Streaming request: the user method returns a generator whose
         items are re-yielded through the core streaming-generator plane
         (reference: replica.py streaming ASGI responses ride streaming
-        generator actor calls)."""
+        generator actor calls).
+
+        Not a generator itself: the trace context must be captured at
+        CALL time (inside the task's activated context) — the inner
+        generator's frames run in the consumer's context, where a
+        `span()` contextvar set/reset would leak or raise on
+        cross-context finalization.  The span is recorded explicitly
+        when the drain ends (including abandonment)."""
+        import time
+        from ray_tpu._private import tracing
+        from ray_tpu.util import profiling
+        ctx = tracing.current()
+        t0 = time.time()
         self._inflight += 1
-        try:
-            out = getattr(self._user, method)(*args, **(kwargs or {}))
-            yield from out
-        finally:
-            self._inflight -= 1
-            self._served += 1
+
+        def _stream():
+            try:
+                out = getattr(self._user, method)(*args,
+                                                  **(kwargs or {}))
+                yield from out
+            finally:
+                profiling.record_span(
+                    "replica.handle_request", t0, time.time(),
+                    trace_ctx=ctx, deployment=self._name,
+                    method=method, stream=True)
+                self._inflight -= 1
+                self._served += 1
+
+        return _stream()
 
     def check_health(self) -> bool:
         """Controller-probed liveness (reference: replica.py

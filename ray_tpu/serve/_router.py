@@ -196,19 +196,28 @@ class Router:
 
     def assign(self, method: str, args: tuple, kwargs: dict,
                model_id: str = ""):
-        """Submit one request; returns (ObjectRef, replica)."""
-        replica = self.pick(model_id)
-        ref = replica.handle_request.remote(method, args, kwargs,
-                                            model_id)
+        """Submit one request; returns (ObjectRef, replica).  The span
+        covers replica choice + submission, and the actor-call spec
+        inherits its trace context — the cross-process link between
+        the proxy's root span and the replica's execute span."""
+        from ray_tpu.util import profiling
+        with profiling.span("router.assign", deployment=self._name,
+                            method=method):
+            replica = self.pick(model_id)
+            ref = replica.handle_request.remote(method, args, kwargs,
+                                                model_id)
         return ref, replica
 
     def assign_stream(self, method: str, args: tuple, kwargs: dict):
         """Submit one STREAMING request; returns (ObjectRefGenerator,
         replica).  Items ride the core streaming-generator plane
         (reference: streaming replica calls, proxy.py:779)."""
-        replica = self.pick()
-        gen = replica.handle_request_stream.options(
-            num_returns="streaming").remote(method, args, kwargs)
+        from ray_tpu.util import profiling
+        with profiling.span("router.assign", deployment=self._name,
+                            method=method, stream=True):
+            replica = self.pick()
+            gen = replica.handle_request_stream.options(
+                num_returns="streaming").remote(method, args, kwargs)
         return gen, replica
 
     def report_failure(self, replica) -> None:

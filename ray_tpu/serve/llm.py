@@ -59,7 +59,13 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     tokens: List[int] = field(default_factory=list)
     ttft_s: float = 0.0
+    # TTFT decomposition: queue_s = submit -> slot admission (engine
+    # queue wait), prefill_s = admission -> first token materialized
+    # (device prefill + pipeline/transfer).  ttft_s = queue_s + prefill_s.
+    queue_s: float = 0.0
+    prefill_s: float = 0.0
     _t0: float = 0.0
+    _admit_t: float = 0.0
     slot: int = -1
     error: Optional[Exception] = None
     # "eos" | "length" (hit max_new) | "cache" (KV cache exhausted)
@@ -187,6 +193,7 @@ class ContinuousBatcher:
         if req.error is not None:
             raise req.error
         return {"tokens": req.tokens, "ttft_s": req.ttft_s,
+                "queue_s": req.queue_s, "prefill_s": req.prefill_s,
                 "finish_reason": req.finish_reason}
 
     def generate_stream(self, prompt: List[int], max_new: int = 32,
@@ -333,12 +340,17 @@ class ContinuousBatcher:
             for row in range(len(batch), N):
                 packed[row, P + 1] = remaining[row - len(batch)]
             packed[N, :self.num_slots] = active
+            # Admission happens HERE (slots are committed); stamp it
+            # before the prefill dispatch so compile/dispatch time
+            # lands in prefill_s, not queue_s.
+            admit_t = time.time()
             self.caches, first, dtoks = self._dec.prefill_decode_packed(
                 self.params, self.caches, jnp.asarray(packed),
                 self.cfg, chunk, P)
             with self._state_lock:
                 for _, slot, req in admitted:
                     self._owner[slot] = req
+                    req._admit_t = admit_t
                     # prompt + the chunk the fused step decodes for it
                     self._disp_len[slot] = len(req.prompt) + chunk
             pairs = live + [(slot, req) for _, slot, req in admitted]
@@ -385,6 +397,9 @@ class ContinuousBatcher:
             firsts = np.asarray(devs[0])
             for row, slot, req in admitted:
                 req.ttft_s = now - req._t0
+                admit = req._admit_t or now
+                req.queue_s = max(admit - req._t0, 0.0)
+                req.prefill_s = max(now - admit, 0.0)
                 req.slot = slot
                 tok = int(firsts[row])
                 self._push_token(req, tok)
@@ -501,6 +516,8 @@ class LLMDeployment:
     async def generate(self, prompt: List[int],
                        max_new: int = 32) -> Dict[str, Any]:
         import asyncio
+        import time as _time
+        route_t0 = _time.time()
         req = self.batcher.submit(prompt, max_new)
         loop = asyncio.get_running_loop()
         finished = await loop.run_in_executor(None, req.done.wait, 300.0)
@@ -508,7 +525,25 @@ class LLMDeployment:
             raise TimeoutError("generation timed out after 300s")
         if req.error is not None:
             raise req.error
-        return {"tokens": req.tokens, "ttft_s": req.ttft_s}
+        # TTFT decomposition spans: route (replica hop -> engine
+        # submit), queue (slot wait), prefill (device prefill +
+        # transfer to first token) — recorded into the request's trace
+        # so timeline() shows where Serve TTFT milliseconds go.
+        try:
+            from ray_tpu.util import profiling
+            admit = req._admit_t or req._t0
+            first_tok = req._t0 + req.ttft_s
+            profiling.record_span("llm.route", route_t0, req._t0)
+            profiling.record_span("llm.queue", req._t0, admit)
+            profiling.record_span("llm.prefill", admit, first_tok)
+        except Exception:
+            pass
+        return {"tokens": req.tokens, "ttft_s": req.ttft_s,
+                "ttft_breakdown": {
+                    "route_s": max(req._t0 - route_t0, 0.0),
+                    "queue_s": req.queue_s,
+                    "prefill_s": req.prefill_s,
+                }}
 
     def generate_stream(self, prompt: List[int],
                         max_new: int = 32) -> Iterator[int]:

@@ -13,6 +13,8 @@ serves on its metrics port)."""
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -20,6 +22,17 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ray_tpu._private.client import get_global_client
 
 FLUSH_INTERVAL_S = 1.0
+
+# Prometheus metric-name grammar (exposition format spec).
+METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+
+# Task-lifecycle stage histogram, auto-recorded by the node service
+# for every completed task (stage tag: submit/queued/deps_fetch/
+# dispatch/executing/total) — scheduling delay and queue wait land in
+# every Prometheus scrape with no user code.
+TASK_STAGE_METRIC = "ray_tpu_task_stage_duration_seconds"
+TASK_STAGE_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                      1.0, 5.0, 30.0)
 
 _lock = threading.RLock()
 _registry: List["_Metric"] = []
@@ -42,6 +55,10 @@ class _Metric:
                  tag_keys: Optional[Sequence[str]] = None) -> None:
         if not name:
             raise ValueError("metric name must be non-empty")
+        if not METRIC_NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} is not a valid Prometheus name "
+                f"([a-zA-Z_:][a-zA-Z0-9_:]*)")
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys or ())
@@ -149,6 +166,16 @@ class Histogram(_Metric):
                  boundaries: Optional[Sequence[float]] = None,
                  tag_keys: Optional[Sequence[str]] = None) -> None:
         self.boundaries = tuple(sorted(boundaries or DEFAULT_BUCKETS))
+        if not self.boundaries:
+            raise ValueError("histogram needs at least one boundary")
+        for lo, hi in zip(self.boundaries, self.boundaries[1:]):
+            if not lo < hi:
+                raise ValueError(
+                    f"histogram boundaries must be strictly increasing "
+                    f"(got duplicate {lo})")
+        if any(not math.isfinite(b) for b in self.boundaries):
+            raise ValueError("histogram boundaries must be finite "
+                             "(+Inf is implicit)")
         super().__init__(name, description, tag_keys)
 
     def _new_cell(self) -> dict:
@@ -232,9 +259,31 @@ def scrape() -> List[dict]:
     return client.metrics_scrape()
 
 
+def _escape_label_value(v: str) -> str:
+    """Label-value escaping per the exposition format spec: backslash,
+    double-quote, and line-feed must be escaped."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping per the spec: backslash and line-feed."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _labels(tags: Dict[str, str]) -> str:
+    if not tags:
+        return ""
+    return ("{" + ",".join(f'{k}="{_escape_label_value(v)}"'
+                           for k, v in sorted(tags.items())) + "}")
+
+
 def prometheus_text() -> str:
     """Render `scrape()` in the Prometheus exposition format the
-    reference's metrics agent serves."""
+    reference's metrics agent serves.  Histograms emit cumulative
+    buckets ending in the mandatory `+Inf` bucket, which always equals
+    `_count` (spec: the +Inf bucket counts all observations, including
+    those above the largest declared boundary)."""
     lines: List[str] = []
     seen_help = set()
     for s in sorted(scrape(), key=lambda s: s["name"]):
@@ -242,26 +291,26 @@ def prometheus_text() -> str:
         if name not in seen_help:
             seen_help.add(name)
             if s.get("description"):
-                lines.append(f"# HELP {name} {s['description']}")
+                lines.append(
+                    f"# HELP {name} {_escape_help(s['description'])}")
             lines.append(f"# TYPE {name} {s['kind']}")
         tags = s.get("tags") or {}
-        label = ("{" + ",".join(f'{k}="{v}"'
-                                for k, v in sorted(tags.items())) + "}"
-                 if tags else "")
+        label = _labels(tags)
         if s["kind"] == "histogram":
+            count = int(s["count"])
             acc = 0
             for b in sorted(s["buckets"], key=float):
                 acc += s["buckets"][b]
-                ltags = dict(tags, le=b)
-                lab = "{" + ",".join(
-                    f'{k}="{v}"' for k, v in sorted(ltags.items())) + "}"
-                lines.append(f"{name}_bucket{lab} {acc}")
-            inf = dict(tags, le="+Inf")
-            lab = "{" + ",".join(f'{k}="{v}"'
-                                 for k, v in sorted(inf.items())) + "}"
-            lines.append(f"{name}_bucket{lab} {int(s['count'])}")
+                lines.append(
+                    f"{name}_bucket{_labels(dict(tags, le=b))} {acc}")
+            # +Inf is cumulative over ALL observations; guard against a
+            # malformed merge where bucket sums exceed the count so the
+            # series stays monotone.
+            inf = max(count, acc)
+            lines.append(
+                f"{name}_bucket{_labels(dict(tags, le='+Inf'))} {inf}")
             lines.append(f"{name}_sum{label} {s['sum']}")
-            lines.append(f"{name}_count{label} {int(s['count'])}")
+            lines.append(f"{name}_count{label} {inf}")
         else:
             lines.append(f"{name}{label} {s['value']}")
     return "\n".join(lines) + "\n"

@@ -20,6 +20,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import tracing
 from ray_tpu._private.client import get_global_client
 
 
@@ -30,30 +31,63 @@ def _client():
     return c
 
 
+def current_trace_id() -> Optional[str]:
+    """The ambient trace id (set inside `span()` bodies and task
+    executions), or None outside any trace."""
+    ctx = tracing.current()
+    return ctx["trace_id"] if ctx else None
+
+
 def timeline_events(cluster: bool = True) -> List[dict]:
     """Raw profile events: task execution spans (name/start/end/pid/
     node) + custom `span()` records."""
     return _client().timeline_events(cluster=cluster)
 
 
+_TRACE_ARG_KEYS = ("failed", "extra", "trace_id", "span_id",
+                   "parent_span_id", "task_id")
+
+
 def timeline(filename: Optional[str] = None) -> Any:
     """Chrome-trace export (open in chrome://tracing or Perfetto).
     Returns the event list; writes JSON when `filename` is given.
+
+    Task-lifecycle records expand into per-stage child spans
+    (submit/queued/dispatch/executing) on the worker's row, linked to
+    the proxy/router/user spans of the same request by `trace_id` in
+    `args` — one flame per request across processes.
     Reference: ray.timeline."""
     traced = []
     for ev in timeline_events():
-        traced.append({
+        args = {k: v for k, v in ev.items() if k in _TRACE_ARG_KEYS
+                and v is not None}
+        row = {
             "name": ev.get("name", "<span>"),
-            "cat": ("actor" if ev.get("actor") else
+            "cat": ("lifecycle" if ev.get("kind") == "lifecycle" else
+                    "actor" if ev.get("actor") else
                     "user" if ev.get("user") else "task"),
             "ph": "X",
             "ts": ev["start"] * 1e6,
             "dur": max(ev["end"] - ev["start"], 0.0) * 1e6,
             "pid": ev.get("node_id", "node")[:8],
             "tid": ev.get("pid", 0),
-            "args": {k: v for k, v in ev.items()
-                     if k in ("failed", "extra")},
-        })
+            "args": args,
+        }
+        traced.append(row)
+        if ev.get("kind") == "lifecycle":
+            base = ev.get("task_name") or ev.get("name", "<task>")
+            for stage, s0, s1 in tracing.stage_intervals(
+                    ev.get("stages") or {}):
+                traced.append({
+                    "name": f"{base}:{stage}",
+                    "cat": "lifecycle",
+                    "ph": "X",
+                    "ts": s0 * 1e6,
+                    "dur": max(s1 - s0, 0.0) * 1e6,
+                    "pid": row["pid"],
+                    "tid": row["tid"],
+                    "args": dict(args, stage=stage),
+                })
     traced.sort(key=lambda e: e["ts"])
     if filename:
         with open(filename, "w") as f:
@@ -61,18 +95,50 @@ def timeline(filename: Optional[str] = None) -> Any:
     return traced
 
 
+def record_span(name: str, start: float, end: float,
+                trace_ctx: Optional[Dict[str, str]] = None,
+                **extra) -> None:
+    """Record a span with explicit timestamps (e.g. a latency
+    decomposition measured after the fact).  Attaches the ambient
+    trace context — or an explicit `trace_ctx` captured earlier, for
+    spans finalized outside the originating context (generator
+    drains, callbacks) — so the span joins the request's trace."""
+    ev: Dict[str, Any] = {"name": name, "start": start, "end": end,
+                          "pid": os.getpid(), "user": True,
+                          "extra": extra or None}
+    ctx = trace_ctx if trace_ctx is not None else tracing.current()
+    if ctx is not None:
+        ev["trace_id"] = ctx["trace_id"]
+        ev["span_id"] = tracing.new_span_id()
+        ev["parent_span_id"] = ctx["span_id"]
+    try:
+        _client().profile_event(ev)
+    except Exception:
+        pass
+
+
 @contextlib.contextmanager
 def span(name: str, **extra):
     """Record a custom span from driver or task code into the runtime
-    timeline (reference: ray.util.tracing spans / ray.profile)."""
+    timeline (reference: ray.util.tracing spans / ray.profile).
+
+    Opens a child of the ambient trace context (or roots a new trace),
+    and activates it for the body — so tasks submitted inside the span
+    carry the trace across processes."""
+    info = tracing.child_span()
+    token = tracing.set_current(info)
     t0 = time.time()
     try:
         yield
     finally:
+        tracing.reset(token)
         try:
             _client().profile_event({
                 "name": name, "start": t0, "end": time.time(),
                 "pid": os.getpid(), "user": True,
+                "trace_id": info["trace_id"],
+                "span_id": info["span_id"],
+                "parent_span_id": info["parent_span_id"],
                 "extra": extra or None})
         except Exception:
             pass
@@ -122,6 +188,8 @@ def export_otlp(filename: Optional[str] = None,
         return f"{n & 0xFFFFFFFFFFFFFFFF:016x}"
 
     spans = []
+    # Fallback trace for legacy events recorded without a trace
+    # context; traced events carry their own per-request trace ids.
     trace_id = os.urandom(16).hex()
     for i, ev in enumerate(timeline_events()):
         attrs = [{"key": "node.id",
@@ -132,9 +200,9 @@ def export_otlp(filename: Optional[str] = None,
                 if isinstance(ev.get("extra"), dict) else []:
             attrs.append({"key": str(k),
                           "value": {"stringValue": str(v)}})
-        spans.append({
-            "traceId": trace_id,
-            "spanId": span_id(i + 1),
+        sp = {
+            "traceId": ev.get("trace_id") or trace_id,
+            "spanId": ev.get("span_id") or span_id(i + 1),
             "name": ev.get("name", "<span>"),
             "kind": 1,  # SPAN_KIND_INTERNAL
             "startTimeUnixNano": str(int(ev["start"] * 1e9)),
@@ -142,7 +210,10 @@ def export_otlp(filename: Optional[str] = None,
             "attributes": attrs,
             "status": ({"code": 2} if ev.get("failed")
                        else {"code": 1}),
-        })
+        }
+        if ev.get("parent_span_id"):
+            sp["parentSpanId"] = ev["parent_span_id"]
+        spans.append(sp)
     payload = {"resourceSpans": [{
         "resource": {"attributes": [
             {"key": "service.name",

@@ -18,13 +18,17 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ray_tpu._private.client import get_global_client
 
 
-def _dump() -> dict:
+def _client():
     client = get_global_client()
     if client is None:
-        import ray_tpu
-        ray_tpu.init()
-        client = get_global_client()
-    return client.state_dump(cluster=True)
+        # Implicit init here would silently mask a misconfigured
+        # session — raise the same error every other API uses.
+        raise RuntimeError("ray_tpu is not initialized")
+    return client
+
+
+def _dump() -> dict:
+    return _client().state_dump(cluster=True)
 
 
 def _apply_filters(rows: List[dict],
@@ -89,12 +93,58 @@ def list_nodes(filters=None, limit: int = 10_000) -> List[dict]:
     return _apply_filters(rows, filters, limit)
 
 
-def summarize_tasks() -> Dict[str, Dict[str, int]]:
-    """Task counts grouped by name then state (api.py:793)."""
-    out: Dict[str, Dict[str, int]] = {}
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(len(sorted_vals) * q), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def summarize_tasks() -> Dict[str, Dict[str, Any]]:
+    """Task counts grouped by name then state (api.py:793), plus
+    per-stage latency aggregates from the lifecycle trace ring.
+
+    Each name maps to its live-state counts ({"pending": n, ...}), a
+    "finished"/"failed" count from completed lifecycles, and a
+    "stages" dict of {stage: {count, p50_s, p95_s, max_s}} over the
+    submitted→queued→worker_assigned→executing→finished transitions —
+    the queue-wait / scheduling-delay decomposition the reference
+    exposes through `ray summary tasks`.
+
+    Completed counts and stage percentiles come from the bounded
+    per-node event ring (profile_events_max, default 10k entries
+    shared with all spans): they are a recent-window sample, not an
+    all-time total — long-running workloads will see old completions
+    evicted."""
+    from ray_tpu._private.tracing import stage_durations
+
+    out: Dict[str, Dict[str, Any]] = {}
     for t in _dump()["tasks"]:
         per = out.setdefault(t["name"] or "<anonymous>", {})
         per[t["state"]] = per.get(t["state"], 0) + 1
+    # Completed tasks left the live tables; their lifecycle records
+    # (stage checkpoint dicts) live in the per-node event ring.
+    samples: Dict[str, Dict[str, List[float]]] = {}
+    for ev in _client().timeline_events(cluster=True):
+        if ev.get("kind") != "lifecycle":
+            continue
+        name = ev.get("task_name") or "<anonymous>"
+        per = out.setdefault(name, {})
+        state = "failed" if ev.get("failed") else "finished"
+        per[state] = per.get(state, 0) + 1
+        by_stage = samples.setdefault(name, {})
+        for stage, dur in stage_durations(ev.get("stages") or {}).items():
+            by_stage.setdefault(stage, []).append(dur)
+    for name, by_stage in samples.items():
+        stages = out[name].setdefault("stages", {})
+        for stage, vals in by_stage.items():
+            vals.sort()
+            stages[stage] = {
+                "count": len(vals),
+                "p50_s": _percentile(vals, 0.50),
+                "p95_s": _percentile(vals, 0.95),
+                "max_s": vals[-1],
+            }
     return out
 
 

@@ -142,7 +142,11 @@ def _measure(bat, cfg, *, num_slots, decode_chunk, pipeline_depth,
             first_tok_s = time.time() - st0
         streamed.append(tok)
 
+    from ray_tpu.util.state import _percentile as pct
+
     ttfts = sorted(r["ttft_s"] for r in lat_results)
+    queues = sorted(r.get("queue_s", 0.0) for r in lat_results)
+    prefills = sorted(r.get("prefill_s", 0.0) for r in lat_results)
     return {
         "num_slots": num_slots,
         "decode_chunk": decode_chunk,
@@ -151,8 +155,20 @@ def _measure(bat, cfg, *, num_slots, decode_chunk, pipeline_depth,
         "max_new_tokens": max_new,
         "req_per_s": round(len(reqs) / wall, 2),
         "decode_tokens_per_s": round(total_tokens / wall, 1),
-        "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
-        "ttft_p95_ms": round(ttfts[int(len(ttfts) * 0.95)] * 1e3, 1),
+        "ttft_p50_ms": round(pct(ttfts, 0.50) * 1e3, 1),
+        "ttft_p95_ms": round(pct(ttfts, 0.95) * 1e3, 1),
+        # Where the TTFT milliseconds go (engine-side decomposition:
+        # queue = submit -> slot admission, prefill = admission ->
+        # first token; route is the proxy/router hop, not traversed by
+        # this direct-engine harness) — so a regression in a future
+        # round is attributable to a stage, not just a total.
+        "ttft_breakdown": {
+            "queue_p50_ms": round(pct(queues, 0.50) * 1e3, 1),
+            "queue_p95_ms": round(pct(queues, 0.95) * 1e3, 1),
+            "prefill_p50_ms": round(pct(prefills, 0.50) * 1e3, 1),
+            "prefill_p95_ms": round(pct(prefills, 0.95) * 1e3, 1),
+            "route": "n/a (direct engine, no proxy hop)",
+        },
         "ttft_load": "4 closed-loop clients (unsaturated), 96 samples",
         "stream_first_token_ms": round((first_tok_s or 0) * 1e3, 1),
         "stream_tokens": len(streamed),
@@ -161,6 +177,36 @@ def _measure(bat, cfg, *, num_slots, decode_chunk, pipeline_depth,
 
 
 def main() -> None:
+    """Retry-once wrapper: a tunnel that probes healthy can still wedge
+    between the probe and first device use (the round-3/4 evidence-loss
+    mode: capture died rc=1 mid-run).  jax caches a failed backend for
+    the life of the process, so the retry re-execs a FRESH process; a
+    second failure emits the structured last-good/stale record and
+    exits 0 — the driver always gets one JSON line."""
+    import sys as _sys
+    import traceback as _tb
+    try:
+        _run()
+        return
+    except (SystemExit, KeyboardInterrupt):
+        raise
+    except BaseException:
+        _tb.print_exc()
+    from ray_tpu.util import hwprobe
+    model = os.environ.get("SERVE_MODEL", "gpt2s")
+    name = hwprobe.lg_name("SERVE_BENCH", model, "gpt2s")
+    if not os.environ.get("SERVE_BENCH_RETRIED"):
+        print("serve_bench: run failed; retrying once in a fresh "
+              "process", file=_sys.stderr, flush=True)
+        os.environ["SERVE_BENCH_RETRIED"] = "1"
+        os.execv(_sys.executable,
+                 [_sys.executable, os.path.abspath(__file__)])
+    print(json.dumps(hwprobe.stale_record(
+        name, {"error": "serve bench crashed twice (see stderr)"},
+        "fresh serve capture failed twice; emitting last-good")))
+
+
+def _run() -> None:
     from ray_tpu.util import hwprobe
 
     model = os.environ.get("SERVE_MODEL", "gpt2s")

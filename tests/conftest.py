@@ -30,12 +30,18 @@ import jax  # noqa: E402
 
 try:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
 except RuntimeError:
     # Backends already initialized (a plugin touched jax.devices() before
     # pytest started).  The XLA_FLAGS fallback above may still provide 8
     # host devices; if not, the cpu_mesh_devices fixture will fail with a
     # clear message rather than aborting collection here.
+    pass
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except (RuntimeError, AttributeError):
+    # AttributeError: jax < 0.5 has no jax_num_cpu_devices option — the
+    # XLA_FLAGS --xla_force_host_platform_device_count=8 fallback above
+    # provides the 8 host devices there.
     pass
 
 import pytest  # noqa: E402

@@ -1,0 +1,65 @@
+"""Metric-name lint: after importing the package surface, every metric
+in the registry must have a Prometheus-legal name and every histogram
+strictly increasing buckets (CI guard: a bad name silently breaks the
+scrape endpoint, not the writer)."""
+
+import re
+
+import pytest
+
+_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+
+
+def _import_surface():
+    import ray_tpu  # noqa: F401
+    import ray_tpu.dashboard  # noqa: F401
+    import ray_tpu.serve  # noqa: F401
+    import ray_tpu.serve.llm  # noqa: F401
+    import ray_tpu.util.metrics as metrics
+    import ray_tpu.util.profiling  # noqa: F401
+    import ray_tpu.util.state  # noqa: F401
+    return metrics
+
+
+def test_registry_names_and_buckets_lint():
+    metrics = _import_surface()
+    with metrics._lock:
+        registry = list(metrics._registry)
+    for m in registry:
+        assert _NAME.match(m.name), \
+            f"metric {m.name!r} is not a legal Prometheus name"
+        for k in m.tag_keys:
+            assert _LABEL.match(k), \
+                f"metric {m.name!r} has illegal tag key {k!r}"
+        if m.kind == "histogram":
+            bs = m.boundaries
+            assert all(a < b for a, b in zip(bs, bs[1:])), \
+                f"histogram {m.name!r} buckets not strictly increasing"
+
+
+def test_declared_builtin_names_are_legal():
+    metrics = _import_surface()
+    assert _NAME.match(metrics.TASK_STAGE_METRIC)
+    bs = metrics.TASK_STAGE_BUCKETS
+    assert all(a < b for a, b in zip(bs, bs[1:]))
+    bs = metrics.DEFAULT_BUCKETS
+    assert all(a < b for a, b in zip(bs, bs[1:]))
+
+
+def test_constructor_rejects_bad_names_and_buckets():
+    metrics = _import_surface()
+    with pytest.raises(ValueError):
+        metrics.Counter("bad name with spaces")
+    with pytest.raises(ValueError):
+        metrics.Counter("0starts_with_digit")
+    with pytest.raises(ValueError):
+        metrics.Histogram("test_dup_buckets", boundaries=[1.0, 1.0])
+    with pytest.raises(ValueError):
+        metrics.Histogram("test_inf_bucket",
+                          boundaries=[1.0, float("inf")])
+    # Empty boundaries fall back to the defaults (not an error).
+    h = metrics.Histogram("test_empty_buckets", boundaries=[])
+    assert h.boundaries == metrics.DEFAULT_BUCKETS
+    with metrics._lock:
+        metrics._registry.remove(h)

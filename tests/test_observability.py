@@ -118,6 +118,125 @@ def test_metric_tag_validation(rt):
         c.inc(-1.0)
 
 
+def test_state_requires_init():
+    """State APIs must raise, not silently ray_tpu.init(), when no
+    session exists (implicit init hides misconfiguration)."""
+    from ray_tpu._private.client import (get_global_client,
+                                         set_global_client)
+    from ray_tpu.util import state
+
+    prev = get_global_client()
+    set_global_client(None)
+    try:
+        with pytest.raises(RuntimeError, match="not initialized"):
+            state.list_tasks()
+        with pytest.raises(RuntimeError, match="not initialized"):
+            state.summarize_tasks()
+        assert not ray_tpu.is_initialized()
+    finally:
+        set_global_client(prev)
+
+
+def test_metrics_flush_retry_no_double_count(rt, monkeypatch):
+    """A failed push requeues into _pending and is retried by the next
+    flush exactly once (no double counting); _pending stays bounded at
+    _PENDING_MAX."""
+    from ray_tpu.util import metrics
+
+    client = ray_tpu._ensure_connected()
+    c = metrics.Counter("test_retry_total", "retry test")
+    try:
+        real_push = client.metrics_push
+        state_ = {"fail": True, "pushed": []}
+
+        def flaky(series):
+            if state_["fail"]:
+                raise RuntimeError("transient push failure")
+            state_["pushed"].extend(series)
+            return real_push(series)
+
+        monkeypatch.setattr(client, "metrics_push", flaky)
+        c.inc(3.0)
+        metrics.flush()                      # fails -> requeued
+        assert any(s["name"] == "test_retry_total" and s["value"] == 3.0
+                   for s in metrics._pending)
+        state_["fail"] = False
+        metrics.flush()                      # retries the batch
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not state_["pushed"]:
+            time.sleep(0.05)                 # flusher may race us; wait
+            metrics.flush()
+        total = sum(s["value"] for s in state_["pushed"]
+                    if s["name"] == "test_retry_total")
+        assert total == 3.0                  # once, not double-counted
+        assert not any(s["name"] == "test_retry_total"
+                       for s in metrics._pending)
+        by = {s["name"]: s for s in metrics.scrape()}
+        assert by["test_retry_total"]["value"] == 3.0
+
+        # Bound: with pushes permanently failing, _pending never grows
+        # past _PENDING_MAX.
+        state_["fail"] = True
+        monkeypatch.setattr(metrics, "_PENDING_MAX", 5)
+        for _ in range(12):
+            c.inc(1.0)
+            metrics.flush()
+        assert len(metrics._pending) <= 5
+    finally:
+        with metrics._lock:
+            metrics._pending.clear()
+            if c in metrics._registry:
+                metrics._registry.remove(c)
+
+
+def test_prometheus_exposition_escaping(rt):
+    """Label values with quotes/backslashes/newlines and HELP text with
+    newlines must be escaped per the exposition spec."""
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_escape_total",
+                        'desc with \\ backslash\nand newline',
+                        tag_keys=("path",))
+    try:
+        c.inc(1.0, tags={"path": 'a"b\\c\nd'})
+        metrics.flush()
+        text = metrics.prometheus_text()
+        assert ('# HELP test_escape_total desc with \\\\ backslash'
+                '\\nand newline') in text
+        assert 'path="a\\"b\\\\c\\nd"' in text
+        # No raw newline may survive inside any single line.
+        for line in text.splitlines():
+            assert '\n' not in line
+    finally:
+        with metrics._lock:
+            if c in metrics._registry:
+                metrics._registry.remove(c)
+
+
+def test_histogram_exposition_inf_and_count(rt):
+    """The +Inf bucket must be cumulative and equal _count, including
+    observations above the largest declared boundary."""
+    from ray_tpu.util import metrics
+
+    h = metrics.Histogram("test_expo_hist", "hist",
+                          boundaries=[0.1, 1.0])
+    try:
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(99.0)       # above the largest boundary
+        metrics.flush()
+        text = metrics.prometheus_text()
+        assert 'test_expo_hist_bucket{le="0.1"} 1' in text
+        assert 'test_expo_hist_bucket{le="1.0"} 2' in text
+        assert 'test_expo_hist_bucket{le="+Inf"} 3' in text
+        assert 'test_expo_hist_count 3' in text
+        assert 'test_expo_hist_sum 99.55' in text
+    finally:
+        with metrics._lock:
+            if h in metrics._registry:
+                metrics._registry.remove(h)
+
+
 @ray_tpu.remote
 def chatty():
     print("hello-from-worker-stdout")

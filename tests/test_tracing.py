@@ -1,0 +1,216 @@
+"""End-to-end task-lifecycle tracing: stage checkpoints, cross-process
+trace-context propagation, and the Serve request flame (reference:
+ray.util.tracing span propagation + task events feeding
+`ray summary tasks` / ray.timeline)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import profiling, state
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    try:
+        from ray_tpu import serve
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def staged(ms):
+    time.sleep(ms / 1000)
+    return ms
+
+
+def _lifecycles(name):
+    return [e for e in profiling.timeline_events()
+            if e.get("kind") == "lifecycle"
+            and (e.get("task_name") or "").endswith(name)]
+
+
+def test_lifecycle_stages_recorded(rt):
+    ray_tpu.get([staged.remote(20) for _ in range(3)])
+    evs = _lifecycles("staged")
+    assert len(evs) == 3
+    for e in evs:
+        st = e["stages"]
+        assert {"submitted", "queued", "worker_assigned", "executing",
+                "finished"} <= set(st)
+        assert (st["finished"] >= st["executing"]
+                >= st["worker_assigned"] >= st["queued"]
+                >= st["submitted"])
+        assert len(e["trace_id"]) == 32 and len(e["span_id"]) == 16
+
+
+def test_lifecycle_of_failed_task(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    evs = _lifecycles("boom")
+    assert evs and evs[0]["failed"]
+
+
+def test_summarize_tasks_stage_latencies(rt):
+    ray_tpu.get([staged.remote(25) for _ in range(4)])
+    summary = state.summarize_tasks()
+    per = summary["staged"]
+    assert per["finished"] >= 4
+    stages = per["stages"]
+    # Acceptance: non-zero queued and executing latencies.
+    assert stages["queued"]["p50_s"] > 0
+    assert stages["executing"]["p50_s"] > 0.02
+    assert stages["executing"]["max_s"] >= stages["executing"]["p50_s"]
+    assert stages["total"]["p95_s"] >= stages["executing"]["p50_s"]
+
+
+def test_dep_fetch_stage_recorded(rt):
+    @ray_tpu.remote
+    def produce():
+        time.sleep(0.02)
+        return 7
+
+    @ray_tpu.remote
+    def consume(x):
+        return x + 1
+
+    assert ray_tpu.get(consume.remote(produce.remote())) == 8
+    evs = _lifecycles("consume")
+    assert evs and "deps_fetched" in evs[0]["stages"]
+    st = evs[0]["stages"]
+    # The dep arrived ~20ms after submission; deps_fetched must
+    # reflect the wait, not the submit instant.
+    assert st["deps_fetched"] - st["queued"] > 0.01
+
+
+def test_trace_propagates_driver_to_task(rt):
+    @ray_tpu.remote
+    def traced():
+        with profiling.span("inside"):
+            time.sleep(0.005)
+        return profiling.current_trace_id()
+
+    assert profiling.current_trace_id() is None
+    with profiling.span("root"):
+        driver_tid = profiling.current_trace_id()
+        assert driver_tid
+        task_tid = ray_tpu.get(traced.remote())
+    assert task_tid == driver_tid
+
+    evs = profiling.timeline_events()
+    root = next(e for e in evs if e["name"] == "root")
+    exe = next(e for e in evs if e["name"].endswith("traced")
+               and not e.get("user") and e.get("kind") != "lifecycle")
+    inner = next(e for e in evs if e["name"] == "inside")
+    life = _lifecycles("traced")[0]
+    assert (root["trace_id"] == exe["trace_id"] == inner["trace_id"]
+            == life["trace_id"])
+    # Span tree: root -> lifecycle -> execute -> inner.
+    assert life["parent_span_id"] == root["span_id"]
+    assert exe["parent_span_id"] == life["span_id"]
+    assert inner["parent_span_id"] == exe["span_id"]
+
+
+def test_nested_task_inherits_trace(rt):
+    @ray_tpu.remote
+    def child():
+        return 1
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote())
+
+    with profiling.span("origin"):
+        assert ray_tpu.get(parent.remote()) == 1
+    evs = profiling.timeline_events()
+    origin = next(e for e in evs if e["name"] == "origin")
+    child_life = _lifecycles("child")[0]
+    assert child_life["trace_id"] == origin["trace_id"]
+
+
+def test_timeline_expands_stages(rt, tmp_path):
+    ray_tpu.get(staged.remote(15))
+    out = tmp_path / "trace.json"
+    traced = profiling.timeline(str(out))
+    assert json.load(open(out))
+    stage_rows = [t for t in traced if t["cat"] == "lifecycle"]
+    names = {t["name"] for t in stage_rows}
+    assert "staged:lifecycle" in names
+    assert "staged:queued" in names and "staged:executing" in names
+    for t in stage_rows:
+        assert t["ph"] == "X" and t["dur"] >= 0
+        assert "trace_id" in t["args"]
+
+
+def test_stage_metrics_in_scrape(rt):
+    from ray_tpu.util import metrics
+
+    ray_tpu.get([staged.remote(10) for _ in range(2)])
+    series = metrics.scrape()
+    stage_series = [s for s in series
+                    if s["name"] == metrics.TASK_STAGE_METRIC]
+    stages = {s["tags"]["stage"] for s in stage_series}
+    assert {"queued", "executing", "total"} <= stages
+    for s in stage_series:
+        assert s["kind"] == "histogram"
+        assert s["count"] >= 1
+        assert s["sum"] >= 0
+    text = metrics.prometheus_text()
+    assert f"# TYPE {metrics.TASK_STAGE_METRIC} histogram" in text
+    assert f'{metrics.TASK_STAGE_METRIC}_bucket' in text
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_serve_request_spans_share_trace(rt):
+    """Acceptance: one HTTP request -> >=4 correlated spans (proxy,
+    router, replica, task execute) sharing a single trace_id."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, body):
+            return {"ok": body["x"]}
+
+    serve.run(Echo.bind())
+    httpd = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    out = _post(f"{base}/Echo", {"x": 5})
+    assert out == {"result": {"ok": 5}}
+
+    deadline = time.time() + 10.0
+    names = set()
+    group = []
+    while time.time() < deadline:
+        evs = profiling.timeline_events()
+        proxies = [e for e in evs if e["name"] == "proxy.request"]
+        if proxies:
+            tid = proxies[-1]["trace_id"]
+            group = [e for e in evs if e.get("trace_id") == tid]
+            names = {e["name"] for e in group}
+            if {"proxy.request", "router.assign",
+                    "replica.handle_request", "handle_request"} <= names:
+                break
+        time.sleep(0.2)
+    assert {"proxy.request", "router.assign", "replica.handle_request",
+            "handle_request"} <= names, names
+    assert len(group) >= 4
+    # The actor-call lifecycle rides the same trace.
+    assert any(e.get("kind") == "lifecycle" for e in group)
