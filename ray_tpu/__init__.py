@@ -190,9 +190,18 @@ def _mark_worker_connected(client) -> None:
 def remote(*args, **options):
     """@remote decorator for functions and classes."""
     def wrap(obj):
+        # Decoration-time lint runs HERE, once per decoration — not in
+        # the constructors, which also run on every .options() clone
+        # and on worker-side unpickle.
+        from ray_tpu.devtools.lint.decoration import (
+            check_actor_class, check_remote_function)
         if isinstance(obj, type):
-            return ActorClass(obj, options)
-        return RemoteFunction(obj, options)
+            ac = ActorClass(obj, options)
+            check_actor_class(obj)
+            return ac
+        rf = RemoteFunction(obj, options)
+        check_remote_function(obj)
+        return rf
 
     if len(args) == 1 and not options and callable(args[0]):
         return wrap(args[0])

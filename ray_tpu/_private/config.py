@@ -169,3 +169,7 @@ _D("profile_events_max", int, 10_000,
 _D("workflow_storage_dir", str, "",
    "Durable workflow storage root (default: ~/.ray_tpu/workflows). "
    "Deliberately outside the session dir so resume survives shutdown.")
+_D("lint_mode", str, "warn",
+   "Decoration-time static analysis on @remote/@actor (devtools/lint): "
+   "'warn' emits RayTpuLintWarning, 'error' raises LintError, 'off' "
+   "disables the check.")

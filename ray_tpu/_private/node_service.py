@@ -124,6 +124,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._listener: Optional[socket.socket] = None
         self._next_worker_seq = 0
         self._deadline_waiters: List[Tuple[float, Callable[[], None]]] = []
+        # Wakes _monitor_loop out of its wait: set by shutdown() and by
+        # _add_deadline_waiter for deadlines nearer than the tick.
+        self._monitor_wake = threading.Event()
         self._max_workers = int(os.environ.get(
             "RAY_TPU_MAX_WORKERS", max(8, int(resources.get("CPU", 4)) * 2)))
         # Circuit breaker: consecutive workers that died before ever
@@ -205,6 +208,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         with self.lock:
             self._shutdown = True
             workers = list(self.workers.values())
+        self._monitor_wake.set()    # don't pay a last monitor sleep
         # Wake the accept loop(s) with a dummy connection and JOIN them
         # BEFORE closing the listener fds.  A thread left blocked in
         # accept() survives close(); when the fd number is reused by the
@@ -950,8 +954,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 try_reply(timed_out=True)
                 return
             if deadline is not None and missing:
-                self._deadline_waiters.append(
-                    (deadline, lambda: try_reply(timed_out=True)))
+                self._add_deadline_waiter(
+                    deadline, lambda: try_reply(timed_out=True))
         try_reply()
 
     def _h_wait(self, ctx: _ConnCtx, m: dict) -> None:
@@ -992,8 +996,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 try_reply(timed_out=True)
                 return
             if deadline is not None:
-                self._deadline_waiters.append(
-                    (deadline, lambda: try_reply(timed_out=True)))
+                self._add_deadline_waiter(
+                    deadline, lambda: try_reply(timed_out=True))
         try_reply()
 
     def _h_task_done(self, ctx: _ConnCtx, m: dict) -> None:
@@ -1272,8 +1276,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 return
 
             with self.lock:
-                self._deadline_waiters.append(
-                    (time.time() + timeout, expire))
+                self._add_deadline_waiter(time.time() + timeout, expire)
             return
 
         # Multinode: park at the GCS service via a side thread (the
@@ -1324,8 +1327,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 except Exception:
                     pass
 
-            self._deadline_waiters.append(
-                (time.time() + timeout, expire))
+            self._add_deadline_waiter(time.time() + timeout, expire)
 
     def _h_stacks_reply(self, ctx: _ConnCtx, m: dict) -> None:
         with self.lock:
@@ -2514,28 +2516,63 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
     # ------------------------------------------------------------------
     # monitor: deadlines, dead procs, idle reaping
     # ------------------------------------------------------------------
+    def _add_deadline_waiter(self, deadline: float,
+                             cb: Callable[[], None]) -> None:
+        """Register a timeout callback for the monitor to fire.  Wakes
+        the monitor when the deadline lands inside the current tick so
+        sub-50ms get/wait timeouts are honored precisely."""
+        self._deadline_waiters.append((deadline, cb))
+        if deadline - time.time() < 0.05:
+            self._monitor_wake.set()
+
     def _monitor_loop(self) -> None:
-        ticks = 0
+        # Event wait, not a fixed sleep (an RT005-class self-finding of
+        # devtools/lint): shutdown() and a newly-registered near
+        # deadline wake the loop immediately, so get/wait timeouts fire
+        # on time instead of quantized to the next 50ms tick, and
+        # shutdown never pays a last stale sleep.
+        next_spill = next_infeasible = next_mem = next_scan = 0.0
         while not self._shutdown:
-            time.sleep(0.05)
-            ticks += 1
-            if ticks % 20 == 0:       # ~1s: spill-threshold watchdog
+            with self.lock:
+                nearest = min(
+                    (d for d, _ in self._deadline_waiters),
+                    default=None)
+            timeout = 0.05
+            if nearest is not None:
+                timeout = max(0.0, min(timeout, nearest - time.time()))
+            self._monitor_wake.wait(timeout)
+            self._monitor_wake.clear()
+            if self._shutdown:
+                break
+            now = time.time()
+            # Periodic jobs are wall-clock scheduled (event wakes can
+            # arrive much faster than the 50ms tick ever did).
+            if now >= next_spill:     # ~1s: spill-threshold watchdog
+                next_spill = now + 1.0
                 try:
                     self._maybe_proactive_spill()
                 except Exception:
                     pass
-            if ticks % 40 == 0:       # ~2s: infeasible-demand recheck
+            if now >= next_infeasible:   # ~2s: infeasible recheck
+                next_infeasible = now + 2.0
                 try:
                     self._recheck_infeasible()
                 except Exception:
                     pass
             refresh_ms = config.memory_monitor_refresh_ms
-            if refresh_ms > 0 and ticks % max(refresh_ms // 50, 1) == 0:
+            if refresh_ms > 0 and now >= next_mem:
+                next_mem = now + refresh_ms / 1000.0
                 try:
                     self._check_memory_pressure()
                 except Exception:
                     pass
-            now = time.time()
+            # Deadline firing runs on EVERY wake (that is the point of
+            # the event); the O(workers) death/idle/reap scans keep
+            # their 50ms wall-clock cadence so a stream of sub-tick
+            # timeouts can't turn them into wake-rate lock traffic.
+            scan = now >= next_scan
+            if scan:
+                next_scan = now + 0.05
             fire = []
             with self.lock:
                 remaining = []
@@ -2547,40 +2584,46 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     else:
                         remaining.append((deadline, cb))
                 self._deadline_waiters = remaining
-                for w in list(self.workers.values()):
-                    if (w.proc is not None and w.proc.poll() is not None
-                            and w.state != "dead"):
-                        self._handle_worker_death(
-                            w, f"worker process exited "
-                               f"(code {w.proc.returncode})")
-                        self._schedule()
-                idle_timeout = config.worker_idle_timeout_s
-                for w in list(self.workers.values()):
-                    if (w.state == "idle" and w.actor_id is None
-                            and now - w.last_idle_time > idle_timeout):
-                        w.state = "dead"
-                        self.workers.pop(w.worker_id, None)
-                        if w.conn_send:
-                            w.conn_send({"type": "exit"})
-                        self._schedule_reap(w)
-                still_pending = []
-                for proc, pid, deadline in self._pending_reaps:
-                    if proc.poll() is not None:
-                        try:
-                            self._store().reap_client(pid)
-                        except Exception:
-                            pass
-                    elif now >= deadline:
-                        proc.kill()
-                        still_pending.append((proc, pid, now + 2.0))
-                    else:
-                        still_pending.append((proc, pid, deadline))
-                self._pending_reaps = still_pending
+                if scan:
+                    self._monitor_scan_locked(now)
             for cb in fire:
                 try:
                     cb()
                 except Exception:
                     pass
+
+    def _monitor_scan_locked(self, now: float) -> None:
+        """Worker-death / idle-reap / pending-reap sweep (caller holds
+        self.lock; runs at the 50ms scan cadence, not per wake)."""
+        for w in list(self.workers.values()):
+            if (w.proc is not None and w.proc.poll() is not None
+                    and w.state != "dead"):
+                self._handle_worker_death(
+                    w, f"worker process exited "
+                       f"(code {w.proc.returncode})")
+                self._schedule()
+        idle_timeout = config.worker_idle_timeout_s
+        for w in list(self.workers.values()):
+            if (w.state == "idle" and w.actor_id is None
+                    and now - w.last_idle_time > idle_timeout):
+                w.state = "dead"
+                self.workers.pop(w.worker_id, None)
+                if w.conn_send:
+                    w.conn_send({"type": "exit"})
+                self._schedule_reap(w)
+        still_pending = []
+        for proc, pid, deadline in self._pending_reaps:
+            if proc.poll() is not None:
+                try:
+                    self._store().reap_client(pid)
+                except Exception:
+                    pass
+            elif now >= deadline:
+                proc.kill()
+                still_pending.append((proc, pid, now + 2.0))
+            else:
+                still_pending.append((proc, pid, deadline))
+        self._pending_reaps = still_pending
 
 
 def main() -> None:

@@ -1,0 +1,67 @@
+"""The single source of truth for `@remote`/`.options()` keys.
+
+The reference scatters its option tables across ray_option_utils.py
+(task_options / actor_options dicts); here both live in one module so
+the decorators, the `.options()` merge path, and the static analyzer
+(devtools/lint rule RT003) validate against the SAME tables — a typo'd
+key produces the same suggestion everywhere.
+"""
+
+from __future__ import annotations
+
+import difflib
+from typing import Any, Dict, FrozenSet, Iterable, Optional
+
+# Options shared by tasks and actors.
+COMMON_OPTIONS: FrozenSet[str] = frozenset({
+    "num_cpus", "num_tpus", "resources", "name",
+    "placement_group", "placement_group_bundle_index",
+    "runtime_env", "scheduling_strategy", "_affinity",
+})
+
+# Task-only options.
+TASK_OPTIONS: FrozenSet[str] = COMMON_OPTIONS | {
+    "num_returns", "max_retries",
+}
+
+# Actor-only options.
+ACTOR_OPTIONS: FrozenSet[str] = COMMON_OPTIONS | {
+    "max_restarts", "max_concurrency", "namespace", "lifetime",
+    "max_task_retries",
+}
+
+
+def suggest(key: str, valid: Iterable[str]) -> Optional[str]:
+    """Closest valid key for a typo, or None if nothing is close."""
+    matches = difflib.get_close_matches(key, list(valid), n=1, cutoff=0.6)
+    return matches[0] if matches else None
+
+
+def validate_options(options: Dict[str, Any], valid: FrozenSet[str],
+                     kind: str) -> None:
+    """Raise ValueError for unknown keys, naming the closest valid key.
+
+    `kind` is "task" or "actor" (used in the message so an actor option
+    passed to a task reads as a kind mismatch, not a typo).
+    """
+    bad = sorted(set(options) - valid)
+    if not bad:
+        return
+    hints = []
+    for key in bad:
+        # Cross-kind check FIRST: `max_restarts` on a task is a kind
+        # mismatch, not a typo — a fuzzy "did you mean max_retries?"
+        # would send the user the wrong way.
+        if key in (ACTOR_OPTIONS | TASK_OPTIONS):
+            other = "actor" if kind == "task" else "task"
+            hints.append(f"{key!r} (valid only for {other}s, "
+                         f"not {kind}s)")
+            continue
+        near = suggest(key, valid)
+        if near is not None and near != key:
+            hints.append(f"{key!r} (did you mean {near!r}?)")
+        else:
+            hints.append(repr(key))
+    raise ValueError(
+        f"invalid {kind} options: {', '.join(hints)}; valid keys: "
+        f"{sorted(valid)}")

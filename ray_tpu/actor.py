@@ -14,15 +14,13 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 from ray_tpu._private.config import config
+from ray_tpu._private.options import ACTOR_OPTIONS, validate_options
 from ray_tpu.remote_function import (_pg_spec_from_options,
                                      _resources_from_options)
 
-_VALID_ACTOR_OPTIONS = {
-    "num_cpus", "num_tpus", "resources", "max_restarts", "max_concurrency",
-    "name", "namespace", "lifetime", "max_task_retries",
-    "placement_group", "placement_group_bundle_index", "runtime_env",
-    "scheduling_strategy", "_affinity",
-}
+# Back-compat alias; the canonical table lives in _private/options.py
+# (shared with remote_function.py and the RT003 lint rule).
+_VALID_ACTOR_OPTIONS = ACTOR_OPTIONS
 
 
 def method(num_returns: int = 1):
@@ -40,9 +38,7 @@ class ActorClass:
                  options: Optional[Dict[str, Any]] = None) -> None:
         self._cls = cls
         self._options = dict(options or {})
-        bad = set(self._options) - _VALID_ACTOR_OPTIONS
-        if bad:
-            raise ValueError(f"invalid actor options: {sorted(bad)}")
+        validate_options(self._options, ACTOR_OPTIONS, "actor")
         self._blob: Optional[bytes] = None
 
     def __call__(self, *args, **kwargs):

@@ -1,0 +1,3 @@
+"""Developer tooling that ships with the runtime (static analysis,
+introspection helpers).  Nothing here is imported on the task hot
+path; the decorators import `devtools.lint.decoration` lazily."""

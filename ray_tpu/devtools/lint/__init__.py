@@ -1,0 +1,30 @@
+"""`ray_tpu lint` — static analysis for remote/actor/sharding code.
+
+Whole classes of user error that the runtime only reports as
+multi-minute TPU-pod hangs — nested-get deadlocks, unserializable
+closure captures, resource typos, sharding specs that don't match the
+mesh — are caught here at decoration time and in CI instead.
+
+    python -m ray_tpu lint ray_tpu/            # CLI over a tree
+    # ray-tpu: noqa[RT001]                     # per-line suppression
+    config.lint_mode = "error"                 # decoration-time raise
+
+Rules: RT001 nested blocking get, RT002 non-picklable capture, RT003
+invalid options keys / bundle index, RT004 undeclared mesh axis in a
+PartitionSpec, RT005 blocking call in async code, RT006 dropped
+ObjectRef, RT007 metric name/bucket hygiene.
+"""
+
+from ray_tpu.devtools.lint.engine import (Finding, LintResult,
+                                          all_rules, apply_baseline,
+                                          lint_paths, lint_source,
+                                          load_baseline,
+                                          write_baseline)
+from ray_tpu.devtools.lint.decoration import (LintError,
+                                              RayTpuLintWarning)
+
+__all__ = [
+    "Finding", "LintResult", "all_rules", "apply_baseline",
+    "lint_paths", "lint_source", "load_baseline", "write_baseline",
+    "LintError", "RayTpuLintWarning",
+]

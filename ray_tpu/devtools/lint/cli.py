@@ -1,0 +1,98 @@
+"""CLI for `ray_tpu lint` (wired into scripts/cli.py).
+
+Exit codes: 0 clean (or everything absorbed by the baseline),
+1 findings, 2 usage/internal error — the flake8 convention, so the
+self-lint can gate CI with a plain `ray_tpu lint ray_tpu/ --baseline
+ray_tpu/devtools/lint/baseline.txt`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from ray_tpu.devtools.lint import engine
+
+
+def rule_table_text() -> str:
+    """Rule-id table for --help epilogs and the README."""
+    rules = engine.all_rules()
+    lines = ["rules:"]
+    for rid in sorted(rules):
+        lines.append(f"  {rid}  {rules[rid].summary}")
+    lines.append("")
+    lines.append("suppress per line with `# ray-tpu: noqa[RT001]` "
+                 "(or bare `# ray-tpu: noqa`);")
+    lines.append("decoration-time checks follow config.lint_mode = "
+                 "off | warn | error.")
+    return "\n".join(lines)
+
+
+def add_arguments(parser) -> None:
+    parser.add_argument("paths", nargs="+",
+                        help="files or directories to lint")
+    parser.add_argument("--format", choices=["text", "json"],
+                        default="text")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids (default: all)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file of accepted findings; only "
+                             "NEW findings fail")
+    parser.add_argument("--write-baseline", default=None, metavar="FILE",
+                        help="write current findings as the baseline "
+                             "and exit 0")
+    parser.add_argument("--rel-root", default=None,
+                        help="root paths are reported/keyed relative "
+                             "to (default: cwd)")
+
+
+def run(args) -> int:
+    rel_root = os.path.abspath(args.rel_root or os.getcwd())
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    try:
+        res = engine.lint_paths(args.paths, select=select)
+    except (FileNotFoundError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        if res.errors:
+            # A partial baseline silently masks the unparsable files'
+            # findings — refuse rather than claim success.
+            for err in res.errors:
+                print(f"error: {err}", file=sys.stderr)
+            print("error: not writing baseline (fix the files above "
+                  "first)", file=sys.stderr)
+            return 2
+        n = engine.write_baseline(res, args.write_baseline, rel_root)
+        print(f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} to "
+              f"{args.write_baseline}")
+        return 0
+    findings = res.findings
+    if args.baseline:
+        try:
+            baseline = engine.load_baseline(args.baseline)
+        except OSError as e:
+            print(f"error: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        findings = engine.apply_baseline(res, baseline, rel_root)
+    if args.format == "json":
+        print(engine.to_json(findings, res, rel_root))
+    else:
+        for f in findings:
+            print(f.render(rel_root))
+        for err in res.errors:
+            print(f"error: {err}", file=sys.stderr)
+        tail = []
+        if args.baseline:
+            absorbed = len(res.findings) - len(findings)
+            if absorbed:
+                tail.append(f"{absorbed} baselined")
+        if res.suppressed:
+            tail.append(f"{res.suppressed} noqa-suppressed")
+        suffix = f" ({', '.join(tail)})" if tail else ""
+        print(f"{len(findings)} finding"
+              f"{'' if len(findings) == 1 else 's'}{suffix}")
+    if res.errors:
+        return 2
+    return 1 if findings else 0

@@ -1,0 +1,140 @@
+"""Decoration-time lint: the fast path run inside `@remote`/`@actor`.
+
+Unlike the AST rules (CLI / CI), this path sees the LIVE function
+object, so the closure-capture rule (RT002) is exact: it inspects the
+actual cell contents and default values instead of guessing from
+source.  It is deliberately cheap — no source retrieval, no AST — so
+decorating a module full of tasks costs microseconds, and the import
+path stays lazy (this module is imported on first decoration, not at
+`import ray_tpu`).
+
+Behavior is governed by ``config.lint_mode``:
+    "warn"  (default) — emit a RayTpuLintWarning
+    "error"           — raise LintError
+    "off"             — skip entirely
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+import threading
+from types import ModuleType
+from typing import Any, Iterable, List, Optional, Tuple
+
+from ray_tpu._private.config import config
+
+
+class RayTpuLintWarning(UserWarning):
+    """Decoration-time lint finding (rule id in the message)."""
+
+
+class LintError(ValueError):
+    """A lint finding escalated by config.lint_mode = 'error'."""
+
+
+_LOCK_TYPES: Tuple[type, ...] = (
+    type(threading.Lock()), type(threading.RLock()),
+    threading.Event, threading.Condition, threading.Semaphore,
+)
+
+
+def _unpicklable_reason(value: Any) -> Optional[str]:
+    """Why `value` must not ride a cloudpickled task spec, or None."""
+    if isinstance(value, ModuleType):
+        # Importable modules cloudpickle BY REFERENCE — harmless.
+        # Only __main__ / dynamically-created modules ship by value
+        # (and break, or drag the whole script into the spec).
+        if value.__name__ == "__main__" \
+                or getattr(value, "__spec__", None) is None:
+            return f"module {value.__name__!r} (pickled by value — " \
+                   f"not importable on workers)"
+        return None
+    if isinstance(value, _LOCK_TYPES):
+        return f"synchronization primitive {type(value).__name__}"
+    if isinstance(value, io.IOBase):
+        return f"open file handle {getattr(value, 'name', '?')!r}"
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            if isinstance(value, jax.core.Tracer):
+                return "jax tracer (leaked from a traced function)"
+            if isinstance(value, jax.Array):
+                return "jax device array (ship a host array or an " \
+                       "ObjectRef instead)"
+        except AttributeError:
+            pass
+    return None
+
+
+def _closure_findings(fn, owner: str) -> List[str]:
+    out: List[str] = []
+    code = getattr(fn, "__code__", None)
+    cells = getattr(fn, "__closure__", None) or ()
+    freevars = getattr(code, "co_freevars", ()) if code else ()
+    for name, cell in zip(freevars, cells):
+        try:
+            value = cell.cell_contents
+        except ValueError:
+            continue        # empty cell (still being defined)
+        reason = _unpicklable_reason(value)
+        if reason:
+            out.append(
+                f"RT002 {owner} captures {name!r} in its closure — "
+                f"{reason} — which cannot be serialized into the "
+                f"task spec")
+    defaults = getattr(fn, "__defaults__", None) or ()
+    if code and defaults:
+        for name, value in zip(_default_names(fn), defaults):
+            reason = _unpicklable_reason(value)
+            if reason:
+                out.append(
+                    f"RT002 {owner} default for parameter {name!r} is "
+                    f"{reason} — it cannot be serialized into the "
+                    f"task spec")
+    return out
+
+
+def _default_names(fn) -> List[str]:
+    code = fn.__code__
+    args = code.co_varnames[:code.co_argcount]
+    n = len(fn.__defaults__ or ())
+    return list(args[-n:]) if n else []
+
+
+def _emit(findings: Iterable[str]) -> None:
+    findings = list(findings)
+    if not findings:
+        return
+    mode = config.lint_mode
+    if mode == "error":
+        raise LintError("; ".join(findings))
+    import warnings
+    for f in findings:
+        warnings.warn(RayTpuLintWarning(f), stacklevel=4)
+
+
+def check_remote_function(fn) -> None:
+    """RT002 over a @remote function's closure (options are validated
+    separately by _private/options.validate_options — that is the
+    decoration-time RT003)."""
+    if config.lint_mode == "off":
+        return
+    _emit(_closure_findings(fn, f"@remote task {fn.__name__!r}"))
+
+
+def check_actor_class(cls) -> None:
+    """RT002 over every method closure of a @remote class."""
+    if config.lint_mode == "off":
+        return
+    findings: List[str] = []
+    for name in dir(cls):
+        if name.startswith("__") and name != "__init__":
+            continue
+        fn = getattr(cls, name, None)
+        inner = getattr(fn, "__func__", fn)
+        if not callable(inner) or not hasattr(inner, "__code__"):
+            continue
+        findings.extend(_closure_findings(
+            inner, f"@remote actor {cls.__name__}.{name}"))
+    _emit(findings)

@@ -1,0 +1,353 @@
+"""Rule engine for `ray_tpu lint`.
+
+The shape follows flake8/ruff: a registry of small AST rules, each
+producing `Finding`s; per-line `# ray-tpu: noqa[RTxxx]` suppressions;
+and a baseline file so the analyzer can be self-applied to a codebase
+with known, accepted violations (new ones fail, old ones don't).
+
+Baseline keys are content-addressed — `rule|relpath|stripped source
+line` — so findings survive unrelated line-number churn; duplicates on
+identical lines are counted, not collapsed.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from collections import Counter as _Counter
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+_NOQA_RE = re.compile(
+    r"#\s*ray-tpu:\s*noqa(?:\[(?P<codes>[A-Za-z0-9_,\s]+)\])?",
+    re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    path: str          # absolute path of the offending file
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+
+    def render(self, rel_root: Optional[str] = None) -> str:
+        return (f"{_relpath(self.path, rel_root)}:{self.line}:"
+                f"{self.col + 1}: {self.rule_id} {self.message}")
+
+    def key(self, rel_root: Optional[str] = None,
+            source_line: str = "") -> str:
+        return "|".join((self.rule_id, _relpath(self.path, rel_root),
+                         source_line.strip()))
+
+    def to_dict(self, rel_root: Optional[str] = None) -> dict:
+        return {"rule": self.rule_id,
+                "path": _relpath(self.path, rel_root),
+                "line": self.line, "col": self.col + 1,
+                "message": self.message}
+
+
+def _relpath(path: str, rel_root: Optional[str]) -> str:
+    if rel_root:
+        try:
+            rel = os.path.relpath(path, rel_root)
+            if not rel.startswith(".."):
+                return rel.replace(os.sep, "/")
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+@dataclass
+class Rule:
+    """One lint rule: an id, a one-line summary, and a checker run over
+    a parsed module."""
+    rule_id: str
+    summary: str
+    check: Callable[["SourceModule"], Iterable[Finding]]
+    doc: str = ""
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_id: str, summary: str, doc: str = ""):
+    """Decorator registering a checker function as a rule."""
+    def deco(fn):
+        _REGISTRY[rule_id] = Rule(rule_id, summary, fn, doc or summary)
+        return fn
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    _load_builtin_rules()
+    return dict(_REGISTRY)
+
+
+def _load_builtin_rules() -> None:
+    # Import for side effect (registration); idempotent.
+    from ray_tpu.devtools.lint import rules  # noqa: F401
+
+
+class SourceModule:
+    """A parsed file plus the shared derived tables rules need, computed
+    once per file (not once per rule)."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # Parent links (ast has none) — rules walk up for context.
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule_id: str, node: ast.AST, message: str
+                ) -> Finding:
+        return Finding(rule_id, self.path,
+                       getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+    # -- shared AST helpers --------------------------------------------
+    def decorator_kind(self, node: ast.AST) -> Optional[str]:
+        """"task" for @remote functions, "actor" for @remote classes,
+        else None.  Recognizes `@remote`, `@ray_tpu.remote`,
+        `@ray.remote` and their call forms `@remote(...)`."""
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            return None
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _dotted_name(target) in ("remote", "ray_tpu.remote",
+                                        "ray.remote"):
+                return ("actor" if isinstance(node, ast.ClassDef)
+                        else "task")
+        return None
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    def enclosing_remote_task(self, node: ast.AST):
+        """Nearest enclosing function that is a @remote task (directly
+        decorated, not a lambda/nested helper)."""
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and self.decorator_kind(cur) == "task":
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    def in_async_function(self, node: ast.AST) -> bool:
+        """True when the nearest enclosing function is `async def`."""
+        fn = self.enclosing_function(node)
+        return isinstance(fn, ast.AsyncFunctionDef)
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# noqa suppression
+# ---------------------------------------------------------------------------
+def noqa_codes_by_line(source: str) -> Dict[int, Optional[set]]:
+    """Map line -> suppressed rule ids (None = suppress all).
+
+    Scans tokenize COMMENT tokens (not raw text) so a noqa inside a
+    string literal doesn't suppress anything.
+    """
+    import io
+    out: Dict[int, Optional[set]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _NOQA_RE.search(tok.string)
+            if not m:
+                continue
+            codes = m.group("codes")
+            if codes is None:
+                out[tok.start[0]] = None
+            else:
+                ids = {c.strip().upper() for c in codes.split(",")
+                       if c.strip()}
+                prev = out.get(tok.start[0])
+                if prev is None and tok.start[0] in out:
+                    continue       # blanket noqa already wins
+                out[tok.start[0]] = (prev or set()) | ids
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def _suppressed(f: Finding, noqa: Dict[int, Optional[set]]) -> bool:
+    if f.line not in noqa:
+        return False
+    codes = noqa[f.line]
+    return codes is None or f.rule_id in codes
+
+
+# ---------------------------------------------------------------------------
+# running
+# ---------------------------------------------------------------------------
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)    # unparsable files
+    suppressed: int = 0
+
+    # path -> source lines, for baseline keying of the final findings.
+    _line_cache: Dict[str, List[str]] = field(default_factory=dict)
+
+    def source_line(self, f: Finding) -> str:
+        lines = self._line_cache.get(f.path, [])
+        if 1 <= f.line <= len(lines):
+            return lines[f.line - 1]
+        return ""
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        else:
+            raise FileNotFoundError(p)
+    # Dedup while keeping order: overlapping inputs (`lint pkg
+    # pkg/sub`) must not lint — and report — the same file twice.
+    return list(dict.fromkeys(out))
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run (selected) rules over one source string; noqa applied."""
+    res = LintResult()
+    _lint_one(source, path, select, res)
+    return res.findings
+
+
+def _lint_one(source: str, path: str,
+              select: Optional[Sequence[str]], res: LintResult) -> None:
+    rules = all_rules()
+    if select:
+        sel = {s.upper() for s in select}
+        unknown = sel - set(rules)
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
+        rules = {k: v for k, v in rules.items() if k in sel}
+    try:
+        mod = SourceModule(path, source)
+    except SyntaxError as e:
+        res.errors.append(f"{path}: syntax error: {e}")
+        return
+    res._line_cache[path] = mod.lines
+    noqa = noqa_codes_by_line(source)
+    for rule in rules.values():
+        for f in rule.check(mod):
+            if _suppressed(f, noqa):
+                res.suppressed += 1
+            else:
+                res.findings.append(f)
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None) -> LintResult:
+    res = LintResult()
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            res.errors.append(f"{path}: {e}")
+            continue
+        _lint_one(source, path, select, res)
+    res.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return res
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+def baseline_keys(res: LintResult, rel_root: Optional[str]
+                  ) -> List[str]:
+    return [f.key(rel_root, res.source_line(f)) for f in res.findings]
+
+
+def load_baseline(path: str) -> _Counter:
+    """Baseline file: one key per line; '#' comments and blanks ignored.
+    Duplicate keys accumulate (N accepted hits on identical lines)."""
+    counts: _Counter = _Counter()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                counts[line] += 1
+    return counts
+
+
+def apply_baseline(res: LintResult, baseline: _Counter,
+                   rel_root: Optional[str]) -> List[Finding]:
+    """Findings not absorbed by the baseline (the ones that fail CI)."""
+    budget = _Counter(baseline)
+    new: List[Finding] = []
+    for f in res.findings:
+        k = f.key(rel_root, res.source_line(f))
+        if budget[k] > 0:
+            budget[k] -= 1
+        else:
+            new.append(f)
+    return new
+
+
+def write_baseline(res: LintResult, path: str,
+                   rel_root: Optional[str]) -> int:
+    keys = sorted(baseline_keys(res, rel_root))
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# ray_tpu lint baseline — accepted findings; "
+                "regenerate with `ray_tpu lint --write-baseline`.\n")
+        for k in keys:
+            f.write(k + "\n")
+    return len(keys)
+
+
+def to_json(findings: Sequence[Finding], res: LintResult,
+            rel_root: Optional[str]) -> str:
+    return json.dumps(
+        {"findings": [f.to_dict(rel_root) for f in findings],
+         "suppressed": res.suppressed,
+         "errors": res.errors,
+         "count": len(findings)},
+        indent=1)

@@ -1,0 +1,647 @@
+"""Built-in RT-series rules.
+
+Each rule is a function over an `engine.SourceModule` registered with
+`@register("RTxxx", ...)`.  Rules are deliberately conservative: they
+fire only on patterns they can resolve statically (imports tracked per
+file), because a decoration-time warning that cries wolf gets turned
+off.  The runtime counterparts (closure introspection at `@remote`
+time) live in `decoration.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ray_tpu._private.options import (ACTOR_OPTIONS, TASK_OPTIONS,
+                                      suggest)
+from ray_tpu.devtools.lint.engine import (Finding, SourceModule,
+                                          _dotted_name, register)
+
+# ---------------------------------------------------------------------------
+# shared import resolution
+# ---------------------------------------------------------------------------
+
+
+def _import_map(mod: SourceModule) -> Dict[str, str]:
+    """Local name -> fully dotted origin, from this file's imports."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname is None:
+                    # `import a.b` binds `a` (which resolves to `a`)
+                    head = alias.name.split(".")[0]
+                    out[head] = head
+                else:
+                    # `import a.b as c` binds c -> a.b
+                    out[alias.asname] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for alias in node.names:
+                out[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return out
+
+
+def _resolved(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Fully qualified dotted name of an expression, expanding the
+    first segment through this file's imports."""
+    name = _dotted_name(node)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    origin = imports.get(head)
+    if origin:
+        return origin + ("." + rest if rest else "")
+    return name
+
+
+def _call_name(call: ast.Call, imports: Dict[str, str]) -> Optional[str]:
+    return _resolved(call.func, imports)
+
+
+def _mod_cached(mod: SourceModule, key: str, build):
+    cache = getattr(mod, "_rule_cache", None)
+    if cache is None:
+        cache = mod._rule_cache = {}
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]
+
+
+def _imports(mod: SourceModule) -> Dict[str, str]:
+    return _mod_cached(mod, "imports", lambda: _import_map(mod))
+
+
+_GET_NAMES = {"ray_tpu.get", "ray.get"}
+
+
+# ---------------------------------------------------------------------------
+# RT001 — nested blocking get inside a @remote task
+# ---------------------------------------------------------------------------
+@register(
+    "RT001", "blocking get inside a @remote task (nested-get deadlock)",
+    "ray_tpu.get()/.result() inside a @remote function blocks a worker "
+    "slot while waiting on work that may need that slot — on a full "
+    "cluster this deadlocks (and on TPU pods it presents as a hang, "
+    "not an error).  Restructure to pass ObjectRefs, or await inside "
+    "an async actor.")
+def check_rt001(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    # Names bound from `<x>.remote(...)` per function scope, for the
+    # `.result()` leg.
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        task = mod.enclosing_remote_task(node)
+        if task is None:
+            continue
+        name = _call_name(node, imports)
+        if name in _GET_NAMES:
+            yield mod.finding(
+                "RT001", node,
+                f"blocking {name}() inside @remote task "
+                f"{task.name!r} can deadlock the worker pool; pass "
+                f"the ObjectRef out instead")
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "result" \
+                and isinstance(node.func.value, ast.Name) \
+                and _is_ref_name(mod, task, node.func.value.id):
+            yield mod.finding(
+                "RT001", node,
+                f"blocking .result() on ObjectRef "
+                f"{node.func.value.id!r} inside @remote task "
+                f"{task.name!r} can deadlock the worker pool")
+
+
+def _is_ref_name(mod: SourceModule, scope: ast.AST, name: str) -> bool:
+    """True if `name` is assigned from a `.remote(...)` call anywhere in
+    `scope` (a function body)."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and _is_remote_call(node.value):
+            return True
+    return False
+
+
+def _is_remote_call(node: ast.AST) -> bool:
+    """A task/actor invocation `<x>.remote(...)` — NOT the functional
+    decorator form `ray_tpu.remote(fn)`, which returns a wrapper, not
+    an ObjectRef."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "remote"
+            and _dotted_name(node.func) not in ("ray_tpu.remote",
+                                                "ray.remote"))
+
+
+# ---------------------------------------------------------------------------
+# RT002 — closure/global capture of non-picklable state
+# ---------------------------------------------------------------------------
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore",
+    "threading.BoundedSemaphore", "multiprocessing.Lock",
+    "multiprocessing.RLock",
+}
+_FILE_CTORS = {"open", "io.open", "builtins.open"}
+_DEVICE_ARRAY_CTORS = {
+    "jax.device_put",
+    "jax.numpy.array", "jax.numpy.asarray", "jax.numpy.ones",
+    "jax.numpy.zeros", "jax.numpy.arange", "jax.numpy.full",
+    "jnp.array", "jnp.asarray", "jnp.ones", "jnp.zeros",
+    "jnp.arange", "jnp.full",
+}
+
+
+def _capture_kind(call_name: Optional[str]) -> Optional[str]:
+    if call_name in _LOCK_CTORS:
+        return ("a lock/synchronization primitive, which cannot be "
+                "serialized into the task spec")
+    if call_name in _FILE_CTORS:
+        return ("an open file handle, which cannot be serialized "
+                "into the task spec")
+    if call_name in _DEVICE_ARRAY_CTORS:
+        return ("a jax device array — ship a host array or an "
+                "ObjectRef instead")
+    return None
+
+
+@register(
+    "RT002", "capture of non-picklable state by a @remote body",
+    "A @remote function/actor body that references a module-level or "
+    "enclosing-scope lock, open file, jax device array, or an "
+    "enclosing function's module import gets that object "
+    "cloudpickled into the task spec — which fails at submission "
+    "(or worse, ships device buffers).  Create such state inside the "
+    "task, or pass it via an ObjectRef.")
+def check_rt002(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+
+    def scope_captures(body: List[ast.stmt], is_module: bool
+                       ) -> Dict[str, str]:
+        """name -> kind for risky bindings created in this scope."""
+        caps: Dict[str, str] = {}
+        for stmt in body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Call):
+                kind = _capture_kind(_call_name(stmt.value, imports))
+                if kind:
+                    caps[stmt.targets[0].id] = kind
+            elif not is_module and isinstance(stmt, ast.Import):
+                # A module imported at module level is referenced by
+                # name at unpickle time (fine); one imported in an
+                # ENCLOSING FUNCTION becomes a closure cell.
+                for alias in stmt.names:
+                    caps[alias.asname or alias.name.split(".")[0]] = \
+                        ("a module captured in a closure cell — "
+                         "serialized by reference when importable on "
+                         "the workers, by value (broken) otherwise; "
+                         "import it inside the task to be safe")
+            elif not is_module and isinstance(stmt, ast.ImportFrom) \
+                    and stmt.names[0].name == "*":
+                continue
+        return caps
+
+    module_caps = scope_captures(mod.tree.body, is_module=True)
+
+    for node in ast.walk(mod.tree):
+        kind = mod.decorator_kind(node)
+        if kind is None:
+            continue
+        # Environment visible to this remote body: module-level risky
+        # bindings + risky bindings of every enclosing function.
+        env: Dict[str, str] = dict(module_caps)
+        cur = mod.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                env.update(scope_captures(cur.body, is_module=False))
+            cur = mod.parent.get(cur)
+        if not env:
+            continue
+        local = _local_bindings(node)
+        # Walk the BODY only: decorator expressions (`@ray_tpu.remote`)
+        # are evaluated at definition time, not captured.
+        for sub in (s for stmt in node.body for s in ast.walk(stmt)):
+            if isinstance(sub, ast.Name) \
+                    and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in env and sub.id not in local:
+                yield mod.finding(
+                    "RT002", sub,
+                    f"@remote {('actor' if kind == 'actor' else 'task')}"
+                    f" {getattr(node, 'name', '?')!r} captures "
+                    f"{sub.id!r}: {env[sub.id]}")
+
+
+def _local_bindings(scope: ast.AST) -> Set[str]:
+    """Names bound inside `scope` (params, assignments, imports)."""
+    out: Set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                out.add(arg.arg)
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                out.add(alias.asname or alias.name.split(".")[0])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RT003 — invalid @remote/.options keys; bad bundle index
+# ---------------------------------------------------------------------------
+@register(
+    "RT003", "invalid @remote/.options() key or bundle index",
+    "Option keys are validated against the shared table in "
+    "_private/options.py (the same one the decorators enforce); "
+    "misspellings name the closest valid key.  A statically "
+    "out-of-range placement_group_bundle_index is flagged when the "
+    "placement group's bundle list is a literal in the same file.")
+def check_rt003(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    # kind of each @remote-decorated def in this file, by name.
+    decorated: Dict[str, str] = {}
+    # name -> literal bundle count for `pg = placement_group([...])`
+    pg_sizes: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        k = mod.decorator_kind(node)
+        if k is not None:
+            decorated[node.name] = k
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            cname = _call_name(node.value, imports) or ""
+            if cname.endswith("placement_group") and node.value.args:
+                first = node.value.args[0]
+                if isinstance(first, (ast.List, ast.Tuple)):
+                    pg_sizes[node.targets[0].id] = len(first.elts)
+
+    def check_kwargs(call: ast.Call, valid, kind: str
+                     ) -> Iterable[Finding]:
+        pg_name = None
+        bundle_kw = None
+        for kw in call.keywords:
+            if kw.arg is None:       # **kwargs: opaque
+                continue
+            if kw.arg == "placement_group" \
+                    and isinstance(kw.value, ast.Name):
+                pg_name = kw.value.id
+            if kw.arg == "placement_group_bundle_index":
+                bundle_kw = kw
+            if kw.arg not in valid:
+                near = suggest(kw.arg, valid)
+                hint = f" (did you mean {near!r}?)" if near else ""
+                yield mod.finding(
+                    "RT003", kw.value,
+                    f"unknown {kind} option {kw.arg!r}{hint}")
+        idx = _const_int(bundle_kw.value) if bundle_kw is not None \
+            else None
+        if idx is not None:
+            if idx < 0:
+                yield mod.finding(
+                    "RT003", bundle_kw.value,
+                    f"placement_group_bundle_index {idx} is negative")
+            elif pg_name in pg_sizes and idx >= pg_sizes[pg_name]:
+                yield mod.finding(
+                    "RT003", bundle_kw.value,
+                    f"placement_group_bundle_index {idx} is out of "
+                    f"range for {pg_name!r} ({pg_sizes[pg_name]} "
+                    f"bundle(s))")
+
+    def _const_int(node: ast.AST):
+        if isinstance(node, ast.Constant) \
+                and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.UnaryOp) \
+                and isinstance(node.op, ast.USub):
+            inner = _const_int(node.operand)
+            return -inner if inner is not None else None
+        return None
+
+    for node in ast.walk(mod.tree):
+        # @remote(...) decorator call form
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            kind = mod.decorator_kind(node)
+            if kind is None:
+                continue
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _dotted_name(dec.func) \
+                        in ("remote", "ray_tpu.remote", "ray.remote"):
+                    valid = (ACTOR_OPTIONS if kind == "actor"
+                             else TASK_OPTIONS)
+                    yield from check_kwargs(dec, valid, kind)
+        # <decorated-name>.options(...) calls
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "options" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in decorated:
+            kind = decorated[node.func.value.id]
+            valid = (ACTOR_OPTIONS if kind == "actor"
+                     else TASK_OPTIONS)
+            yield from check_kwargs(node, valid, kind)
+
+
+# ---------------------------------------------------------------------------
+# RT004 — PartitionSpec axis not on the mesh
+# ---------------------------------------------------------------------------
+_PSPEC_NAMES = {"jax.sharding.PartitionSpec",
+                "jax.experimental.PartitionSpec"}
+
+
+@register(
+    "RT004", "PartitionSpec names a mesh axis the mesh doesn't declare",
+    "A P('axis') referencing an axis absent from every mesh declared "
+    "in the file fails at trace/compile time with an opaque XLA "
+    "error (or silently replicates).  Checked only when the file "
+    "declares mesh axes statically (Mesh(...), MeshSpec(...), "
+    "make_mesh(axis_sizes={...})).")
+def check_rt004(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    declared: Set[str] = set()
+    saw_mesh = False
+
+    def str_elts(node: ast.AST) -> List[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for e in node.elts:
+                if isinstance(e, ast.Constant) \
+                        and isinstance(e.value, str):
+                    out.append(e.value)
+            return out
+        return []
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = _call_name(node, imports) or ""
+        tail = cname.rsplit(".", 1)[-1]
+        if tail == "Mesh" or cname in ("jax.make_mesh",):
+            axes: List[str] = []
+            if len(node.args) >= 2:
+                axes = str_elts(node.args[1])
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    axes = str_elts(kw.value)
+            if axes:
+                saw_mesh = True
+                declared.update(axes)
+        elif tail == "MeshSpec":
+            kws = [kw.arg for kw in node.keywords if kw.arg]
+            if kws:
+                saw_mesh = True
+                declared.update(kws)
+        elif tail == "make_mesh":
+            for kw in node.keywords:
+                if kw.arg == "axis_sizes" and isinstance(
+                        kw.value, ast.Dict):
+                    keys = [k.value for k in kw.value.keys
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)]
+                    if keys:
+                        saw_mesh = True
+                        declared.update(keys)
+
+    if not saw_mesh or not declared:
+        return
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = _call_name(node, imports) or ""
+        if cname not in _PSPEC_NAMES \
+                and cname.rsplit(".", 1)[-1] != "PartitionSpec":
+            continue
+        for arg in node.args:
+            for ax in _spec_axis_names(arg):
+                if ax not in declared:
+                    yield mod.finding(
+                        "RT004", arg,
+                        f"PartitionSpec axis {ax!r} is not declared "
+                        f"by any mesh in this file (axes: "
+                        f"{sorted(declared)})")
+
+
+def _spec_axis_names(arg: ast.AST) -> List[str]:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in arg.elts:
+            out.extend(_spec_axis_names(e))
+        return out
+    return []
+
+
+# ---------------------------------------------------------------------------
+# RT005 — blocking call inside async code
+# ---------------------------------------------------------------------------
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() blocks the event loop; use "
+                  "`await asyncio.sleep()`",
+    "ray_tpu.get": "sync ray_tpu.get() blocks the event loop; use "
+                   "`await loop.run_in_executor(...)` or restructure",
+    "ray.get": "sync ray.get() blocks the event loop",
+    "open": "filesystem I/O blocks the event loop; use "
+            "run_in_executor",
+    "io.open": "filesystem I/O blocks the event loop; use "
+               "run_in_executor",
+    "subprocess.run": "subprocess.run() blocks the event loop; use "
+                      "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "blocking subprocess call inside async "
+                               "code",
+}
+
+
+@register(
+    "RT005", "blocking call inside an async def body",
+    "time.sleep / sync ray_tpu.get / filesystem reads inside `async "
+    "def` starve every coroutine sharing the actor or serve event "
+    "loop — one slow request stalls all of them.")
+def check_rt005(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not mod.in_async_function(node):
+            continue
+        cname = _call_name(node, imports)
+        msg = _BLOCKING_CALLS.get(cname or "")
+        if msg:
+            yield mod.finding("RT005", node, f"{cname}: {msg}")
+
+
+# ---------------------------------------------------------------------------
+# RT006 — ObjectRef created but never consumed
+# ---------------------------------------------------------------------------
+@register(
+    "RT006", "ObjectRef created but never awaited/passed (dropped)",
+    "A `.remote()` return value that is never gotten, waited on, "
+    "passed, or returned is dropped: errors in that task vanish "
+    "silently and backpressure disappears.  Bind it (and use it), or "
+    "suppress deliberately for fire-and-forget.")
+def check_rt006(mod: SourceModule) -> Iterable[Finding]:
+    scopes: List[ast.AST] = [mod.tree]
+    scopes += [n for n in ast.walk(mod.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for scope in scopes:
+        # (a) bare-statement `<x>.remote(...)` — result dropped on the
+        # floor.  Only direct statements of THIS scope (nested function
+        # bodies are their own scope pass).
+        for stmt in _scope_statements(scope):
+            if isinstance(stmt, ast.Expr) and _is_remote_call(stmt.value):
+                yield mod.finding(
+                    "RT006", stmt,
+                    "result of .remote() is discarded — the returned "
+                    "ObjectRef (and any error in the task) is dropped")
+        # (b) `ref = x.remote(...)` where ref is never read again.
+        # Assignments are scanned scope-locally; loads over the FULL
+        # subtree (nested closures consuming the ref must count).
+        assigned: Dict[str, ast.Assign] = {}
+        loads: Set[str] = set()
+        for sub in _scope_walk(scope):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and _is_remote_call(sub.value):
+                name = sub.targets[0].id
+                if not name.startswith("_"):
+                    assigned[name] = sub
+        if not assigned:
+            continue
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Name) \
+                    and isinstance(sub.ctx, ast.Load):
+                loads.add(sub.id)
+        for name, stmt in assigned.items():
+            if name not in loads:
+                yield mod.finding(
+                    "RT006", stmt,
+                    f"ObjectRef {name!r} is assigned but never used — "
+                    f"the task's result and errors are dropped")
+
+
+def _scope_walk(scope: ast.AST) -> Iterable[ast.AST]:
+    """Walk a scope's subtree, pruning nested function/class bodies
+    (they are scopes of their own)."""
+    stack: List[ast.AST] = list(getattr(scope, "body", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scope_statements(scope: ast.AST) -> Iterable[ast.stmt]:
+    """Statements belonging to this scope only."""
+    for node in _scope_walk(scope):
+        if isinstance(node, ast.stmt):
+            yield node
+
+
+# ---------------------------------------------------------------------------
+# RT007 — metric declarations (Prometheus-legal names, sane buckets)
+# ---------------------------------------------------------------------------
+def _metric_name_re():
+    # The ONE name grammar, shared with the runtime constructor check
+    # (util/metrics.py) so the static rule can't drift from what the
+    # registry actually rejects.  Imported lazily: rules load on first
+    # all_rules(), which must not drag the metrics registry in.
+    from ray_tpu.util.metrics import METRIC_NAME_RE
+    return METRIC_NAME_RE
+
+
+_METRICS_MODULE = "ray_tpu.util.metrics"
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+
+
+@register(
+    "RT007", "metric name/bucket lint (Prometheus exposition rules)",
+    "Counter/Gauge/Histogram declarations (ray_tpu.util.metrics) with "
+    "an illegal Prometheus name, or histogram boundaries that are "
+    "not strictly increasing/finite, silently break the scrape "
+    "endpoint rather than the writer.  Static twin of "
+    "tests/test_metric_names.py's registry check.")
+def check_rt007(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = _call_name(node, imports) or ""
+        head, _, ctor = cname.rpartition(".")
+        if ctor not in _METRIC_CTORS:
+            continue
+        # Only metrics-module constructors: `collections.Counter` and
+        # friends must not fire.
+        if head != _METRICS_MODULE:
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+            if not _metric_name_re().match(name):
+                yield mod.finding(
+                    "RT007", node.args[0],
+                    f"metric name {name!r} is not a legal Prometheus "
+                    f"name")
+        if ctor == "Histogram":
+            for kw in node.keywords:
+                if kw.arg != "boundaries" or not isinstance(
+                        kw.value, (ast.List, ast.Tuple)):
+                    continue
+                vals: List[float] = []
+                literal = True
+                for e in kw.value.elts:
+                    v = _const_number(e)
+                    if v is None:
+                        literal = False
+                        break
+                    vals.append(v)
+                if not literal or not vals:
+                    continue
+                if any(v != v or v in (float("inf"), float("-inf"))
+                       for v in vals):
+                    yield mod.finding(
+                        "RT007", kw.value,
+                        "histogram boundaries must be finite (+Inf "
+                        "bucket is implicit)")
+                elif any(a >= b for a, b in zip(vals, vals[1:])):
+                    yield mod.finding(
+                        "RT007", kw.value,
+                        "histogram boundaries must be strictly "
+                        "increasing")
+
+
+def _const_number(node: ast.AST) -> Optional[float]:
+    if isinstance(node, ast.Constant) \
+            and isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool):
+        return float(node.value)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_number(node.operand)
+        return -inner if inner is not None else None
+    if isinstance(node, ast.Call):
+        # float("inf") literals
+        name = _dotted_name(node.func)
+        if name == "float" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            try:
+                return float(node.args[0].value)
+            except ValueError:
+                return None
+    return None

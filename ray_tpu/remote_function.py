@@ -14,12 +14,11 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 from ray_tpu._private.config import config
+from ray_tpu._private.options import TASK_OPTIONS, validate_options
 
-_VALID_OPTIONS = {
-    "num_returns", "num_cpus", "num_tpus", "resources", "max_retries",
-    "name", "placement_group", "placement_group_bundle_index",
-    "runtime_env", "scheduling_strategy", "_affinity",
-}
+# Back-compat alias; the canonical table lives in _private/options.py
+# (shared with actor.py and the RT003 lint rule).
+_VALID_OPTIONS = TASK_OPTIONS
 
 
 def _pg_spec_from_options(options: Dict[str, Any]) -> Optional[Dict]:
@@ -51,9 +50,7 @@ class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None) -> None:
         self._fn = fn
         self._options = dict(options or {})
-        bad = set(self._options) - _VALID_OPTIONS
-        if bad:
-            raise ValueError(f"invalid @remote options: {sorted(bad)}")
+        validate_options(self._options, TASK_OPTIONS, "task")
         self._blob: Optional[bytes] = None
         self._function_id: Optional[bytes] = None
         functools.update_wrapper(self, fn)

@@ -365,7 +365,8 @@ class IMPALA(RLCheckpointMixin):
                 host = jax.device_get(self.params)
                 pref = ray_tpu.put(host)
                 for w in self.workers:
-                    w.set_params.remote(pref)   # fire and forget
+                    # fire-and-forget param broadcast
+                    w.set_params.remote(pref)  # ray-tpu: noqa[RT006]
         wall = time.time() - t0
         # Per-worker batch counts round up, so up to W-1 surplus
         # batches may still be in flight; drain them so no producer

@@ -13,6 +13,8 @@ status`) + `ray list/summary` (util/state CLI) + `ray job` (job CLI).
     metrics                   Prometheus text from the head
     job {submit,status,logs,list,stop}
     microbench                core-runtime perf harness
+    lint <path>...            static analysis (RT001-RT007) for
+                              remote/actor/sharding code
 
 State (started pids, head address) persists in ~/.ray_tpu_cli.json so
 `stop`/`status` work from a fresh shell."""
@@ -395,8 +397,14 @@ def cmd_microbench(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    from ray_tpu.devtools.lint import cli as lint_cli
+    return lint_cli.run(args)
+
+
 # ---------------------------------------------------------------------------
 def main(argv: Optional[List[str]] = None) -> int:
+    raw = sys.argv[1:] if argv is None else list(argv)
     ap = argparse.ArgumentParser(prog="ray_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -481,6 +489,21 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p = sub.add_parser("microbench", help="core perf harness")
     p.set_defaults(fn=cmd_microbench)
+
+    # The rule-table epilog imports + registers the whole lint rule
+    # set; only `ray_tpu lint -h` ever renders a subparser epilog, so
+    # build it only on the lint path — every other command stays lean.
+    epilog = None
+    if raw and raw[0] == "lint":
+        from ray_tpu.devtools.lint import cli as lint_cli
+        epilog = lint_cli.rule_table_text()
+    from ray_tpu.devtools.lint.cli import add_arguments
+    p = sub.add_parser(
+        "lint", help="static analysis for remote/actor/sharding code",
+        epilog=epilog,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    add_arguments(p)
+    p.set_defaults(fn=cmd_lint)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -50,7 +50,10 @@ class Router:
         self._last_probe = 0.0
         self._probe_thread = None
         self._poll_thread: Optional[threading.Thread] = None
-        self._closed = False
+        # Event (not a bool): the long-poll loop's error backoff waits
+        # on it, so close() interrupts the backoff instead of leaving
+        # the thread sleeping out a stale second (RT005-class fix).
+        self._closed = threading.Event()
 
     def _controller(self):
         import ray_tpu
@@ -99,7 +102,7 @@ class Router:
         import ray_tpu
         from ray_tpu._private.client import get_global_client
         client0 = get_global_client()
-        while not self._closed:
+        while not self._closed.is_set():
             if get_global_client() is not client0:
                 return          # session shut down / replaced
             try:
@@ -112,10 +115,10 @@ class Router:
                     self._apply(info)
             except Exception:
                 # Controller restart / timeout: back off, the fallback
-                # refresh in pick() keeps correctness.
-                if self._closed:
+                # refresh in pick() keeps correctness.  close() wakes
+                # the wait immediately.
+                if self._closed.wait(1.0):
                     return
-                time.sleep(1.0)
 
     # -- replica queue-length folding (cross-router correctness) --------
     def _maybe_probe(self) -> None:
@@ -235,4 +238,4 @@ class Router:
         self._refresh(force=True)
 
     def close(self) -> None:
-        self._closed = True
+        self._closed.set()

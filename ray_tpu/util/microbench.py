@@ -192,11 +192,12 @@ def bench_multi_client_put_gbps(ray_tpu, clients: int = 4, n: int = 6,
     for: every writer maps the shared segment and memcpys directly —
     no per-put server round-trip to serialize on (the reference's
     plasma store brokers every create through the store thread)."""
-    import numpy as np
-
     @ray_tpu.remote
     class Putter:
         def __init__(self, mb: int) -> None:
+            # Imported here, not in the enclosing scope: a closure-
+            # captured module rides the pickled actor spec (RT002).
+            import numpy as np
             self.payload = np.random.bytes(mb * 1024 * 1024)
 
         def warm(self) -> int:
@@ -285,7 +286,10 @@ def bench_thin_client_sync(n: int = 500) -> float:
             self.x += 1
             return self.x
 
-    Counter.options(name="_mb_counter", lifetime="detached").remote()
+    # Named detached actor: the handle is re-fetched by name in the
+    # child process, so dropping this one is deliberate.
+    Counter.options(  # ray-tpu: noqa[RT006]
+        name="_mb_counter", lifetime="detached").remote()
     script = textwrap.dedent(f"""
         import sys, time
         sys.path.insert(0, {__file__.rsplit('/ray_tpu/', 1)[0]!r})

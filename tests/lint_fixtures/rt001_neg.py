@@ -1,0 +1,19 @@
+"""RT001 negative: gets in the driver, refs passed out of the task."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def child():
+    return 1
+
+
+@ray_tpu.remote
+def passes_ref_out():
+    # Returning the ref (no blocking wait) is the recommended shape.
+    return child.remote()
+
+
+def driver():
+    ref = passes_ref_out.remote()
+    inner = ray_tpu.get(ref)         # get in the driver is fine
+    return ray_tpu.get(inner)

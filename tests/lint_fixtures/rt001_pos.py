@@ -1,0 +1,19 @@
+"""RT001 positive: blocking get / .result() inside a @remote task."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def child():
+    return 1
+
+
+@ray_tpu.remote
+def nested_get():
+    ref = child.remote()
+    return ray_tpu.get(ref)          # RT001: blocking get in a task
+
+
+@ray_tpu.remote
+def nested_result():
+    ref = child.remote()
+    return ref.result()              # RT001: blocking result in a task

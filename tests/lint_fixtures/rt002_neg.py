@@ -1,0 +1,23 @@
+"""RT002 negative: state created inside the task; module-level module
+imports (referenced by name at unpickle time, never captured)."""
+import os
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def makes_own_lock():
+    import threading
+    lock = threading.Lock()          # created in the task: fine
+    with lock:
+        return os.getpid()           # module-level import: by name
+
+
+@ray_tpu.remote
+class Writer:
+    def __init__(self, path):
+        self._path = path
+
+    def write(self, line):
+        with open(self._path, "a") as f:   # opened per call: fine
+            f.write(line)

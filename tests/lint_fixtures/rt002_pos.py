@@ -1,0 +1,29 @@
+"""RT002 positive: @remote bodies capturing non-picklable state."""
+import threading
+
+import ray_tpu
+
+LOCK = threading.Lock()
+LOG = open("/tmp/rt002_fixture.log", "w")
+
+
+@ray_tpu.remote
+def uses_module_lock():
+    with LOCK:                       # RT002: lock in the task spec
+        return 1
+
+
+@ray_tpu.remote
+class Logger:
+    def write(self, line):
+        LOG.write(line)              # RT002: open file in the spec
+
+
+def outer():
+    import os
+
+    @ray_tpu.remote
+    def closure_module():
+        return os.getpid()           # RT002: module closure cell
+
+    return closure_module

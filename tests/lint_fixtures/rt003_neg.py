@@ -1,0 +1,23 @@
+"""RT003 negative: valid option keys and an in-range bundle index."""
+import ray_tpu
+from ray_tpu.util import placement_group
+
+
+@ray_tpu.remote(num_cpus=1, max_retries=0)
+def task():
+    return 1
+
+
+@ray_tpu.remote(max_restarts=2, max_concurrency=4)
+class Actor:
+    pass
+
+
+pg = placement_group([{"CPU": 1}, {"CPU": 1}])
+
+
+def driver():
+    ref = task.options(
+        placement_group=pg,
+        placement_group_bundle_index=1).remote()
+    return ray_tpu.get(ref)

@@ -1,0 +1,25 @@
+"""RT003 positive: misspelled option keys; out-of-range bundle index."""
+import ray_tpu
+from ray_tpu.util import placement_group
+
+
+@ray_tpu.remote(num_cpu=1)           # RT003: did you mean num_cpus?
+def typo_task():
+    return 1
+
+
+@ray_tpu.remote(max_restart=2)       # RT003: did you mean max_restarts?
+class TypoActor:
+    pass
+
+
+pg = placement_group([{"CPU": 1}, {"CPU": 1}])
+
+
+def driver():
+    typo_task.options(                       # RT003: out of range
+        placement_group=pg,
+        placement_group_bundle_index=2).remote()
+    typo_task.options(                       # RT003: negative
+        placement_group=pg,
+        placement_group_bundle_index=-1).remote()

@@ -1,0 +1,13 @@
+"""RT004 negative: every PartitionSpec axis is declared by a mesh."""
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+mesh = Mesh(jax.devices(), ("dp", "tp"))
+mesh2 = make_mesh(MeshSpec(dp=2, fsdp=2))
+
+ok_single = P("dp")
+ok_tuple = P(("dp", "fsdp"), None, "tp")
+sharding = NamedSharding(mesh, P("dp", "tp"))
+replicated = P(None, None)

@@ -1,0 +1,9 @@
+"""RT004 positive: PartitionSpec axes the declared mesh doesn't have."""
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("dp", "tp"))
+
+bad_single = P("mp")                 # RT004: 'mp' not on the mesh
+bad_tuple = P(("dp", "sp"), None)    # RT004: 'sp' not on the mesh
+sharding = NamedSharding(mesh, P("dp", "model"))   # RT004: 'model'

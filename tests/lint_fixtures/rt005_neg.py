@@ -1,0 +1,20 @@
+"""RT005 negative: async-safe waits; blocking calls in sync code."""
+import asyncio
+import time
+
+import ray_tpu
+
+
+class Deployment:
+    async def __call__(self, x):
+        await asyncio.sleep(0.1)     # async sleep: fine
+        return x
+
+    async def load(self, ref):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, ray_tpu.get, ref)
+
+
+def sync_helper(ref):
+    time.sleep(0.1)                  # sync code may block
+    return ray_tpu.get(ref)

@@ -1,0 +1,15 @@
+"""RT005 positive: blocking calls on an event loop."""
+import time
+
+import ray_tpu
+
+
+class Deployment:
+    async def __call__(self, x):
+        time.sleep(0.1)              # RT005: blocks the event loop
+        return x
+
+    async def load(self, ref):
+        data = ray_tpu.get(ref)      # RT005: sync get in async
+        with open("/tmp/rt005") as f:    # RT005: filesystem read
+            return data, f.read()

@@ -1,0 +1,23 @@
+"""RT006 negative: every ref is consumed (or deliberately dropped)."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def work():
+    return 1
+
+
+def consumed():
+    ref = work.remote()
+    return ray_tpu.get(ref)
+
+
+def passed_on():
+    refs = [work.remote() for _ in range(4)]
+    ready, _ = ray_tpu.wait(refs, num_returns=4)
+    return ready
+
+
+def deliberate():
+    work.remote()                    # ray-tpu: noqa[RT006]
+    _ignored = work.remote()         # underscore opt-out

@@ -1,0 +1,16 @@
+"""RT006 positive: ObjectRefs created and dropped."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def work():
+    return 1
+
+
+def fire_and_forget():
+    work.remote()                    # RT006: ref discarded
+
+
+def assigned_never_used():
+    ref = work.remote()              # RT006: never read again
+    return None

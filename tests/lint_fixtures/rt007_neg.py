@@ -1,0 +1,12 @@
+"""RT007 negative: legal names and buckets; collections.Counter is
+out of scope."""
+from collections import Counter
+
+import ray_tpu.util.metrics as metrics
+from ray_tpu.util.metrics import Histogram
+
+ok_name = metrics.Counter("requests_total")
+ok_gauge = metrics.Gauge("queue_depth")
+ok_hist = Histogram("latency_seconds",
+                    boundaries=[0.01, 0.1, 1.0, 10.0])
+word_counts = Counter("not a metric, a collections.Counter")

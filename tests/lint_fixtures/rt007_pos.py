@@ -1,0 +1,10 @@
+"""RT007 positive: illegal metric names / bad histogram buckets."""
+import ray_tpu.util.metrics as metrics
+from ray_tpu.util.metrics import Histogram
+
+bad_name = metrics.Counter("requests total")     # RT007: space
+bad_start = metrics.Gauge("0_queue_depth")       # RT007: leading digit
+bad_order = Histogram("latency_s",
+                      boundaries=[0.1, 0.1, 1.0])    # RT007: not increasing
+bad_inf = Histogram("ttft_s",
+                    boundaries=[0.1, float("inf")])  # RT007: +Inf literal

@@ -1,0 +1,256 @@
+"""`ray_tpu lint` rule engine: per-rule fixtures, noqa, CLI surface,
+and the decoration-time fast path."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import warnings
+
+import pytest
+
+from ray_tpu.devtools.lint import engine
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+RULE_IDS = ["RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
+            "RT007"]
+
+
+def _fixture(rule_id: str, kind: str) -> str:
+    return os.path.join(FIXTURES, f"{rule_id.lower()}_{kind}.py")
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: positive fires, negative silent
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_positive_fixture_fires(rule_id):
+    res = engine.lint_paths([_fixture(rule_id, "pos")], select=[rule_id])
+    assert res.findings, f"{rule_id} found nothing in its positive " \
+                         f"fixture"
+    assert all(f.rule_id == rule_id for f in res.findings)
+    assert not res.errors
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_negative_fixture_silent(rule_id):
+    res = engine.lint_paths([_fixture(rule_id, "neg")], select=[rule_id])
+    assert not res.findings, \
+        f"{rule_id} false positives: " \
+        f"{[f.render() for f in res.findings]}"
+
+
+def test_negative_fixtures_clean_across_all_rules():
+    """A rule's negative fixture must not trip OTHER rules either."""
+    paths = [_fixture(r, "neg") for r in RULE_IDS]
+    res = engine.lint_paths(paths)
+    assert not res.findings, [f.render() for f in res.findings]
+
+
+def test_registry_has_all_rules():
+    rules = engine.all_rules()
+    assert set(RULE_IDS) <= set(rules)
+    for rid, rule in rules.items():
+        assert rule.summary and rule.doc
+
+
+# ---------------------------------------------------------------------------
+# noqa suppression
+# ---------------------------------------------------------------------------
+def test_noqa_specific_code():
+    src = ("import time\n"
+           "async def f():\n"
+           "    time.sleep(1)  # ray-tpu: noqa[RT005]\n")
+    assert engine.lint_source(src) == []
+
+
+def test_noqa_blanket():
+    src = ("import time\n"
+           "async def f():\n"
+           "    time.sleep(1)  # ray-tpu: noqa\n")
+    assert engine.lint_source(src) == []
+
+
+def test_noqa_wrong_code_does_not_suppress():
+    src = ("import time\n"
+           "async def f():\n"
+           "    time.sleep(1)  # ray-tpu: noqa[RT001]\n")
+    found = engine.lint_source(src)
+    assert [f.rule_id for f in found] == ["RT005"]
+
+
+def test_noqa_inside_string_is_inert():
+    src = ('S = "# ray-tpu: noqa"\n'
+           "import time\n"
+           "async def f():\n"
+           "    time.sleep(1)\n")
+    assert [f.rule_id for f in engine.lint_source(src)] == ["RT005"]
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics
+# ---------------------------------------------------------------------------
+def test_syntax_error_is_reported_not_raised(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    res = engine.lint_paths([str(bad)])
+    assert res.errors and not res.findings
+
+
+def test_unknown_rule_select_raises():
+    with pytest.raises(KeyError):
+        engine.lint_source("x = 1", select=["RT999"])
+
+
+def test_baseline_roundtrip(tmp_path):
+    fix = _fixture("RT005", "pos")
+    res = engine.lint_paths([fix], select=["RT005"])
+    assert res.findings
+    baseline_file = tmp_path / "baseline.txt"
+    engine.write_baseline(res, str(baseline_file), str(FIXTURES))
+    baseline = engine.load_baseline(str(baseline_file))
+    fresh = engine.lint_paths([fix], select=["RT005"])
+    assert engine.apply_baseline(fresh, baseline, str(FIXTURES)) == []
+    # An EMPTY baseline absorbs nothing — everything still fails.
+    from collections import Counter
+    assert engine.apply_baseline(fresh, Counter(),
+                                 str(FIXTURES)) == fresh.findings
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, JSON output
+# ---------------------------------------------------------------------------
+def _run_cli(*args):
+    repo_root = os.path.dirname(os.path.dirname(FIXTURES))
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "lint", *args],
+        capture_output=True, text=True, timeout=120, cwd=repo_root)
+
+
+def test_cli_exit_one_on_findings_and_json():
+    proc = _run_cli(_fixture("RT001", "pos"), "--select", "RT001",
+                    "--format", "json")
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["count"] == len(payload["findings"]) >= 1
+    assert all(f["rule"] == "RT001" for f in payload["findings"])
+    assert {"path", "line", "col", "message"} <= set(
+        payload["findings"][0])
+
+
+def test_cli_exit_zero_on_clean():
+    proc = _run_cli(_fixture("RT001", "neg"), "--select", "RT001")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 findings" in proc.stdout
+
+
+def test_cli_exit_two_on_missing_path():
+    proc = _run_cli("/nonexistent/definitely_missing_dir")
+    assert proc.returncode == 2
+
+
+def test_cli_baseline_flow(tmp_path):
+    fix = _fixture("RT006", "pos")
+    baseline = str(tmp_path / "b.txt")
+    proc = _run_cli(fix, "--select", "RT006",
+                    "--write-baseline", baseline)
+    assert proc.returncode == 0, proc.stderr
+    proc = _run_cli(fix, "--select", "RT006", "--baseline", baseline)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "baselined" in proc.stdout
+
+
+def test_cli_help_lists_rule_ids():
+    proc = _run_cli("--help")
+    assert proc.returncode == 0
+    for rid in RULE_IDS:
+        assert rid in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# decoration-time fast path
+# ---------------------------------------------------------------------------
+def test_decoration_warns_on_lock_closure():
+    import ray_tpu
+
+    def make():
+        lk = threading.Lock()
+
+        @ray_tpu.remote
+        def f():
+            with lk:
+                return 1
+        return f
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        make()
+    msgs = [str(w.message) for w in caught
+            if "RT002" in str(w.message)]
+    assert msgs and "lk" in msgs[0]
+
+
+def test_decoration_error_mode_raises():
+    import ray_tpu
+    from ray_tpu._private.config import config
+    from ray_tpu.devtools.lint import LintError
+
+    config.set("lint_mode", "error")
+    try:
+        with pytest.raises(LintError):
+            lk = threading.Lock()
+
+            @ray_tpu.remote
+            def f():
+                with lk:
+                    return 1
+    finally:
+        config.reset()
+
+
+def test_decoration_off_mode_is_silent():
+    import ray_tpu
+    from ray_tpu._private.config import config
+
+    config.set("lint_mode", "off")
+    try:
+        lk = threading.Lock()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+
+            @ray_tpu.remote
+            def f():
+                with lk:
+                    return 1
+        assert not [w for w in caught if "RT002" in str(w.message)]
+    finally:
+        config.reset()
+
+
+def test_decoration_clean_function_no_warning():
+    import ray_tpu
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+
+        @ray_tpu.remote
+        def clean(x):
+            return x + 1
+    assert not [w for w in caught if "RT002" in str(w.message)]
+
+
+def test_options_typo_suggests_closest_key():
+    import ray_tpu
+    with pytest.raises(ValueError, match="num_cpus"):
+        @ray_tpu.remote(num_cpu=1)
+        def f():
+            return 1
+    with pytest.raises(ValueError, match="max_restarts"):
+        ray_tpu.remote(max_restart=1)(type("A", (), {}))
+
+
+def test_shared_options_table_is_single_source():
+    from ray_tpu import actor, remote_function
+    from ray_tpu._private.options import ACTOR_OPTIONS, TASK_OPTIONS
+    assert remote_function._VALID_OPTIONS is TASK_OPTIONS
+    assert actor._VALID_ACTOR_OPTIONS is ACTOR_OPTIONS

@@ -1,0 +1,57 @@
+"""Self-application: `ray_tpu lint` over ray_tpu/ itself, gated by the
+checked-in baseline.  New violations anywhere in the package fail this
+test (and therefore CI); accepted pre-existing ones live in
+ray_tpu/devtools/lint/baseline.txt.
+
+To accept a new finding deliberately, either add a
+`# ray-tpu: noqa[RTxxx]` at the site (preferred, visible in review) or
+regenerate the baseline:
+
+    python -m ray_tpu lint ray_tpu/ \
+        --write-baseline ray_tpu/devtools/lint/baseline.txt \
+        --rel-root .
+"""
+
+import os
+
+import ray_tpu
+from ray_tpu.devtools.lint import engine
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(ray_tpu.__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "ray_tpu")
+BASELINE = os.path.join(PACKAGE, "devtools", "lint", "baseline.txt")
+
+
+def test_package_passes_self_lint_against_baseline():
+    assert os.path.exists(BASELINE), \
+        "committed baseline file is missing"
+    res = engine.lint_paths([PACKAGE])
+    assert not res.errors, res.errors
+    new = engine.apply_baseline(res, engine.load_baseline(BASELINE),
+                                REPO_ROOT)
+    assert not new, (
+        "new lint violations in ray_tpu/ (fix, noqa, or regenerate "
+        "the baseline — see this test's docstring):\n"
+        + "\n".join(f.render(REPO_ROOT) for f in new))
+
+
+def test_baseline_is_not_stale():
+    """Every baseline entry must still match a real finding — fixed
+    violations must leave the baseline so it can't mask regressions
+    elsewhere on the same (rule, file, line-text) key."""
+    res = engine.lint_paths([PACKAGE])
+    current = set(engine.baseline_keys(res, REPO_ROOT))
+    stale = [k for k in engine.load_baseline(BASELINE)
+             if k not in current]
+    assert not stale, (
+        "baseline entries no longer match any finding — regenerate "
+        "the baseline:\n" + "\n".join(stale))
+
+
+def test_self_lint_is_fast_enough_for_tier1():
+    """The self-run must stay cheap (it rides tier-1, not `slow`)."""
+    import time
+    t0 = time.time()
+    engine.lint_paths([PACKAGE])
+    assert time.time() - t0 < 60.0

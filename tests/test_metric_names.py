@@ -1,8 +1,14 @@
 """Metric-name lint: after importing the package surface, every metric
 in the registry must have a Prometheus-legal name and every histogram
 strictly increasing buckets (CI guard: a bad name silently breaks the
-scrape endpoint, not the writer)."""
+scrape endpoint, not the writer).
 
+The same check also runs STATICALLY as rule RT007 of the
+devtools/lint engine (`ray_tpu lint --select RT007`), so declarations
+behind code paths the import surface doesn't reach are covered too —
+all lint lives in one framework."""
+
+import os
 import re
 
 import pytest
@@ -63,3 +69,34 @@ def test_constructor_rejects_bad_names_and_buckets():
     assert h.boundaries == metrics.DEFAULT_BUCKETS
     with metrics._lock:
         metrics._registry.remove(h)
+
+
+def test_static_metric_lint_rt007_is_clean():
+    """Run the metric lint as an RT-series rule inside the devtools
+    lint engine over the whole package: every static
+    Counter/Gauge/Histogram declaration must be Prometheus-legal."""
+    import ray_tpu
+    from ray_tpu.devtools.lint import engine
+    package = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+    res = engine.lint_paths([package], select=["RT007"])
+    assert not res.errors, res.errors
+    assert not res.findings, [f.render() for f in res.findings]
+
+
+def test_rt007_rule_matches_runtime_validation():
+    """The static rule and the runtime registry check enforce the same
+    contract: what RT007 flags, the constructor rejects."""
+    from ray_tpu.devtools.lint import engine
+    src = ("import ray_tpu.util.metrics as metrics\n"
+           "c = metrics.Counter('bad name')\n"
+           "h = metrics.Histogram('h', boundaries=[1.0, 1.0])\n")
+    rules_hit = sorted({f.rule_id for f in
+                        engine.lint_source(src, select=["RT007"])})
+    assert rules_hit == ["RT007"]
+    metrics = _import_surface()
+    with pytest.raises(ValueError):
+        metrics.Counter("bad name")
+    with pytest.raises(ValueError):
+        # The constructor sorts, so only DUPLICATE boundaries raise at
+        # runtime; RT007 additionally flags out-of-order literals.
+        metrics.Histogram("h", boundaries=[1.0, 1.0])
