@@ -1,0 +1,409 @@
+"""Deterministic fault injection (reference: src/ray/rpc/rpc_chaos.h +
+RAY_testing_rpc_failure / RAY_testing_asio_delay_us, grown into a
+first-class subsystem).
+
+Every injection decision is drawn from ONE seeded RNG
+(``config.chaos_seed``), so a failing schedule replays exactly: run the
+same workload with the same seed and the same faults fire at the same
+draw points.  The injected-fault trace (``trace()``) is the replay
+witness — tests assert two runs with one seed produce identical traces.
+
+Spec grammar (``config.chaos_spec`` / ``RAY_TPU_CHAOS_SPEC``)::
+
+    spec  := entry ("," entry)*
+    entry := site (":" key "=" value)*
+
+``site`` is an rpc/message type (``submit_task``, ``get_objects``, ...),
+a node-level hook (``dispatch``, ``serve.assign``, ``partition``), or
+``*`` (every rpc site).  Keys:
+
+    kind    error | drop | delay | kill_worker | evict | kill_replica
+            | partition            (default: error)
+    p       injection probability per eligible event (default 1.0)
+    n       budget: total injections allowed; -1 = unlimited (default -1)
+    lo_ms / hi_ms
+            delay bounds for kind=delay (milliseconds)
+    node    hex prefix of the target node id for kind=partition
+
+Fault kinds and where they act:
+
+* ``error``   — raise ``ConnectionLost`` before the rpc is sent.  The
+  protocol layer retries injected/pre-send failures with backoff, so a
+  budgeted error exercises the rpc retry path transparently.
+* ``drop``    — a request/reply rpc raises pre-send (retried like
+  ``error``); a one-way notify is silently dropped (lossy by design —
+  recovery must come from a higher layer).
+* ``delay``   — sleep uniform(lo_ms, hi_ms) before dispatch.
+* ``kill_worker``  — at task dispatch (site ``dispatch``): SIGKILL the
+  worker the task was just assigned to (exercises crash retry).
+* ``evict``   — at ``get_objects``: evict a requested READY object's
+  shm payload, forcing lineage reconstruction
+  (``node_objects._try_reconstruct``).
+* ``kill_replica`` — at ``serve.assign``: kill the replica the router
+  just picked (exercises Serve failover).
+* ``partition`` — standing condition: drop peer control AND
+  object-transfer connections to nodes whose id matches ``node``.
+
+The legacy env specs ``testing_rpc_failure`` ("method:N" → kind=error,
+p=0.5, n=N) and ``testing_asio_delay_us`` ("method:lo:hi" microseconds)
+are folded into the same schedule.
+
+State is per-process.  The env/config spec reaches workers through the
+inherited environment; the runtime API (``ray_tpu.util.chaos.inject`` /
+``clear``) acts on the calling process — which, single-node, is where
+the node service threads live, so driver-side ``inject()`` drives node
+faults (dispatch kills, evictions) directly.
+
+Unlike the old ``protocol._Chaos`` (parsed once, frozen, global unseeded
+``random``), the schedule here is re-resolved when the config changes
+(checked at most every 250 ms) and every mutation is lock-protected.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import config
+
+FAULT_KINDS = ("error", "drop", "delay", "kill_worker", "evict",
+               "kill_replica", "partition")
+
+# How often (at most) the env/config spec is re-read on the hot path.
+_REFRESH_INTERVAL_S = 0.25
+
+
+class FaultSpec:
+    __slots__ = ("site", "kind", "p", "budget", "lo_ms", "hi_ms", "node",
+                 "announced")
+
+    def __init__(self, site: str, kind: str = "error", p: float = 1.0,
+                 n: int = -1, lo_ms: float = 0.0, hi_ms: float = 0.0,
+                 node: str = "") -> None:
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} (valid: "
+                f"{', '.join(FAULT_KINDS)})")
+        if not site:
+            raise ValueError("fault spec needs a site")
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"probability {p} not in [0, 1]")
+        if hi_ms < lo_ms:
+            raise ValueError(f"hi_ms {hi_ms} < lo_ms {lo_ms}")
+        if kind == "partition" and not node:
+            raise ValueError("kind=partition needs node=<hex prefix>")
+        self.site = site
+        self.kind = kind
+        self.p = p
+        self.budget = n
+        self.lo_ms = lo_ms
+        self.hi_ms = hi_ms
+        self.node = node
+        self.announced = False     # partition: trace once, not per check
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"site": self.site, "kind": self.kind, "p": self.p,
+               "n": self.budget}
+        if self.kind == "delay":
+            out["lo_ms"], out["hi_ms"] = self.lo_ms, self.hi_ms
+        if self.node:
+            out["node"] = self.node
+        return out
+
+
+def parse_spec(spec: str) -> List[FaultSpec]:
+    """Parse the chaos spec grammar; raises ValueError with the bad
+    entry named."""
+    out: List[FaultSpec] = []
+    for raw in (spec or "").split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        site = parts[0].strip()
+        kwargs: Dict[str, Any] = {}
+        for field in parts[1:]:
+            key, sep, value = field.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"chaos spec entry {raw!r}: field {field!r} is not "
+                    f"key=value")
+            key = key.strip()
+            value = value.strip()
+            try:
+                if key == "kind":
+                    kwargs["kind"] = value
+                elif key == "p":
+                    kwargs["p"] = float(value)
+                elif key == "n":
+                    kwargs["n"] = int(value)
+                elif key in ("lo_ms", "hi_ms"):
+                    kwargs[key] = float(value)
+                elif key == "node":
+                    kwargs["node"] = value
+                else:
+                    raise ValueError(f"unknown key {key!r}")
+            except ValueError as e:
+                raise ValueError(
+                    f"chaos spec entry {raw!r}: {e}") from None
+        try:
+            out.append(FaultSpec(site, **kwargs))
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"chaos spec entry {raw!r}: {e}") from None
+    return out
+
+
+def _legacy_specs() -> List[FaultSpec]:
+    """testing_rpc_failure / testing_asio_delay_us compatibility."""
+    out: List[FaultSpec] = []
+    spec = config.testing_rpc_failure
+    if spec:
+        for part in spec.split(","):
+            method, _, n = part.partition(":")
+            # Old behavior: 50% coin flip per rpc while budget remains.
+            out.append(FaultSpec(method.strip(), kind="error", p=0.5,
+                                 n=int(n or 1)))
+    dspec = config.testing_asio_delay_us
+    if dspec:
+        for part in dspec.split(","):
+            method, lo, hi = part.split(":")
+            out.append(FaultSpec(method.strip(), kind="delay",
+                                 lo_ms=int(lo) / 1000.0,
+                                 hi_ms=int(hi) / 1000.0))
+    return out
+
+
+class ChaosController:
+    """Seeded, re-resolvable, thread-safe fault-injection schedule.
+
+    ``seed``/``spec`` constructor overrides exist for unit tests; the
+    process singleton (``chaos`` below) resolves both from config.
+    """
+
+    def __init__(self, seed: Optional[int] = None,
+                 spec: Optional[str] = None) -> None:
+        self._lock = threading.RLock()
+        self._seed_override = seed
+        self._spec_override = spec
+        self._env_specs: List[FaultSpec] = []
+        self._runtime_specs: List[FaultSpec] = []
+        self._rng = random.Random(seed or 0)
+        # Separate stream for retry-backoff jitter so backoff draws never
+        # perturb the fault sequence (determinism of the fault trace).
+        self._jitter_rng = random.Random((seed or 0) ^ 0x9E3779B9)
+        self._trace: List[Tuple[int, str, str]] = []
+        self._seq = 0
+        self._fingerprint: Optional[tuple] = None
+        self._next_check = 0.0
+        self._enabled = False
+
+    # -- schedule resolution -------------------------------------------
+    def _refresh_locked(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now < self._next_check:
+            return
+        self._next_check = now + _REFRESH_INTERVAL_S
+        try:
+            fp = (self._seed_override
+                  if self._seed_override is not None
+                  else config.chaos_seed,
+                  self._spec_override
+                  if self._spec_override is not None
+                  else config.chaos_spec,
+                  config.testing_rpc_failure,
+                  config.testing_asio_delay_us)
+        except Exception:
+            return
+        if fp == self._fingerprint:
+            return
+        self._fingerprint = fp
+        seed = int(fp[0] or 0)
+        self._rng = random.Random(seed)
+        self._jitter_rng = random.Random(seed ^ 0x9E3779B9)
+        specs: List[FaultSpec] = []
+        try:
+            specs.extend(parse_spec(fp[1]))
+        except ValueError:
+            pass    # a bad env spec must not break every rpc
+        if self._spec_override is None:
+            try:
+                specs.extend(_legacy_specs())
+            except (ValueError, TypeError):
+                pass    # same contract for the legacy grammar
+        self._env_specs = specs
+        self._enabled = bool(self._env_specs or self._runtime_specs)
+
+    def refresh(self) -> None:
+        """Force immediate re-resolution of the env/config schedule."""
+        with self._lock:
+            self._fingerprint = None
+            self._refresh_locked(force=True)
+
+    def _match(self, site: str) -> List[FaultSpec]:
+        return [s for s in self._env_specs + self._runtime_specs
+                if s.site == site or s.site == "*"]
+
+    # -- recording ------------------------------------------------------
+    def _record_locked(self, site: str, kind: str) -> None:
+        self._seq += 1
+        self._trace.append((self._seq, site, kind))
+        if len(self._trace) > 10_000:
+            del self._trace[:5_000]
+        _count_injection(kind)
+
+    def trace(self) -> List[Tuple[int, str, str]]:
+        """Injected-fault trace: [(seq, site, kind), ...] — the replay
+        witness for seeded determinism tests."""
+        with self._lock:
+            return list(self._trace)
+
+    def reset_trace(self) -> None:
+        with self._lock:
+            self._trace = []
+            self._seq = 0
+
+    # -- runtime API ----------------------------------------------------
+    def inject(self, site: str, kind: str = "error", p: float = 1.0,
+               n: int = -1, lo_ms: float = 0.0, hi_ms: float = 0.0,
+               node: str = "") -> None:
+        """Add a fault spec at runtime (this process)."""
+        spec = FaultSpec(site, kind=kind, p=p, n=n, lo_ms=lo_ms,
+                         hi_ms=hi_ms, node=node)
+        with self._lock:
+            self._runtime_specs.append(spec)
+            self._enabled = True
+
+    def clear(self, site: Optional[str] = None) -> None:
+        """Remove runtime-injected specs (all, or one site's)."""
+        with self._lock:
+            if site is None:
+                self._runtime_specs = []
+            else:
+                self._runtime_specs = [s for s in self._runtime_specs
+                                       if s.site != site]
+            self._refresh_locked(force=True)
+            self._enabled = bool(self._env_specs or self._runtime_specs)
+
+    def describe(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            self._refresh_locked(force=True)
+            return [s.to_dict()
+                    for s in self._env_specs + self._runtime_specs]
+
+    # -- injection points ----------------------------------------------
+    def maybe_inject(self, site: str) -> Optional[str]:
+        """Rpc-layer hook (protocol.Connection call/notify).  Returns
+        "drop" when the message should be dropped, None otherwise;
+        raises ConnectionLost for kind=error.  Delays sleep here."""
+        if not self._enabled and time.monotonic() < self._next_check:
+            return None
+        delays: List[float] = []
+        action: Optional[str] = None
+        raise_error = False
+        with self._lock:
+            self._refresh_locked()
+            if not self._enabled:
+                return None
+            for spec in self._match(site):
+                if spec.kind in ("kill_worker", "evict", "kill_replica",
+                                 "partition"):
+                    continue    # node-level kinds don't fire on rpcs
+                if spec.budget == 0:
+                    continue
+                if spec.p < 1.0 and self._rng.random() >= spec.p:
+                    continue
+                if spec.budget > 0:
+                    spec.budget -= 1
+                self._record_locked(site, spec.kind)
+                if spec.kind == "delay":
+                    delays.append(self._rng.uniform(spec.lo_ms,
+                                                    spec.hi_ms) / 1e3)
+                elif spec.kind == "drop":
+                    action = "drop"
+                else:           # error
+                    raise_error = True
+        for d in delays:
+            time.sleep(d)
+        if raise_error:
+            from ray_tpu._private.protocol import ConnectionLost
+            raise ConnectionLost(
+                f"chaos: injected failure for {site}")
+        return action
+
+    def armed(self, site: str, kind: str) -> bool:
+        """Is any budgeted spec for (site, kind) armed?  Consumes no
+        budget, draws no randomness, records nothing — the cheap
+        pre-check before work whose eligibility must be verified
+        before `fire()` spends the budget."""
+        if not self._enabled and time.monotonic() < self._next_check:
+            return False
+        with self._lock:
+            self._refresh_locked()
+            return any(s.kind == kind and s.budget != 0
+                       for s in self._match(site))
+
+    def fire(self, site: str, kind: str) -> bool:
+        """Node-level hook: should fault `kind` fire at `site` now?
+        Consumes budget and records the injection when it does."""
+        if not self._enabled and time.monotonic() < self._next_check:
+            return False
+        with self._lock:
+            self._refresh_locked()
+            if not self._enabled:
+                return False
+            for spec in self._match(site):
+                if spec.kind != kind or spec.budget == 0:
+                    continue
+                if spec.p < 1.0 and self._rng.random() >= spec.p:
+                    continue
+                if spec.budget > 0:
+                    spec.budget -= 1
+                self._record_locked(site, kind)
+                return True
+        return False
+
+    def partitioned(self, node_id: bytes) -> bool:
+        """Standing node-partition check (peer control + transfer
+        connections).  Does not consume budget; traced once per spec."""
+        if not self._enabled and time.monotonic() < self._next_check:
+            return False
+        hexid = node_id.hex()
+        with self._lock:
+            self._refresh_locked()
+            for spec in self._env_specs + self._runtime_specs:
+                if spec.kind != "partition" or spec.budget == 0:
+                    continue
+                if hexid.startswith(spec.node):
+                    if not spec.announced:
+                        spec.announced = True
+                        self._record_locked("partition", "partition")
+                    return True
+        return False
+
+    def jitter(self) -> float:
+        """Uniform [0, 1) from the dedicated jitter stream — used by the
+        node's retry backoff so delays replay under one seed without
+        perturbing the fault draw sequence."""
+        with self._lock:
+            return self._jitter_rng.random()
+
+
+def _count_injection(kind: str) -> None:
+    """ray_tpu_chaos_injected_total{kind=...} — flushed to the node like
+    any app metric.  Lazy import: metrics -> client -> protocol ->
+    chaos would otherwise cycle at import time."""
+    try:
+        from ray_tpu.util.metrics import (CHAOS_INJECTED_METRIC,
+                                          shared_counter)
+        shared_counter(
+            CHAOS_INJECTED_METRIC,
+            description="chaos faults injected, by kind",
+            tag_keys=("kind",)).inc(tags={"kind": kind})
+    except Exception:
+        pass
+
+
+# Process singleton (the old protocol.chaos, promoted).
+chaos = ChaosController()

@@ -128,29 +128,57 @@ class CoreClient:
                             "(matches the reference's behavior)")
         s, embedded = self.serialize_with_refs(value)
         oid = ObjectID.from_random()
-        inline_limit = config.max_direct_call_object_size
         # One-way: registration is ordered ahead of any later RPC on this
         # connection (server processes a connection's frames in order),
         # so a subsequent get()/submit referencing the ref always finds
         # the directory entry.  Saves a round-trip per put (the hot path
         # the reference optimizes with plasma's async create).
-        if s.total_size <= inline_limit:
-            self.conn.notify({"type": "put_object",
-                              "object_id": oid.binary(),
-                              "loc": "inline", "data": s.to_bytes(),
-                              "size": s.total_size, "embedded": embedded})
-        else:
-            buf = self._create_in_store(oid, s.total_size)
-            s.write_into(buf)
-            self.store.seal(oid)
-            # Creator pin intentionally NOT released: the directory owns
-            # it (unevictable while the entry lives) and releases it on
-            # delete — the analog of the reference pinning primary copies.
-            self.conn.notify({"type": "put_object",
-                              "object_id": oid.binary(),
-                              "loc": "shm", "data": None,
-                              "size": s.total_size, "embedded": embedded})
+        self._publish_value(oid.binary(), s, embedded, ack=False)
         return ObjectRef(oid.binary(), owned=True)
+
+    def _publish_value(self, oid: bytes, s, embedded: List[bytes],
+                       ack: bool) -> None:
+        """THE inline-vs-shm publication step, shared by put() and
+        put_with_id() so the loc decision and message shape can never
+        diverge.  `ack` chooses acked call vs one-way notify."""
+        send = self.conn.call if ack else self.conn.notify
+        if (self.store is None
+                or s.total_size <= config.max_direct_call_object_size):
+            send({"type": "put_object", "object_id": oid,
+                  "loc": "inline", "data": s.to_bytes(),
+                  "size": s.total_size, "embedded": embedded})
+            return
+        buf = self._create_in_store(ObjectID(oid), s.total_size)
+        s.write_into(buf)
+        self.store.seal(ObjectID(oid))
+        # Creator pin intentionally NOT released: the directory owns
+        # it (unevictable while the entry lives) and releases it on
+        # delete — the analog of the reference pinning primary copies.
+        send({"type": "put_object", "object_id": oid,
+              "loc": "shm", "data": None,
+              "size": s.total_size, "embedded": embedded})
+
+    def put_with_id(self, oid: bytes, value: Any,
+                    as_error: bool = False) -> None:
+        """Publish `value` under a caller-chosen object id — the bridge
+        primitive behind relay/response refs (Serve router failover):
+        the consumer blocks on `oid` while producers decide later which
+        attempt's outcome lands there.  With as_error=True the value is
+        an exception delivered as the object's FAILED tombstone (raised
+        at get, like a task error).
+
+        Uses acked calls, NOT one-way notifies: a silently dropped
+        registration (chaos drop, connection blip) would strand the
+        relay's reader in a permanent hang — the one failure mode this
+        object must not have."""
+        if as_error:
+            blob = ser.dumps(value)
+            self.conn.call({"type": "put_object", "object_id": oid,
+                            "loc": "error", "data": blob,
+                            "size": len(blob), "embedded": []})
+            return
+        s, embedded = self.serialize_with_refs(value)
+        self._publish_value(oid, s, embedded, ack=True)
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
@@ -292,6 +320,7 @@ class CoreClient:
                     pg: Optional[dict] = None,
                     runtime_env: Optional[dict] = None,
                     affinity: Optional[dict] = None,
+                    retry_exceptions=None,
                     ) -> List[ObjectRef]:
         spec_args, embedded = self._pack_args(args, kwargs)
         return_ids = [os.urandom(16) for _ in range(num_returns)]
@@ -315,6 +344,10 @@ class CoreClient:
             "affinity": affinity,
             "submit_ts": time.time(),
             "trace_ctx": tracing.for_submit(),
+            # True, or a tuple of exception types: application errors
+            # matching it count as retryable (matched worker-side, see
+            # worker_main._app_retryable).
+            "retry_exceptions": retry_exceptions,
         }
         if actor_spec_extra:
             spec.update(actor_spec_extra)
@@ -322,7 +355,7 @@ class CoreClient:
         # back via spec.get(...) server-side, so absent == default.
         # (actor_id/pg/resources are accessed directly and must stay.)
         for k in ("method_name", "runtime_env", "affinity",
-                  "is_actor_creation", "trace_ctx"):
+                  "is_actor_creation", "trace_ctx", "retry_exceptions"):
             if not spec.get(k):
                 del spec[k]
         # One-way submit: return ids are generated client-side and any

@@ -122,11 +122,26 @@ _D("session_dir_prefix", str, "/tmp/ray_tpu",
 _D("inline_small_args_size", int, 100 * 1024,
    "Task args <= this many bytes are shipped inline in the task spec.")
 _D("testing_rpc_failure", str, "",
-   "Chaos: 'method:max_failures' pairs, comma separated — injected "
-   "failures in the message layer (reference: RAY_testing_rpc_failure).")
+   "Chaos (legacy): 'method:max_failures' pairs, comma separated — "
+   "injected failures in the message layer (reference: "
+   "RAY_testing_rpc_failure).  Folded into the chaos_spec schedule.")
 _D("testing_asio_delay_us", str, "",
-   "Chaos: 'method:min:max' artificial delays in message dispatch "
-   "(reference: RAY_testing_asio_delay_us).")
+   "Chaos (legacy): 'method:min:max' artificial delays in message "
+   "dispatch (reference: RAY_testing_asio_delay_us).  Folded into the "
+   "chaos_spec schedule.")
+_D("chaos_seed", int, 0,
+   "Seed for the chaos fault-injection RNG (_private/chaos.py): the "
+   "same seed + workload replays the identical injected-fault trace.")
+_D("chaos_spec", str, "",
+   "Chaos schedule: comma-separated 'site:key=value:...' entries "
+   "(kinds: error, drop, delay, kill_worker, evict, kill_replica, "
+   "partition).  See _private/chaos.py for the grammar; validate with "
+   "`ray_tpu chaos`.")
+_D("task_retry_delay_ms", int, 50,
+   "Base backoff before a task retry is resubmitted; doubles per "
+   "attempt with jitter (reference role: task resubmit backoff).")
+_D("task_retry_max_delay_ms", int, 5000,
+   "Upper bound on the per-retry backoff delay.")
 _D("object_store_prefault", bool, True,
    "Write-touch every store page at creation so puts never pay "
    "first-touch page faults (~4x single-copy put bandwidth).")

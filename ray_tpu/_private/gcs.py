@@ -88,6 +88,12 @@ class GlobalControlState:
         # directly: "inline" values and serialized errors.
         self._small_objects: Dict[bytes, Tuple[str, bytes]] = {}
         self._actor_nodes: Dict[bytes, bytes] = {}  # actor_id -> node_id
+        # Objects whose ONLY copies died with a node: the record is
+        # gone, but "it was once READY" is the bit an owner needs to
+        # tell completed-then-lost (reconstruct from lineage) apart
+        # from never-ran (retry/fail by task policy).  Cleared when a
+        # reconstruction republishes or the owner deletes the object.
+        self._lost_objects: Set[bytes] = set()
         # subscriptions (server wires these to connection pushes)
         self._loc_subs: Dict[bytes, List[Callable[[bytes, dict], None]]] = {}
         # kv_wait parking: (ns, key) -> callbacks fired on the next put
@@ -277,6 +283,7 @@ class GlobalControlState:
                 holders.discard(node_id)
                 if not holders and oid not in self._small_objects:
                     del self._locations[oid]
+                    self._lost_objects.add(oid)
                     subs = self._loc_subs.pop(oid, [])
                     if subs:
                         lost_notifies.append((oid, size, subs))
@@ -333,6 +340,7 @@ class GlobalControlState:
             if node_id is not None:
                 holders.add(node_id)
             self._locations[oid] = (holders, size)
+            self._lost_objects.discard(oid)
             if kind in ("inline", "error") and data is not None:
                 self._small_objects[oid] = (kind, data)
             subs = list(self._loc_subs.get(oid, ()))
@@ -350,11 +358,14 @@ class GlobalControlState:
             small = self._small_objects.get(oid)
             alive = [self._nodes[h].to_dict() for h in holders
                      if h in self._nodes and self._nodes[h].state == "alive"]
+            lost = oid in self._lost_objects
         out = {"nodes": alive, "size": size}
         if small is not None:
             out["kind"], out["data"] = small
         else:
             out["kind"] = "shm" if alive else None
+            if out["kind"] is None and lost:
+                out["lost"] = True      # once READY; copies died
         return out
 
     def remove_object(self, oid: bytes) -> List[bytes]:
@@ -365,6 +376,7 @@ class GlobalControlState:
         with self._lock:
             holders, size = self._locations.pop(oid, (set(), 0))
             self._small_objects.pop(oid, None)
+            self._lost_objects.discard(oid)
             subs = self._loc_subs.pop(oid, [])
         evt = {"object_id": oid, "node_id": None, "size": size,
                "kind": "lost"}

@@ -274,6 +274,65 @@ class ObjectPlaneMixin:
         self._schedule()
         return True
 
+    def _chaos_evictable(self, oid: bytes) -> bool:
+        """Eligibility for the chaos store-eviction fault: a READY,
+        lineage-bearing, local shm object (always recoverable).
+        Caller holds self.lock."""
+        e = self.objects.get(oid)
+        return not (e is None or e.state != READY or e.loc != "shm"
+                    or e.lineage is None or e.foreign or e.spilling)
+
+    def _chaos_evict_entry(self, oid: bytes) -> bool:
+        """Chaos store-eviction fault: drop a READY object's shm payload
+        while KEEPING the directory entry READY — exactly the
+        evicted-under-a-reader shape that forces the
+        client-reconstruct path (_materialize_recovering →
+        reconstruct_object → _try_reconstruct).  Caller holds
+        self.lock."""
+        if not self._chaos_evictable(oid):
+            return False
+        try:
+            store = self._store()
+            store.release(_OID(oid))     # the directory's pin
+            store.delete(_OID(oid))
+        except Exception:
+            return False
+        return True
+
+    def _h_relay_result(self, ctx: _ConnCtx, m: dict) -> None:
+        """Serve-relay fast path: alias a completed attempt's INLINE
+        result onto the relay object id without the payload ever
+        re-entering the client (zero copy — the directory entry shares
+        the bytes).  Replies done=False for error outcomes (the router
+        must classify the exception to decide failover) and for
+        shm/spilled payloads (no by-id aliasing in the store; the
+        router bridges those by value)."""
+        src, dst = m["src"], m["dst"]
+        with self.lock:
+            e = self.objects.get(src)
+            if e is None or e.state != READY or e.loc != "inline":
+                ctx.reply(m, {"done": False,
+                              "failed": bool(e is not None
+                                             and e.state == FAILED)})
+                return
+            # The relay entry owns one hold per ref embedded in the
+            # shared payload, exactly as if it were put() separately.
+            for dep in e.embedded:
+                de = self.objects.get(dep)
+                if de is not None:
+                    de.refcount += 1
+            self._register_object(dst, "inline", e.data, e.size,
+                                  embedded=list(e.embedded))
+            self._schedule()
+        ctx.reply(m, {"done": True, "failed": False})
+
+    def _h_chaos_evict(self, ctx: _ConnCtx, m: dict) -> None:
+        """Runtime chaos API (ray_tpu.util.chaos.evict_object): evict
+        one specific READY object's payload on demand."""
+        with self.lock:
+            ok = self._chaos_evict_entry(m["object_id"])
+        ctx.reply(m, {"ok": ok})
+
     def _h_reconstruct_object(self, ctx: _ConnCtx, m: dict) -> None:
         """Client found a READY directory entry whose shm payload is
         gone: recover via lineage (or confirm a racing restore)."""
@@ -646,6 +705,13 @@ class ObjectPlaneMixin:
                     conn.notify(a)
             except Exception:
                 if kind == "fwd":
+                    # Brief pause before the requeue re-picks a
+                    # target: an unreachable peer (partition, dead
+                    # node not yet declared) must not turn
+                    # fail→requeue→forward into a hot loop.  Failed
+                    # NOTIFIES are simply dropped — no loop to damp,
+                    # so no sleep stalling the FIFO behind them.
+                    time.sleep(0.05)
                     self._forward_send_failed(a)
 
     def _forward_send_failed(self, rec: TaskRecord) -> None:

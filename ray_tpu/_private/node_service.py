@@ -28,6 +28,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization as ser
+from ray_tpu._private.chaos import chaos
 from ray_tpu._private.config import config
 from ray_tpu._private.gcs import GlobalControlState
 from ray_tpu._private.node_agent import NodeAgentMixin
@@ -561,17 +562,18 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         # A forwarded task may have completed before the node died — its
         # returns are then in the GCS (inline) or on surviving replicas.
         # Only tasks with no published results are retried/failed.
+        reconstruct: List[TaskRecord] = []
         for rec in pull_check:
-            done = True
+            statuses = []
             for oid in rec.spec["return_ids"]:
                 try:
                     locs = self.gcs.get_locations(oid)
                 except Exception:
                     locs = {}
-                if locs.get("kind") is None:
-                    done = False
-                    break
-            if done:
+                statuses.append(
+                    "ready" if locs.get("kind") is not None
+                    else "lost" if locs.get("lost") else "missing")
+            if all(s == "ready" for s in statuses):
                 with self.lock:
                     # Completed remotely but the forward_done notify was
                     # lost with the node: release the owner-side holds
@@ -581,16 +583,24 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     for oid in rec.spec["return_ids"]:
                         self._ensure_pull(oid)
                 continue
+            if (all(s in ("ready", "lost") for s in statuses)
+                    and rec.actor_id is None):
+                # Completed, but the only copies died with the node
+                # (the GCS lost-marker proves it WAS ready): re-running
+                # is lineage reconstruction, budgeted by
+                # max_object_reconstructions — independent of the
+                # task's retry policy, which governs never-ran work.
+                reconstruct.append(rec)
+                continue
             (retry if rec.retries_left > 0
              and not rec.is_actor_creation else fail).append(rec)
         with self.lock:
             for rec in retry:
-                rec.retries_left -= 1
-                rec.state = "pending"
-                rec.worker = None
-                rec.spec.pop("spilled", None)
-                self.tasks[rec.task_id] = rec
-                self.pending_queue.append(rec)
+                self._schedule_retry(rec, "node_death", dead_reason)
+            for rec in reconstruct:
+                if not self._requeue_as_reconstruction(rec,
+                                                       dead_reason):
+                    fail.append(rec)
             for rec in fail:
                 if rec.actor_id is not None and not rec.is_actor_creation:
                     err: Exception = exc.ActorDiedError(
@@ -612,6 +622,12 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         """Get (or open) the persistent Connection to a peer node."""
         from ray_tpu._private.protocol import Connection, connect_tcp
         nid = ninfo["node_id"]
+        if chaos.partitioned(nid):
+            # Node-partition fault: this node cannot reach the target —
+            # covers control forwards AND object transfer, since both
+            # ride these peer connections.
+            raise ConnectionLost(
+                f"chaos: partitioned from node {nid.hex()[:12]}")
         with self._peer_lock:
             conn = self._peer_conns.get(nid)
             if conn is not None and not conn._closed:
@@ -710,7 +726,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     for oid in spec["return_ids"]:
                         self.objects.setdefault(oid, ObjectEntry())
                     self._fail_task_returns(rec, exc.ActorDiedError(
-                        aid.hex(), tomb))
+                        aid.hex(), tomb, task_started=False))
                     ctx.reply(m, {"ok": True})
                     return
                 if home is not None and home != self.node_id:
@@ -804,8 +820,12 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
 
     def _h_put_object(self, ctx: _ConnCtx, m: dict) -> None:
         with self.lock:
+            # loc="error" puts deliver an exception as the object's
+            # value (Serve failover bridges a final failure this way).
             self._register_object(m["object_id"], m["loc"],
                                   m.get("data"), m["size"],
+                                  state=(FAILED if m["loc"] == "error"
+                                         else READY),
                                   embedded=m.get("embedded") or [],
                                   creator_pid=ctx.pid)
             self._schedule()
@@ -912,6 +932,20 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
     def _h_get_objects(self, ctx: _ConnCtx, m: dict) -> None:
         """Blocking get: reply once every requested object is ready."""
         oids: List[bytes] = m["object_ids"]
+        if chaos.armed("get_objects", "evict"):
+            # Store-eviction fault: vanish a requested READY object's
+            # shm payload (directory entry kept READY) so the reader
+            # hits the lineage-reconstruction path.  Eligibility is
+            # checked BEFORE fire() so a get of inline/lineage-less
+            # objects can't burn the budget (and pollute the fault
+            # trace) without evicting anything.
+            with self.lock:
+                eligible = [o for o in oids if self._chaos_evictable(o)]
+            if eligible and chaos.fire("get_objects", "evict"):
+                with self.lock:
+                    for oid in eligible:
+                        if self._chaos_evict_entry(oid):
+                            break
         timeout = m.get("timeout")
         deadline = time.time() + timeout if timeout is not None else None
         done = threading.Event()   # reply-once guard
@@ -1000,6 +1034,17 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     deadline, lambda: try_reply(timed_out=True))
         try_reply()
 
+    def _h_task_started(self, ctx: _ConnCtx, m: dict) -> None:
+        """Worker signal: user code for an actor call began executing.
+        Until this arrives a dispatched call is still replayable (it
+        sat in the worker's queue) — worker death requeues it for free
+        instead of burning retry budget or surfacing an error."""
+        with self.lock:
+            rec = self.tasks.get(m["task_id"])
+            if rec is not None:
+                rec.started = True
+                rec.stages.setdefault("executing", time.time())
+
     def _h_task_done(self, ctx: _ConnCtx, m: dict) -> None:
         notify_owner: Optional[bytes] = None
         prof = m.get("profile")
@@ -1013,6 +1058,24 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                                                            self.node_id)):
                 notify_owner = rec.spec["owner_node"]
             w = ctx.worker
+            if (m.get("failed") and m.get("app_retryable")
+                    and rec is not None and rec.retries_left > 0
+                    and not rec.cancelled and rec.actor_id is None):
+                # retry_exceptions matched (decided worker-side): the
+                # error is NOT registered on the return objects — the
+                # task resubmits after backoff, waiters stay parked,
+                # and the submitter's embedded holds stay live for the
+                # replay.  Returning here also skips forward_done: a
+                # forwarded task is only "done" for its owner once a
+                # run actually completes.
+                self._schedule_retry(
+                    rec, "app_error",
+                    "application exception matched retry_exceptions")
+                if w is not None and w.state == "busy" \
+                        and w.actor_id is None:
+                    self._release_worker(w)
+                self._schedule()
+                return
             for oid, loc, data, size, embedded in m["returns"]:
                 entry = self.objects.get(oid)
                 if entry is not None and entry.deleted:
@@ -1212,7 +1275,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 return
             rec.cancelled = True
             rec.retries_left = 0
-            if rec.state == "pending":
+            if rec.state in ("pending", "retry_backoff"):
+                # retry_backoff: the parked resubmission callback
+                # checks rec.state and becomes a no-op.
                 self._fail_task_returns(rec, exc.TaskCancelledError(
                     f"task {rec.spec.get('name')!r} was cancelled "
                     f"before it started"))
@@ -1533,7 +1598,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         if actor is None or actor.state == "dead":
             reason = actor.death_reason if actor else "unknown actor"
             self._fail_task_returns(rec, exc.ActorDiedError(
-                rec.actor_id.hex(), reason))
+                rec.actor_id.hex(), reason, task_started=False))
             return
         actor.queue.append(rec)
         self._drain_actor_queue(actor)
@@ -1554,6 +1619,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             actor.in_flight[rec.task_id] = rec
             actor.worker.conn_send({"type": "execute_task",
                                     "spec": rec.spec})
+            self._chaos_kill_dispatch(actor.worker)
 
     def _release_actor_holds(self, actor: ActorRecord) -> None:
         """Release the creation-task embedded ref holds exactly once, at
@@ -1566,11 +1632,20 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             self._decref(dep)
 
     def _fail_actor_queue(self, actor: ActorRecord) -> None:
-        err = exc.ActorDiedError(actor.actor_id.hex(), actor.death_reason)
+        # task_started distinguishes queued (never ran — safe for a
+        # caller to retry elsewhere, e.g. Serve failover) from
+        # in-flight calls (a retry could double side effects).
         while actor.queue:
-            self._fail_task_returns(actor.queue.popleft(), err)
+            self._fail_task_returns(
+                actor.queue.popleft(),
+                exc.ActorDiedError(actor.actor_id.hex(),
+                                   actor.death_reason,
+                                   task_started=False))
         for rec in list(actor.in_flight.values()):
-            self._fail_task_returns(rec, err)
+            self._fail_task_returns(
+                rec, exc.ActorDiedError(actor.actor_id.hex(),
+                                        actor.death_reason,
+                                        task_started=rec.started))
         actor.in_flight.clear()
 
     def _h_actor_release_scope(self, ctx: _ConnCtx, m: dict) -> None:
@@ -1980,6 +2055,117 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             cur["sum"] += dur
             cur["count"] += 1
 
+    def _inc_counter(self, name: str, tags: Dict[str, str],
+                     description: str = "") -> None:
+        """Bump a node-side auto-registered counter cell (same table
+        the stage histograms land in).  Caller holds self.lock."""
+        key = (name, "counter", tuple(sorted(tags.items())))
+        cur = self._metrics.get(key)
+        if cur is None:
+            cur = {"name": name, "kind": "counter", "tags": dict(tags),
+                   "value": 0.0, "buckets": {}, "sum": 0.0,
+                   "count": 0.0, "description": description}
+            self._metrics[key] = cur
+        cur["value"] += 1
+
+    # ------------------------------------------------------------------
+    # retry scheduling: exponential backoff with jitter
+    # (reference role: task resubmit backoff; the jitter stream is
+    # seeded alongside the chaos RNG so a chaos schedule replays)
+    # ------------------------------------------------------------------
+    def _retry_delay_s(self, rec: TaskRecord) -> float:
+        base = max(config.task_retry_delay_ms, 0) / 1000.0
+        cap = max(config.task_retry_max_delay_ms, 0) / 1000.0
+        attempt = max(rec.spec.get("retries", 0) - rec.retries_left, 1)
+        delay = min(cap, base * (2 ** (attempt - 1)))
+        # Full-ish jitter in [0.5x, 1x]: staggers a thundering herd of
+        # simultaneous retries without ever *extending* the cap.
+        return delay * (0.5 + 0.5 * chaos.jitter())
+
+    def _schedule_retry(self, rec: TaskRecord, reason_tag: str,
+                        reason: str) -> None:
+        """Re-run `rec` after an exponential-backoff delay.  Decrements
+        the retry budget, emits the retry lifecycle event + counter,
+        and parks the resubmission on the monitor's deadline list.
+        Caller holds self.lock and has already verified
+        rec.retries_left > 0."""
+        rec.retries_left -= 1
+        rec.state = "retry_backoff"
+        rec.worker = None
+        rec.spec.pop("spilled", None)
+        self.tasks[rec.task_id] = rec
+        delay = self._retry_delay_s(rec)
+        now = time.time()
+        self._emit_retry(rec, reason_tag, reason, delay)
+
+        def fire() -> None:
+            with self.lock:
+                if rec.state != "retry_backoff" or self._shutdown:
+                    return      # cancelled / failed during backoff
+                rec.state = "pending"
+                rec.stages["queued"] = time.time()
+                self.pending_queue.append(rec)
+                self._schedule()
+
+        self._add_deadline_waiter(now + delay, fire)
+
+    def _requeue_as_reconstruction(self, rec: TaskRecord,
+                                   reason: str) -> bool:
+        """Re-run a forwarded plain task lost to a node death under the
+        object-reconstruction budget.  Caller holds self.lock; returns
+        False when the budget is spent (caller fails the returns)."""
+        if rec.is_actor_creation or rec.cancelled:
+            return False
+        entries = []
+        for oid in rec.spec["return_ids"]:
+            e = self.objects.setdefault(oid, ObjectEntry())
+            if e.reconstructions >= config.max_object_reconstructions:
+                return False
+            entries.append((oid, e))
+        for oid, e in entries:
+            e.reconstructions += 1
+            e.state = PENDING
+            e.loc = None
+            e.data = None
+            e.producing_task = rec.task_id
+        rec.state = "pending"
+        rec.worker = None
+        rec.spec.pop("spilled", None)
+        rec.deps = {a[1] for a in rec.spec["args"] if a[0] == "ref"
+                    and not self._object_ready(a[1])}
+        for d in rec.deps:
+            self._ensure_pull(d)
+        self.tasks[rec.task_id] = rec
+        self.pending_queue.append(rec)
+        self._emit_retry(rec, "node_death",
+                         f"reconstructing results lost with node: "
+                         f"{reason}", 0.0)
+        return True
+
+    def _emit_retry(self, rec: TaskRecord, reason_tag: str,
+                    reason: str, delay_s: float) -> None:
+        """Retry observability, shared by every retry path: the
+        counter cell plus one lifecycle event carrying the backoff
+        delay and reason.  Caller holds self.lock and has already
+        decremented the budget."""
+        from ray_tpu.util.metrics import TASK_RETRIES_METRIC
+        self._inc_counter(
+            TASK_RETRIES_METRIC, {"reason": reason_tag},
+            "task retries, by failure reason")
+        now = time.time()
+        self._events.append({
+            "kind": "retry",
+            "name": (rec.spec.get("name") or "<task>") + ":retry",
+            "task_id": rec.task_id.hex(),
+            "reason": reason,
+            "reason_tag": reason_tag,
+            "delay_s": delay_s,
+            "attempt": rec.spec.get("retries", 0) - rec.retries_left,
+            "start": now, "end": now,
+            "pid": 0,
+            "node_id": self.node_id.hex(),
+        })
+
     # ------------------------------------------------------------------
     # scheduling
     # ------------------------------------------------------------------
@@ -2137,7 +2323,21 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 w.resources_held = res
                 w.bundle_key = key if bundle is not None else None
                 w.conn_send({"type": "execute_task", "spec": rec.spec})
+                self._chaos_kill_dispatch(w)
                 progressed = True
+
+    def _chaos_kill_dispatch(self, w: WorkerHandle) -> None:
+        """Chaos kind=kill_worker at site 'dispatch': SIGKILL the worker
+        a task was just handed to — the monitor's death sweep then
+        drives the crash-retry path.  No-op unless a chaos schedule
+        arms it."""
+        if not chaos.fire("dispatch", "kill_worker"):
+            return
+        try:
+            if w.proc is not None:
+                w.proc.kill()
+        except Exception:
+            pass
 
     def _release_held(self, w: WorkerHandle) -> None:
         """Return a worker's held resources to their source pool: the pg
@@ -2321,11 +2521,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._schedule_reap(w)
         rec = w.current_task
         if rec is not None and rec.state == "dispatched":
-            if rec.retries_left > 0 and not rec.is_actor_creation:
-                rec.retries_left -= 1
-                rec.state = "pending"
-                rec.worker = None
-                self.pending_queue.append(rec)
+            if rec.retries_left > 0 and not rec.is_actor_creation \
+                    and not rec.cancelled:
+                self._schedule_retry(rec, "worker_crash", reason)
             else:
                 err_cls = (exc.TaskCancelledError if rec.cancelled
                            else exc.OutOfMemoryError if oom
@@ -2348,19 +2546,67 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 self._on_actor_worker_death(actor, reason)
 
     def _on_actor_worker_death(self, actor: ActorRecord, reason: str) -> None:
-        # Fail in-flight calls; restart if budget remains.  An exit
-        # announced via exit_actor() keeps its intentional reason.
+        # Fail or retry in-flight calls; restart if budget remains.  An
+        # exit announced via exit_actor() keeps its intentional reason.
         if actor.intentional_exit:
             reason = actor.death_reason
-        err = exc.ActorDiedError(actor.actor_id.hex(), reason)
+        will_restart = (actor.restarts_left != 0
+                        and not actor.intentional_exit)
+        retried: List[TaskRecord] = []
         for rec in list(actor.in_flight.values()):
-            self._fail_task_returns(rec, err)
+            if rec.cancelled:
+                # Unreachable today (_h_cancel_task rejects actor
+                # tasks) but load-bearing if cancellation ever extends
+                # to them: a cancelled call must surface as cancelled,
+                # never as a retryable/transient failure.
+                self._fail_task_returns(rec, exc.TaskCancelledError(
+                    f"task {rec.spec.get('name')!r} was cancelled"))
+            elif will_restart and not rec.started:
+                # Never began executing (sat in the dead worker's
+                # queue): requeue for FREE — nothing ran, so nothing
+                # can double, and no retry budget is owed.
+                rec.state = "pending"
+                rec.worker = None
+                retried.append(rec)
+            elif will_restart and rec.retries_left > 0:
+                # max_task_retries: a STARTED call rides the restart —
+                # back onto the head of the actor queue, re-dispatched
+                # once the replacement worker is alive.
+                rec.retries_left -= 1
+                rec.state = "pending"
+                rec.worker = None
+                rec.started = False
+                retried.append(rec)
+                # delay 0: the resubmission is gated on the restart
+                # itself, not a timer.
+                self._emit_retry(rec, "actor_restart",
+                                 f"actor restarting: {reason}", 0.0)
+            elif will_restart:
+                # The actor comes back but this started call's budget
+                # is spent: typed TRANSIENT error (task_started=True —
+                # a re-route could double its side effects; callers
+                # decide).
+                self._fail_task_returns(rec, exc.ActorUnavailableError(
+                    actor.actor_id.hex(),
+                    f"restarting after: {reason}",
+                    task_started=True))
+            else:
+                self._fail_task_returns(rec, exc.ActorDiedError(
+                    actor.actor_id.hex(), reason,
+                    task_started=rec.started))
         actor.in_flight.clear()
+        # Retried calls precede everything already queued, in their
+        # original dispatch order.
+        for rec in reversed(retried):
+            actor.queue.appendleft(rec)
         actor.worker = None
         if actor.restarts_left != 0:
             if actor.restarts_left > 0:
                 actor.restarts_left -= 1
             actor.state = "restarting"
+            from ray_tpu.util.metrics import ACTOR_RESTARTS_METRIC
+            self._inc_counter(ACTOR_RESTARTS_METRIC, {},
+                              "actor restarts after worker death")
             creation = dict(actor.spec["creation_task"])
             creation["task_id"] = os.urandom(16)
             # Fresh return object for the restart's creation result.

@@ -57,7 +57,7 @@ class ObjectEntry:
 class TaskRecord:
     __slots__ = ("task_id", "spec", "deps", "state", "worker",
                  "retries_left", "is_actor_creation", "actor_id",
-                 "cancelled", "stages", "had_deps")
+                 "cancelled", "stages", "had_deps", "started")
 
     def __init__(self, spec: dict) -> None:
         self.task_id: bytes = spec["task_id"]
@@ -69,6 +69,12 @@ class TaskRecord:
         self.state = "pending"     # pending | dispatched | done
         self.worker: Optional[WorkerHandle] = None
         self.retries_left: int = spec.get("retries", 0)
+        # Actor calls: did USER CODE begin executing?  Dispatch alone
+        # doesn't set this — the worker queues dispatched calls, so
+        # "in flight" at the node still means "may never have run".
+        # The worker's task_started notify flips it; worker death then
+        # distinguishes replayable-queued from maybe-side-effecting.
+        self.started = False
         self.is_actor_creation = spec.get("is_actor_creation", False)
         self.cancelled = False
         self.actor_id: Optional[bytes] = spec.get("actor_id")

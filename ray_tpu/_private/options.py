@@ -21,7 +21,7 @@ COMMON_OPTIONS: FrozenSet[str] = frozenset({
 
 # Task-only options.
 TASK_OPTIONS: FrozenSet[str] = COMMON_OPTIONS | {
-    "num_returns", "max_retries",
+    "num_returns", "max_retries", "retry_exceptions",
 }
 
 # Actor-only options.

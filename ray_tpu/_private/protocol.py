@@ -9,70 +9,65 @@ streams.
 
 Chaos hooks replicate the reference's RAY_testing_rpc_failure /
 RAY_testing_asio_delay_us env-driven fault injection (src/ray/rpc/
-rpc_chaos.h:23, ray_config_def.h:833-841) so failure-handling tests can
-exercise retry paths deterministically.
+rpc_chaos.h:23, ray_config_def.h:833-841).  The injector itself lives in
+_private/chaos.py (seeded, re-resolvable schedule); this layer holds the
+hook points plus the rpc retry that absorbs injected pre-send failures
+— the analog of the reference's gRPC-level retry on transient errors.
 """
 
 from __future__ import annotations
 
-import os
 import pickle
-import random
 import socket
 import struct
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-from ray_tpu._private.config import config
-
 _LEN = struct.Struct("<Q")
+
+# Pre-send failures (chaos-injected errors/drops) are retried this many
+# times with exponential backoff before surfacing to the caller.
+_RPC_RETRY_ATTEMPTS = 3
+_RPC_RETRY_BASE_S = 0.01
 
 
 class ConnectionLost(Exception):
     pass
 
 
-# ---------------------------------------------------------------------------
-# Chaos injection (reference: rpc_chaos.h)
-# ---------------------------------------------------------------------------
-class _Chaos:
-    def __init__(self) -> None:
-        self._fail_budget: Dict[str, int] = {}
-        self._delays: Dict[str, tuple] = {}
-        self._lock = threading.Lock()
-        self._parsed = False
-
-    def _parse(self) -> None:
-        if self._parsed:
-            return
-        self._parsed = True
-        spec = config.testing_rpc_failure
-        if spec:
-            for part in spec.split(","):
-                method, _, n = part.partition(":")
-                self._fail_budget[method.strip()] = int(n or 1)
-        dspec = config.testing_asio_delay_us
-        if dspec:
-            for part in dspec.split(","):
-                method, lo, hi = part.split(":")
-                self._delays[method.strip()] = (int(lo), int(hi))
-
-    def maybe_inject(self, method: str) -> None:
-        self._parse()
-        if not self._fail_budget and not self._delays:
-            return
-        with self._lock:
-            if method in self._delays:
-                lo, hi = self._delays[method]
-                time.sleep(random.uniform(lo, hi) / 1e6)
-            budget = self._fail_budget.get(method, 0)
-            if budget > 0 and random.random() < 0.5:
-                self._fail_budget[method] = budget - 1
-                raise ConnectionLost(f"chaos: injected failure for {method}")
+# Re-exported singleton: the seeded chaos schedule (kept under the old
+# `protocol.chaos` name for existing imports).  Imported AFTER
+# ConnectionLost is defined — chaos.py raises it via a lazy import.
+from ray_tpu._private.chaos import chaos  # noqa: E402
 
 
-chaos = _Chaos()
+def _chaos_gate(msg_type: str, one_way: bool) -> bool:
+    """Run the chaos hook with pre-send retry.
+
+    Request/reply rpcs treat an injected drop like the reference treats
+    a lost request — a (simulated) timeout absorbed by the retry loop.
+    One-way notifies return True ("drop this message"): lossy by
+    design, recovery belongs to a higher layer.  Raises ConnectionLost
+    when injected failures out-budget the retry."""
+    for attempt in range(_RPC_RETRY_ATTEMPTS + 1):
+        try:
+            action = chaos.maybe_inject(msg_type)
+        except ConnectionLost:
+            if attempt >= _RPC_RETRY_ATTEMPTS:
+                raise
+            time.sleep(_RPC_RETRY_BASE_S * (2 ** attempt))
+            continue
+        if action == "drop":
+            if one_way:
+                return True
+            if attempt >= _RPC_RETRY_ATTEMPTS:
+                raise ConnectionLost(
+                    f"chaos: dropped rpc {msg_type}")
+            time.sleep(_RPC_RETRY_BASE_S * (2 ** attempt))
+            continue
+        return False
+    return False
 
 
 def send_msg(sock: socket.socket, msg: Any, lock: Optional[threading.Lock] = None) -> None:
@@ -158,7 +153,7 @@ class Connection:
 
     def call(self, msg: dict, timeout: Optional[float] = None) -> dict:
         """Blocking request/reply."""
-        chaos.maybe_inject(msg.get("type", "?"))
+        _chaos_gate(msg.get("type", "?"), one_way=False)
         if self._closed:
             raise ConnectionLost("connection closed")
         rid = self._next_req_id()
@@ -181,7 +176,8 @@ class Connection:
 
     def notify(self, msg: dict) -> None:
         """One-way message (no reply expected)."""
-        chaos.maybe_inject(msg.get("type", "?"))
+        if _chaos_gate(msg.get("type", "?"), one_way=True):
+            return      # chaos: message dropped on the floor
         send_msg(self._sock, msg, self._send_lock)
 
     def close(self) -> None:

@@ -170,6 +170,20 @@ class WorkerRuntime:
                          name="rtpu-actor-loop").start()
         started.wait()
 
+    def _notify_started(self, spec: dict) -> None:
+        """Tell the node USER CODE for this actor call is now running.
+        Dispatch alone queues calls inside the worker, so without this
+        signal the node could not tell a replayable never-ran call from
+        one that may already have side effects (the task_started flag
+        on death errors; Serve failover keys off it).  One-way + same
+        connection as task_done, so ordering is preserved."""
+        try:
+            self.client.conn.notify({"type": "task_started",
+                                     "task_id": spec["task_id"],
+                                     "actor_id": spec.get("actor_id")})
+        except Exception:
+            pass
+
     def _execute_actor_method(self, spec: dict) -> None:
         instance = self.actors.get(spec["actor_id"])
         if instance is None:
@@ -182,6 +196,7 @@ class WorkerRuntime:
             # that duration (reference: aDAG loops pin the actor).
             def loop(spec: dict) -> int:
                 from ray_tpu.experimental.dag_executor import run_dag_loop
+                self._notify_started(spec)
                 (ops,), _ = self.client.unpack_args(spec["args"])
                 return run_dag_loop(instance, ops, self.client)
 
@@ -204,6 +219,7 @@ class WorkerRuntime:
                 tracing.activate_for_task(spec)
                 async with self.actor_semaphore:
                     start_box["t"] = time.time()
+                    self._notify_started(spec)
                     args, kwargs = self.client.unpack_args(spec["args"])
                     return await method(*args, **kwargs)
 
@@ -220,6 +236,7 @@ class WorkerRuntime:
             return
 
         def call(_spec: dict) -> Any:
+            self._notify_started(_spec)
             args, kwargs = self.client.unpack_args(_spec["args"])
             if _spec.get("streaming"):
                 # Streaming generator METHOD: same yield path as
@@ -301,6 +318,36 @@ class WorkerRuntime:
                                  "profile": self._profile(spec, start,
                                                           False)})
 
+    @staticmethod
+    def _app_retryable(spec: dict, error: BaseException) -> bool:
+        """Does this application exception match the task's
+        `retry_exceptions` policy?  Matched HERE (the worker holds the
+        live exception object) so the node never has to deserialize
+        error blobs — which also keeps the decision correct for
+        forwarded tasks whose exception types the node can't import.
+        The policy is True or a tuple of "module.QualName" strings
+        (never classes — they wouldn't survive the plain-pickle spec);
+        a name matches anywhere in the raised type's MRO, so listing a
+        base class catches subclasses like isinstance would."""
+        pol = spec.get("retry_exceptions")
+        if not pol or spec.get("actor_id") is not None \
+                or spec.get("streaming"):
+            return False
+        cause = error.cause if isinstance(error, exc.TaskError) \
+            else error
+        if cause is None or isinstance(cause, exc.ActorExitRequest):
+            return False
+        if pol is True:
+            return True
+        mro = set()
+        for c in type(cause).__mro__:
+            mro.add(f"{c.__module__}.{c.__qualname__}")
+            mro.add(f"{c.__module__}.{c.__name__}")
+        try:
+            return bool(mro & set(pol))
+        except TypeError:
+            return False
+
     def _report_error(self, spec: dict, error: BaseException,
                       start: Optional[float] = None) -> None:
         if isinstance(error, exc.ActorExitRequest) \
@@ -333,6 +380,8 @@ class WorkerRuntime:
         self.client.conn.notify({"type": "task_done",
                                  "task_id": spec["task_id"],
                                  "returns": returns, "failed": True,
+                                 "app_retryable":
+                                     self._app_retryable(spec, error),
                                  "profile": self._profile(spec, start,
                                                           True)})
 

@@ -85,7 +85,9 @@ class ActorClass:
         owns = (not detached and self._options.get("name") is None)
         return ActorHandle(actor_id, class_id, self._cls.__name__,
                            method_meta, creation_ref=ready_ref,
-                           owns_lifetime=owns)
+                           owns_lifetime=owns,
+                           max_task_retries=int(
+                               self._options.get("max_task_retries", 0)))
 
 
 def _method_meta(cls: type) -> Dict[str, int]:
@@ -116,7 +118,8 @@ class ActorMethod:
         client = ray_tpu._ensure_connected()
         refs = client.submit_actor_task(
             self._handle._actor_id, self._handle._class_id, self._name,
-            args, kwargs, self._num_returns)
+            args, kwargs, self._num_returns,
+            retries=self._handle._max_task_retries)
         if self._num_returns == 1:
             return refs[0]
         return refs    # a list, or the ObjectRefGenerator for streaming
@@ -129,7 +132,8 @@ class ActorMethod:
 class ActorHandle:
     def __init__(self, actor_id: bytes, class_id: bytes, class_name: str,
                  method_meta: Dict[str, int], creation_ref=None,
-                 owns_lifetime: bool = False) -> None:
+                 owns_lifetime: bool = False,
+                 max_task_retries: int = 0) -> None:
         self._actor_id = actor_id
         self._class_id = class_id
         self._class_name = class_name
@@ -138,6 +142,9 @@ class ActorHandle:
         # construction; dropping it is harmless.
         self._creation_ref = creation_ref
         self._owns_lifetime = owns_lifetime
+        # Per-call retry budget honored when the actor restarts
+        # (reference: max_task_retries on actor methods).
+        self._max_task_retries = max_task_retries
         self._shared = False
 
     def __getattr__(self, name: str) -> ActorMethod:
@@ -156,8 +163,9 @@ class ActorHandle:
         # A pickled handle may outlive this one anywhere in the
         # cluster: local GC can no longer prove the actor unreachable.
         self._shared = True
-        return (ActorHandle, (self._actor_id, self._class_id,
-                              self._class_name, self._method_meta))
+        return (_rebuild_handle, (self._actor_id, self._class_id,
+                                  self._class_name, self._method_meta,
+                                  self._max_task_retries))
 
     def __del__(self):
         if not getattr(self, "_owns_lifetime", False) \
@@ -174,3 +182,11 @@ class ActorHandle:
                                     "actor_id": self._actor_id})
         except Exception:
             pass
+
+
+def _rebuild_handle(actor_id: bytes, class_id: bytes, class_name: str,
+                    method_meta: Dict[str, int],
+                    max_task_retries: int = 0) -> ActorHandle:
+    """Unpickle target for shipped handles (keeps max_task_retries)."""
+    return ActorHandle(actor_id, class_id, class_name, method_meta,
+                       max_task_retries=max_task_retries)

@@ -645,3 +645,72 @@ def _const_number(node: ast.AST) -> Optional[float]:
             except ValueError:
                 return None
     return None
+
+
+# ---------------------------------------------------------------------------
+# RT008 — retry_exceptions on a task with side-effecting submissions
+# ---------------------------------------------------------------------------
+_PUT_NAMES = {"ray_tpu.put", "ray.put"}
+
+
+def _retry_flag_value(call: ast.Call) -> bool:
+    """True when a call's keywords enable app-level retry
+    (retry_exceptions=True or a non-empty list/tuple literal)."""
+    for kw in call.keywords:
+        if kw.arg != "retry_exceptions":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and v.value is True:
+            return True
+        if isinstance(v, (ast.List, ast.Tuple)) and v.elts:
+            return True
+    return False
+
+
+@register(
+    "RT008", "retry_exceptions on a task whose body submits work",
+    "A task with retry_exceptions=True re-EXECUTES its whole body when "
+    "it raises a matching application exception — including any "
+    ".remote() submissions or ray_tpu.put() calls that already ran "
+    "before the raise.  Unlike a worker crash (where prior side "
+    "effects died with the process), an app-level retry duplicates "
+    "them: double-submitted child tasks, double-stored objects.  Make "
+    "the body idempotent, or drop retry_exceptions.")
+def check_rt008(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    # Tasks with app-level retry enabled: decorator form plus
+    # `<name>.options(retry_exceptions=...)` on a decorated task.
+    flagged: Dict[str, ast.AST] = {}
+    task_defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if mod.decorator_kind(node) != "task":
+            continue
+        task_defs[node.name] = node
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call) and _retry_flag_value(dec):
+                flagged[node.name] = node
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "options" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in task_defs \
+                and _retry_flag_value(node):
+            flagged[node.func.value.id] = task_defs[node.func.value.id]
+
+    for name, fn in flagged.items():
+        for sub in (s for stmt in fn.body for s in ast.walk(stmt)):
+            if not isinstance(sub, ast.Call):
+                continue
+            if _is_remote_call(sub):
+                yield mod.finding(
+                    "RT008", sub,
+                    f"task {name!r} has retry_exceptions but submits "
+                    f"work with .remote() — an app-level retry "
+                    f"re-runs the submission (non-idempotent)")
+            elif _resolved(sub.func, imports) in _PUT_NAMES:
+                yield mod.finding(
+                    "RT008", sub,
+                    f"task {name!r} has retry_exceptions but calls "
+                    f"put() — an app-level retry re-stores the object "
+                    f"(non-idempotent)")

@@ -46,16 +46,49 @@ class ActorError(TaskError):
 
 
 class ActorDiedError(RayTpuError):
-    """The actor is dead; pending and future calls fail with this."""
+    """The actor is dead; pending and future calls fail with this.
 
-    def __init__(self, actor_id_hex: str, reason: str = "") -> None:
+    ``task_started`` records whether the failing call had begun
+    executing when the actor died: False for calls that were still
+    queued (safe to retry — e.g. the Serve router's failover), True for
+    in-flight calls (a retry could double side effects), None when
+    unknown."""
+
+    def __init__(self, actor_id_hex: str, reason: str = "",
+                 task_started: Optional[bool] = None) -> None:
         self.actor_id_hex = actor_id_hex
         self.reason = reason
+        self.task_started = task_started
         super().__init__(f"Actor {actor_id_hex} is dead. {reason}")
+
+    def __reduce__(self):
+        return (_rebuild_actor_died, (self.actor_id_hex, self.reason,
+                                      self.task_started))
+
+
+def _rebuild_actor_died(actor_id_hex: str, reason: str,
+                        task_started: Optional[bool]) -> "ActorDiedError":
+    return ActorDiedError(actor_id_hex, reason, task_started)
 
 
 class ActorUnavailableError(RayTpuError):
-    """The actor is transiently unreachable (e.g. restarting)."""
+    """The actor is transiently unreachable (e.g. restarting).  Raised
+    for an in-flight call lost to a worker death when the actor WILL
+    restart but the call has no task-retry budget left — transient by
+    contract, so routers/clients may safely retry or re-route it
+    (reference: ray.exceptions.ActorUnavailableError)."""
+
+    def __init__(self, actor_id_hex: str = "", reason: str = "",
+                 task_started: Optional[bool] = None) -> None:
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        self.task_started = task_started
+        super().__init__(
+            f"Actor {actor_id_hex} is temporarily unavailable. {reason}")
+
+    def __reduce__(self):
+        return (ActorUnavailableError, (self.actor_id_hex, self.reason,
+                                        self.task_started))
 
 
 class ObjectLostError(RayTpuError):

@@ -32,6 +32,42 @@ def _pg_spec_from_options(options: Dict[str, Any]) -> Optional[Dict]:
     return {"id": pg.id, "bundle": index}
 
 
+def _retry_exceptions_from_options(options: Dict[str, Any]):
+    """Normalize the `retry_exceptions` option: None/False (off), True
+    (retry any application exception), or a tuple of QUALIFIED TYPE
+    NAMES ("module.QualName").  Names, not classes: the task spec rides
+    plain pickle, and a driver-__main__-defined exception class would
+    fail to unpickle in the worker's receive loop (killing the worker
+    instead of enabling retry).  The worker matches names against the
+    raised exception's MRO (worker_main._app_retryable).  Validated at
+    decoration/option time so a bad value fails at the call site."""
+    pol = options.get("retry_exceptions")
+    if pol is None or pol is False:
+        return None
+    if pol is True:
+        return True
+    try:
+        types = tuple(pol)
+    except TypeError:
+        raise TypeError(
+            "retry_exceptions must be True or a list/tuple of "
+            f"exception types, got {pol!r}") from None
+    for t in types:
+        if not (isinstance(t, type) and issubclass(t, BaseException)):
+            raise TypeError(
+                f"retry_exceptions entries must be exception types, "
+                f"got {t!r}")
+    # Both name forms per type: cloudpickle-reconstructed classes can
+    # lose the "<locals>" qualname prefix, so a function-local
+    # exception's driver-side qualname may not equal its worker-side
+    # one — the plain module.name form bridges that.
+    names = set()
+    for t in types:
+        names.add(f"{t.__module__}.{t.__qualname__}")
+        names.add(f"{t.__module__}.{t.__name__}")
+    return tuple(sorted(names)) or None
+
+
 def _resources_from_options(options: Dict[str, Any],
                             default_cpus: float) -> Dict[str, float]:
     res = dict(options.get("resources") or {})
@@ -51,6 +87,7 @@ class RemoteFunction:
         self._fn = fn
         self._options = dict(options or {})
         validate_options(self._options, TASK_OPTIONS, "task")
+        _retry_exceptions_from_options(self._options)  # fail-fast check
         self._blob: Optional[bytes] = None
         self._function_id: Optional[bytes] = None
         functools.update_wrapper(self, fn)
@@ -109,6 +146,8 @@ class RemoteFunction:
             resources=resources,
             retries=self._options.get("max_retries",
                                       config.max_task_retries),
+            retry_exceptions=_retry_exceptions_from_options(
+                self._options),
             pg=_pg_spec_from_options(self._options),
             runtime_env=rte.pack(self._options.get("runtime_env")),
             affinity=self._options.get("_affinity"))

@@ -402,6 +402,35 @@ def cmd_lint(args) -> int:
     return lint_cli.run(args)
 
 
+def cmd_chaos(args) -> int:
+    """Print/validate a chaos fault-injection spec (the schedule from
+    --spec, or the ambient RAY_TPU_CHAOS_SPEC / config + legacy env
+    specs).  Exit 0 on a valid schedule, 2 on a grammar error."""
+    from ray_tpu._private.chaos import (FAULT_KINDS, chaos, parse_spec)
+    from ray_tpu._private.config import config
+    if args.spec is not None:
+        try:
+            entries = [s.to_dict() for s in parse_spec(args.spec)]
+        except ValueError as e:
+            print(f"invalid chaos spec: {e}", file=sys.stderr)
+            return 2
+        seed = config.chaos_seed
+    else:
+        entries = chaos.describe()
+        seed = config.chaos_seed
+    if args.json:
+        print(json.dumps({"seed": seed, "entries": entries}, indent=1))
+        return 0
+    print(f"chaos seed: {seed} "
+          f"(same seed + workload => identical fault trace)")
+    if not entries:
+        print("no faults armed (set RAY_TPU_CHAOS_SPEC or pass --spec)")
+    else:
+        _print_table(entries, ["site", "kind", "p", "n"])
+    print(f"fault kinds: {', '.join(FAULT_KINDS)}")
+    return 0
+
+
 # ---------------------------------------------------------------------------
 def main(argv: Optional[List[str]] = None) -> int:
     raw = sys.argv[1:] if argv is None else list(argv)
@@ -489,6 +518,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p = sub.add_parser("microbench", help="core perf harness")
     p.set_defaults(fn=cmd_microbench)
+
+    p = sub.add_parser(
+        "chaos", help="print/validate a chaos fault-injection spec")
+    p.add_argument("--spec", default=None,
+                   help="spec to validate (default: the ambient "
+                        "config/env schedule)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_chaos)
 
     # The rule-table epilog imports + registers the whole lint rule
     # set; only `ray_tpu lint -h` ever renders a subparser epilog, so

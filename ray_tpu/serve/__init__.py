@@ -187,18 +187,19 @@ class _HandleMethod:
                                                 kwargs)
             _attach_done_callback(router, gen.completed(), replica)
             return gen
-        ref, replica = router.assign(self._method, args, kwargs,
-                                     self._model_id)
-        _attach_done_callback(router, ref, replica)
+        # Unary requests: the router's per-request waiter owns the
+        # done-callback AND failover (un-started requests retry once on
+        # a different replica) — see _router.Router._watch.
+        ref, _ = router.assign(self._method, args, kwargs,
+                               self._model_id)
         return ref
 
 
 def _attach_done_callback(router, ref, replica) -> None:
-    """Decrement the outstanding count when the reply lands, and report
-    dead replicas to the controller (drop from routing + backfill).
-    Piggybacks on a tiny waiter thread per request — cheap at serving
-    rates compared to an RPC; replaced by completion pushes if it shows
-    up in profiles."""
+    """STREAM path only: decrement the outstanding count when the
+    stream completes, and report dead replicas to the controller (drop
+    from routing + backfill).  Unary requests ride the router's own
+    waiter, which additionally handles failover."""
     import threading
 
     import ray_tpu
@@ -207,8 +208,11 @@ def _attach_done_callback(router, ref, replica) -> None:
     def waiter():
         try:
             ray_tpu.get(ref)
-        except (exc.ActorDiedError, exc.WorkerCrashedError):
-            router.report_failure(replica)
+        except (exc.ActorDiedError, exc.WorkerCrashedError,
+                exc.ActorUnavailableError) as e:
+            # One classifier for both waiters: circuit-break locally,
+            # report only true deaths to the controller.
+            router._note_replica_failure(replica, e)
         except Exception:
             pass
         finally:

@@ -1,4 +1,5 @@
-"""Serve request router: power-of-two-choices replica scheduling.
+"""Serve request router: power-of-two-choices replica scheduling, with
+request failover and a per-replica circuit breaker.
 
 Analog of the reference's Router (serve/_private/router.py:311) +
 PowerOfTwoChoicesReplicaScheduler
@@ -13,10 +14,28 @@ Config updates arrive by PUSH: a long-poll thread parks a
 `wait_for_update` call on the controller (reference:
 serve/_private/long_poll.py:64 LongPollClient) and refreshes the
 replica list the moment the version advances — no hot-path polling.
+
+Failover (reference: the router re-scheduling requests whose replica
+died before running them): the ref a caller gets back from `assign` is
+a RELAY object, not the replica call's own return.  A per-request
+waiter bridges the attempt's outcome onto the relay — and when the
+attempt dies with a death-type error (ActorDiedError /
+WorkerCrashedError / ActorUnavailableError) whose task_started flag
+PROVES the request never began executing, it resubmits ONCE on a
+different replica first.  The caller never observes the first death;
+`get` on the relay blocks until a final outcome lands.  Started — or
+possibly-started (task_started unknown) — requests are NOT retried (a
+replay could double side effects); their death error bridges through.
+
+Circuit breaker: consecutive request failures sideline a replica
+(excluded from pick) until its next successful queue-length probe —
+router-local protection for the window before the controller's
+replacement propagates.
 """
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -27,6 +46,11 @@ from typing import Any, Dict, List, Optional
 _FALLBACK_REFRESH_S = 30.0
 # Replica queue-length probe period (correct cross-router drift).
 _PROBE_INTERVAL_S = 1.0
+# Consecutive failures before a replica is sidelined.
+_CB_THRESHOLD = 3
+# How long a failover retry waits for the controller to backfill a
+# replacement when no other replica exists yet.
+_FAILOVER_WAIT_S = 15.0
 
 
 class NoReplicasError(RuntimeError):
@@ -45,6 +69,9 @@ class Router:
         self._probed: Dict[bytes, int] = {}
         # replica -> resident multiplexed model ids (last probe)
         self._models: Dict[bytes, list] = {}
+        # circuit breaker: consecutive failures + sidelined set
+        self._failures: Dict[bytes, int] = {}
+        self._sidelined: Dict[bytes, float] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         self._last_probe = 0.0
@@ -79,6 +106,7 @@ class Router:
             self._replicas = info["replicas"]
             self._version = info["version"]
             self._last_refresh = time.time()
+            live = {r._actor_id for r in self._replicas}
             self._outstanding = {
                 r._actor_id: self._outstanding.get(r._actor_id, 0)
                 for r in self._replicas}
@@ -88,6 +116,10 @@ class Router:
             self._models = {
                 r._actor_id: self._models.get(r._actor_id, [])
                 for r in self._replicas}
+            self._failures = {k: v for k, v in self._failures.items()
+                              if k in live}
+            self._sidelined = {k: v for k, v in self._sidelined.items()
+                               if k in live}
 
     # -- long-poll push (reference: long_poll.py LongPollClient) --------
     def _ensure_poll_thread(self) -> None:
@@ -154,6 +186,9 @@ class Router:
                         self._probed[r._actor_id] = max(
                             0, int(info["qlen"]) - ours)
                         self._models[r._actor_id] = info["model_ids"]
+                # The probe doubles as the router-side health signal:
+                # a sidelined replica that answers it rejoins the pool.
+                self._record_success(r._actor_id)
 
         t = threading.Thread(target=probe, daemon=True,
                              name="rtpu-serve-probe")
@@ -161,24 +196,60 @@ class Router:
             self._probe_thread = t
         t.start()
 
+    # -- circuit breaker ------------------------------------------------
+    def _note_replica_failure(self, replica, err) -> None:
+        """THE death-vs-transient classification, shared by the unary
+        and stream waiters: every failure circuit-breaks locally;
+        only true death errors are reported to the controller.
+        ActorUnavailableError means the replica is RESTARTING —
+        reporting it would make the controller kill+backfill a
+        replica that is already coming back."""
+        from ray_tpu import exceptions as exc
+        self._record_failure(replica._actor_id)
+        if not isinstance(err, exc.ActorUnavailableError):
+            self.report_failure(replica)
+
+    def _record_failure(self, actor_id: bytes) -> None:
+        with self._lock:
+            n = self._failures.get(actor_id, 0) + 1
+            self._failures[actor_id] = n
+            if n >= _CB_THRESHOLD:
+                self._sidelined.setdefault(actor_id, time.time())
+
+    def _record_success(self, actor_id: bytes) -> None:
+        with self._lock:
+            self._failures.pop(actor_id, None)
+            self._sidelined.pop(actor_id, None)
+
     def _load(self, replica) -> int:
         k = replica._actor_id
         return self._outstanding.get(k, 0) + self._probed.get(k, 0)
 
-    def pick(self, model_id: str = ""):
+    def pick(self, model_id: str = "", exclude=()):
         """Pow-2 choice over caller-side outstanding + probed counts;
         with a multiplexed model id, replicas already holding the
-        model win (reference: multiplex-aware pow_2_scheduler)."""
+        model win (reference: multiplex-aware pow_2_scheduler).
+        Sidelined (circuit-broken) replicas are skipped unless the
+        whole pool is sidelined — degraded beats down."""
         self._refresh()
         self._maybe_probe()
+        exclude = set(exclude)
         with self._lock:
             reps = self._replicas
             if not reps:
                 raise NoReplicasError(
                     f"deployment {self._name!r} has no replicas")
-            pool = reps
+            pool = [r for r in reps if r._actor_id not in exclude]
+            if not pool:
+                raise NoReplicasError(
+                    f"deployment {self._name!r} has no replicas "
+                    f"outside the excluded set")
+            healthy = [r for r in pool
+                       if r._actor_id not in self._sidelined]
+            if healthy:
+                pool = healthy
             if model_id:
-                holders = [r for r in reps if model_id in
+                holders = [r for r in pool if model_id in
                            self._models.get(r._actor_id, ())]
                 if holders:
                     pool = holders
@@ -197,24 +268,206 @@ class Router:
             if self._outstanding.get(k, 0) > 0:
                 self._outstanding[k] -= 1
 
+    # -- request assignment + failover ----------------------------------
     def assign(self, method: str, args: tuple, kwargs: dict,
                model_id: str = ""):
-        """Submit one request; returns (ObjectRef, replica).  The span
+        """Submit one request; returns (ObjectRef, replica).  The ref
+        is a RELAY object: the per-request waiter bridges the replica
+        call's outcome onto it, retrying an un-started request once on
+        a different replica when the first assignment dies.  The span
         covers replica choice + submission, and the actor-call spec
         inherits its trace context — the cross-process link between
         the proxy's root span and the replica's execute span."""
+        from ray_tpu._private.chaos import chaos
+        from ray_tpu.object_ref import ObjectRef
         from ray_tpu.util import profiling
         with profiling.span("router.assign", deployment=self._name,
                             method=method):
+            relay = os.urandom(16)
+            # ONE shared ObjectRef instance for the caller AND the
+            # waiter closure: its GC-time remove_ref must fire after
+            # BOTH are done with it.  A caller-only ref dropped before
+            # the bridge would decref a not-yet-existing entry (no-op)
+            # and the bridged response would then be pinned node-side
+            # forever.
+            relay_ref = ObjectRef(relay, owned=True)
             replica = self.pick(model_id)
+            self._maybe_chaos_kill(chaos, replica)
             ref = replica.handle_request.remote(method, args, kwargs,
                                                 model_id)
-        return ref, replica
+        self._watch(relay_ref, ref, replica, method, args, kwargs,
+                    model_id)
+        return relay_ref, replica
+
+    @staticmethod
+    def _maybe_chaos_kill(chaos, replica) -> None:
+        """Chaos kind=kill_replica at site 'serve.assign': kill the
+        replica the router just picked, so the request lands on a dead
+        actor and must fail over."""
+        if not chaos.fire("serve.assign", "kill_replica"):
+            return
+        try:
+            import ray_tpu
+            ray_tpu.kill(replica)
+        except Exception:
+            pass
+
+    def _watch(self, relay_ref, ref, replica, method: str,
+               args: tuple, kwargs: dict, model_id: str) -> None:
+        """Per-request waiter thread: awaits the attempt, retries an
+        un-started request once on another replica, and bridges the
+        final outcome (value or error) onto the relay object.  One
+        short-lived thread per request — same cost shape as the old
+        done-callback waiter, now also carrying the failover.  The
+        closure's hold on `relay_ref` keeps the relay's GC decref
+        ordered after the bridge (see assign)."""
+        relay = relay_ref.binary()
+
+        def waiter() -> None:
+            import ray_tpu
+            from ray_tpu import exceptions as exc
+            from ray_tpu._private.client import get_global_client
+            _pin = relay_ref     # hold until the bridge lands
+            attempt_ref, attempt_replica = ref, replica
+            failed_ids: set = set()
+            for attempt in range(2):
+                try:
+                    ray_tpu.wait([attempt_ref], timeout=None)
+                    # Fast path: alias the completed inline outcome
+                    # onto the relay NODE-SIDE — the response payload
+                    # never re-enters this process (no deserialize +
+                    # reserialize on the serving hot path).  A failure
+                    # of this control rpc must NOT become the
+                    # request's outcome: the result is sitting READY
+                    # in the store — fall through and read it.
+                    rep = {}
+                    try:
+                        client = get_global_client()
+                        if client is not None:
+                            rep = client.conn.call(
+                                {"type": "relay_result",
+                                 "src": attempt_ref.binary(),
+                                 "dst": relay})
+                    except Exception:
+                        rep = {}
+                    if rep.get("done"):
+                        self.done(attempt_replica)
+                        self._record_success(attempt_replica._actor_id)
+                        return
+                    # Error outcome (classify below) or shm-sized
+                    # value (bridge by value — rare for serve).
+                    value = ray_tpu.get(attempt_ref)
+                except (exc.ActorDiedError, exc.WorkerCrashedError,
+                        exc.ActorUnavailableError) as e:
+                    self.done(attempt_replica)
+                    self._note_replica_failure(attempt_replica, e)
+                    if not isinstance(e, exc.ActorUnavailableError):
+                        # A restarting (unavailable) replica keeps its
+                        # actor id and is NOT excluded from the retry
+                        # pick: the resubmission queues on it and runs
+                        # once it's back.  Dead replicas are excluded.
+                        failed_ids.add(attempt_replica._actor_id)
+                    # Retry ONLY a provably un-started request
+                    # (task_started is False).  None means unknown —
+                    # e.g. a node-death ActorDiedError where the
+                    # request may have been mid-execution with side
+                    # effects already emitted; re-running it could
+                    # double them.
+                    started = getattr(e, "task_started", None)
+                    if attempt == 0 and started is False:
+                        nxt = self._pick_for_failover(failed_ids,
+                                                      model_id)
+                        if nxt is not None:
+                            self._count_failover()
+                            try:
+                                attempt_ref = \
+                                    nxt.handle_request.remote(
+                                        method, args, kwargs,
+                                        model_id)
+                            except Exception:
+                                # Resubmit itself failed (replica torn
+                                # down in the window): the relay MUST
+                                # still resolve.
+                                self.done(nxt)
+                                self._bridge(relay, e, as_error=True)
+                                return
+                            attempt_replica = nxt
+                            continue
+                    self._bridge(relay, e, as_error=True)
+                    return
+                except BaseException as e:  # noqa: BLE001
+                    # Application error (or shutdown): no failover —
+                    # surface it to the caller unchanged.
+                    self.done(attempt_replica)
+                    self._bridge(relay, e, as_error=True)
+                    return
+                else:
+                    self.done(attempt_replica)
+                    self._record_success(attempt_replica._actor_id)
+                    self._bridge(relay, value, as_error=False)
+                    return
+
+        threading.Thread(target=waiter, daemon=True,
+                         name="rtpu-serve-request").start()
+
+    def _pick_for_failover(self, exclude: set, model_id: str):
+        """Pick a retry replica, waiting briefly for the controller to
+        backfill when the dead one was the only replica."""
+        deadline = time.time() + _FAILOVER_WAIT_S
+        while time.time() < deadline and not self._closed.is_set():
+            try:
+                return self.pick(model_id, exclude=exclude)
+            except NoReplicasError:
+                pass
+            except Exception:
+                return None
+            try:
+                self._refresh(force=True)
+            except Exception:
+                pass
+            if self._closed.wait(0.2):
+                return None
+        return None
+
+    @staticmethod
+    def _count_failover() -> None:
+        try:
+            from ray_tpu.util.metrics import (TASK_RETRIES_METRIC,
+                                              shared_counter)
+            shared_counter(
+                TASK_RETRIES_METRIC,
+                description="task retries, by failure reason",
+                tag_keys=("reason",)).inc(
+                    tags={"reason": "serve_failover"})
+        except Exception:
+            pass
+
+    def _bridge(self, relay: bytes, outcome, as_error: bool) -> None:
+        """Publish the final outcome under the relay object id.  The
+        relay MUST resolve or its reader hangs forever: a failed value
+        publish (store full, unserializable response) degrades to
+        publishing that failure as the relay's error instead."""
+        from ray_tpu._private.client import get_global_client
+        client = get_global_client()
+        if client is None:
+            return      # session gone: nobody is left to read the relay
+        try:
+            client.put_with_id(relay, outcome, as_error=as_error)
+            return
+        except Exception as publish_err:
+            if as_error:
+                return  # error publish failed: connection is gone
+            fallback = publish_err
+        try:
+            client.put_with_id(relay, fallback, as_error=True)
+        except Exception:
+            pass
 
     def assign_stream(self, method: str, args: tuple, kwargs: dict):
         """Submit one STREAMING request; returns (ObjectRefGenerator,
         replica).  Items ride the core streaming-generator plane
-        (reference: streaming replica calls, proxy.py:779)."""
+        (reference: streaming replica calls, proxy.py:779).  No
+        failover: a partially-consumed stream must not replay."""
         from ray_tpu.util import profiling
         with profiling.span("router.assign", deployment=self._name,
                             method=method, stream=True):
@@ -235,7 +488,10 @@ class Router:
         with self._lock:
             self._replicas = [r for r in self._replicas
                               if r._actor_id != replica._actor_id]
-        self._refresh(force=True)
+        try:
+            self._refresh(force=True)
+        except Exception:
+            pass
 
     def close(self) -> None:
         self._closed.set()

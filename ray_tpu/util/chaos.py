@@ -1,0 +1,80 @@
+"""Public runtime surface of the chaos-injection subsystem.
+
+Thin wrapper over ``ray_tpu._private.chaos`` (the seeded schedule) plus
+the node-service hooks that need a live session.  Unlike the frozen
+env-spec of the original ``protocol._Chaos``, faults can be armed and
+cleared at runtime::
+
+    from ray_tpu.util import chaos
+
+    chaos.inject("dispatch", kind="kill_worker", n=1)   # next dispatch
+    chaos.inject("get_objects", kind="drop", p=0.2, n=5)
+    ...
+    chaos.clear()
+    print(chaos.trace())     # [(seq, site, kind), ...] — replay witness
+
+State is per-process: single-node, the node service runs inside the
+driver, so driver-side ``inject()`` drives node-level faults directly.
+Workers inherit the env/config spec (``RAY_TPU_CHAOS_SPEC`` +
+``RAY_TPU_CHAOS_SEED``) at spawn.  See ``_private/chaos.py`` for the
+spec grammar and fault-kind semantics; ``ray_tpu chaos`` validates a
+spec from the CLI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.chaos import FAULT_KINDS, chaos as _chaos
+from ray_tpu._private.chaos import parse_spec  # noqa: F401  (CLI/tests)
+
+__all__ = ["inject", "clear", "trace", "reset_trace", "refresh",
+           "describe", "evict_object", "parse_spec", "FAULT_KINDS"]
+
+
+def inject(site: str, kind: str = "error", p: float = 1.0, n: int = -1,
+           lo_ms: float = 0.0, hi_ms: float = 0.0,
+           node: str = "") -> None:
+    """Arm a fault at runtime (this process).  Raises ValueError for an
+    invalid kind/probability/bounds combination."""
+    _chaos.inject(site, kind=kind, p=p, n=n, lo_ms=lo_ms, hi_ms=hi_ms,
+                  node=node)
+
+
+def clear(site: Optional[str] = None) -> None:
+    """Disarm runtime-injected faults (all of them, or one site's)."""
+    _chaos.clear(site)
+
+
+def trace() -> List[Tuple[int, str, str]]:
+    """The injected-fault trace: [(seq, site, kind), ...].  Two runs of
+    one workload with the same ``chaos_seed`` produce identical
+    traces — assert equality to prove a failure schedule replays."""
+    return _chaos.trace()
+
+
+def reset_trace() -> None:
+    _chaos.reset_trace()
+
+
+def refresh() -> None:
+    """Force immediate re-resolution of the env/config schedule (it is
+    otherwise re-checked lazily, at most every 250 ms)."""
+    _chaos.refresh()
+
+
+def describe() -> List[Dict[str, Any]]:
+    """The currently-armed fault specs (env/config + runtime)."""
+    return _chaos.describe()
+
+
+def evict_object(ref) -> bool:
+    """Evict a READY object's shm payload from the local store while
+    keeping its directory entry — the store-eviction fault, aimed at
+    one object.  The next reader hits the lineage-reconstruction path
+    (``node_objects._try_reconstruct``).  Returns False when the object
+    is not eligible (not READY, not in shm, or has no lineage)."""
+    import ray_tpu
+    client = ray_tpu._ensure_connected()
+    return bool(client.conn.call({"type": "chaos_evict",
+                                  "object_id": ref.binary()})["ok"])

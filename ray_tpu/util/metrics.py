@@ -34,6 +34,14 @@ TASK_STAGE_METRIC = "ray_tpu_task_stage_duration_seconds"
 TASK_STAGE_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
                       1.0, 5.0, 30.0)
 
+# Retry/fault counters, auto-registered node-side like the stage
+# histograms (no user code needed for a Prometheus scrape to show
+# them).  Tags: reason = worker_crash | node_death | app_error |
+# actor_restart | serve_failover; kind = the injected fault kind.
+TASK_RETRIES_METRIC = "ray_tpu_task_retries_total"
+ACTOR_RESTARTS_METRIC = "ray_tpu_actor_restarts_total"
+CHAOS_INJECTED_METRIC = "ray_tpu_chaos_injected_total"
+
 _lock = threading.RLock()
 _registry: List["_Metric"] = []
 _flusher_started = False
@@ -207,6 +215,24 @@ class Histogram(_Metric):
                                 "description": self.description})
                     self._cells[ts] = self._new_cell()
         return out
+
+
+_shared_counters: Dict[Tuple[str, Tuple[str, ...]], "Counter"] = {}
+
+
+def shared_counter(name: str, description: str = "",
+                   tag_keys: Sequence[str] = ()) -> "Counter":
+    """Process-wide singleton Counter by (name, tag_keys) — for runtime
+    subsystems (chaos injector, Serve router) that bump a counter from
+    arbitrary call sites without each reinventing a lazy global."""
+    key = (name, tuple(tag_keys))
+    with _lock:
+        c = _shared_counters.get(key)
+        if c is None:
+            c = Counter(name, description=description,
+                        tag_keys=tag_keys)
+            _shared_counters[key] = c
+        return c
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,30 @@
+"""RT008 negative: retry-enabled pure bodies; submitting bodies
+without app-level retry; deliberate opt-out."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def child(x):
+    return x + 1
+
+
+@ray_tpu.remote(retry_exceptions=True)
+def pure(x):
+    return x * 2                 # no submissions: retry is safe
+
+
+@ray_tpu.remote(retry_exceptions=[ValueError])
+def also_pure(x):
+    return {"v": x}
+
+
+@ray_tpu.remote
+def fan_out(xs):
+    refs = [child.remote(x) for x in xs]   # no retry_exceptions: fine
+    return refs
+
+
+@ray_tpu.remote(retry_exceptions=True)
+def deliberate(xs):
+    refs = [child.remote(x) for x in xs]   # ray-tpu: noqa[RT008]
+    return refs

@@ -1,0 +1,29 @@
+"""RT008 positive: app-level retry re-runs non-idempotent bodies."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def child(x):
+    return x + 1
+
+
+@ray_tpu.remote(retry_exceptions=True)
+def fan_out(xs):
+    refs = [child.remote(x) for x in xs]     # RT008: re-submitted on retry
+    return refs
+
+
+@ray_tpu.remote(retry_exceptions=[ValueError])
+def stores(x):
+    ref = ray_tpu.put(x)                     # RT008: re-stored on retry
+    return ref
+
+
+@ray_tpu.remote
+def later_flagged(xs):
+    refs = [child.remote(x) for x in xs]     # RT008 via .options below
+    return refs
+
+
+def submit(xs):
+    return later_flagged.options(retry_exceptions=True).remote(xs)

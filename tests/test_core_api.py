@@ -170,6 +170,10 @@ def test_parallelism(ray_start):
         time.sleep(t)
         return 1
 
+    # Prewarm the pool: worker cold-start is ~0.4s each on a loaded
+    # 1-core box, which is spawn latency, not (this test's subject)
+    # execution overlap.
+    ray_tpu.get([block.remote(0.01) for _ in range(4)])
     t0 = time.time()
     ray_tpu.get([block.remote(1.0) for _ in range(4)])
     # 4 one-second sleeps across 4 CPUs should overlap.

@@ -467,3 +467,88 @@ def test_user_config_reconfigure_without_restart(serve_session):
 
     with __import__("pytest").raises(ValueError):
         serve.run(NoReconf.bind(), name="noreconf")
+
+
+def test_router_failover_unstarted_requests(serve_session):
+    """Requests assigned to a replica that dies before running them
+    fail over (retry on another replica / after backfill) with zero
+    user-visible errors — only the poison call itself (which STARTED)
+    may surface an error."""
+    from ray_tpu import exceptions as exc
+
+    @serve.deployment(num_replicas=2)
+    class S:
+        def pid(self):
+            return os.getpid()
+
+        def boom(self):
+            os._exit(1)
+
+    h = serve.run(S)
+    assert ray_tpu.get(h.method("pid").remote(), timeout=60) > 0
+    # Kill one replica OUT FROM UNDER the router (no_restart): requests
+    # routed to it before the refresh land on a dead actor.
+    import ray_tpu as rt
+    controller = rt.get_actor("SERVE_CONTROLLER")
+    replicas = rt.get(controller.get_replicas.remote("S"),
+                      timeout=30)["replicas"]
+    rt.kill(replicas[0], no_restart=True)
+    refs = [h.method("pid").remote() for _ in range(8)]
+    pids = [ray_tpu.get(r, timeout=60) for r in refs]
+    assert all(p > 0 for p in pids)
+
+
+def test_router_circuit_breaker_sidelines_replica():
+    """Unit: consecutive failures sideline a replica from pick() until
+    a successful probe; an all-sidelined pool still serves."""
+    import time as _time
+    import types
+
+    from ray_tpu.serve import _router
+
+    r = _router.Router("unit")
+    a = types.SimpleNamespace(_actor_id=b"a")
+    b = types.SimpleNamespace(_actor_id=b"b")
+    r._replicas = [a, b]
+    r._last_refresh = _time.time()     # fresh: no controller round-trip
+    r._last_probe = _time.time()       # suppress the probe thread
+    for _ in range(_router._CB_THRESHOLD):
+        r._record_failure(b"a")
+    assert b"a" in r._sidelined
+    picked = {r.pick()._actor_id for _ in range(20)}
+    for _ in range(20):
+        r.done(b)
+    assert picked == {b"b"}
+    # Successful probe resurrects it.
+    r._record_success(b"a")
+    assert b"a" not in r._sidelined
+    # Whole pool sidelined -> fall back to serving everything.
+    for _ in range(_router._CB_THRESHOLD):
+        r._record_failure(b"a")
+        r._record_failure(b"b")
+    assert {r.pick()._actor_id for _ in range(20)} <= {b"a", b"b"}
+
+
+def test_actor_unavailable_counts_as_transient():
+    """The router's shared failure classifier: ActorUnavailableError
+    from a restarting replica circuit-breaks locally but must NOT
+    report the replica dead to the controller (no kill+backfill for a
+    transient); true death errors do both."""
+    import types
+
+    from ray_tpu import exceptions as exc
+    from ray_tpu.serve import _router
+
+    r = _router.Router("unit2")
+    calls = []
+    r.report_failure = lambda replica: calls.append(replica._actor_id)
+    rep = types.SimpleNamespace(_actor_id=b"x")
+
+    r._note_replica_failure(rep, exc.ActorUnavailableError(
+        "x", "restarting", task_started=True))
+    assert calls == []                      # transient: no report
+    assert r._failures.get(b"x") == 1       # but circuit-break counted
+
+    r._note_replica_failure(rep, exc.ActorDiedError("x", "gone"))
+    assert calls == [b"x"]                  # death: reported
+    assert r._failures.get(b"x") == 2
