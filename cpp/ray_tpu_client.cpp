@@ -333,6 +333,14 @@ struct Reader {
           stack.push_back(Value::bytes(q, n));
           break;
         }
+        case 0x96: {  // BYTEARRAY8 (protocol 5) — the node ships
+          // bytearray-backed payloads on zero-copy paths; decode
+          // them exactly like bytes.
+          uint64_t n = u64();
+          const uint8_t *q = take(n);
+          stack.push_back(Value::bytes(q, n));
+          break;
+        }
         case ']':
           stack.push_back(Value::list({}));
           break;

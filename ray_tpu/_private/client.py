@@ -145,7 +145,7 @@ class CoreClient:
         if (self.store is None
                 or s.total_size <= config.max_direct_call_object_size):
             send({"type": "put_object", "object_id": oid,
-                  "loc": "inline", "data": s.to_bytes(),
+                  "loc": "inline", "data": s.to_buffer(),
                   "size": s.total_size, "embedded": embedded})
             return
         buf = self._create_in_store(ObjectID(oid), s.total_size)
@@ -410,7 +410,7 @@ class CoreClient:
             (positional, ref_slots, list(kw_refs.items()), plain_kwargs))
         all_embedded.extend(embedded)
         if s.total_size <= config.inline_small_args_size:
-            packed.insert(0, ("inline", s.to_bytes()))
+            packed.insert(0, ("inline", s.to_buffer()))
         else:
             oid = ObjectID.from_random()
             self._store_arg_blob(oid, s)
@@ -467,7 +467,7 @@ class CoreClient:
         """Returns (oid, loc, data, size, embedded_refs) for task_done."""
         s, embedded = self.serialize_with_refs(value)
         if s.total_size <= config.max_direct_call_object_size:
-            return (oid, "inline", s.to_bytes(), s.total_size, embedded)
+            return (oid, "inline", s.to_buffer(), s.total_size, embedded)
         obj = ObjectID(oid)
         try:
             buf = self._create_in_store(obj, s.total_size)
@@ -483,7 +483,7 @@ class CoreClient:
             os.makedirs(spill_dir, exist_ok=True)
             path = os.path.join(spill_dir, oid.hex())
             with open(path, "wb") as f:
-                f.write(s.to_bytes())
+                f.write(s.to_buffer())
             return (oid, "spilled", path.encode(), s.total_size,
                     embedded)
         except FileExistsError:
@@ -768,7 +768,7 @@ class RemoteCoreClient(CoreClient):
         oid = ObjectID.from_random()
         self.conn.notify({"type": "put_object",
                           "object_id": oid.binary(),
-                          "loc": "inline", "data": s.to_bytes(),
+                          "loc": "inline", "data": s.to_buffer(),
                           "size": s.total_size, "embedded": embedded})
         return ObjectRef(oid.binary(), owned=True)
 
@@ -777,7 +777,7 @@ class RemoteCoreClient(CoreClient):
         # live in the node's directory like thin-client put()s.
         self.conn.notify({"type": "put_object",
                           "object_id": oid.binary(),
-                          "loc": "inline", "data": s.to_bytes(),
+                          "loc": "inline", "data": s.to_buffer(),
                           "size": s.total_size, "embedded": []})
 
     def _materialize(self, oid: bytes, loc: str,

@@ -161,6 +161,29 @@ _D("max_object_reconstructions", int, 3,
 _D("object_transfer_chunk_bytes", int, 4 * 1024 * 1024,
    "Chunk size for inter-node object transfer (reference: "
    "object_manager_default_chunk_size, 5 MiB).")
+_D("object_transfer_window", int, 8,
+   "Outstanding chunk requests pipelined per transfer stream "
+   "(reference: object_manager_max_bytes_in_flight role).  <=1 falls "
+   "back to stop-and-wait chunk RPCs over the control connection.")
+_D("object_transfer_parallelism", int, 4,
+   "Max concurrent source nodes for a range-split parallel fetch of "
+   "one large object.")
+_D("object_transfer_multisource_min_bytes", int, 16 * 1024 * 1024,
+   "Objects at least this large with multiple holders are fetched as "
+   "contiguous ranges from several holders in parallel.")
+_D("object_pull_workers", int, 8,
+   "Bounded worker pool for the object pull manager (replaces "
+   "thread-per-object pulls; reference: pull_manager.h request "
+   "pipelining).")
+_D("locality_spill_threshold_bytes", int, 1024 * 1024,
+   "A queued task whose locally-resident dependency bytes reach this "
+   "threshold (and dominate every candidate peer's resident bytes) "
+   "briefly waits for local capacity instead of spilling to a "
+   "dependency-less node (reference: locality-aware spillback in "
+   "cluster_task_manager).")
+_D("locality_spill_wait_s", float, 1.0,
+   "How long a locality-dominant task waits for local capacity before "
+   "spilling anyway.")
 
 # ---------------------------------------------------------------------------
 # TPU / mesh execution layer

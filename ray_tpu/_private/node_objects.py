@@ -13,21 +13,40 @@ NodeService instance.
 
 from __future__ import annotations
 
+import heapq
 import os
 import queue
+import socket as _socket
+import struct
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization as ser
+from ray_tpu._private.chaos import chaos
 from ray_tpu._private.config import config
+from ray_tpu._private.protocol import (
+    ConnectionLost, TRANSFER_ERR, TRANSFER_MAGIC, TRANSFER_REQ,
+    TRANSFER_RESP, _recv_exact, connect_tcp, recv_exact_into)
 from ray_tpu import exceptions as exc
 from ray_tpu._private.node_state import (
     FAILED, ObjectEntry, PENDING, READY, TaskRecord, _ConnCtx, _OID)
 
 
+class _TransferConnectError(ConnectionLost):
+    """The peer's transfer listener did not accept a TCP connection
+    (the control plane may still work — callers can degrade)."""
+
+
 class ObjectPlaneMixin:
-    # -- object pull manager (reference: pull_manager.h:52) ----------------
+    # ------------------------------------------------------------------
+    # object pull manager (reference: pull_manager.h:52) — a bounded
+    # worker pool consuming a due-time heap of pull attempts.  An
+    # attempt that can't finish yet (no locations, holder unreachable)
+    # requeues itself with a short delay instead of parking a thread,
+    # so the pool never starves on not-yet-produced objects.
+    # ------------------------------------------------------------------
     def _ensure_pull(self, oid: bytes) -> None:
         """Start pulling an object that lives (or will live) on another
         node.  Caller holds self.lock."""
@@ -42,97 +61,209 @@ class ObjectPlaneMixin:
         if oid in self._pulls_inflight:
             return
         self._pulls_inflight.add(oid)
-        t = threading.Thread(target=self._pull_object, args=(oid,),
-                             daemon=True, name="rtpu-pull")
-        self._pull_threads.append(t)
-        if len(self._pull_threads) > 32:
-            self._pull_threads = [x for x in self._pull_threads
-                                  if x.is_alive()]
-        t.start()
+        self._pull_submit(oid, 0.0)
 
-    def _pull_object(self, oid: bytes) -> None:
-        evt = threading.Event()
-        last_event: Dict[str, dict] = {}
+    def _pull_submit(self, oid: bytes, delay: float) -> None:
+        """Queue a pull attempt.  Takes only _pull_cond (safe from GCS
+        push threads and under self.lock)."""
+        due = time.time() + delay
+        with self._pull_cond:
+            if self._shutdown:
+                return
+            cur = self._pull_due.get(oid)
+            if cur is not None and cur <= due:
+                return      # an equal-or-earlier attempt is queued
+            self._pull_due[oid] = due
+            self._pull_seq += 1
+            heapq.heappush(self._pull_heap,
+                           (due, self._pull_seq, oid))
+            limit = max(1, config.object_pull_workers)
+            # Grow the pool while queued attempts outnumber idle
+            # workers (idle == 0 alone would leave a burst of pulls
+            # draining near-serially behind one parked worker).
+            if (len(self._pull_threads) < limit
+                    and len(self._pull_heap) > self._pull_idle):
+                t = threading.Thread(target=self._pull_pool_loop,
+                                     daemon=True, name="rtpu-pull")
+                self._pull_threads.append(t)
+                t.start()
+            self._pull_cond.notify()
 
-        def on_loc(o, e):
-            last_event["evt"] = e
-            evt.set()
+    def _pull_pool_loop(self) -> None:
+        while True:
+            oid = None
+            with self._pull_cond:
+                while oid is None:
+                    if self._shutdown:
+                        return
+                    now = time.time()
+                    if self._pull_heap and self._pull_heap[0][0] <= now:
+                        due, _, cand = heapq.heappop(self._pull_heap)
+                        if self._pull_due.get(cand) != due:
+                            continue    # superseded duplicate entry
+                        del self._pull_due[cand]
+                        if cand in self._pull_running:
+                            continue    # runner requeues as needed
+                        self._pull_running.add(cand)
+                        oid = cand
+                        break
+                    timeout = (self._pull_heap[0][0] - now
+                               if self._pull_heap else 0.5)
+                    self._pull_idle += 1
+                    self._pull_cond.wait(timeout)
+                    self._pull_idle -= 1
+            done = True
+            try:
+                done = self._pull_attempt(oid)
+            except Exception:
+                done = False
+            finally:
+                with self._pull_cond:
+                    self._pull_running.discard(oid)
+            if done:
+                self._pull_finish(oid)
+            else:
+                self._pull_submit(oid, 0.4)
 
-        subscribed = False
-        try:
+    def _pull_attempt(self, oid: bytes) -> bool:
+        """One pull round; True when the pull is finished (object
+        registered, failed, or cancelled), False to retry later."""
+        st = self._pull_state.get(oid)
+        if st is None:
+            st = {"last_event": None, "subscribed": False, "cb": None}
+
+            def on_loc(o, evt, _st=st):
+                _st["last_event"] = evt
+                self._pull_submit(oid, 0.0)   # expedite the next round
+
+            st["cb"] = on_loc
+            self._pull_state[oid] = st
             try:
                 self.gcs.sub_location(oid, on_loc)
-                subscribed = True
+                st["subscribed"] = True
             except Exception:
                 pass
-            while not self._shutdown:
-                with self.lock:
-                    if oid in self._cancelled_pulls:
-                        return   # local entry deleted mid-pull
-                    ent = self.objects.get(oid)
-                    if ent is not None and ent.state in (READY, FAILED):
-                        return
-                try:
-                    locs = self.gcs.get_locations(oid)
-                except Exception:
-                    time.sleep(0.2)
-                    continue
-                kind = locs.get("kind")
-                if kind in ("inline", "error"):
-                    data = locs["data"]
-                    with self.lock:
-                        self._register_object(
-                            oid, "inline" if kind == "inline" else "error",
-                            data, len(data),
-                            state=READY if kind == "inline" else FAILED,
-                            foreign=True)
-                        self._schedule()
-                    return
-                done = False
-                for n in locs.get("nodes", ()):
-                    if n["node_id"] == self.node_id:
-                        continue
-                    if self._fetch_from(oid, n, locs.get("size", 0)):
-                        done = True
-                        break
-                if done:
-                    return
-                evt.clear()
-                evt.wait(timeout=0.5)
-                le = last_event.get("evt")
-                if le is not None and le.get("kind") == "lost":
-                    last_event.pop("evt", None)
-                    with self.lock:
-                        # Lineage first: recompute rather than fail
-                        # (reference: object_recovery_manager ladder).
-                        # KEEP PULLING afterwards: this thread is still
-                        # registered in _pulls_inflight, so exiting here
-                        # would block the re-arm and strand the waiters
-                        # (recomputation may land on a peer node and
-                        # come back through the location directory).
-                        if self._try_reconstruct(oid):
-                            continue
-                        blob = ser.dumps(exc.ObjectLostError(
-                            oid.hex(), "all copies lost (node died)"))
-                        self._register_object(oid, "error", blob,
-                                              len(blob), state=FAILED,
-                                              foreign=True)
-                        self._schedule()
-                    return
-        finally:
-            if subscribed:
-                try:
-                    self.gcs.unsub_location(oid, on_loc)
-                except Exception:
-                    pass
+        with self.lock:
+            if oid in self._cancelled_pulls or self._shutdown:
+                return True   # local entry deleted mid-pull
+            ent = self.objects.get(oid)
+            if ent is not None and ent.state in (READY, FAILED):
+                return True
+        try:
+            locs = self.gcs.get_locations(oid)
+        except Exception:
+            return False
+        size = locs.get("size", 0)
+        nodes = locs.get("nodes") or []
+        self._cache_locations(oid, nodes, size)
+        kind = locs.get("kind")
+        if kind in ("inline", "error"):
+            data = locs["data"]
             with self.lock:
-                self._pulls_inflight.discard(oid)
-                self._cancelled_pulls.discard(oid)
+                self._register_object(
+                    oid, "inline" if kind == "inline" else "error",
+                    data, len(data),
+                    state=READY if kind == "inline" else FAILED,
+                    foreign=True)
+                self._schedule()
+            return True
+        holders = [n for n in nodes if n["node_id"] != self.node_id]
+        # Deterministic order, recently-failing holders last (two
+        # mid-transfer strikes prune a holder from the GCS entirely).
+        holders.sort(key=lambda n: (
+            self._holder_strikes.get((oid, n["node_id"]), 0),
+            n["node_id"].hex()))
+        if holders:
+            if (len(holders) > 1
+                    and size >= config.object_transfer_multisource_min_bytes
+                    and config.object_transfer_parallelism > 1
+                    and config.object_transfer_window > 1):
+                if self._fetch_multi(oid, holders, size):
+                    return True
+            for n in holders:
+                if self._fetch_from(oid, n, size):
+                    return True
+        le = st.get("last_event")
+        if le is not None and le.get("kind") == "lost":
+            st["last_event"] = None
+            with self.lock:
+                # Lineage first: recompute rather than fail (reference:
+                # object_recovery_manager ladder).  KEEP PULLING after a
+                # successful re-arm — the pull stays registered in
+                # _pulls_inflight, and the recomputation may land on a
+                # peer node and come back through the directory.
+                if self._try_reconstruct(oid):
+                    return False
+                blob = ser.dumps(exc.ObjectLostError(
+                    oid.hex(), "all copies lost (node died)"))
+                self._register_object(oid, "error", blob,
+                                      len(blob), state=FAILED,
+                                      foreign=True)
+                self._schedule()
+            return True
+        return False
 
+    def _pull_finish(self, oid: bytes) -> None:
+        st = self._pull_state.pop(oid, None)
+        if st is not None and st.get("subscribed"):
+            try:
+                self.gcs.unsub_location(oid, st["cb"])
+            except Exception:
+                pass
+        with self.lock:
+            self._pulls_inflight.discard(oid)
+            self._cancelled_pulls.discard(oid)
+            # In-place deletion (not a rebound filtered copy): strike
+            # writers in other pull/range threads must never land in a
+            # stale dict object.
+            for k in [k for k in self._holder_strikes if k[0] == oid]:
+                del self._holder_strikes[k]
+
+    def _cache_locations(self, oid: bytes, nodes: List[dict],
+                         size: int) -> None:
+        """Remember who holds an object (feeds locality-aware spillback
+        scoring without a GCS round-trip under the lock)."""
+        holders = frozenset(n["node_id"] for n in nodes)
+        self._obj_loc_cache[oid] = (holders, size)
+        if len(self._obj_loc_cache) > 4096:
+            for k in list(self._obj_loc_cache)[:2048]:
+                self._obj_loc_cache.pop(k, None)
+
+    def _note_holder_failure(self, oid: bytes, nid: bytes) -> None:
+        """A holder failed MID-transfer (meta said found, stream or
+        chunk reads then broke): deprioritize it, and after two
+        consecutive strikes prune it from the GCS holder set like a
+        not-found holder.  The LAST known holder is never pruned — the
+        failure may be local (seal error, congested control plane),
+        and dropping the sole location would turn a recoverable retry
+        into a permanent hang (no 'lost' event ever fires)."""
+        key = (oid, nid)
+        with self.lock:
+            n = self._holder_strikes.get(key, 0) + 1
+            self._holder_strikes[key] = n
+            cached = self._obj_loc_cache.get(oid)
+            others = (len(cached[0] - {nid, self.node_id})
+                      if cached is not None else 0)
+        if n >= 2 and others > 0:
+            try:
+                self.gcs.remove_location(oid, nid)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # inter-node transfer, fetch side (reference: object_manager.h
+    # chunked pulls).  Default path: raw binary chunk streams over the
+    # holder's dedicated transfer listener, a window of
+    # config.object_transfer_window outstanding requests, payloads
+    # received straight into the pre-allocated shm buffer (recv_into —
+    # zero intermediate copies).  window<=1 degrades to the legacy
+    # stop-and-wait chunk RPCs on the control connection.
+    # ------------------------------------------------------------------
     def _fetch_from(self, oid: bytes, ninfo: dict, size: int) -> bool:
-        """Chunked fetch of one object from a holder node into the local
-        store.  Returns True once the object is registered locally."""
+        """Fetch one object from a holder node into the local store.
+        Returns True once the object is registered locally."""
         from ray_tpu._private.ids import ObjectID
+        nid = ninfo["node_id"]
         try:
             conn = self._peer_conn_to(ninfo)
             meta = conn.call({"type": "fetch_object_meta",
@@ -143,7 +274,7 @@ class ObjectPlaneMixin:
             # Stale holder (replica evicted/freed): prune it so later
             # pulls of this object skip the dead end.
             try:
-                self.gcs.remove_location(oid, ninfo["node_id"])
+                self.gcs.remove_location(oid, nid)
             except Exception:
                 pass
             return False
@@ -167,23 +298,213 @@ class ObjectPlaneMixin:
             return True     # a concurrent pull beat us to it
         except Exception:
             return False    # store full — retry after eviction
+        path = "stream"
+        t0 = time.perf_counter()
         try:
             if meta.get("data") is not None:
+                path = "rpc"        # small object: rode the meta reply
                 buf[:total] = meta["data"]
+            elif (config.object_transfer_window > 1
+                    and self._streamable(ninfo)):
+                try:
+                    self._stream_once(ninfo, oid, 0, total, buf)
+                except _TransferConnectError:
+                    # Transfer listener unreachable but the control
+                    # conn works: degrade to stop-and-wait RPCs.
+                    path = "rpc"
+                    self._fetch_chunks_rpc(conn, oid, buf, total)
             else:
-                chunk = config.object_transfer_chunk_bytes
-                off = 0
-                while off < total:
-                    r = conn.call({"type": "fetch_object_chunk",
-                                   "object_id": oid, "offset": off,
-                                   "length": min(chunk, total - off)},
-                                  timeout=60.0)
-                    d = r.get("data")
-                    if not d:
-                        store.abort(obj)
-                        return False
-                    buf[off:off + len(d)] = d
-                    off += len(d)
+                path = "rpc"
+                self._fetch_chunks_rpc(conn, oid, buf, total)
+            store.seal(obj)
+        except Exception:
+            self._note_holder_failure(oid, nid)
+            try:
+                store.abort(obj)
+            except Exception:
+                pass
+            return False
+        self._holder_strikes.pop((oid, nid), None)
+        self._record_transfer(total, time.perf_counter() - t0, path)
+        with self.lock:
+            self._register_object(oid, "shm", None, total,
+                                  creator_pid=os.getpid(), foreign=True)
+            self._schedule()
+        return True
+
+    def _fetch_chunks_rpc(self, conn, oid: bytes, buf, total: int
+                          ) -> None:
+        """Legacy stop-and-wait chunk fetch over the control connection
+        (one pickled request/reply RTT per chunk) — the window<=1 /
+        no-transfer-listener fallback, and the baseline the
+        object_transfer microbench compares against."""
+        chunk = config.object_transfer_chunk_bytes
+        off = 0
+        while off < total:
+            r = conn.call({"type": "fetch_object_chunk",
+                           "object_id": oid, "offset": off,
+                           "length": min(chunk, total - off)},
+                          timeout=60.0)
+            d = r.get("data")
+            if not d:
+                raise ConnectionLost("chunk fetch returned no data")
+            buf[off:off + len(d)] = d
+            off += len(d)
+
+    @staticmethod
+    def _streamable(ninfo: dict) -> bool:
+        """Does this peer serve the binary transfer plane?  A node
+        whose transfer listener failed to bind advertises its CONTROL
+        port there (node_service fallback) — sending raw RTX1 frames
+        to the pickled control listener would wedge both sides."""
+        return bool(ninfo.get("transfer_port")
+                    and ninfo["transfer_port"]
+                    != ninfo.get("control_port"))
+
+    def _transfer_socket(self, ninfo: dict) -> "_socket.socket":
+        """Raw socket to a peer's binary transfer listener."""
+        nid = ninfo["node_id"]
+        if chaos.partitioned(nid):
+            raise ConnectionLost(
+                f"chaos: partitioned from node {nid.hex()[:12]}")
+        if not self._streamable(ninfo):
+            raise ConnectionLost(
+                f"node {nid.hex()[:12]} has no transfer listener")
+        sock = connect_tcp(ninfo["host"], ninfo["transfer_port"],
+                           deadline_s=5.0)
+        # Same failover bound the chunk RPCs had: a holder dying
+        # without FIN/RST must not park a pull-pool worker in recv
+        # forever — time out and fail over to another holder.
+        sock.settimeout(60.0)
+        return sock
+
+    def _stream_once(self, src: dict, oid: bytes, start: int,
+                     length: int, buf) -> None:
+        """Connect to one holder and stream one range; raises on any
+        failure (the ONE copy of the connect/stream/close sequence).
+        A plain TCP connect failure raises _TransferConnectError so
+        single-source fetches can degrade to the control plane;
+        partition faults stay ConnectionLost (no silent rpc bypass of
+        an injected partition)."""
+        try:
+            sock = self._transfer_socket(src)
+        except ConnectionLost:
+            raise                       # partitioned / no listener
+        except Exception as e:          # TCP connect failed
+            raise _TransferConnectError(str(e)) from e
+        try:
+            self._stream_range(sock, src["node_id"], oid, start,
+                               length, buf)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _stream_range(self, sock: "_socket.socket", nid: bytes,
+                      oid: bytes, start: int, length: int, buf) -> None:
+        """Stream [start, start+length) of an object over one transfer
+        connection with a pipelined window of outstanding chunk
+        requests; payload bytes land directly in `buf` (recv_into)."""
+        chunk = max(64 * 1024, config.object_transfer_chunk_bytes)
+        window = max(2, config.object_transfer_window)
+        end = start + length
+        next_off = start
+        inflight: deque = deque()
+        while inflight or next_off < end:
+            if chaos.partitioned(nid):
+                raise ConnectionLost(
+                    f"chaos: partitioned from node {nid.hex()[:12]} "
+                    f"mid-stream")
+            # Chaos hook per round: kind=delay throttles the stream
+            # (lets tests catch a transfer in flight), kind=error
+            # aborts it mid-stream.
+            chaos.maybe_inject("transfer_chunk")
+            while next_off < end and len(inflight) < window:
+                ln = min(chunk, end - next_off)
+                sock.sendall(TRANSFER_REQ.pack(TRANSFER_MAGIC, oid,
+                                               next_off, ln))
+                inflight.append((next_off, ln))
+                next_off += ln
+            off, ln = inflight.popleft()
+            roff, rlen = TRANSFER_RESP.unpack(
+                _recv_exact(sock, TRANSFER_RESP.size))
+            if rlen == TRANSFER_ERR or roff != off or rlen != ln:
+                raise ConnectionLost(
+                    f"transfer stream error at offset {off}")
+            recv_exact_into(sock, buf[off:off + ln])
+
+    def _fetch_multi(self, oid: bytes, holders: List[dict],
+                     total: int) -> bool:
+        """Range-split parallel fetch: contiguous ranges of one large
+        object streamed concurrently from several holder nodes.  A
+        failed range is retried once from a surviving source before the
+        whole fetch aborts."""
+        from ray_tpu._private.ids import ObjectID
+        streamable = [h for h in holders if self._streamable(h)]
+        if len(streamable) < 2:
+            return False    # single-source path handles rpc fallback
+        nsrc = min(len(streamable), max(2,
+                                        config.object_transfer_parallelism))
+        sources = streamable[:nsrc]
+        store = self._store()
+        obj = ObjectID(oid)
+        try:
+            buf = store.create(obj, total)
+        except FileExistsError:
+            return True
+        except Exception:
+            return False
+        base = total // len(sources)
+        ranges: List[Tuple[dict, int, int]] = []
+        off = 0
+        for i, src in enumerate(sources):
+            ln = total - off if i == len(sources) - 1 else base
+            ranges.append((src, off, ln))
+            off += ln
+        failed: List[Tuple[int, int]] = []
+        failed_nids: set = set()
+        flock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def run(src: dict, start: int, ln: int) -> None:
+            try:
+                self._stream_once(src, oid, start, ln, buf)
+            except Exception:
+                self._note_holder_failure(oid, src["node_id"])
+                with flock:
+                    failed.append((start, ln))
+                    failed_nids.add(src["node_id"])
+
+        threads = [threading.Thread(target=run, args=r, daemon=True,
+                                    name="rtpu-pull-range")
+                   for r in ranges]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if failed:
+            survivors = [s for s in sources
+                         if s["node_id"] not in failed_nids]
+            ok = bool(survivors)
+            for start, ln in failed:
+                if not ok:
+                    break
+                ok = False
+                for alt in survivors:
+                    try:
+                        self._stream_once(alt, oid, start, ln, buf)
+                        ok = True
+                        break
+                    except Exception:
+                        self._note_holder_failure(oid, alt["node_id"])
+            if not ok:
+                try:
+                    store.abort(obj)
+                except Exception:
+                    pass
+                return False
+        try:
             store.seal(obj)
         except Exception:
             try:
@@ -191,11 +512,134 @@ class ObjectPlaneMixin:
             except Exception:
                 pass
             return False
+        self._record_transfer(total, time.perf_counter() - t0, "multi")
         with self.lock:
             self._register_object(oid, "shm", None, total,
                                   creator_pid=os.getpid(), foreign=True)
             self._schedule()
         return True
+
+    def _record_transfer(self, nbytes: int, seconds: float, path: str,
+                         direction: str = "in") -> None:
+        """Transfer observability: bytes counter (both directions) and
+        a per-object duration histogram on the fetch side."""
+        from ray_tpu.util.metrics import (OBJECT_TRANSFER_BUCKETS,
+                                          OBJECT_TRANSFER_BYTES_METRIC,
+                                          OBJECT_TRANSFER_SECONDS_METRIC)
+        with self.lock:
+            self._inc_counter(
+                OBJECT_TRANSFER_BYTES_METRIC, {"direction": direction},
+                "inter-node object transfer bytes",
+                value=float(nbytes))
+            if direction == "in":
+                self._observe_hist(
+                    OBJECT_TRANSFER_SECONDS_METRIC, {"path": path},
+                    seconds, OBJECT_TRANSFER_BUCKETS,
+                    "inter-node object transfer duration (per object)")
+
+    # ------------------------------------------------------------------
+    # inter-node transfer, serve side: the dedicated binary listener
+    # (node_service._start_multinode opens it; transfer_port in the
+    # GCS node record).  One thread per peer connection reads
+    # fixed-layout chunk requests and answers them in order, straight
+    # from the shm mmap (or a cached spill-file fd).
+    # ------------------------------------------------------------------
+    def _transfer_accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                sock, _ = self._transfer_listener.accept()
+            except OSError:
+                return
+            if self._shutdown:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            ctx = _ConnCtx(sock)
+            ctx.kind = "transfer"
+            t = threading.Thread(target=self._transfer_serve_loop,
+                                 args=(ctx,), daemon=True,
+                                 name="rtpu-xfer-serve")
+            with self.lock:
+                self._conns.append(ctx)
+                self._conn_threads.append(t)
+                if len(self._conn_threads) > 64:
+                    self._conn_threads = [x for x in self._conn_threads
+                                          if x.is_alive()]
+            t.start()
+
+    def _transfer_serve_loop(self, ctx: _ConnCtx) -> None:
+        sock = ctx.sock
+        # Reap serve threads stuck on a silently-dead peer; fetchers
+        # open a fresh connection per object, so a timeout close costs
+        # one reconnect at worst.
+        sock.settimeout(300.0)
+        served = 0
+        try:
+            while not self._shutdown:
+                magic, oid, off, ln = TRANSFER_REQ.unpack(
+                    _recv_exact(sock, TRANSFER_REQ.size))
+                if magic != TRANSFER_MAGIC:
+                    break
+                served += self._serve_transfer_chunk(sock, oid, off, ln)
+                # Batched counter flush: the per-chunk hot path must
+                # not take the scheduler lock per 4 MiB.  Fetchers
+                # close the connection after each object, so the
+                # close-time flush below is prompt.
+                if served >= 64 * 1024 * 1024:
+                    self._record_transfer(served, 0.0, "stream",
+                                          direction="out")
+                    served = 0
+        except (ConnectionLost, OSError, struct.error):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            with self.lock:
+                if ctx in self._conns:
+                    self._conns.remove(ctx)
+            if served:
+                self._record_transfer(served, 0.0, "stream",
+                                      direction="out")
+
+    def _serve_transfer_chunk(self, sock: "_socket.socket", oid: bytes,
+                              off: int, ln: int) -> int:
+        """Answer one chunk request; returns payload bytes sent (0 for
+        an error frame)."""
+        err = TRANSFER_RESP.pack(off, TRANSFER_ERR)
+        with self.lock:
+            e = self.objects.get(oid)
+            spill_path = (e.spill_path if e is not None
+                          and e.loc == "spilled" else None)
+        if spill_path is not None:
+            try:
+                data = self._spill_pread(oid, spill_path, off, ln)
+            except OSError:
+                data = b""
+            if len(data) != ln:
+                sock.sendall(err)
+                return 0
+            sock.sendall(TRANSFER_RESP.pack(off, ln))
+            sock.sendall(data)
+            return ln
+        mv = self._store().get(_OID(oid))
+        if mv is None:
+            sock.sendall(err)
+            return 0
+        try:
+            if off + ln > len(mv):
+                sock.sendall(err)
+                return 0
+            sock.sendall(TRANSFER_RESP.pack(off, ln))
+            # sendall straight from the shm mmap view — no copy.
+            sock.sendall(mv[off:off + ln])
+            return ln
+        finally:
+            self._store().release(_OID(oid))
 
     # ------------------------------------------------------------------
     # lineage reconstruction (reference: object_recovery_manager.h:41)
@@ -350,6 +794,7 @@ class ObjectPlaneMixin:
                     ctx.reply(m, {"ok": True})
                     return
                 e.spill_path = None     # spill file destroyed
+                self._drop_spill_fd(oid)
             elif e.loc == "shm":
                 try:
                     present = self._store().contains(_OID(oid))
@@ -533,9 +978,8 @@ class ObjectPlaneMixin:
                           and e.loc == "spilled" else None)
         if spill_path is not None:
             try:
-                with open(spill_path, "rb") as f:
-                    f.seek(m["offset"])
-                    ctx.reply(m, {"data": f.read(m["length"])})
+                ctx.reply(m, {"data": self._spill_pread(
+                    oid, spill_path, m["offset"], m["length"])})
             except OSError:
                 ctx.reply(m, {"data": None})
             return
@@ -548,6 +992,47 @@ class ObjectPlaneMixin:
             ctx.reply(m, {"data": bytes(mv[off:off + m["length"]])})
         finally:
             self._store().release(_OID(oid))
+
+    # -- spilled reads: cached fds + pread ---------------------------------
+    def _spill_pread(self, oid: bytes, path: str, off: int,
+                     ln: int) -> bytes:
+        """Serve a spilled-object range via os.pread on a cached fd —
+        no open+seek per chunk.  The fd drops when the object is
+        deleted/restored (_drop_spill_fd) or evicted from the cache.
+        The pread runs UNDER the fd lock: a concurrent close could
+        otherwise recycle the fd number and silently serve another
+        file's bytes as this object's payload."""
+        with self._spill_fd_lock:
+            ent = self._spill_fds.get(oid)
+            if ent is None or ent[1] != path:
+                fd = os.open(path, os.O_RDONLY)
+                if ent is not None:
+                    try:
+                        os.close(ent[0])
+                    except OSError:
+                        pass
+                self._spill_fds[oid] = (fd, path)
+                while len(self._spill_fds) > 128:
+                    old = next(iter(self._spill_fds))
+                    if old == oid:
+                        break
+                    ofd, _ = self._spill_fds.pop(old)
+                    try:
+                        os.close(ofd)
+                    except OSError:
+                        pass
+            else:
+                fd = ent[0]
+            return os.pread(fd, ln, off)
+
+    def _drop_spill_fd(self, oid: bytes) -> None:
+        with self._spill_fd_lock:
+            ent = self._spill_fds.pop(oid, None)
+        if ent is not None:
+            try:
+                os.close(ent[0])
+            except OSError:
+                pass
 
     def _complete_forwarded(self, task_id: bytes) -> None:
         """Release the owner-side embedded arg holds of a forwarded task
@@ -591,6 +1076,8 @@ class ObjectPlaneMixin:
             rec.deps = {d for d in rec.deps if not self._object_ready(d)}
             for d in rec.deps:
                 self._ensure_pull(d)
+            if rec.deps:
+                rec.stages.setdefault("pull_wait", time.time())
             if rec.actor_id is not None and not rec.is_actor_creation:
                 self._enqueue_actor_task(rec)
             else:
@@ -614,17 +1101,55 @@ class ObjectPlaneMixin:
         return all(v <= self.resources_total.get(k, 0.0) + 1e-9
                    for k, v in (res or {}).items())
 
+    def _dep_bytes_by_node(self, rec: TaskRecord
+                           ) -> Tuple[int, Dict[bytes, int]]:
+        """Bytes of rec's ref-arg dependencies resident locally and per
+        peer node.  Peer residency comes from the pull-time location
+        cache (peers we pulled replicas from still hold them) — no GCS
+        round-trip under the lock.  Caller holds self.lock."""
+        local = 0
+        per_node: Dict[bytes, int] = {}
+        for kind, val in rec.spec["args"]:
+            if kind != "ref":
+                continue
+            e = self.objects.get(val)
+            size = e.size if e is not None and e.size else 0
+            cached = self._obj_loc_cache.get(val)
+            if not size and cached is not None:
+                size = cached[1]
+            if not size:
+                continue
+            if (e is not None and e.state == READY
+                    and e.loc in ("shm", "inline", "spilled")):
+                local += size
+            if cached is not None:
+                for nid in cached[0]:
+                    if nid != self.node_id:
+                        per_node[nid] = per_node.get(nid, 0) + size
+        return local, per_node
+
     def _pick_spill_target(self, res: Dict[str, float],
-                           need_avail: bool) -> Optional[dict]:
+                           need_avail: bool,
+                           dep_bytes: Optional[Dict[bytes, int]] = None
+                           ) -> Optional[dict]:
+        """Best feasible peer, scored by resident dependency bytes
+        (most first), ties broken by available resources (reference:
+        locality-aware spillback in cluster_task_manager)."""
+        best = None
+        best_key = None
         for n in self._cluster_view:
             if n["node_id"] == self.node_id or n.get("state") != "alive":
                 continue
             pool = n["resources_avail"] if need_avail \
                 else n["resources_total"]
-            if all(pool.get(k, 0.0) >= v - 1e-9
-                   for k, v in (res or {}).items()):
-                return n
-        return None
+            if not all(pool.get(k, 0.0) >= v - 1e-9
+                       for k, v in (res or {}).items()):
+                continue
+            key = (-(dep_bytes or {}).get(n["node_id"], 0),
+                   -sum(n.get("resources_avail", {}).values()))
+            if best is None or key < best_key:
+                best, best_key = n, key
+        return best
 
     def _try_spill(self, rec: TaskRecord, res: Dict[str, float]) -> bool:
         """Decide whether to forward a pending task to a peer.  Caller
@@ -636,13 +1161,37 @@ class ObjectPlaneMixin:
         feasible_local = self._local_totals_satisfy(res)
         if rec.spec.get("spilled") and feasible_local:
             return False    # already hopped once; wait for local capacity
-        target = self._pick_spill_target(res, need_avail=True)
+        local_bytes, per_node = self._dep_bytes_by_node(rec)
+        target = self._pick_spill_target(res, need_avail=True,
+                                         dep_bytes=per_node)
         if target is None and not feasible_local:
-            target = self._pick_spill_target(res, need_avail=False)
+            target = self._pick_spill_target(res, need_avail=False,
+                                             dep_bytes=per_node)
         if target is None:
             return False
+        if (feasible_local
+                and local_bytes >= config.locality_spill_threshold_bytes
+                and local_bytes >= per_node.get(target["node_id"], 0)):
+            # Local dependency bytes dominate every candidate: wait
+            # briefly for local capacity rather than shipping the task
+            # to a node that must pull everything back.
+            now = time.time()
+            if rec.locality_deadline is None:
+                rec.locality_deadline = \
+                    now + max(0.0, config.locality_spill_wait_s)
+                self._add_deadline_waiter(
+                    rec.locality_deadline + 0.01,
+                    self._wake_scheduler)
+            if now < rec.locality_deadline:
+                return False
         self._forward_task(rec, target)
         return True
+
+    def _wake_scheduler(self) -> None:
+        """Deadline-waiter target: re-run the scheduling pass (e.g. a
+        locality wait expired with no local capacity — spill now)."""
+        with self.lock:
+            self._schedule()
 
     def _forward_task(self, rec: TaskRecord, ninfo: dict) -> None:
         """Hand a pending task to a peer node.  Caller holds self.lock.

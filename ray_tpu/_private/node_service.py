@@ -94,11 +94,37 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._actor_homes: Dict[bytes, bytes] = {}
         # actor_id -> death reason, for remote actors whose node died
         self._remote_actor_tombstones: Dict[bytes, str] = {}
-        # object ids with an in-flight pull thread
+        # object ids with an in-flight pull (owned by the pull pool)
         self._pulls_inflight: set = set()
         # pulls whose local entry was deleted mid-flight: the loop must
         # exit instead of polling a vanished GCS record forever
         self._cancelled_pulls: set = set()
+        # Bounded pull-manager pool (reference: pull_manager.h request
+        # pipelining; replaces thread-per-object pulls).  A heap of
+        # (due, seq, oid) attempts consumed by at most
+        # config.object_pull_workers threads; an attempt that can't
+        # finish requeues itself with a short delay instead of camping
+        # on a pool slot.
+        self._pull_cond = threading.Condition()
+        self._pull_heap: List[Tuple[float, int, bytes]] = []
+        self._pull_due: Dict[bytes, float] = {}
+        self._pull_running: set = set()
+        self._pull_seq = 0
+        self._pull_idle = 0
+        # per-pull subscription state: oid -> {"cb", "subscribed",
+        # "last_event"}
+        self._pull_state: Dict[bytes, dict] = {}
+        # Location cache fed by pull-time GCS lookups: oid ->
+        # (frozenset(holder node ids), size).  Drives locality-aware
+        # spillback scoring without a GCS round-trip under the lock.
+        self._obj_loc_cache: Dict[bytes, Tuple[frozenset, int]] = {}
+        # (oid, node_id) -> consecutive mid-transfer failures; two
+        # strikes prune the holder from the GCS directory.
+        self._holder_strikes: Dict[Tuple[bytes, bytes], int] = {}
+        # Cached read fds for spilled objects served to peers
+        # (os.pread instead of open+seek per chunk).
+        self._spill_fds: Dict[bytes, Tuple[int, str]] = {}
+        self._spill_fd_lock = threading.Lock()
         # (pg_id, bundle_index) -> Bundle reserved ON THIS NODE
         self.bundles: Dict[Tuple[bytes, int], Bundle] = {}
         # pg_id -> coordinator record for PGs created via this node:
@@ -210,6 +236,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             self._shutdown = True
             workers = list(self.workers.values())
         self._monitor_wake.set()    # don't pay a last monitor sleep
+        with self._pull_cond:       # wake parked pull-pool workers
+            self._pull_cond.notify_all()
         # Wake the accept loop(s) with a dummy connection and JOIN them
         # BEFORE closing the listener fds.  A thread left blocked in
         # accept() survives close(); when the fd number is reused by the
@@ -238,6 +266,11 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 self._peer_listener.close()
             except Exception:
                 pass
+            if getattr(self, "_transfer_listener", None) is not None:
+                try:
+                    self._transfer_listener.close()
+                except Exception:
+                    pass
             with self._peer_lock:
                 conns = list(self._peer_conns.values())
                 self._peer_conns.clear()
@@ -278,6 +311,13 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             os.unlink(self.store_path)
         except OSError:
             pass
+        with self._spill_fd_lock:
+            fds, self._spill_fds = list(self._spill_fds.values()), {}
+        for fd, _ in fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------
     # connection handling
@@ -308,6 +348,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             wake_and_join_acceptor(
                 getattr(self, "_peer_accept_thread", None),
                 socket.AF_INET, (self.host, self.control_port))
+            if getattr(self, "_transfer_listener", None) is not None:
+                wake_and_join_acceptor(
+                    getattr(self, "_transfer_accept_thread", None),
+                    socket.AF_INET, (self.host, self.transfer_port))
 
     def _accept_loop(self) -> None:
         while not self._shutdown:
@@ -381,11 +425,32 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._peer_listener.listen(64)
         self.host = host
         self.control_port = self._peer_listener.getsockname()[1]
-        self.transfer_port = self.control_port  # one listener, both roles
         self._peer_accept_thread = threading.Thread(
             target=self._peer_accept_loop, daemon=True,
             name="rtpu-peer-accept")
         self._peer_accept_thread.start()
+        # Dedicated object-transfer listener: raw binary chunk streams
+        # (node_objects._transfer_serve_loop), kept OFF the pickled
+        # control-plane listener so bulk data never queues behind
+        # control rpcs (reference: object_manager.h transfer plane).
+        try:
+            self._transfer_listener = socket.socket(socket.AF_INET,
+                                                    socket.SOCK_STREAM)
+            self._transfer_listener.setsockopt(socket.SOL_SOCKET,
+                                               socket.SO_REUSEADDR, 1)
+            self._transfer_listener.bind((host, 0))
+            self._transfer_listener.listen(64)
+            self.transfer_port = \
+                self._transfer_listener.getsockname()[1]
+            self._transfer_accept_thread = threading.Thread(
+                target=self._transfer_accept_loop, daemon=True,
+                name="rtpu-xfer-accept")
+            self._transfer_accept_thread.start()
+        except OSError:
+            # No transfer listener: advertise the control port so peers
+            # fall back to the control-plane chunk RPCs.
+            self._transfer_listener = None
+            self.transfer_port = self.control_port
         self._gcs_event_thread = threading.Thread(
             target=self._gcs_event_loop, daemon=True,
             name="rtpu-gcs-events")
@@ -807,6 +872,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 # _ensure_pull no-ops for locally-producing deps.
                 for d in rec.deps:
                     self._ensure_pull(d)
+                if rec.deps:
+                    # pull_wait checkpoint: transfer-plane share of the
+                    # deps_fetch stage (tracing.STAGE_DURATION_PAIRS).
+                    rec.stages.setdefault("pull_wait", time.time())
             if rec.actor_id is not None and not rec.is_actor_creation:
                 self._enqueue_actor_task(rec)
             else:
@@ -1193,6 +1262,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         e.deleted = True
         e.data = None
         self.objects.pop(oid, None)
+        self._obj_loc_cache.pop(oid, None)
+        self._drop_spill_fd(oid)
         if e.spill_path:
             try:
                 os.unlink(e.spill_path)
@@ -1554,6 +1625,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 rec.stages.setdefault("deps_fetched", time.time())
             for d in rec.deps:
                 self._ensure_pull(d)
+            if rec.deps and self.multinode:
+                rec.stages.setdefault("pull_wait", time.time())
             self.pending_queue.append(rec)
             self._schedule()
         if self.multinode:
@@ -2036,27 +2109,37 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         from ray_tpu.util.metrics import (TASK_STAGE_BUCKETS,
                                           TASK_STAGE_METRIC)
         for stage, dur in stage_durations(stages).items():
-            key = (TASK_STAGE_METRIC, "histogram", (("stage", stage),))
-            cur = self._metrics.get(key)
-            if cur is None:
-                # Prefill every boundary (like Histogram._new_cell) so
-                # each scrape exposes a stable, uniform bucket set.
-                cur = {"name": TASK_STAGE_METRIC, "kind": "histogram",
-                       "tags": {"stage": stage}, "value": 0.0,
-                       "buckets": {str(b): 0 for b in TASK_STAGE_BUCKETS},
-                       "sum": 0.0, "count": 0.0,
-                       "description": "task lifecycle stage duration"}
-                self._metrics[key] = cur
-            for b in TASK_STAGE_BUCKETS:
-                if dur <= b:
-                    k = str(b)
-                    cur["buckets"][k] = cur["buckets"].get(k, 0) + 1
-                    break
-            cur["sum"] += dur
-            cur["count"] += 1
+            self._observe_hist(TASK_STAGE_METRIC, {"stage": stage},
+                               dur, TASK_STAGE_BUCKETS,
+                               "task lifecycle stage duration")
+
+    def _observe_hist(self, name: str, tags: Dict[str, str],
+                      value: float, buckets, description: str = ""
+                      ) -> None:
+        """Fold one observation into a node-side auto-registered
+        histogram cell (same table as _h_metrics_push).  Prefills every
+        boundary (like Histogram._new_cell) so each scrape exposes a
+        stable, uniform bucket set.  Caller holds self.lock."""
+        key = (name, "histogram", tuple(sorted(tags.items())))
+        cur = self._metrics.get(key)
+        if cur is None:
+            cur = {"name": name, "kind": "histogram",
+                   "tags": dict(tags), "value": 0.0,
+                   "buckets": {str(b): 0 for b in buckets},
+                   "sum": 0.0, "count": 0.0,
+                   "description": description}
+            self._metrics[key] = cur
+        for b in buckets:
+            if value <= b:
+                k = str(b)
+                cur["buckets"][k] = cur["buckets"].get(k, 0) + 1
+                break
+        cur["sum"] += value
+        cur["count"] += 1
 
     def _inc_counter(self, name: str, tags: Dict[str, str],
-                     description: str = "") -> None:
+                     description: str = "",
+                     value: float = 1.0) -> None:
         """Bump a node-side auto-registered counter cell (same table
         the stage histograms land in).  Caller holds self.lock."""
         key = (name, "counter", tuple(sorted(tags.items())))
@@ -2066,7 +2149,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                    "value": 0.0, "buckets": {}, "sum": 0.0,
                    "count": 0.0, "description": description}
             self._metrics[key] = cur
-        cur["value"] += 1
+        cur["value"] += value
 
     # ------------------------------------------------------------------
     # retry scheduling: exponential backoff with jitter
@@ -2092,6 +2175,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         rec.retries_left -= 1
         rec.state = "retry_backoff"
         rec.worker = None
+        rec.locality_deadline = None
         rec.spec.pop("spilled", None)
         self.tasks[rec.task_id] = rec
         delay = self._retry_delay_s(rec)

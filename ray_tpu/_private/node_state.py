@@ -57,7 +57,8 @@ class ObjectEntry:
 class TaskRecord:
     __slots__ = ("task_id", "spec", "deps", "state", "worker",
                  "retries_left", "is_actor_creation", "actor_id",
-                 "cancelled", "stages", "had_deps", "started")
+                 "cancelled", "stages", "had_deps", "started",
+                 "locality_deadline")
 
     def __init__(self, spec: dict) -> None:
         self.task_id: bytes = spec["task_id"]
@@ -77,6 +78,10 @@ class TaskRecord:
         self.started = False
         self.is_actor_creation = spec.get("is_actor_creation", False)
         self.cancelled = False
+        # Locality-aware spillback: while set and in the future, a task
+        # whose local dependency bytes dominate waits for local
+        # capacity instead of spilling (node_objects._try_spill).
+        self.locality_deadline: Optional[float] = None
         self.actor_id: Optional[bytes] = spec.get("actor_id")
         # Lifecycle checkpoints (reference: task events feeding
         # ray.util.state task summaries): submitted -> queued ->

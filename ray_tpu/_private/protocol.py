@@ -70,14 +70,30 @@ def _chaos_gate(msg_type: str, one_way: bool) -> bool:
     return False
 
 
+# Frames below this size still concatenate header+payload (one syscall
+# beats one tiny copy); larger payloads are sent as header then payload
+# so the full-frame copy never happens.
+_SEND_CONCAT_MAX = 64 * 1024
+
+
 def send_msg(sock: socket.socket, msg: Any, lock: Optional[threading.Lock] = None) -> None:
     data = pickle.dumps(msg, protocol=5)
-    frame = _LEN.pack(len(data)) + data
+    header = _LEN.pack(len(data))
+    if len(data) <= _SEND_CONCAT_MAX:
+        frame = header + data
+        if lock:
+            with lock:
+                sock.sendall(frame)
+        else:
+            sock.sendall(frame)
+        return
     if lock:
         with lock:
-            sock.sendall(frame)
+            sock.sendall(header)
+            sock.sendall(data)
     else:
-        sock.sendall(frame)
+        sock.sendall(header)
+        sock.sendall(data)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -97,6 +113,42 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def recv_msg(sock: socket.socket) -> Any:
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     return pickle.loads(_recv_exact(sock, length))
+
+
+# ---------------------------------------------------------------------------
+# binary object-transfer plane (reference: object_manager.h chunked
+# pushes over dedicated channels).  No pickle anywhere on this path:
+# requests and reply headers are fixed-layout structs and chunk payloads
+# stream straight between the holder's mmap and the fetcher's
+# pre-allocated shm buffer (recv_into).
+#
+#   request  (fetcher -> holder):  magic 'RTX1', object_id[16],
+#                                  u64 offset, u64 length
+#   response (holder -> fetcher):  u64 offset, u64 length, payload[length]
+#
+# One connection serves requests strictly in order, so the fetcher keeps
+# a window of outstanding requests and matches replies FIFO.  length ==
+# TRANSFER_ERR signals "not servable here" (object gone / truncated) and
+# carries no payload.
+# ---------------------------------------------------------------------------
+TRANSFER_MAGIC = b"RTX1"
+TRANSFER_REQ = struct.Struct("<4s16sQQ")
+TRANSFER_RESP = struct.Struct("<QQ")
+TRANSFER_ERR = (1 << 64) - 1
+
+
+def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill `view` completely from the socket (zero-copy receive)."""
+    got = 0
+    n = len(view)
+    while got < n:
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except (ConnectionResetError, OSError) as e:
+            raise ConnectionLost(str(e)) from e
+        if not r:
+            raise ConnectionLost("socket closed mid-transfer")
+        got += r
 
 
 class Connection:

@@ -71,10 +71,18 @@ class SerializedObject:
             dest[o:o + ln] = mv
         return off
 
-    def to_bytes(self) -> bytes:
+    def to_buffer(self) -> bytearray:
+        """Serialize into a fresh bytearray WITHOUT the final
+        bytearray->bytes copy.  For callers that only need a
+        buffer-protocol payload (socket sends, pickle fields, file
+        writes, memoryview deserialization) — bytearray satisfies all
+        of them and pickles/loads transparently."""
         out = bytearray(self.total_size)
         self.write_into(memoryview(out))
-        return bytes(out)
+        return out
+
+    def to_bytes(self) -> bytes:
+        return bytes(self.to_buffer())
 
 
 def _device_arrays_to_host(obj: Any) -> Any:
@@ -140,7 +148,10 @@ def serialize(
         return SerializedObject(inband, buffers)
     f = io.BytesIO()
     _Pickler(f, cb, ref_reducer).dump(obj)
-    return SerializedObject(f.getvalue(), buffers)
+    # getbuffer(), not getvalue(): the view aliases the BytesIO's
+    # internal buffer (kept alive by the view) instead of copying it —
+    # inband bytes are only ever read through the buffer protocol.
+    return SerializedObject(f.getbuffer(), buffers)
 
 
 def deserialize(data: memoryview, copy_buffers: bool = False) -> Any:

@@ -118,6 +118,10 @@ STAGE_SPANS = (
 
 STAGE_DURATION_PAIRS = STAGE_SPANS + (
     ("deps_fetch", "queued", "deps_fetched"),
+    # Time spent pulling remote dependencies (pull_wait is stamped when
+    # the node arms cross-node pulls for a task's deps) — the transfer
+    # plane's share of deps_fetch.
+    ("pull_wait", "pull_wait", "deps_fetched"),
     ("total", "submitted", "finished"),
 )
 

@@ -42,6 +42,15 @@ TASK_RETRIES_METRIC = "ray_tpu_task_retries_total"
 ACTOR_RESTARTS_METRIC = "ray_tpu_actor_restarts_total"
 CHAOS_INJECTED_METRIC = "ray_tpu_chaos_injected_total"
 
+# Inter-node object-transfer plane, auto-recorded node-side.
+# bytes_total tags: direction = in | out.  seconds tags: path =
+# stream (windowed binary plane) | multi (range-split, several
+# holders) | rpc (stop-and-wait control-plane fallback).
+OBJECT_TRANSFER_BYTES_METRIC = "ray_tpu_object_transfer_bytes_total"
+OBJECT_TRANSFER_SECONDS_METRIC = "ray_tpu_object_transfer_seconds"
+OBJECT_TRANSFER_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                           5.0, 30.0)
+
 _lock = threading.RLock()
 _registry: List["_Metric"] = []
 _flusher_started = False

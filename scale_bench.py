@@ -90,6 +90,64 @@ def measure_actor_churn(ray_tpu, total: int, batch: int = 50) -> float:
     return total / (time.perf_counter() - t0)
 
 
+def measure_object_transfer(size_mb: int = 256) -> dict:
+    """Inter-node object-transfer throughput on a loopback two-node
+    cluster: one `size_mb` object produced on the worker node, pulled
+    by the head (driver) node — window=1 (the stop-and-wait
+    control-plane baseline) vs the default windowed binary stream.
+    Reported as MB/s of the driver-side get()."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.config import config as _cfg
+    from ray_tpu.cluster_utils import Cluster
+
+    store = (size_mb + 192) * 1024 * 1024
+    cluster = Cluster()
+    cluster.add_node(resources={"CPU": 2.0, "remote": 1.0},
+                     store_capacity=2 * store)
+    ray_tpu.init(num_cpus=2, gcs_address=cluster.gcs_address,
+                 object_store_memory=2 * store)
+    out: dict = {"object_mb": size_mb}
+    try:
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(resources={"remote": 1}, num_returns=2)
+        def produce(n):
+            return np.arange(n // 8, dtype=np.float64), "done"
+
+        def one_pull() -> float:
+            big_ref, done_ref = produce.remote(size_mb << 20)
+            # The small sentinel proves the big object is produced
+            # remotely WITHOUT arming a pull for it: the measured get()
+            # below is pure transfer.
+            assert ray_tpu.get(done_ref, timeout=120) == "done"
+            t0 = time.perf_counter()
+            arr = ray_tpu.get(big_ref, timeout=300)
+            dt = time.perf_counter() - t0
+            assert arr[4096] == 4096.0
+            del arr, big_ref, done_ref
+            time.sleep(0.5)     # let the freed objects drain
+            return size_mb / dt
+
+        # warm both worker pools + the peer connection
+        ray_tpu.get(list(produce.remote(1 << 20)), timeout=120)
+        default_window = _cfg.object_transfer_window
+        _cfg.set("object_transfer_window", 1)
+        try:
+            out["window1_mb_s"] = round(one_pull(), 1)
+        finally:
+            _cfg.set("object_transfer_window", default_window)
+        out["windowed_mb_s"] = round(one_pull(), 1)
+        out["window"] = _cfg.object_transfer_window
+        out["speedup"] = round(out["windowed_mb_s"]
+                               / max(out["window1_mb_s"], 1e-9), 2)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+    return out
+
+
 def run_envelope(node_counts: List[int], n_tasks: int, n_actors: int,
                  n_pgs: int, churn: int) -> dict:
     import ray_tpu
@@ -132,6 +190,25 @@ def run_envelope(node_counts: List[int], n_tasks: int, n_actors: int,
 
 
 def main() -> None:
+    rnd = os.environ.get("SCALE_ROUND", "r05")
+    if os.environ.get("SCALE_OBJECT_TRANSFER", "") not in ("", "0",
+                                                           "false"):
+        # Object-transfer microbench only: loopback two-node pull of a
+        # 256 MiB object, stop-and-wait (window=1) vs windowed binary
+        # stream.  Recorded into MICROBENCH_<round>.json next to the
+        # single-node microbench numbers.
+        size = int(os.environ.get("SCALE_TRANSFER_MB", "256"))
+        res = measure_object_transfer(size)
+        path = f"MICROBENCH_{rnd}.json"
+        blob = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                blob = json.load(f)
+        blob["object_transfer"] = res
+        with open(path, "w") as f:
+            json.dump(blob, f, indent=1)
+        print(json.dumps({"metric": "object_transfer", **res}))
+        return
     quick = os.environ.get("SCALE_QUICK", "") not in ("", "0", "false")
     if quick:
         out = run_envelope([1, 2], n_tasks=60, n_actors=8, n_pgs=5,
@@ -139,7 +216,6 @@ def main() -> None:
     else:
         out = run_envelope([1, 2, 4, 8], n_tasks=400, n_actors=40,
                            n_pgs=20, churn=200)
-    rnd = os.environ.get("SCALE_ROUND", "r05")
     with open(f"SCALE_{rnd}.json", "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

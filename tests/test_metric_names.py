@@ -47,10 +47,12 @@ def test_registry_names_and_buckets_lint():
 def test_declared_builtin_names_are_legal():
     metrics = _import_surface()
     assert _NAME.match(metrics.TASK_STAGE_METRIC)
-    bs = metrics.TASK_STAGE_BUCKETS
-    assert all(a < b for a, b in zip(bs, bs[1:]))
-    bs = metrics.DEFAULT_BUCKETS
-    assert all(a < b for a, b in zip(bs, bs[1:]))
+    assert _NAME.match(metrics.TASK_RETRIES_METRIC)
+    assert _NAME.match(metrics.OBJECT_TRANSFER_BYTES_METRIC)
+    assert _NAME.match(metrics.OBJECT_TRANSFER_SECONDS_METRIC)
+    for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
+               metrics.OBJECT_TRANSFER_BUCKETS):
+        assert all(a < b for a, b in zip(bs, bs[1:]))
 
 
 def test_constructor_rejects_bad_names_and_buckets():
