@@ -18,12 +18,15 @@ a node-level hook (``dispatch``, ``serve.assign``, ``partition``), or
 ``*`` (every rpc site).  Keys:
 
     kind    error | drop | delay | kill_worker | evict | kill_replica
-            | partition            (default: error)
+            | partition | preempt  (default: error)
     p       injection probability per eligible event (default 1.0)
     n       budget: total injections allowed; -1 = unlimited (default -1)
     lo_ms / hi_ms
             delay bounds for kind=delay (milliseconds)
     node    hex prefix of the target node id for kind=partition
+    deadline_s
+            kind=preempt: seconds between the simulated termination
+            notice and the "VM" disappearing (0 = config.drain_grace_s)
 
 Fault kinds and where they act:
 
@@ -43,6 +46,10 @@ Fault kinds and where they act:
   just picked (exercises Serve failover).
 * ``partition`` — standing condition: drop peer control AND
   object-transfer connections to nodes whose id matches ``node``.
+* ``preempt`` — at the node monitor (site ``node``): deliver a
+  simulated TPU-preemption notice with ``deadline_s`` of grace — the
+  node begins a graceful drain; work that cannot finish or move by the
+  deadline falls back to the ordinary kill-and-retry path.
 
 The legacy env specs ``testing_rpc_failure`` ("method:N" → kind=error,
 p=0.5, n=N) and ``testing_asio_delay_us`` ("method:lo:hi" microseconds)
@@ -69,7 +76,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.config import config
 
 FAULT_KINDS = ("error", "drop", "delay", "kill_worker", "evict",
-               "kill_replica", "partition")
+               "kill_replica", "partition", "preempt")
 
 # How often (at most) the env/config spec is re-read on the hot path.
 _REFRESH_INTERVAL_S = 0.25
@@ -77,11 +84,11 @@ _REFRESH_INTERVAL_S = 0.25
 
 class FaultSpec:
     __slots__ = ("site", "kind", "p", "budget", "lo_ms", "hi_ms", "node",
-                 "announced")
+                 "deadline_s", "announced")
 
     def __init__(self, site: str, kind: str = "error", p: float = 1.0,
                  n: int = -1, lo_ms: float = 0.0, hi_ms: float = 0.0,
-                 node: str = "") -> None:
+                 node: str = "", deadline_s: float = 0.0) -> None:
         if kind not in FAULT_KINDS:
             raise ValueError(
                 f"unknown fault kind {kind!r} (valid: "
@@ -94,6 +101,10 @@ class FaultSpec:
             raise ValueError(f"hi_ms {hi_ms} < lo_ms {lo_ms}")
         if kind == "partition" and not node:
             raise ValueError("kind=partition needs node=<hex prefix>")
+        if deadline_s < 0.0:
+            raise ValueError(f"deadline_s {deadline_s} < 0")
+        if deadline_s and kind != "preempt":
+            raise ValueError("deadline_s only applies to kind=preempt")
         self.site = site
         self.kind = kind
         self.p = p
@@ -101,6 +112,10 @@ class FaultSpec:
         self.lo_ms = lo_ms
         self.hi_ms = hi_ms
         self.node = node
+        # kind=preempt: the simulated termination notice's deadline —
+        # the drained node has this long before the "VM" is gone
+        # (0.0 = use config.drain_grace_s).
+        self.deadline_s = deadline_s
         self.announced = False     # partition: trace once, not per check
 
     def to_dict(self) -> Dict[str, Any]:
@@ -108,6 +123,8 @@ class FaultSpec:
                "n": self.budget}
         if self.kind == "delay":
             out["lo_ms"], out["hi_ms"] = self.lo_ms, self.hi_ms
+        if self.kind == "preempt":
+            out["deadline_s"] = self.deadline_s
         if self.node:
             out["node"] = self.node
         return out
@@ -139,7 +156,7 @@ def parse_spec(spec: str) -> List[FaultSpec]:
                     kwargs["p"] = float(value)
                 elif key == "n":
                     kwargs["n"] = int(value)
-                elif key in ("lo_ms", "hi_ms"):
+                elif key in ("lo_ms", "hi_ms", "deadline_s"):
                     kwargs[key] = float(value)
                 elif key == "node":
                     kwargs["node"] = value
@@ -267,10 +284,10 @@ class ChaosController:
     # -- runtime API ----------------------------------------------------
     def inject(self, site: str, kind: str = "error", p: float = 1.0,
                n: int = -1, lo_ms: float = 0.0, hi_ms: float = 0.0,
-               node: str = "") -> None:
+               node: str = "", deadline_s: float = 0.0) -> None:
         """Add a fault spec at runtime (this process)."""
         spec = FaultSpec(site, kind=kind, p=p, n=n, lo_ms=lo_ms,
-                         hi_ms=hi_ms, node=node)
+                         hi_ms=hi_ms, node=node, deadline_s=deadline_s)
         with self._lock:
             self._runtime_specs.append(spec)
             self._enabled = True
@@ -308,7 +325,7 @@ class ChaosController:
                 return None
             for spec in self._match(site):
                 if spec.kind in ("kill_worker", "evict", "kill_replica",
-                                 "partition"):
+                                 "partition", "preempt"):
                     continue    # node-level kinds don't fire on rpcs
                 if spec.budget == 0:
                     continue
@@ -347,12 +364,18 @@ class ChaosController:
     def fire(self, site: str, kind: str) -> bool:
         """Node-level hook: should fault `kind` fire at `site` now?
         Consumes budget and records the injection when it does."""
+        return self.fire_spec(site, kind) is not None
+
+    def fire_spec(self, site: str, kind: str) -> Optional[Dict[str, Any]]:
+        """Like fire(), but returns the firing spec's parameters (e.g.
+        a preemption's deadline_s) instead of a bare bool; None when
+        nothing fires.  Same budget/trace semantics as fire()."""
         if not self._enabled and time.monotonic() < self._next_check:
-            return False
+            return None
         with self._lock:
             self._refresh_locked()
             if not self._enabled:
-                return False
+                return None
             for spec in self._match(site):
                 if spec.kind != kind or spec.budget == 0:
                     continue
@@ -361,8 +384,8 @@ class ChaosController:
                 if spec.budget > 0:
                     spec.budget -= 1
                 self._record_locked(site, kind)
-                return True
-        return False
+                return spec.to_dict()
+        return None
 
     def partitioned(self, node_id: bytes) -> bool:
         """Standing node-partition check (peer control + transfer

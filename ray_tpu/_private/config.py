@@ -135,8 +135,18 @@ _D("chaos_seed", int, 0,
 _D("chaos_spec", str, "",
    "Chaos schedule: comma-separated 'site:key=value:...' entries "
    "(kinds: error, drop, delay, kill_worker, evict, kill_replica, "
-   "partition).  See _private/chaos.py for the grammar; validate with "
-   "`ray_tpu chaos`.")
+   "partition, preempt).  See _private/chaos.py for the grammar; "
+   "validate with `ray_tpu chaos`.")
+_D("drain_grace_s", float, 30.0,
+   "Default grace for a graceful node drain: running tasks get this "
+   "long to finish (and actors/objects to migrate) before the node "
+   "falls back to the kill-and-retry path and exits.")
+_D("preemption_notice_file", str, "",
+   "Path polled (~4x/s) by the node monitor: when the file appears, "
+   "the node treats it as a TPU preemption notice and begins a "
+   "graceful drain.  File contents: empty (use drain_grace_s), a "
+   "float (seconds until the deadline), or JSON {\"deadline_s\": N}. "
+   "A GCE metadata-watcher shim or a test writes this file.")
 _D("task_retry_delay_ms", int, 50,
    "Base backoff before a task retry is resubmitted; doubles per "
    "attempt with jitter (reference role: task resubmit backoff).")

@@ -25,7 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 class NodeInfo:
     __slots__ = ("node_id", "host", "control_port", "transfer_port",
                  "resources_total", "resources_avail", "last_heartbeat",
-                 "state", "load")
+                 "state", "load", "drain_deadline", "drain_reason")
 
     def __init__(self, node_id: bytes, host: str, control_port: int,
                  transfer_port: int, resources_total: Dict[str, float]
@@ -37,7 +37,18 @@ class NodeInfo:
         self.resources_total = dict(resources_total)
         self.resources_avail = dict(resources_total)
         self.last_heartbeat = time.time()
-        self.state = "alive"        # alive | dead
+        # alive | draining | dead.  "draining" is a first-class
+        # lifecycle state (planned departure: operator drain or a TPU
+        # preemption notice): the node is still reachable and serving,
+        # but schedulers must stop routing NEW work to it and it will
+        # transition to dead — cleanly (it hands back work, migrates
+        # actors, re-replicates sole object copies, then reports
+        # itself drained) or via the drain-deadline health check.
+        self.state = "alive"
+        # Wall-clock deadline by which a draining node must be gone
+        # (preemption deadline / drain grace); None while alive.
+        self.drain_deadline: Optional[float] = None
+        self.drain_reason = ""
         # Scheduling load from the node's last heartbeat (autoscaler
         # demand signal): {"pending": N, "shapes": [resource dicts],
         # "idle_since": ts | None}.
@@ -49,7 +60,9 @@ class NodeInfo:
                 "transfer_port": self.transfer_port,
                 "resources_total": dict(self.resources_total),
                 "resources_avail": dict(self.resources_avail),
-                "state": self.state, "load": dict(self.load)}
+                "state": self.state, "load": dict(self.load),
+                "drain_deadline": self.drain_deadline,
+                "drain_reason": self.drain_reason}
 
 
 class GlobalControlState:
@@ -263,16 +276,45 @@ class GlobalControlState:
             n = self._nodes.get(node_id)
             if n is None or n.state == "dead":
                 return
+            # Draining nodes keep heartbeating while they hand off
+            # work; a heartbeat must NOT resurrect them to "alive" —
+            # only last_heartbeat/resources update, the state machine
+            # moves forward exclusively (alive -> draining -> dead).
             n.last_heartbeat = time.time()
             n.resources_avail = dict(resources_avail)
             if load is not None:
                 n.load = dict(load)
+
+    def drain_node(self, node_id: bytes, grace_s: float = 30.0,
+                   reason: str = "drain requested") -> bool:
+        """Begin a graceful departure: alive -> draining, published as
+        a `node_draining` event (the node itself reacts by handing
+        back queued work, migrating actors, and re-replicating sole
+        object copies; peers stop targeting it).  Returns False for an
+        unknown, already-draining, or dead node — the transition fires
+        exactly once."""
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None or n.state != "alive":
+                return False
+            n.state = "draining"
+            n.drain_deadline = time.time() + max(grace_s, 0.0)
+            n.drain_reason = reason
+            info = n.to_dict()
+        info["reason"] = reason
+        info["grace_s"] = max(grace_s, 0.0)
+        self._publish_node("node_draining", info)
+        return True
 
     def mark_node_dead(self, node_id: bytes, reason: str = "") -> None:
         lost_notifies = []
         with self._lock:
             n = self._nodes.get(node_id)
             if n is None or n.state == "dead":
+                # Drain/death race guard: a drained node reports itself
+                # dead AND the health check may fire on it — whichever
+                # call runs second sees "dead" here and returns, so the
+                # node_dead actor/object cleanup publishes exactly once.
                 return
             n.state = "dead"
             # Copies on a dead node are gone.  Subscribers waiting on an
@@ -306,9 +348,14 @@ class GlobalControlState:
         self._publish_node("node_dead", info)
 
     def nodes(self, alive_only: bool = True) -> List[dict]:
+        """alive_only means "not dead": draining nodes are still
+        reachable and still serving (objects pull from them, their
+        actors answer until migrated), so they stay in the cluster
+        view — consumers that must not target them filter on
+        state == "alive" (spill targets, placement, feasibility)."""
         with self._lock:
             return [n.to_dict() for n in self._nodes.values()
-                    if not alive_only or n.state == "alive"]
+                    if not alive_only or n.state != "dead"]
 
     def node_info(self, node_id: bytes) -> Optional[dict]:
         with self._lock:
@@ -316,15 +363,38 @@ class GlobalControlState:
             return n.to_dict() if n else None
 
     def check_health(self, timeout_s: float) -> List[dict]:
-        """Mark nodes with stale heartbeats dead; returns newly-dead."""
+        """Mark nodes with stale heartbeats dead; returns newly-dead.
+
+        Draining nodes get their drain-grace deadline instead of the
+        plain heartbeat timeout: heartbeats naturally stop while a
+        node finishes its drain sequence and exits, so silence alone
+        is not death until the deadline has passed (a cleanly drained
+        node reports itself dead before that)."""
         now = time.time()
         with self._lock:
-            stale = [n.node_id for n in self._nodes.values()
-                     if n.state == "alive"
-                     and now - n.last_heartbeat > timeout_s]
+            stale = []
+            for n in self._nodes.values():
+                hb_stale = now - n.last_heartbeat > timeout_s
+                if n.state == "alive" and hb_stale:
+                    stale.append((n.node_id, "missed heartbeats"))
+                elif n.state == "draining" and hb_stale:
+                    # Heartbeats continue THROUGH a drain (a clean exit
+                    # reports itself dead), so silence during one means
+                    # either the final exit race (give it the deadline)
+                    # or a hard crash mid-drain — a long grace must not
+                    # hide a dead node for minutes, so extended silence
+                    # (3x the plain timeout) reaps it regardless.
+                    if now > (n.drain_deadline or 0.0):
+                        stale.append((n.node_id,
+                                      "drain deadline exceeded "
+                                      f"({n.drain_reason or 'drain'})"))
+                    elif now - n.last_heartbeat > 3 * timeout_s:
+                        stale.append((n.node_id,
+                                      "crashed while draining "
+                                      "(missed heartbeats)"))
         newly_dead = []
-        for nid in stale:
-            self.mark_node_dead(nid, "missed heartbeats")
+        for nid, reason in stale:
+            self.mark_node_dead(nid, reason)
             newly_dead.append(self.node_info(nid))
         return newly_dead
 
@@ -356,8 +426,12 @@ class GlobalControlState:
         with self._lock:
             holders, size = self._locations.get(oid, (set(), 0))
             small = self._small_objects.get(oid)
+            # Draining holders stay fetchable: their copies are valid
+            # until the node actually exits (and the drain re-replicates
+            # sole copies elsewhere before that).
             alive = [self._nodes[h].to_dict() for h in holders
-                     if h in self._nodes and self._nodes[h].state == "alive"]
+                     if h in self._nodes
+                     and self._nodes[h].state != "dead"]
             lost = oid in self._lost_objects
         out = {"nodes": alive, "size": size}
         if small is not None:

@@ -165,6 +165,11 @@ class GcsServer:
         self.state.mark_node_dead(m["node_id"], m.get("reason", ""))
         conn.reply(m, {"ok": True})
 
+    def _h_drain_node(self, conn, m):
+        conn.reply(m, {"ok": self.state.drain_node(
+            m["node_id"], m.get("grace_s", 30.0),
+            m.get("reason", "drain requested"))})
+
     def _h_kv_put(self, conn, m):
         conn.reply(m, {"ok": self.state.kv_put(
             m["ns"], m["key"], m["value"], m.get("overwrite", True))})
@@ -349,6 +354,12 @@ class GcsClient:
     def mark_node_dead(self, node_id, reason=""):
         self.conn.call({"type": "mark_node_dead", "node_id": node_id,
                         "reason": reason})
+
+    def drain_node(self, node_id, grace_s=30.0,
+                   reason="drain requested"):
+        return self.conn.call({"type": "drain_node", "node_id": node_id,
+                               "grace_s": grace_s,
+                               "reason": reason})["ok"]
 
     def kv_put(self, ns, key, value, overwrite=True):
         return self.conn.call({"type": "kv_put", "ns": ns, "key": key,

@@ -1,0 +1,685 @@
+"""Graceful node drain: planned departure as a first-class lifecycle.
+
+On real TPU fleets the dominant "failure" is planned: preemptible VMs
+get a termination notice with a deadline, and operators drain nodes for
+maintenance.  This mixin converts that from a post-mortem fault
+(node-death retries, lineage reconstruction, Serve failover blips) into
+a zero-loss transition (reference analogs: the raylet's DrainRaylet
+RPC + node drain in gcs_node_manager, and tf.data service workers
+leaving a cluster without losing work).
+
+Drain sequence (``_drain_loop``), every phase bounded by the drain
+deadline:
+
+1. **hand back** queued-but-unstarted tasks — foreign (forwarded-in)
+   tasks return to their owner for resubmission elsewhere
+   (``drain_handback``); locally-owned tasks spill to a healthy peer.
+2. **re-replicate** primary object copies whose ONLY holder is this
+   node to healthy peers over the streaming transfer plane (priority:
+   owned refs with live borrowers first, largest last); small inline
+   payloads are pushed into the GCS record directly.  Runs before the
+   actor phase so migrated constructors can pull their args, and again
+   after quiesce for results produced during the drain.
+3. **migrate actors** — each live actor's queue is held, in-flight
+   calls drain, then the creation spec replays on a healthy peer
+   (restart-then-redirect: the GCS actor directory flips via
+   ``set_actor_node`` and handles re-resolve), WITHOUT consuming
+   ``max_restarts`` budget; queued calls forward to the new home.
+4. **quiesce** — running tasks get the remaining grace to finish;
+   past the deadline the workers are killed and the ordinary
+   kill-and-retry path (PR 3) takes over.
+5. report ``mark_node_dead(reason="drained")`` and exit.
+
+Triggers: a GCS ``node_draining`` event (``ray_tpu drain`` CLI /
+``Cluster.drain_node``), SIGTERM on the node process, a preemption
+notice file (``config.preemption_notice_file``, pollable so tests and
+GCE metadata shims can write it), or the seeded chaos kind
+``preempt`` (site ``node``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.chaos import chaos
+from ray_tpu._private.config import config
+from ray_tpu._private.node_state import READY, TaskRecord, _ConnCtx
+
+
+class DrainMixin:
+    # Set by node_service.main(): called once the drain sequence ends
+    # so the hosting process can exit.
+    _drain_exit_cb = None
+
+    def _init_drain_state(self) -> None:
+        """Called from NodeService.__init__."""
+        self.draining = False
+        self._drain_reason = ""
+        self._drain_reason_tag = ""
+        self._drain_grace = 0.0
+        self._drain_deadline = 0.0
+        self._drain_started = 0.0
+        self._drain_thread: Optional[threading.Thread] = None
+        # Tasks handed off across ALL sweeps (the drain loop's first
+        # pass plus the monitor-tick sweeps that catch late arrivals).
+        self._drain_handed = 0
+        # A preemption-notice file fires ONE drain while it persists
+        # (metadata shims leave the file in place; single-node drains
+        # return to normal operation afterwards and must not re-drain
+        # every tick, killing workers at each grace deadline).
+        self._notice_consumed = False
+        # actor_id -> new home node id, for actors migrated off this
+        # node: late calls from peers with stale home hints re-forward.
+        self._migrated_actors: Dict[bytes, bytes] = {}
+        # creation task ids of in-flight actor migrations: the drain
+        # waits for their forward_done before declaring itself clean.
+        self._drain_migrations: set = set()
+
+    # ------------------------------------------------------------------
+    # triggers
+    # ------------------------------------------------------------------
+    def _begin_drain(self, reason_tag: str, detail: str = "",
+                     grace_s: Optional[float] = None,
+                     publish: bool = True) -> None:
+        """Idempotent drain entry.  reason_tag is the metric label
+        (gcs | sigterm | preemption | chaos_preempt); detail is the
+        human-readable cause.  publish=False when the GCS already
+        knows (the drain was GCS-initiated)."""
+        grace = (config.drain_grace_s if grace_s is None or grace_s <= 0
+                 else float(grace_s))
+        with self.lock:
+            if self.draining or self._shutdown:
+                return
+            self.draining = True
+            self._drain_reason_tag = reason_tag
+            self._drain_reason = detail or reason_tag
+            self._drain_grace = grace
+            self._drain_started = time.time()
+            self._drain_deadline = self._drain_started + grace
+            from ray_tpu.util.metrics import NODE_DRAINS_METRIC
+            self._inc_counter(NODE_DRAINS_METRIC,
+                              {"reason": reason_tag},
+                              "graceful node drains, by trigger")
+        if publish and self.multinode:
+            try:
+                self.gcs.drain_node(self.node_id, grace,
+                                    self._drain_reason)
+            except Exception:
+                pass
+        t = threading.Thread(target=self._drain_loop, daemon=True,
+                             name="rtpu-drain")
+        self._drain_thread = t
+        t.start()
+
+    def _drain_monitor_tick(self) -> None:
+        """Periodic (from _monitor_loop): watch for a preemption
+        notice — file-based (GCE metadata shims / tests write it) or
+        the seeded chaos kind `preempt` — and, while draining, sweep
+        stragglers (work that arrived after the first handback pass)."""
+        if self.draining:
+            try:
+                self._drain_handback_tasks()
+            except Exception:
+                pass
+            return
+        path = config.preemption_notice_file
+        if path and not os.path.exists(path):
+            self._notice_consumed = False   # notice withdrawn: re-arm
+        if path and os.path.exists(path) and not self._notice_consumed:
+            self._notice_consumed = True
+            deadline_s = None
+            try:
+                raw = open(path).read().strip()
+                if raw:
+                    try:
+                        deadline_s = float(raw)
+                    except ValueError:
+                        deadline_s = float(
+                            json.loads(raw).get("deadline_s", 0) or 0)
+            except Exception:
+                pass
+            self._begin_drain("preemption",
+                              f"preemption notice at {path}",
+                              grace_s=deadline_s)
+            return
+        spec = chaos.fire_spec("node", "preempt")
+        if spec is not None:
+            self._begin_drain(
+                "chaos_preempt",
+                "chaos: simulated TPU preemption notice",
+                grace_s=spec.get("deadline_s") or None)
+
+    # ------------------------------------------------------------------
+    # the drain sequence
+    # ------------------------------------------------------------------
+    def _drain_loop(self) -> None:
+        migrated = moved = 0
+        clean = True
+        try:
+            self._drain_handback_tasks()
+            moved = self._drain_replicate_objects()
+            migrated = self._drain_migrate_actors()
+            clean = self._drain_quiesce()
+            # Second replication pass: tasks that finished DURING the
+            # drain published fresh sole-holder results.
+            moved += self._drain_replicate_objects()
+            self._drain_flush_peer_sends()
+        except Exception:
+            clean = False
+        duration = time.time() - self._drain_started
+        self._emit_drain_event(self._drain_handed, migrated, moved,
+                               clean, duration)
+        if not self.multinode:
+            # Embedded single-node service: the "VM" cannot exit (it is
+            # the driver process).  Past-deadline work was already
+            # killed onto the retry path; resume normal scheduling.
+            with self.lock:
+                self.draining = False
+                self._schedule()
+            return
+        try:
+            self.gcs.mark_node_dead(
+                self.node_id,
+                "drained" if clean else
+                f"drain deadline expired ({self._drain_reason})")
+        except Exception:
+            pass
+        cb = self._drain_exit_cb
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def _drain_peers(self) -> List[dict]:
+        """Healthy (alive, non-draining) peers from the cluster view."""
+        return [n for n in self._cluster_view
+                if n["node_id"] != self.node_id
+                and n.get("state") == "alive"]
+
+    # -- phase 1: hand back queued work ---------------------------------
+    def _drain_handback_tasks(self) -> int:
+        """Queued-but-unstarted plain tasks leave the node: foreign
+        (forwarded-in) ones go back to their owner for resubmission
+        elsewhere, owned ones spill to a healthy peer.  Tasks that
+        cannot move (no feasible peer, PG-pinned, hard affinity here)
+        stay and get the grace period to run locally."""
+        if not self.multinode:
+            return 0
+        handed = 0
+        notifies: List[Tuple[bytes, dict]] = []
+        with self.lock:
+            candidates = [r for r in list(self.pending_queue)
+                          if r.actor_id is None
+                          and not r.is_actor_creation
+                          and r.spec.get("pg") is None
+                          and not r.cancelled]
+            candidates += [r for r in self.tasks.values()
+                           if r.state == "retry_backoff"
+                           and r.actor_id is None
+                           and not r.is_actor_creation
+                           and r.spec.get("pg") is None
+                           and not r.cancelled]
+            for rec in candidates:
+                aff = rec.spec.get("affinity")
+                if aff is not None and aff["node_id"] == self.node_id \
+                        and not aff.get("soft"):
+                    rec.drain_keep = True   # pinned here: run in grace
+                    continue
+                owner = rec.spec.get("owner_node")
+                if owner not in (None, self.node_id):
+                    if self._cluster_node(owner) is None:
+                        rec.drain_keep = True   # owner gone: run here
+                        continue
+                    # Return the spec to its owner: the owner still
+                    # holds the original TaskRecord in `forwarded` and
+                    # requeues it there — no ownership flip, no extra
+                    # ref bookkeeping (see _h_drain_handback).
+                    try:
+                        self.pending_queue.remove(rec)
+                    except ValueError:
+                        pass
+                    self.tasks.pop(rec.task_id, None)
+                    rec.state = "handed_back"
+                    notifies.append((owner, {"type": "drain_handback",
+                                             "spec": rec.spec,
+                                             "from": self.node_id}))
+                    handed += 1
+                    continue
+                res = dict(rec.spec.get("resources") or {})
+                target = (self._pick_spill_target(res, need_avail=True)
+                          or self._pick_spill_target(res,
+                                                     need_avail=False))
+                if target is None:
+                    rec.drain_keep = True   # nowhere to go: run here
+                    continue
+                rec.spec.pop("spilled", None)
+                rec.state = "pending"
+                self._forward_task(rec, target)
+                handed += 1
+        for nid, msg in notifies:
+            self._peer_notify(nid, msg)
+        with self.lock:
+            self._drain_handed += handed
+        return handed
+
+    def _h_drain_handback(self, ctx: _ConnCtx, m: dict) -> None:
+        """A draining node returned one of OUR forwarded tasks before
+        running it: requeue the original record for resubmission
+        elsewhere (mirror of _forward_send_failed's requeue).  Actor
+        calls re-resolve the actor's (possibly migrated) home through
+        the GCS directory and re-forward there."""
+        spec = m["spec"]
+        with self.lock:
+            pair = self.forwarded.get(spec["task_id"])
+            if pair is None or pair[1] != m.get("from"):
+                # Already resolved — OR already re-routed: a LATE
+                # handback (sender's flush raced its exit) arriving
+                # after this owner re-forwarded the task elsewhere
+                # (node-death retry) must not pop the new entry and
+                # double-submit the task.
+                return
+            del self.forwarded[spec["task_id"]]
+            rec, _ = pair
+            rec.state = "pending"
+            rec.worker = None
+            rec.spec.pop("spilled", None)
+            rec.deps = {a[1] for a in rec.spec["args"]
+                        if a[0] == "ref"
+                        and not self._object_ready(a[1])}
+            for d in rec.deps:
+                self._ensure_pull(d)
+            self.tasks[rec.task_id] = rec
+            if rec.actor_id is not None and not rec.is_actor_creation:
+                actor_rec = rec
+            else:
+                actor_rec = None
+                self.pending_queue.append(rec)
+                self._schedule()
+        if actor_rec is None:
+            return
+        home = None     # gcs call OUTSIDE the lock
+        try:
+            home = self.gcs.get_actor_node(actor_rec.actor_id)
+        except Exception:
+            pass
+        with self.lock:
+            if actor_rec.actor_id in self.actors:
+                self._enqueue_actor_task(actor_rec)
+                self._schedule()
+                return
+            ninfo = self._cluster_node(home) if home else None
+            if ninfo is not None and ninfo.get("state") == "alive":
+                self._actor_homes[actor_rec.actor_id] = home
+                self._forward_task(actor_rec, ninfo)
+            else:
+                self.tasks.pop(actor_rec.task_id, None)
+                from ray_tpu import exceptions as exc
+                self._fail_task_returns(actor_rec, exc.ActorDiedError(
+                    actor_rec.actor_id.hex(),
+                    "actor's node drained and no new home is known",
+                    task_started=False))
+
+    # -- phase 2/5: proactive re-replication -----------------------------
+    def _drain_sole_holder_candidates(self) -> List[Tuple[bytes, dict]]:
+        """(oid, plan) for READY local copies, priority-ordered: owned
+        refs with live borrowers first, largest last.  Caller must NOT
+        hold the lock (does GCS round-trips)."""
+        with self.lock:
+            local = []
+            for oid, e in self.objects.items():
+                if e.state != READY or e.deleted or e.spilling:
+                    continue
+                if e.loc not in ("shm", "spilled", "inline"):
+                    continue
+                borrowed = e.refcount > 1 or bool(e.waiters)
+                local.append((oid, e.foreign, not borrowed,
+                              e.size or 0, e.loc, e.data))
+        # owned (foreign=False) first, borrowed first, largest last.
+        local.sort(key=lambda t: (t[1], t[2], t[3]))
+        out: List[Tuple[bytes, dict]] = []
+        for oid, _foreign, _nb, size, loc, data in local:
+            try:
+                locs = self.gcs.get_locations(oid)
+            except Exception:
+                continue
+            if locs.get("kind") in ("inline", "error") \
+                    and locs.get("data") is not None:
+                continue    # payload already rides the GCS record
+            holders = {n["node_id"] for n in (locs.get("nodes") or ())}
+            if holders - {self.node_id}:
+                continue    # another holder exists — safe already
+            if not holders:
+                continue    # never published (local-only scratch)
+            out.append((oid, {"size": size, "loc": loc, "data": data}))
+        return out
+
+    def _drain_replicate_objects(self) -> int:
+        """Move sole-holder primary copies to healthy peers before the
+        node exits: inline payloads are pushed straight into the GCS
+        record (they then survive ANY node death); shm/spilled copies
+        are pulled by a peer over the PR-4 streaming transfer plane
+        (`replicate_object` → peer-side _ensure_pull)."""
+        if not self.multinode:
+            return 0
+        candidates = self._drain_sole_holder_candidates()
+        if not candidates:
+            return 0
+        peers = self._drain_peers()
+        moved = 0
+        pending: List[bytes] = []
+        i = 0
+        for oid, plan in candidates:
+            if plan["loc"] == "inline" and plan["data"] is not None:
+                try:
+                    self.gcs.add_location(oid, None, plan["size"],
+                                          kind="inline",
+                                          data=plan["data"])
+                    moved += 1
+                except Exception:
+                    pass
+                continue
+            if not peers:
+                continue
+            peer = peers[i % len(peers)]
+            i += 1
+            try:
+                self._peer_conn_to(peer).notify(
+                    {"type": "replicate_object", "object_id": oid})
+                pending.append(oid)
+            except Exception:
+                pass
+        # Await the replicas.  Bounded by its OWN budget (half the
+        # grace), not the whole drain deadline: one unfulfillable pull
+        # (peer store full, lost notify) must not starve the actor
+        # migration and quiesce phases of their grace.
+        rep_deadline = min(self._drain_deadline,
+                           time.time() + max(2.0,
+                                             self._drain_grace * 0.5))
+        while pending and time.time() < rep_deadline:
+            still = []
+            for oid in pending:
+                try:
+                    locs = self.gcs.get_locations(oid)
+                except Exception:
+                    still.append(oid)
+                    continue
+                holders = {n["node_id"]
+                           for n in (locs.get("nodes") or ())}
+                if holders - {self.node_id} or (
+                        locs.get("kind") in ("inline", "error")
+                        and locs.get("data") is not None):
+                    moved += 1
+                else:
+                    still.append(oid)
+            pending = still
+            if pending:
+                time.sleep(0.05)
+        if moved:
+            from ray_tpu.util.metrics import (
+                DRAIN_OBJECTS_REPLICATED_METRIC)
+            with self.lock:
+                self._inc_counter(
+                    DRAIN_OBJECTS_REPLICATED_METRIC, {},
+                    "sole-holder object copies re-replicated during "
+                    "drain", value=float(moved))
+        return moved
+
+    def _h_replicate_object(self, ctx: _ConnCtx, m: dict) -> None:
+        """A draining peer asked this node to adopt a replica of an
+        object it solely holds: pull it through the ordinary pull
+        manager (streaming transfer plane, GCS location publish).  The
+        pulled entry keeps its directory refcount until the owner
+        deletes the object, so the replica outlives the drain."""
+        with self.lock:
+            self._ensure_pull(m["object_id"])
+
+    # -- phase 3: actor migration ----------------------------------------
+    def _drain_migrate_actors(self) -> int:
+        """Restart-then-redirect for every actor on the node: hold new
+        dispatch, wait for in-flight calls, replay the creation spec on
+        a healthy peer (budget preserved — a drain is not a crash),
+        flip the GCS actor directory, forward queued calls."""
+        if not self.multinode:
+            return 0
+        with self.lock:
+            for a in self.actors.values():
+                # PG-bundled actors never migrate (their creation would
+                # route right back to this bundle's node): they run
+                # within the grace and the PG machinery re-places the
+                # whole group on node death.
+                if a.state != "dead" and a.spec.get("pg") is None:
+                    a.hold_queue = True
+        migrated = 0
+        skip: set = set()
+        while time.time() < self._drain_deadline:
+            with self.lock:
+                remaining = [a for a in self.actors.values()
+                             if a.state != "dead"
+                             and a.spec.get("pg") is None
+                             and a.actor_id not in skip]
+                ready = [a for a in remaining
+                         if a.state == "alive" and not a.in_flight]
+            if not remaining:
+                break
+            if not ready:
+                time.sleep(0.05)
+                continue
+            for actor in ready:
+                if self._drain_migrate_one(actor):
+                    migrated += 1
+                else:
+                    skip.add(actor.actor_id)
+                    with self.lock:
+                        # No peer can host it: release the hold so its
+                        # queued calls at least run locally during the
+                        # grace (mirror of drain_keep for plain tasks)
+                        # before the actor dies with the node.
+                        actor.hold_queue = False
+                        self._drain_actor_queue(actor)
+        return migrated
+
+    def _drain_migrate_one(self, actor) -> bool:
+        """Move one quiesced actor to a healthy peer.  Returns False
+        when no peer can host it (it then dies with the node and its
+        callers see the ordinary retry/ActorDiedError path)."""
+        aid = actor.actor_id
+        spec = dict(actor.spec)
+        res = dict(spec.get("resources") or {})
+        with self.lock:
+            target = (self._pick_spill_target(res, need_avail=True)
+                      or self._pick_spill_target(res, need_avail=False))
+        if target is None:
+            return False
+        # Fresh creation task (restart replay), remaining restart
+        # budget carried over — the drain consumes none of it.  Node
+        # affinity to THIS node is cleared: the node is leaving.
+        creation = dict(spec["creation_task"])
+        creation["task_id"] = os.urandom(16)
+        creation["return_ids"] = [os.urandom(16)]
+        creation["owner_node"] = self.node_id
+        spec["creation_task"] = creation
+        spec["max_restarts"] = actor.restarts_left
+        aff = spec.get("affinity")
+        if aff is not None and aff["node_id"] == self.node_id:
+            spec["affinity"] = None
+        crec = TaskRecord(creation)
+        with self.lock:
+            # Track like any forwarded creation so this node's embedded
+            # arg holds release on the remote forward_done; the local
+            # actor record's own release path is disarmed below.
+            self.forwarded[crec.task_id] = (crec, target["node_id"])
+            self._drain_migrations.add(crec.task_id)
+        try:
+            conn = self._peer_conn_to(target)
+            # RPC timeout capped by the remaining grace: a slow peer
+            # must not pin the drain thread past the preemption
+            # deadline and rob quiesce of its kill-and-retry fallback.
+            conn.call({"type": "create_actor", "spec": spec},
+                      timeout=max(2.0, min(
+                          30.0, self._drain_deadline - time.time())))
+        except Exception:
+            with self.lock:
+                self.forwarded.pop(crec.task_id, None)
+                self._drain_migrations.discard(crec.task_id)
+            return False
+        # Flip the directory BEFORE releasing queued calls back to
+        # their owners: a handback beating set_actor_node would make
+        # the owner re-resolve the STALE (draining) home and fail the
+        # call as actor-dead on a zero-loss drain.
+        try:
+            self.gcs.set_actor_node(aid, target["node_id"])
+        except Exception:
+            pass
+        notifies = []
+        with self.lock:
+            actor.holds_released = True     # forward_done releases them
+            self.actors.pop(aid, None)
+            self._actor_homes[aid] = target["node_id"]
+            self._migrated_actors[aid] = target["node_id"]
+            queued = list(actor.queue)
+            actor.queue.clear()
+            worker = actor.worker
+            actor.worker = None
+            for rec in queued:
+                owner = rec.spec.get("owner_node")
+                if owner not in (None, self.node_id) \
+                        and self._cluster_node(owner) is not None:
+                    # Queued calls forwarded here by ANOTHER owner hand
+                    # back to it: forwarding them to the new home
+                    # directly would re-own them to this exiting node
+                    # (the fwd sender stamps owner_node), and the true
+                    # owner's node-death sweep would then fail — or
+                    # double-run — a call that executes fine at the new
+                    # home.  The owner re-resolves the migrated actor
+                    # through the GCS directory and resubmits (order
+                    # preserved: both hops ride per-target FIFOs).
+                    self.tasks.pop(rec.task_id, None)
+                    rec.state = "handed_back"
+                    notifies.append((owner,
+                                     {"type": "drain_handback",
+                                      "spec": rec.spec,
+                                      "from": self.node_id}))
+                else:
+                    # Locally-owned call (its owner dies with this
+                    # node anyway): follow the actor to its new home
+                    # over the same FIFO the creation rode.
+                    rec.state = "pending"
+                    self._forward_task(rec, target)
+            if worker is not None:
+                self._teardown_worker(worker)
+        for nid, msg in notifies:
+            self._peer_notify(nid, msg)
+        return True
+
+    # -- phase 4: quiesce -------------------------------------------------
+    def _drain_quiesce(self) -> bool:
+        """Wait (until the deadline) for the node to empty out: busy
+        workers, in-flight actor calls, in-flight actor migrations,
+        and movable queued tasks (forwards that landed here mid-drain
+        keep getting handed off by the sweep).  Past the deadline,
+        kill the stragglers — worker death then drives the ordinary
+        retry path.  Returns True for a clean (zero-kill) quiesce."""
+        clean_streak = 0
+        while True:
+            try:
+                self._drain_handback_tasks()    # catch late arrivals
+            except Exception:
+                pass
+            with self.lock:
+                busy = [w for w in self.workers.values()
+                        if w.state in ("busy", "blocked")]
+                inflight = any(a.in_flight
+                               for a in self.actors.values())
+                migrating = any(t in self.forwarded
+                                for t in self._drain_migrations)
+                # EVERY queued plain task counts: movable ones are
+                # waiting on the handback sweep, immovable ones
+                # (drain_keep, PG-bundled) were promised the grace
+                # period to run locally — exiting over either loses
+                # work to the node-death retry path.  Un-held actor
+                # queues (unmigratable actors released back to local
+                # dispatch) count the same way.
+                queued = any(not r.is_actor_creation
+                             for r in self.pending_queue
+                             if r.actor_id is None)
+                queued = queued or any(
+                    a.queue for a in self.actors.values()
+                    if a.state != "dead" and not a.hold_queue)
+            if not busy and not inflight and not migrating \
+                    and not queued:
+                # Settle before declaring empty: a forward dispatched
+                # by a peer that had not yet observed node_draining can
+                # still be in flight into this node's socket — exiting
+                # under it would downgrade its zero-loss handback to a
+                # node-death retry at the owner.  Peers refresh their
+                # cluster view within ~heartbeat_interval/2, so a few
+                # consecutive quiet checks close the window.
+                clean_streak += 1
+                if clean_streak >= 4 \
+                        or time.time() >= self._drain_deadline:
+                    return True
+                time.sleep(0.1)
+                continue
+            clean_streak = 0
+            if time.time() >= self._drain_deadline:
+                with self.lock:
+                    for w in list(self.workers.values()):
+                        if w.state in ("busy", "blocked"):
+                            try:
+                                if w.proc is not None:
+                                    w.proc.kill()
+                            except Exception:
+                                pass
+                return False
+            time.sleep(0.05)
+
+    def _drain_flush_peer_sends(self) -> None:
+        """Give the per-peer FIFO senders a moment to flush queued
+        handbacks / forward_done notifies before the process exits: a
+        notify lost with the exit is RECOVERABLE (the owner's
+        node-death sweep resubmits), but flushing keeps the common
+        case zero-retry."""
+        deadline = min(self._drain_deadline, time.time() + 2.0)
+        while time.time() < deadline:
+            if all(q.empty() for q in list(self._fwd_queues.values())):
+                time.sleep(0.1)     # senders may hold a dequeued item
+                return
+            time.sleep(0.05)
+
+    # -- observability ----------------------------------------------------
+    def _emit_drain_event(self, handed: int, migrated: int, moved: int,
+                          clean: bool, duration: float) -> None:
+        from ray_tpu.util.metrics import (DRAIN_DURATION_BUCKETS,
+                                          DRAIN_DURATION_METRIC)
+        now = time.time()
+        ev = {
+            "kind": "drain",
+            "name": "node:drain",
+            "reason": self._drain_reason,
+            "reason_tag": self._drain_reason_tag,
+            "grace_s": self._drain_grace,
+            "tasks_handed_back": handed,
+            "actors_migrated": migrated,
+            "objects_moved": moved,
+            "completed": clean,
+            "start": self._drain_started,
+            "end": now,
+            "pid": 0,
+            "node_id": self.node_id.hex(),
+        }
+        with self.lock:
+            self._events.append(ev)
+            self._observe_hist(DRAIN_DURATION_METRIC, {}, duration,
+                               DRAIN_DURATION_BUCKETS,
+                               "graceful node drain duration")
+        if self.multinode:
+            # The node is about to exit; park a copy of the event on a
+            # surviving peer so cluster timelines still show the drain.
+            for peer in self._drain_peers()[:1]:
+                self._peer_notify(peer["node_id"],
+                                  {"type": "profile_event", "event": ev})

@@ -1070,9 +1070,14 @@ class ObjectPlaneMixin:
                 entry = self.objects.get(oid)
                 if entry is None:
                     entry = ObjectEntry()
+                    # Ownership decided at entry birth, never flipped:
+                    # a pre-existing entry (this node already owns or
+                    # borrowed the object) keeps its ownership even
+                    # when a forward re-lands here (drain handbacks,
+                    # multi-hop spills).
+                    entry.foreign = True   # owner directory = sender
                     self.objects[oid] = entry
                 entry.producing_task = rec.task_id
-                entry.foreign = True      # owner directory is the sender
             rec.deps = {d for d in rec.deps if not self._object_ready(d)}
             for d in rec.deps:
                 self._ensure_pull(d)
@@ -1138,6 +1143,8 @@ class ObjectPlaneMixin:
         best = None
         best_key = None
         for n in self._cluster_view:
+            # != "alive" also excludes DRAINING peers: a departing node
+            # must not receive new work it would only hand back.
             if n["node_id"] == self.node_id or n.get("state") != "alive":
                 continue
             pool = n["resources_avail"] if need_avail \
@@ -1261,9 +1268,30 @@ class ObjectPlaneMixin:
                     # NOTIFIES are simply dropped — no loop to damp,
                     # so no sleep stalling the FIFO behind them.
                     time.sleep(0.05)
-                    self._forward_send_failed(a)
+                    self._forward_send_failed(a, nid)
 
-    def _forward_send_failed(self, rec: TaskRecord) -> None:
+    def _forward_send_failed(self, rec: TaskRecord,
+                             failed_nid: Optional[bytes] = None) -> None:
+        if rec.actor_id is not None and not rec.is_actor_creation:
+            # The actor may have MIGRATED off the unreachable node
+            # (graceful drain re-points the GCS directory): re-resolve
+            # before declaring it dead.  No self.lock held (gcs call).
+            home = None
+            try:
+                home = self.gcs.get_actor_node(rec.actor_id)
+            except Exception:
+                pass
+            ninfo = (self._cluster_node(home)
+                     if home is not None and home != failed_nid
+                     else None)
+            if ninfo is not None and ninfo.get("state") == "alive":
+                with self.lock:
+                    if self.forwarded.pop(rec.task_id, None) is None:
+                        return
+                    self._actor_homes[rec.actor_id] = home
+                    rec.state = "pending"
+                    self._forward_task(rec, ninfo)
+                return
         with self.lock:
             if self.forwarded.pop(rec.task_id, None) is None:
                 return  # node-death handler already resolved it

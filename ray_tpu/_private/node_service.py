@@ -32,6 +32,7 @@ from ray_tpu._private.chaos import chaos
 from ray_tpu._private.config import config
 from ray_tpu._private.gcs import GlobalControlState
 from ray_tpu._private.node_agent import NodeAgentMixin
+from ray_tpu._private.node_drain import DrainMixin
 from ray_tpu._private.node_native import NativeWorkerMixin
 from ray_tpu._private.node_objects import ObjectPlaneMixin
 from ray_tpu._private.node_pg import PlacementGroupMixin
@@ -45,7 +46,7 @@ from ray_tpu._private.node_state import (  # noqa: F401
 
 class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                   StreamChannelMixin, NodeAgentMixin,
-                  NativeWorkerMixin):
+                  NativeWorkerMixin, DrainMixin):
     """Per-node daemon: scheduler, worker pool, object directory.
 
     Single-node: runs inside the driver process (threads) with an
@@ -197,6 +198,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         # chan_send to it (forwarded node-to-node when remote) with
         # bounded capacity + parked-reply backpressure.
         self._dag_queues: Dict[bytes, dict] = {}
+        # Graceful-drain state (node_drain.DrainMixin).
+        self._init_drain_state()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -591,6 +594,23 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 with self.lock:
                     self._schedule()
             return
+        if event == "node_draining":
+            if nid == self.node_id:
+                # GCS-initiated drain of THIS node (CLI / operator):
+                # the GCS already flipped the state — don't re-publish.
+                self._begin_drain("gcs",
+                                  info.get("reason") or "drain requested",
+                                  grace_s=info.get("grace_s"),
+                                  publish=False)
+            else:
+                # Stop targeting the draining peer immediately (the
+                # heartbeat refresh would catch up within ~0.5s, but
+                # every task spilled there in the window is a task it
+                # must hand back).
+                for n in self._cluster_view:
+                    if n["node_id"] == nid:
+                        n["state"] = "draining"
+            return
         if event != "node_dead" or nid == self.node_id:
             return
         with self._peer_lock:
@@ -612,13 +632,20 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         dead_reason = f"node {nid.hex()[:8]} died: " \
                       f"{info.get('reason') or 'lost heartbeats'}"
         retry, fail, pull_check = [], [], []
+        dead_actors = set(info.get("dead_actors", ()))
         with self.lock:
-            for aid in info.get("dead_actors", ()):
+            for aid in dead_actors:
                 self._remote_actor_tombstones[aid] = dead_reason
             for aid, home in list(self._actor_homes.items()):
                 if home == nid:
-                    self._remote_actor_tombstones[aid] = dead_reason
+                    # Drop the stale hint always; tombstone only actors
+                    # the GCS confirms died THERE — an actor migrated
+                    # off a drained node lives elsewhere now (the GCS
+                    # directory was re-pointed via set_actor_node), and
+                    # the next call re-resolves it.
                     del self._actor_homes[aid]
+                    if aid in dead_actors:
+                        self._remote_actor_tombstones[aid] = dead_reason
             for tid, (rec, target) in list(self.forwarded.items()):
                 if target != nid:
                     continue
@@ -781,6 +808,19 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     home = None
                 if home is not None:
                     self._actor_homes[aid] = home
+            if not local and home is not None:
+                ninfo = self._cluster_node(home)
+                if ninfo is None or ninfo.get("state") != "alive":
+                    # Stale hint: the cached home is draining or gone —
+                    # the actor may have MIGRATED (drain restarts actors
+                    # elsewhere and re-points the GCS directory).
+                    try:
+                        fresh = self.gcs.get_actor_node(aid)
+                    except Exception:
+                        fresh = None
+                    if fresh is not None and fresh != home:
+                        home = fresh
+                        self._actor_homes[aid] = home
         with self.lock:
             if (aid is not None and aid not in self.actors
                     and self.multinode):
@@ -1515,15 +1555,18 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 and aff["node_id"] != self.node_id):
             ninfo = (self._cluster_node(aff["node_id"])
                      if self.multinode else None)
-            if ninfo is None:
+            if ninfo is None or (aff.get("soft")
+                                 and ninfo.get("state") != "alive"):
                 if not aff.get("soft"):
                     ctx.reply(m, {"__error__": exc.NodeAffinityError(
                         f"affinity node {aff['node_id'].hex()[:12]} is "
                         f"not alive (soft=False)")})
                     return
-                # Soft affinity to a dead/unknown node: fall back to
-                # normal placement (spill targets included) — same
-                # semantics as the task path clearing rec affinity.
+                # Soft affinity to a dead/unknown/DRAINING node: fall
+                # back to normal placement (spill targets included) —
+                # same semantics as the task path clearing rec
+                # affinity.  An actor placed on a departing node would
+                # need an immediate second migration.
                 spec = dict(spec)
                 spec["affinity"] = None
                 aff = None
@@ -1538,6 +1581,15 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                             or aff["node_id"] == self.node_id
                             or self._cluster_node(aff["node_id"]) is None
                             else False)
+                if self.draining and local_ok and (
+                        aff is None or aff["node_id"] != self.node_id):
+                    # Draining: a brand-new actor would outlive the
+                    # node only via a second migration — place it on a
+                    # healthy peer up front (the actor-migration phase
+                    # only covers actors that exist when it runs).
+                    # Hard affinity HERE still creates locally and
+                    # rides the grace.
+                    local_ok = False
             if not local_ok:
                 if aff is not None:
                     target = self._cluster_node(aff["node_id"])
@@ -1583,8 +1635,14 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         if spec.get("name") and (spec.get("pg") is not None
                 or self._autoscaler_live()
                 or self._infeasible_reason(spec.get("resources")) is None):
-            ok = self.gcs.register_named_actor(
-                spec.get("namespace", "default"), spec["name"], actor_id)
+            ns = spec.get("namespace", "default")
+            ok = self.gcs.register_named_actor(ns, spec["name"], actor_id)
+            if not ok and self.gcs.lookup_named_actor(
+                    ns, spec["name"]) == actor_id:
+                # The SAME actor re-registering its own name: a drain
+                # migration replays the creation spec on a new node
+                # while the GCS registration survives — idempotent.
+                ok = True
             if not ok:
                 ctx.reply(m, {"__error__": ValueError(
                     f"actor name {spec['name']!r} already taken")})
@@ -1668,6 +1726,28 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
 
     def _enqueue_actor_task(self, rec: TaskRecord) -> None:
         actor = self.actors.get(rec.actor_id)
+        if actor is None and self.multinode:
+            # A call routed here on a stale home hint after the actor
+            # migrated off this (draining) node: redirect to its new
+            # home instead of failing.  Foreign-owned calls hand BACK
+            # to their owner (re-forwarding onward would re-own them
+            # to this exiting node, and the owner's node-death sweep
+            # would fail or double-run a call executing fine at the
+            # new home — same rule as _drain_migrate_one).
+            home = self._migrated_actors.get(rec.actor_id)
+            ninfo = self._cluster_node(home) if home else None
+            if ninfo is not None and ninfo.get("state") == "alive":
+                owner = rec.spec.get("owner_node")
+                if owner not in (None, self.node_id) \
+                        and self._cluster_node(owner) is not None:
+                    self.tasks.pop(rec.task_id, None)
+                    rec.state = "handed_back"
+                    self._peer_notify(owner, {"type": "drain_handback",
+                                              "spec": rec.spec,
+                                              "from": self.node_id})
+                else:
+                    self._forward_task(rec, ninfo)
+                return
         if actor is None or actor.state == "dead":
             reason = actor.death_reason if actor else "unknown actor"
             self._fail_task_returns(rec, exc.ActorDiedError(
@@ -1678,6 +1758,11 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
 
     def _drain_actor_queue(self, actor: ActorRecord) -> None:
         if actor.state != "alive" or actor.worker is None:
+            return
+        if actor.hold_queue:
+            # Node drain is migrating this actor: no new dispatch —
+            # queued calls forward to the new home once in-flight ones
+            # finish (node_drain._drain_migrate_one).
             return
         # Head-of-line blocking on unmet deps preserves the sync-actor
         # strict submission-order guarantee (a later no-dep call must not
@@ -1760,7 +1845,12 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         never diverge by cause of death."""
         actor.state = "dead"
         actor.death_reason = reason
-        self.gcs.drop_named_actor(actor.actor_id)
+        try:
+            self.gcs.drop_named_actor(actor.actor_id)
+        except Exception:
+            # Best-effort cleanup: at shutdown the GCS connection may
+            # already be closed when a worker disconnect lands here.
+            pass
         self._release_actor_holds(actor)
         self._fail_actor_queue(actor)
         if teardown_worker and actor.worker is not None:
@@ -2005,9 +2095,11 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         clusters."""
         from concurrent.futures import ThreadPoolExecutor
 
+        # Draining nodes are still reachable and still hold state worth
+        # observing (their tasks/objects appear in dumps until they go).
         peers = [n for n in self._cluster_view
                  if n["node_id"] != self.node_id
-                 and n.get("state") == "alive"]
+                 and n.get("state") in ("alive", "draining")]
         if not peers:
             return [], []
         results: List[Tuple[dict, dict]] = []
@@ -2329,6 +2421,16 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             for rec in list(self.pending_queue):
                 if rec.deps:
                     continue
+                if (self.draining and self.multinode
+                        and rec.actor_id is None
+                        and not rec.is_actor_creation
+                        and rec.spec.get("pg") is None
+                        and not rec.drain_keep):
+                    # Draining: no new leases for movable work — the
+                    # handback sweep (node_drain) forwards it to a
+                    # healthy peer or marks it drain_keep when nothing
+                    # can take it (then it runs here within the grace).
+                    continue
                 res = dict(rec.spec.get("resources") or {})
                 needs_tpu = res.get("TPU", 0) > 0
                 aff = rec.spec.get("affinity")
@@ -2336,9 +2438,15 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     # Node affinity: route to the pinned node; hard
                     # affinity to a dead node fails, soft falls back
                     # (reference: NodeAffinitySchedulingStrategy).
+                    # A DRAINING target counts as gone for SOFT
+                    # affinity (chasing it would ping-pong with its
+                    # handback sweep); hard pins still forward — the
+                    # node can run the task within its drain grace.
                     ninfo = (self._cluster_node(aff["node_id"])
                              if self.multinode else None)
-                    if ninfo is not None:
+                    if ninfo is not None and (
+                            ninfo.get("state") == "alive"
+                            or not aff.get("soft")):
                         self._forward_task(rec, ninfo)
                         progressed = True
                         continue
@@ -2862,6 +2970,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         # on time instead of quantized to the next 50ms tick, and
         # shutdown never pays a last stale sleep.
         next_spill = next_infeasible = next_mem = next_scan = 0.0
+        next_drain = 0.0
         while not self._shutdown:
             with self.lock:
                 nearest = min(
@@ -2887,6 +2996,12 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 next_infeasible = now + 2.0
                 try:
                     self._recheck_infeasible()
+                except Exception:
+                    pass
+            if now >= next_drain:    # ~0.25s: preemption notice /
+                next_drain = now + 0.25   # chaos preempt / drain sweep
+                try:
+                    self._drain_monitor_tick()
                 except Exception:
                     pass
             refresh_ms = config.memory_monitor_refresh_ms
@@ -2989,7 +3104,23 @@ def main() -> None:
     print(f"NODE_READY={node.node_id.hex()}", flush=True)
 
     stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    # Drain completion (clean or deadline-expired) ends the process.
+    node._drain_exit_cb = stop.set
+
+    def _on_sigterm(*_a) -> None:
+        # First SIGTERM = preemption/maintenance notice: drain
+        # gracefully (hand back work, migrate actors, re-replicate
+        # sole object copies), then exit.  A second SIGTERM — or one
+        # arriving mid-drain — forces an immediate stop.
+        if node.draining:
+            stop.set()
+            return
+        threading.Thread(
+            target=node._begin_drain,
+            args=("sigterm", "SIGTERM (drain requested)"),
+            daemon=True, name="rtpu-sigterm-drain").start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     while not stop.is_set():
         stop.wait(0.5)

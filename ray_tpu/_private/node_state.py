@@ -58,7 +58,7 @@ class TaskRecord:
     __slots__ = ("task_id", "spec", "deps", "state", "worker",
                  "retries_left", "is_actor_creation", "actor_id",
                  "cancelled", "stages", "had_deps", "started",
-                 "locality_deadline")
+                 "locality_deadline", "drain_keep")
 
     def __init__(self, spec: dict) -> None:
         self.task_id: bytes = spec["task_id"]
@@ -82,6 +82,10 @@ class TaskRecord:
         # whose local dependency bytes dominate waits for local
         # capacity instead of spilling (node_objects._try_spill).
         self.locality_deadline: Optional[float] = None
+        # Node drain: the handback sweep found no peer/owner for this
+        # task — it may dispatch locally within the drain grace instead
+        # of waiting to be handed off.
+        self.drain_keep = False
         self.actor_id: Optional[bytes] = spec.get("actor_id")
         # Lifecycle checkpoints (reference: task events feeding
         # ray.util.state task summaries): submitted -> queued ->
@@ -99,7 +103,7 @@ class ActorRecord:
     __slots__ = ("actor_id", "spec", "state", "worker", "queue",
                  "restarts_left", "name", "namespace", "detached",
                  "in_flight", "death_reason", "holds_released",
-                 "intentional_exit", "release_on_drain")
+                 "intentional_exit", "release_on_drain", "hold_queue")
 
     def __init__(self, actor_id: bytes, spec: dict) -> None:
         self.actor_id = actor_id
@@ -123,6 +127,9 @@ class ActorRecord:
         # restart (the spec is replayed); released exactly once at
         # permanent death via _release_actor_holds.
         self.holds_released = False
+        # Node drain: dispatch is held while the actor migrates to a
+        # healthy peer (queued calls forward to the new home instead).
+        self.hold_queue = False
 
 
 class Bundle:

@@ -373,7 +373,10 @@ class StreamChannelMixin:
     def _h_profile_event(self, ctx: _ConnCtx, m: dict) -> None:
         """Custom user span from ray_tpu.util.profiling.span()."""
         ev = dict(m["event"])
-        ev["node_id"] = self.node_id.hex()
+        # Worker spans don't know their node; events parked here by a
+        # DIFFERENT node (a draining peer preserving its drain record)
+        # already carry the originating node id — keep it.
+        ev.setdefault("node_id", self.node_id.hex())
         self._events.append(ev)
 
     def _h_timeline(self, ctx: _ConnCtx, m: dict) -> None:

@@ -147,7 +147,12 @@ class StandardAutoscaler:
     # -- one reconcile step (unit-testable) ----------------------------
     def update(self) -> dict:
         self._refresh_lease()
-        nodes = self._gcs.nodes(alive_only=True)
+        # state filter: nodes(alive_only=True) means "not dead" and so
+        # includes DRAINING nodes — departing capacity must not satisfy
+        # demand or suppress a scale-up right when replacements are
+        # needed most.
+        nodes = [n for n in self._gcs.nodes(alive_only=True)
+                 if n.get("state") == "alive"]
         workers = self.provider.non_terminated_nodes()
         actions = {"launched": 0, "terminated": 0}
 

@@ -6,6 +6,8 @@ status`) + `ray list/summary` (util/state CLI) + `ray job` (job CLI).
     start --head [...]        start GCS + head node + dashboard, detached
     start --address H:P       join an existing cluster as a worker node
     stop                      stop every process this CLI started
+    drain <node_id> [--grace S]
+                              gracefully drain a node (planned departure)
     status [--address H:P]    cluster nodes + resources
     list {tasks,actors,workers,objects,nodes,pgs}
     summary                   task/actor/object rollups
@@ -402,6 +404,44 @@ def cmd_lint(args) -> int:
     return lint_cli.run(args)
 
 
+def cmd_drain(args) -> int:
+    """Gracefully drain one node (reference: `ray drain-node`): the
+    GCS flips it alive -> draining and the node hands back queued
+    work, migrates its actors, re-replicates sole object copies, then
+    exits — a planned departure instead of a failure.  `node_id` is a
+    hex prefix (from `ray_tpu status` / `ray_tpu list nodes`)."""
+    addr = _head_address(args)
+    if not addr:
+        print("no cluster on record; pass --address H:P",
+              file=sys.stderr)
+        return 1
+    from ray_tpu._private.gcs_service import GcsClient
+    host, port = _parse_addr(addr)
+    gcs = GcsClient(host, port)
+    try:
+        matches = [n for n in gcs.nodes()
+                   if n["node_id"].hex().startswith(args.node_id)
+                   and n.get("state") == "alive"]
+        if not matches:
+            print(f"no alive node matches {args.node_id!r}",
+                  file=sys.stderr)
+            return 1
+        if len(matches) > 1:
+            print(f"node id prefix {args.node_id!r} is ambiguous "
+                  f"({len(matches)} matches)", file=sys.stderr)
+            return 1
+        nid = matches[0]["node_id"]
+        ok = gcs.drain_node(nid, grace_s=args.grace,
+                            reason="operator drain (CLI)")
+    finally:
+        gcs.close()
+    if not ok:
+        print("drain refused (node no longer alive?)", file=sys.stderr)
+        return 1
+    print(f"draining node {nid.hex()[:12]} (grace {args.grace:g}s)")
+    return 0
+
+
 def cmd_chaos(args) -> int:
     """Print/validate a chaos fault-injection spec (the schedule from
     --spec, or the ambient RAY_TPU_CHAOS_SPEC / config + legacy env
@@ -518,6 +558,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p = sub.add_parser("microbench", help="core perf harness")
     p.set_defaults(fn=cmd_microbench)
+
+    p = sub.add_parser(
+        "drain", help="gracefully drain a node (planned departure)")
+    p.add_argument("node_id", help="node id hex prefix")
+    p.add_argument("--grace", type=float, default=30.0,
+                   help="seconds the node gets to hand off its work")
+    p.add_argument("--address", default=None, help="GCS address H:P")
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser(
         "chaos", help="print/validate a chaos fault-injection spec")

@@ -134,6 +134,7 @@ class ServeController:
             self._ensure_autoscale_loop()
         if health_check_period_s:
             self._ensure_health_loop()
+        self._ensure_drain_loop()
         if cfg_changed and user_config is None:
             # Clearing user_config has no live representation (there
             # is nothing to reconfigure TO): roll the replicas so
@@ -267,31 +268,37 @@ class ServeController:
         self._notify_update()
 
     # -- reconciliation ----------------------------------------------------
-    def _reconcile(self, name: str) -> None:
+    @staticmethod
+    def _spawn_replica(name: str, d: dict):
+        """One replica actor with the deployment's options — THE spawn
+        expression, shared by reconcile and drain migration so their
+        replicas can never diverge.  Caller holds _state_lock."""
         import ray_tpu
         from ray_tpu.serve._replica import Replica
+        cls = ray_tpu.remote(Replica)
+        opts = {k: v for k, v in d["actor_options"].items()
+                if k in ("num_cpus", "num_tpus", "resources")
+                and v is not None}
+        return cls.options(
+            # +2 headroom over the router's request cap: the
+            # controller's check_health/queue_len probes must
+            # never queue behind a saturated request pool, or
+            # a fully-loaded healthy replica would miss its
+            # health deadline and be killed at peak load.
+            max_concurrency=max(d["max_concurrent_queries"], 1) + 2,
+            max_restarts=2, **opts,
+        ).remote(name, d["blob"], d["init_args"],
+                 d["init_kwargs"], d.get("user_config"))
+
+    def _reconcile(self, name: str) -> None:
+        import ray_tpu
         d = self._deployments.get(name)
         if d is None:
             return
         want, have = d["num_replicas"], len(d["replicas"])
         if have < want:
-            cls = ray_tpu.remote(Replica)
-            opts = {k: v for k, v in d["actor_options"].items()
-                    if k in ("num_cpus", "num_tpus", "resources")
-                    and v is not None}
             for i in range(want - have):
-                h = cls.options(
-                    # +2 headroom over the router's request cap: the
-                    # controller's check_health/queue_len probes must
-                    # never queue behind a saturated request pool, or
-                    # a fully-loaded healthy replica would miss its
-                    # health deadline and be killed at peak load.
-                    max_concurrency=max(d["max_concurrent_queries"], 1)
-                    + 2,
-                    max_restarts=2, **opts,
-                ).remote(name, d["blob"], d["init_args"],
-                         d["init_kwargs"], d.get("user_config"))
-                d["replicas"].append(h)
+                d["replicas"].append(self._spawn_replica(name, d))
             d["version"] += 1
             self._version += 1
             self._notify_update()
@@ -379,6 +386,122 @@ class ServeController:
             elif time.time() > deadline:
                 del pending[key]
                 self._replace_unhealthy(key[0], r)
+
+    # -- graceful node drain (pre-failure signal) -----------------------
+    # Reference role: the controller treating a draining node as a
+    # pre-failure — start replacement replicas FIRST, flip the router
+    # mask once they are ready, then release the old ones.  Contrast
+    # with the reactive path (report_replica_failure after a request
+    # already died): a drain produces zero user-visible errors.
+    def _ensure_drain_loop(self) -> None:
+        import threading
+        if getattr(self, "_drain_thread", None) is not None:
+            return
+
+        def loop() -> None:
+            import time
+
+            import ray_tpu
+            try:
+                # Single-node sessions have no node to drain: exit
+                # instead of polling the control plane once a second
+                # for the controller's whole lifetime.
+                if not ray_tpu._ensure_connected().node_info().get(
+                        "multinode"):
+                    return
+            except Exception:
+                pass
+            while True:
+                try:
+                    self._drain_tick()
+                except Exception:
+                    pass
+                time.sleep(1.0)
+
+        self._drain_thread = threading.Thread(
+            target=loop, daemon=True, name="rtpu-serve-drain")
+        self._drain_thread.start()
+
+    def _drain_tick(self) -> None:
+        """Find replicas homed on DRAINING nodes and proactively move
+        them (migrations run synchronously on this thread; a failed
+        one is simply retried next tick)."""
+        import ray_tpu
+        try:
+            node_list = ray_tpu.nodes()
+        except Exception:
+            return
+        draining = {n["node_id"] for n in node_list
+                    if n.get("state") == "draining"}
+        if not draining:
+            return
+        client = ray_tpu._ensure_connected()
+        with self._state_lock:
+            candidates = [(name, r)
+                          for name, d in self._deployments.items()
+                          for r in d["replicas"]]
+        for name, r in candidates:
+            try:
+                home = client.actor_node(r._actor_id)
+            except Exception:
+                continue
+            if home not in draining:
+                continue
+            self._migrate_replica(name, r)
+
+    def _migrate_replica(self, name: str, old) -> bool:
+        """Start a replacement replica, wait for it to come up, swap it
+        into the routing set (version bump pushes the new list to every
+        router long-poll), then release the old replica once its
+        in-flight requests drain — requests in flight on the draining
+        node are never dropped."""
+        import time
+
+        import ray_tpu
+        with self._state_lock:
+            d = self._deployments.get(name)
+            if d is None or all(r._actor_id != old._actor_id
+                                for r in d["replicas"]):
+                return True     # already gone: nothing left to migrate
+            h = self._spawn_replica(name, d)
+        # Readiness gate OUTSIDE the lock: the replacement must serve
+        # before the old one leaves the mask.
+        try:
+            ray_tpu.get(h.check_health.remote(), timeout=60)
+        except Exception:
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+            return False
+        with self._state_lock:
+            d = self._deployments.get(name)
+            if d is None:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+                return True     # deployment deleted mid-migration
+            d["replicas"] = [r for r in d["replicas"]
+                             if r._actor_id != old._actor_id]
+            d["replicas"].append(h)
+            d["version"] += 1
+            self._version += 1
+            self._notify_update()
+        # Old replica: wait for its outstanding requests, then release.
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            try:
+                if ray_tpu.get(old.queue_len.remote(), timeout=5) == 0:
+                    break
+            except Exception:
+                break       # already gone (node exited / migrated away)
+            time.sleep(0.2)
+        try:
+            ray_tpu.kill(old)
+        except Exception:
+            pass
+        return True
 
     def _replace_unhealthy(self, name: str, replica) -> None:
         """Failed health probe: the actor may still be alive (hung or

@@ -42,6 +42,17 @@ TASK_RETRIES_METRIC = "ray_tpu_task_retries_total"
 ACTOR_RESTARTS_METRIC = "ray_tpu_actor_restarts_total"
 CHAOS_INJECTED_METRIC = "ray_tpu_chaos_injected_total"
 
+# Graceful node drain (operator drain / TPU preemption notice),
+# auto-recorded node-side.  drains_total tags: reason = gcs | sigterm |
+# preemption | chaos_preempt.  duration observes the whole drain
+# sequence (handback + actor migration + re-replication + quiesce);
+# objects_replicated counts sole-holder copies proactively moved to
+# healthy peers before the node exits.
+NODE_DRAINS_METRIC = "ray_tpu_node_drains_total"
+DRAIN_DURATION_METRIC = "ray_tpu_drain_duration_seconds"
+DRAIN_OBJECTS_REPLICATED_METRIC = "ray_tpu_drain_objects_replicated_total"
+DRAIN_DURATION_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 300.0)
+
 # Inter-node object-transfer plane, auto-recorded node-side.
 # bytes_total tags: direction = in | out.  seconds tags: path =
 # stream (windowed binary plane) | multi (range-split, several

@@ -64,6 +64,7 @@ def timeline(filename: Optional[str] = None) -> Any:
         row = {
             "name": ev.get("name", "<span>"),
             "cat": ("lifecycle" if ev.get("kind") == "lifecycle" else
+                    "drain" if ev.get("kind") == "drain" else
                     "actor" if ev.get("actor") else
                     "user" if ev.get("user") else "task"),
             "ph": "X",

@@ -126,6 +126,17 @@ def summarize_tasks() -> Dict[str, Dict[str, Any]]:
     # (stage checkpoint dicts) live in the per-node event ring.
     samples: Dict[str, Dict[str, List[float]]] = {}
     for ev in _client().timeline_events(cluster=True):
+        if ev.get("kind") == "drain":
+            # Graceful node drains surface alongside the task rollup
+            # (reason, grace, and what moved where) — a drained node's
+            # zero-failure departure should be visible, not silent.
+            per = out.setdefault("node:drain", {})
+            per["drains"] = per.get("drains", 0) + 1
+            per.setdefault("events", []).append({
+                k: ev.get(k) for k in
+                ("node_id", "reason", "grace_s", "tasks_handed_back",
+                 "actors_migrated", "objects_moved", "completed")})
+            continue
         if ev.get("kind") != "lifecycle":
             continue
         name = ev.get("task_name") or "<anonymous>"

@@ -50,8 +50,14 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.TASK_RETRIES_METRIC)
     assert _NAME.match(metrics.OBJECT_TRANSFER_BYTES_METRIC)
     assert _NAME.match(metrics.OBJECT_TRANSFER_SECONDS_METRIC)
+    assert _NAME.match(metrics.NODE_DRAINS_METRIC)
+    assert _NAME.match(metrics.DRAIN_DURATION_METRIC)
+    assert _NAME.match(metrics.DRAIN_OBJECTS_REPLICATED_METRIC)
+    assert metrics.NODE_DRAINS_METRIC.endswith("_total")
+    assert metrics.DRAIN_OBJECTS_REPLICATED_METRIC.endswith("_total")
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
-               metrics.OBJECT_TRANSFER_BUCKETS):
+               metrics.OBJECT_TRANSFER_BUCKETS,
+               metrics.DRAIN_DURATION_BUCKETS):
         assert all(a < b for a, b in zip(bs, bs[1:]))
 
 

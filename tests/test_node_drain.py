@@ -1,0 +1,496 @@
+"""Graceful node drain & TPU-preemption-aware migration.
+
+Acceptance (ISSUE 5): draining a node running tasks + holding sole
+object copies + hosting actors produces zero task failures, zero
+lineage reconstructions, and zero user-visible Serve errors; a
+preemption whose deadline expires mid-drain falls back cleanly to the
+existing retry/reconstruction path under seeded chaos replay.
+
+Reference analogs: raylet DrainRaylet / GCS node drain, tf.data
+service workers leaving a cluster without losing work.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.util.state as state_api
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import chaos as chaos_api
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+# Brisk heartbeats so cluster views refresh fast, but a GENEROUS
+# failure threshold: these tests assert the zero-loss drain path, and
+# a spurious heartbeat-timeout death under worker-spawn CPU contention
+# would inject exactly the node-death retries the assertions forbid.
+# (Drain completion reports itself dead — no health check involved.)
+_FAST_HB = {"RAY_TPU_HEARTBEAT_INTERVAL_S": "0.2",
+            "RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD": "25"}
+
+
+# ---------------------------------------------------------------------------
+# GCS drain state machine (no cluster needed)
+# ---------------------------------------------------------------------------
+def test_gcs_drain_state_machine():
+    from ray_tpu._private.gcs import GlobalControlState
+    st = GlobalControlState()
+    st.register_node(b"n1" * 8, "127.0.0.1", 1, 2, {"CPU": 1.0})
+    events = []
+    st.sub_nodes(lambda ev, info: events.append((ev, info)))
+
+    assert st.drain_node(b"n1" * 8, grace_s=30.0, reason="test") is True
+    assert st.node_info(b"n1" * 8)["state"] == "draining"
+    assert [e for e, _ in events] == ["node_draining"]
+    # Draining fires exactly once.
+    assert st.drain_node(b"n1" * 8) is False
+
+    # heartbeat() from a draining node must NOT resurrect it to alive.
+    st.heartbeat(b"n1" * 8, {"CPU": 1.0})
+    assert st.node_info(b"n1" * 8)["state"] == "draining"
+
+    # A draining node with fresh heartbeats (or brief silence inside
+    # its grace deadline) is not health-reaped...
+    assert st.check_health(timeout_s=60.0) == []
+    # ...and a draining node is still in the default cluster view, so
+    # peers keep reaching it while it hands off work.
+    assert [n["state"] for n in st.nodes()] == ["draining"]
+
+    # mark_node_dead on an already-draining node publishes node_dead
+    # cleanup exactly once (drain/death race).
+    st.mark_node_dead(b"n1" * 8, "drained")
+    st.mark_node_dead(b"n1" * 8, "health check fired late")
+    dead = [i for e, i in events if e == "node_dead"]
+    assert len(dead) == 1
+    assert dead[0]["reason"] == "drained"
+    # Dead node cannot be drained or resurrected.
+    assert st.drain_node(b"n1" * 8) is False
+    st.heartbeat(b"n1" * 8, {"CPU": 1.0})
+    assert st.node_info(b"n1" * 8)["state"] == "dead"
+
+
+def test_gcs_drain_deadline_health_reap():
+    """Past the drain deadline, stale heartbeats DO reap the node —
+    the grace replaces the plain heartbeat timeout, it doesn't grant
+    immortality."""
+    from ray_tpu._private.gcs import GlobalControlState
+    st = GlobalControlState()
+    st.register_node(b"n2" * 8, "127.0.0.1", 1, 2, {"CPU": 1.0})
+    st.drain_node(b"n2" * 8, grace_s=0.0, reason="preempted")
+    time.sleep(0.05)
+    newly = st.check_health(timeout_s=0.01)
+    assert len(newly) == 1 and newly[0]["state"] == "dead"
+
+
+def test_gcs_drain_crash_reaped_before_deadline():
+    """A node that goes silent mid-drain (hard crash) is reaped after
+    3x the heartbeat timeout — a long grace must not hide a dead node
+    from the cluster for minutes."""
+    from ray_tpu._private.gcs import GlobalControlState
+    st = GlobalControlState()
+    st.register_node(b"n3" * 8, "127.0.0.1", 1, 2, {"CPU": 1.0})
+    st.drain_node(b"n3" * 8, grace_s=600.0, reason="maintenance")
+    st._nodes[b"n3" * 8].last_heartbeat = time.time() - 1.0
+    newly = st.check_health(timeout_s=0.2)      # 1s silence > 3 * 0.2
+    assert len(newly) == 1 and newly[0]["state"] == "dead"
+    # ...while a briefly-silent drain (silence < 3x timeout, deadline
+    # not reached) is left alone.
+    st.register_node(b"n4" * 8, "127.0.0.1", 1, 2, {"CPU": 1.0})
+    st.drain_node(b"n4" * 8, grace_s=600.0, reason="maintenance")
+    st._nodes[b"n4" * 8].last_heartbeat = time.time() - 0.3
+    assert st.check_health(timeout_s=0.2) == []
+
+
+# ---------------------------------------------------------------------------
+# multinode drain scenarios
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def cluster():
+    """Head (driver) + 2 worker nodes.  Node a additionally carries the
+    {"pin": 1} resource so tests can place work there deterministically;
+    both workers carry {"work": 2} so drained work has somewhere to go."""
+    for k, v in _FAST_HB.items():
+        os.environ[k] = v
+    c = Cluster(env=_FAST_HB)
+    a = c.add_node(resources={"CPU": 2, "work": 2, "pin": 1})
+    b = c.add_node(resources={"CPU": 2, "work": 2})
+    ray_tpu.init(num_cpus=2, gcs_address=c.gcs_address)
+    c.wait_for_nodes(3)
+    yield c, a, b
+    ray_tpu.shutdown()
+    c.shutdown()
+    for k in _FAST_HB:
+        os.environ.pop(k, None)
+
+
+def _retry_events():
+    events = ray_tpu._ensure_connected().timeline_events(cluster=True)
+    return [e for e in events if e.get("kind") == "retry"]
+
+
+def test_drain_under_load_zero_failed_tasks(cluster, tmp_path):
+    """Draining a node with queued + running tasks completes with zero
+    failed tasks and zero re-executions: running work finishes within
+    the grace, queued work is handed back and resubmitted elsewhere."""
+    c, a, b = cluster
+    marker = str(tmp_path / "runs")
+
+    @ray_tpu.remote(resources={"work": 1})
+    def step(i, path):
+        fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        try:
+            os.write(fd, f"{i}\n".encode())   # O_APPEND: atomic line
+        finally:
+            os.close(fd)
+        time.sleep(0.3)
+        return i
+
+    refs = [step.remote(i, marker) for i in range(10)]
+    time.sleep(0.6)             # let some start on node a, some queue
+    c.drain_node(a, grace_s=25.0)
+    assert a.proc.poll() is not None        # the node exited on its own
+
+    got = ray_tpu.get(refs, timeout=60)
+    assert sorted(got) == list(range(10))   # zero failed tasks
+    with open(marker) as f:
+        runs = [ln for ln in f.read().splitlines() if ln]
+    assert sorted(int(x) for x in runs) == list(range(10)), \
+        "a task re-executed (handback must resubmit, not replay)"
+    # No crash/death retries were needed to get here.
+    crash_retries = [e for e in _retry_events()
+                     if e.get("reason_tag") in ("worker_crash",
+                                                "node_death")]
+    assert crash_retries == []
+    # The GCS saw a clean departure.
+    assert c._server.state.node_info(a.node_id)["state"] == "dead"
+
+
+def test_sole_holder_object_survives_drain(cluster, tmp_path):
+    """A shm object whose ONLY copy lives on the draining node is
+    proactively re-replicated to a healthy peer: the later get() needs
+    no lineage reconstruction (the producing task runs exactly once).
+
+    The driver deliberately does NOT touch the ref before the drain —
+    a get()/wait() would pull a head-side replica and the node would no
+    longer be the sole holder.  (The replica the drain creates is held
+    by the adopting node's directory, so unlike an ordinary pulled
+    copy — PR-4's refcount trap — it needs no borrower actor to pin
+    it.)"""
+    c, a, b = cluster
+    marker = str(tmp_path / "runs")
+
+    @ray_tpu.remote(resources={"pin": 1})
+    def big(path):
+        fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        try:
+            os.write(fd, b"r\n")
+        finally:
+            os.close(fd)
+        return np.arange(300_000, dtype=np.float64)     # 2.4 MB: shm
+
+    ref = big.remote(marker)
+    # Await READY via the GCS directory (not get(): see docstring).
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        locs = c._server.state.get_locations(ref.binary())
+        if locs.get("kind") == "shm":
+            break
+        time.sleep(0.05)
+    assert locs.get("kind") == "shm"
+    assert [n["node_id"] for n in locs["nodes"]] == [a.node_id]
+
+    c.drain_node(a, grace_s=25.0)
+    # The copy moved: a holder other than the drained node exists.
+    locs = c._server.state.get_locations(ref.binary())
+    holders = {n["node_id"] for n in locs.get("nodes", [])}
+    assert holders and a.node_id not in holders
+
+    arr = ray_tpu.get(ref, timeout=30)
+    assert arr.shape == (300_000,) and arr[12345] == 12345.0
+    with open(marker) as f:
+        assert f.read().count("r") == 1, "lineage reconstruction ran"
+
+
+def test_actor_migrates_without_consuming_restart_budget(cluster):
+    """An actor with max_restarts=0 survives its node's drain: the
+    creation spec replays on a healthy peer BEFORE the node exits
+    (restart-then-redirect), so the zero restart budget is untouched
+    and the handle keeps working."""
+    c, a, b = cluster
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def where(self):
+            return os.getpid()
+
+    h = Counter.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            a.node_id, soft=False),
+        max_restarts=0).remote()
+    assert ray_tpu.get(h.bump.remote(), timeout=30) == 1
+    pid_before = ray_tpu.get(h.where.remote(), timeout=30)
+
+    c.drain_node(a, grace_s=25.0)
+    time.sleep(0.5)     # let node_dead / directory updates settle
+
+    # With max_restarts=0 any crash-path restart is impossible: a
+    # working call proves migration, not a budgeted restart.  State is
+    # replayed from the creation spec (restart semantics).
+    assert ray_tpu.get(h.bump.remote(), timeout=30) == 1
+    assert ray_tpu.get(h.where.remote(), timeout=30) != pid_before
+
+
+def test_actor_queued_calls_survive_drain_in_order(cluster):
+    """Calls queued on a migrating actor hand back to their owner,
+    which re-resolves the new home — every call runs exactly once, in
+    submission order, with zero errors (max_restarts=0 rules out any
+    crash-path recovery)."""
+    c, a, b = cluster
+
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.seen = []
+
+        def add(self, i):
+            time.sleep(0.15)
+            self.seen.append(i)
+            return i
+
+    h = Acc.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            a.node_id, soft=False),
+        max_restarts=0).remote()
+    refs = [h.add.remote(i) for i in range(12)]   # queue builds on a
+    time.sleep(0.3)
+    c.drain_node(a, grace_s=25.0)
+    assert ray_tpu.get(refs, timeout=60) == list(range(12))
+
+
+def test_sigterm_is_a_graceful_drain(cluster):
+    """SIGTERM on a node process (the preemption-notice signal path)
+    drains before exit: the GCS hears "drained", not a missed-heartbeat
+    death."""
+    c, a, b = cluster
+    events = []
+    c._server.state.sub_nodes(
+        lambda ev, info: events.append((ev, info)))
+    os.kill(b.proc.pid, signal.SIGTERM)
+    b.proc.wait(timeout=20)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        dead = [i for e, i in events
+                if e == "node_dead" and i["node_id"] == b.node_id]
+        if dead:
+            break
+        time.sleep(0.05)
+    assert dead and dead[0]["reason"] == "drained"
+    drains = [i for e, i in events
+              if e == "node_draining" and i["node_id"] == b.node_id]
+    assert drains and "SIGTERM" in drains[0]["reason"]
+
+
+def test_preemption_notice_file_triggers_drain(cluster, tmp_path):
+    """The file-based notice path (GCE metadata shim / tests): a node
+    started with preemption_notice_file drains once the file appears,
+    with the deadline the file carries."""
+    c, a, b = cluster
+    notice = str(tmp_path / "preempt.json")
+    c._env["RAY_TPU_PREEMPTION_NOTICE_FILE"] = notice
+    n = c.add_node(resources={"CPU": 1, "spot": 1})
+    c._env.pop("RAY_TPU_PREEMPTION_NOTICE_FILE", None)
+    c.wait_for_nodes(4)
+
+    with open(notice, "w") as f:
+        json.dump({"deadline_s": 20.0}, f)
+    n.proc.wait(timeout=30)     # node drains and exits by itself
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if c._server.state.node_info(n.node_id)["state"] == "dead":
+            break
+        time.sleep(0.05)
+    assert c._server.state.node_info(n.node_id)["state"] == "dead"
+
+
+def test_drain_cli(cluster, capsys):
+    """`ray_tpu drain <node_id> [--grace S]` smoke: resolves a hex
+    prefix against the GCS and starts the drain."""
+    from ray_tpu.scripts import cli
+    c, a, b = cluster
+    host, port = c.gcs_address
+    rc = cli.main(["drain", a.node_id.hex()[:12], "--grace", "15",
+                   "--address", f"{host}:{port}"])
+    assert rc == 0
+    assert "draining node" in capsys.readouterr().out
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if c._server.state.node_info(a.node_id)["state"] == "dead":
+            break
+        time.sleep(0.1)
+    assert c._server.state.node_info(a.node_id)["state"] == "dead"
+    # Unknown prefix errors cleanly.
+    assert cli.main(["drain", "ffffffffffff",
+                     "--address", f"{host}:{port}"]) == 1
+
+
+def test_serve_drain_serves_all_inflight_requests(cluster):
+    """Serve treats node_draining as a pre-failure signal: replacement
+    replicas come up, the router mask flips, the old replica drains —
+    requests issued continuously across the drain all succeed."""
+    from ray_tpu import serve
+
+    c, a, b = cluster
+
+    @serve.deployment(num_replicas=1,
+                      ray_actor_options={"resources": {"work": 1}})
+    class Echo:
+        def __call__(self, x):
+            time.sleep(0.05)
+            return x * 2
+
+    handle = serve.run(Echo)
+    assert ray_tpu.get(handle.remote(21), timeout=60) == 42
+
+    # Which worker node hosts the replica?
+    rows = [r for r in state_api.list_actors()
+            if "Replica" in (r.get("class_name") or "")]
+    assert rows
+    replica_node = bytes.fromhex(rows[0]["node_id"])
+    victim = a if replica_node == a.node_id else b
+    assert victim.node_id == replica_node
+
+    errors: list = []
+    results: list = []
+    stop = threading.Event()
+
+    def fire() -> None:
+        while not stop.is_set():
+            try:
+                results.append(ray_tpu.get(handle.remote(1), timeout=60))
+            except Exception as e:   # noqa: BLE001
+                errors.append(e)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=fire, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    c.drain_node(victim, grace_s=40.0, timeout_s=90.0)
+    time.sleep(2.0)             # keep firing after the node is gone
+    stop.set()
+    t.join(timeout=30)
+
+    assert not errors, f"user-visible Serve errors during drain: {errors!r}"
+    assert len(results) >= 10 and set(results) == {2}
+    serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos kind=preempt: seeded, deterministic degrade-to-retry
+# ---------------------------------------------------------------------------
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    yield
+    chaos_api.clear()
+    chaos_api.reset_trace()
+
+
+def test_chaos_preempt_spec_validates():
+    from ray_tpu._private.chaos import parse_spec
+    (spec,) = parse_spec("node:kind=preempt:deadline_s=2.5:n=1")
+    assert spec.kind == "preempt" and spec.deadline_s == 2.5
+    with pytest.raises(ValueError):
+        parse_spec("node:kind=preempt:deadline_s=-1")
+    with pytest.raises(ValueError):
+        parse_spec("node:kind=error:deadline_s=1")   # wrong kind
+    from ray_tpu.scripts import cli
+    assert cli.main(["chaos", "--spec",
+                     "node:kind=preempt:deadline_s=2:n=1"]) == 0
+    assert cli.main(["chaos", "--spec",
+                     "node:kind=preempt:deadline_s=oops"]) == 2
+
+
+def test_chaos_preempt_too_short_deadline_degrades_to_retry(
+        ray_start, tmp_path):
+    """A preemption whose deadline expires mid-task falls back to the
+    PR-3 kill-and-retry path: the running task is killed at the
+    deadline, retries, and completes."""
+    marker = str(tmp_path / "attempts")
+
+    @ray_tpu.remote(max_retries=2)
+    def stubborn(path):
+        fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        try:
+            os.write(fd, b"a\n")
+        finally:
+            os.close(fd)
+        with open(path) as f:
+            attempt = f.read().count("a")
+        if attempt == 1:
+            time.sleep(30)      # outlives the preemption deadline
+        return attempt
+
+    ref = stubborn.remote(marker)
+    # Arm the preemption only once attempt 1 is EXECUTING, so the
+    # drain's quiesce finds a busy worker and the deadline kill fires.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if os.path.exists(marker) and open(marker).read().count("a"):
+            break
+        time.sleep(0.05)
+    chaos_api.inject("node", kind="preempt", n=1, deadline_s=0.4)
+    assert ray_tpu.get(ref, timeout=60) == 2
+    trace = chaos_api.trace()
+    assert ("node", "preempt") in [(s, k) for _, s, k in trace]
+
+    # The degrade was the ordinary retry path (worker_crash), and the
+    # drain is visible in the task summary + lifecycle rollup.
+    retries = [e for e in _retry_events()
+               if e.get("reason_tag") == "worker_crash"]
+    assert retries
+    summary = state_api.summarize_tasks()
+    assert summary.get("node:drain", {}).get("drains", 0) >= 1
+    ev = summary["node:drain"]["events"][0]
+    assert ev["reason"] and ev["grace_s"] == pytest.approx(0.4)
+
+
+def test_chaos_preempt_trace_replays_with_same_seed(ray_start):
+    """Seeded determinism: two runs of the same workload + spec + seed
+    inject the identical preemption trace."""
+    from ray_tpu._private.config import config
+
+    def run_once():
+        config.set("chaos_seed", 11)
+        config.set("chaos_spec",
+                   "node:kind=preempt:deadline_s=0.2:n=1:p=1.0")
+        chaos_api.refresh()
+        chaos_api.reset_trace()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if ("node", "preempt") in [(s, k) for _, s, k
+                                       in chaos_api.trace()]:
+                break
+            time.sleep(0.05)
+        time.sleep(0.8)     # let the (empty) drain run to completion
+        return chaos_api.trace()
+
+    t1 = run_once()
+    # Second arming with the same seed: refresh() reseeds the RNG.
+    t2 = run_once()
+    try:
+        assert t1 and t1 == t2
+    finally:
+        config.set("chaos_spec", "")
+        config.set("chaos_seed", 0)
+        chaos_api.refresh()
