@@ -214,6 +214,30 @@ _D("memory_monitor_min_rss_mb", float, 64.0,
 _D("profile_events_max", int, 10_000,
    "Per-node ring capacity for profile/trace events (ray.timeline "
    "analog; reference: RAY_PROFILING event table).")
+_D("event_ring_capacity", int, 0,
+   "Per-node lifecycle/profile event ring capacity; 0 falls back to "
+   "profile_events_max.  Evictions from the full ring are counted in "
+   "ray_tpu_events_dropped_total so long-running clusters can see "
+   "lifecycle history silently rolling off.")
+_D("stall_detection_enabled", bool, True,
+   "Stall sentinel: the node monitor compares every executing task's "
+   "elapsed time against the executing-stage latency histogram and "
+   "auto-captures the worker's stack when it exceeds the threshold "
+   "(a 'stall' lifecycle event; reference role: the dashboard "
+   "reporter's py-spy integration, made automatic).")
+_D("stall_min_seconds", float, 60.0,
+   "Stall sentinel floor: a task is never flagged before running this "
+   "long, regardless of the p95-derived threshold.  The effective "
+   "threshold is max(stall_min_seconds, stall_p95_multiple * p95).")
+_D("stall_p95_multiple", float, 3.0,
+   "Stall threshold as a multiple of the executing-stage p95 from the "
+   "node's ray_tpu_task_stage_duration_seconds histogram.")
+_D("stall_min_samples", int, 10,
+   "Minimum completed-task samples in the executing-stage histogram "
+   "before its p95 participates in the stall threshold (below this, "
+   "only the stall_min_seconds floor applies).")
+_D("stall_check_interval_s", float, 2.0,
+   "How often the node monitor sweeps executing tasks for stalls.")
 _D("workflow_storage_dir", str, "",
    "Durable workflow storage root (default: ~/.ray_tpu/workflows). "
    "Deliberately outside the session dir so resume survives shutdown.")

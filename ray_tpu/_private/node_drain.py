@@ -47,7 +47,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.chaos import chaos
 from ray_tpu._private.config import config
-from ray_tpu._private.node_state import READY, TaskRecord, _ConnCtx
+from ray_tpu._private.node_state import (FAILED, READY, TaskRecord,
+                                         _ConnCtx)
 
 
 class DrainMixin:
@@ -436,6 +437,13 @@ class DrainMixin:
         pulled entry keeps its directory refcount until the owner
         deletes the object, so the replica outlives the drain."""
         with self.lock:
+            e = self.objects.get(m["object_id"])
+            if e is None or e.state not in (READY, FAILED):
+                # Memory accounting: the registration this pull
+                # completes classifies as reference_kind=
+                # "drain_replica" (skip if a copy already lives here —
+                # the pull no-ops and the marker would go stale).
+                self._drain_replica_oids.add(m["object_id"])
             self._ensure_pull(m["object_id"])
 
     # -- phase 3: actor migration ----------------------------------------
@@ -673,7 +681,7 @@ class DrainMixin:
             "node_id": self.node_id.hex(),
         }
         with self.lock:
-            self._events.append(ev)
+            self._emit_event(ev)
             self._observe_hist(DRAIN_DURATION_METRIC, {}, duration,
                                DRAIN_DURATION_BUCKETS,
                                "graceful node drain duration")

@@ -213,6 +213,10 @@ class ObjectPlaneMixin:
         with self.lock:
             self._pulls_inflight.discard(oid)
             self._cancelled_pulls.discard(oid)
+            # A drain-replica marker the pull never consumed (pull
+            # failed/cancelled) must not linger: it would misclassify
+            # a later ordinary borrow of the same object.
+            self._drain_replica_oids.discard(oid)
             # In-place deletion (not a rebound filtered copy): strike
             # writers in other pull/range threads must never land in a
             # stale dict object.

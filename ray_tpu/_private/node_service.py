@@ -42,7 +42,7 @@ from ray_tpu import exceptions as exc
 from ray_tpu._private.node_state import (  # noqa: F401
     ActorRecord, Bundle, FAILED, ObjectEntry, PENDING, READY,
     TaskRecord, WorkerHandle, _ConnCtx, _OID, _charge, _fits,
-    _place_bundles, _uncharge, _unregister_waiter)
+    _place_bundles, _reference_kind, _uncharge, _unregister_waiter)
 
 class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                   StreamChannelMixin, NodeAgentMixin,
@@ -177,8 +177,20 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._log_offsets: Dict[str, int] = {}
         # Profile/trace event ring (reference: profile events table
         # behind ray.timeline); workers attach execution spans to
-        # task_done and push custom spans via profile_event.
-        self._events: deque = deque(maxlen=config.profile_events_max)
+        # task_done and push custom spans via profile_event.  Bounded:
+        # appends go through _emit_event so evictions are counted
+        # (ray_tpu_events_dropped_total) instead of silent.
+        self._events: deque = deque(
+            maxlen=(config.event_ring_capacity
+                    or config.profile_events_max))
+        # Scrape-time cache for the per-kind object-byte gauges: a
+        # Prometheus scrape must not re-walk a 100k-entry directory
+        # under the lock every few seconds.
+        self._mem_kind_cache: Tuple[float, dict] = (0.0, {})
+        # Objects a draining peer asked this node to adopt: their pull
+        # registration marks the entry as a drain replica for the
+        # memory-accounting plane.
+        self._drain_replica_oids: set = set()
         # Streaming-generator item tables, keyed by the generator's
         # completion object id: {"items": [oid...], "done": bool}
         # (reference: streaming generator object refs in task_manager).
@@ -936,7 +948,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                                   state=(FAILED if m["loc"] == "error"
                                          else READY),
                                   embedded=m.get("embedded") or [],
-                                  creator_pid=ctx.pid)
+                                  creator_pid=ctx.pid,
+                                  owner=ctx.client_id)
             self._schedule()
         ctx.reply(m, {"ok": True})
 
@@ -945,7 +958,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                          state: str = READY,
                          embedded: Optional[List[bytes]] = None,
                          creator_pid: int = 0,
-                         foreign: bool = False) -> None:
+                         foreign: bool = False,
+                         owner: Optional[bytes] = None) -> None:
         if loc == "shm" and creator_pid and creator_pid != os.getpid():
             # Adopt the creator's pin into the directory's ledger so
             # reaping the (possibly dead) creator leaves it pinned.
@@ -980,6 +994,16 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         entry.loc = loc
         entry.data = data
         entry.size = size
+        if owner is not None and entry.owner is None:
+            # First writer wins: a pulled replica arriving later must
+            # not overwrite the owner recorded at put/submit time.
+            entry.owner = owner
+        if oid in self._drain_replica_oids:
+            # Copy adopted from a draining peer: visible as its own
+            # reference kind in the memory plane (it outlives ordinary
+            # borrow refcounting — the adopting directory holds it).
+            entry.drain_replica = True
+            self._drain_replica_oids.discard(oid)
         if loc == "spilled" and data is not None:
             # Born spilled (worker wrote the return to disk because the
             # store was full of in-flight returns): track the file so
@@ -1159,7 +1183,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         prof = m.get("profile")
         if prof is not None:
             prof["node_id"] = self.node_id.hex()
-            self._events.append(prof)
+            self._emit_event(prof)
         with self.lock:
             rec = self.tasks.pop(m["task_id"], None)
             if (rec is not None and self.multinode
@@ -1199,7 +1223,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 self._register_object(
                     oid, loc, data, size,
                     state=FAILED if loc == "error" else READY,
-                    embedded=embedded, creator_pid=ctx.pid)
+                    embedded=embedded, creator_pid=ctx.pid,
+                    owner=(rec.spec.get("owner")
+                           if rec is not None else None))
                 if oid in self._streams:
                     self.finish_stream(oid)   # wake parked consumers
             if rec is not None:
@@ -1470,54 +1496,159 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         threading.Thread(target=fwd, daemon=True,
                          name="rtpu-kv-wait").start()
 
-    def _h_stack_dump(self, ctx: _ConnCtx, m: dict) -> None:
-        """On-demand stack profiling of every live worker on this node
-        (reference: the dashboard reporter's py-spy role).  Parked
-        reply; answers with whatever arrived when `timeout` expires."""
+    def _request_worker_stacks(self, workers: List[WorkerHandle],
+                               timeout: float, cb,
+                               samples: int = 0,
+                               interval_s: float = 0.02) -> None:
+        """Ask `workers` for stack captures; `cb(stacks, folded)` fires
+        exactly once — when every reply landed or at `timeout`
+        (whatever arrived by then).  One-shot mode returns formatted
+        per-pid stacks; sampling mode (samples>0) additionally merges
+        folded-stack counts (flamegraph input).  Shared by the
+        stack_dump RPC and the stall sentinel's targeted captures."""
         token = os.urandom(8)
-        timeout = m.get("timeout", 10.0)
+        rec = {"stacks": {}, "folded": {}, "pending": set(),
+               "cb": cb, "done": False}
         with self.lock:
-            workers = [w for w in self.workers.values()
-                       if w.conn_send is not None and w.state != "dead"]
-            rec = {"stacks": {}, "pending": set(), "ctx": ctx,
-                   "m": m, "done": False}
             for w in workers:
+                if w.conn_send is None or w.state == "dead":
+                    continue
+                msg: Dict[str, Any] = {"type": "dump_stacks",
+                                       "token": token}
+                if samples:
+                    msg["samples"] = int(samples)
+                    msg["interval_s"] = float(interval_s)
                 try:
-                    w.conn_send({"type": "dump_stacks", "token": token})
+                    w.conn_send(msg)
                     rec["pending"].add(w.pid)
                 except Exception:
                     pass
-            if not rec["pending"]:
-                ctx.reply(m, {"stacks": {}})
+            if rec["pending"]:
+                self._stack_dumps[token] = rec
+
+                def expire() -> None:
+                    with self.lock:
+                        r = self._stack_dumps.pop(token, None)
+                        if r is None or r["done"]:
+                            return
+                        r["done"] = True
+                    try:
+                        cb(r["stacks"], r["folded"])
+                    except Exception:
+                        pass
+
+                self._add_deadline_waiter(time.time() + timeout, expire)
                 return
-            self._stack_dumps[token] = rec
+        try:
+            cb({}, {})
+        except Exception:
+            pass
 
-            def expire() -> None:
-                with self.lock:
-                    r = self._stack_dumps.pop(token, None)
-                    if r is None or r["done"]:
-                        return
-                    r["done"] = True
-                try:
-                    ctx.reply(m, {"stacks": r["stacks"]})
-                except Exception:
-                    pass
+    def _task_workers_locked(self, task_id_hex: str
+                             ) -> List[WorkerHandle]:
+        """The worker(s) currently running tasks whose id matches the
+        hex prefix (actor calls resolve through the actor's resident
+        worker).  Caller holds self.lock."""
+        out = []
+        for rec in self.tasks.values():
+            if not rec.task_id.hex().startswith(task_id_hex):
+                continue
+            w = rec.worker
+            if w is None and rec.actor_id is not None:
+                a = self.actors.get(rec.actor_id)
+                w = a.worker if a is not None else None
+            if w is not None and w.state != "dead":
+                out.append(w)
+        return out
 
-            self._add_deadline_waiter(time.time() + timeout, expire)
+    def _h_stack_dump(self, ctx: _ConnCtx, m: dict) -> None:
+        """On-demand stack profiling (reference: the dashboard
+        reporter's py-spy role).  Scopes:
+        * default: every live worker on this node;
+        * task_id (hex prefix): only the worker(s) executing that task;
+        * cluster=True (multinode): fan out to every alive peer and
+          merge — the documented "every live worker" behavior.
+        samples>0 turns one-shot dumps into low-rate sampling (N
+        samples, interval_s apart, per worker) whose merged
+        folded-stack counts come back under "folded" (flamegraphs)."""
+        timeout = m.get("timeout", 10.0)
+        samples = int(m.get("samples") or 0)
+        interval_s = float(m.get("interval_s") or 0.02)
+        task_id = m.get("task_id")
+        want_cluster = bool(m.get("cluster")) and self.multinode
+        with self.lock:
+            if task_id:
+                workers = self._task_workers_locked(task_id)
+            else:
+                workers = [w for w in self.workers.values()
+                           if w.conn_send is not None
+                           and w.state != "dead"]
+        # Sampling keeps workers capturing for samples*interval — give
+        # replies room beyond the nominal timeout.
+        wait_s = timeout + (samples * interval_s if samples else 0.0)
+        merged = {"stacks": {}, "folded": {}}
+        merge_lock = threading.Lock()
+        remaining = [2 if want_cluster else 1]
+
+        def merge_part(stacks: dict, folded: dict) -> None:
+            with merge_lock:
+                merged["stacks"].update(stacks)
+                for k, v in folded.items():
+                    merged["folded"][k] = merged["folded"].get(k, 0) + v
+                remaining[0] -= 1
+                if remaining[0] > 0:
+                    return
+            reply = {"stacks": merged["stacks"]}
+            if samples:
+                reply["folded"] = merged["folded"]
+            ctx.reply(m, reply)
+
+        if want_cluster:
+            def fanout() -> None:
+                sub: Dict[str, Any] = {"type": "stack_dump",
+                                       "cluster": False,
+                                       "timeout": timeout}
+                if task_id:
+                    sub["task_id"] = task_id
+                if samples:
+                    sub["samples"] = samples
+                    sub["interval_s"] = interval_s
+                replies, _ = self._fanout_peers(sub,
+                                                timeout=wait_s + 5.0)
+                stacks: Dict[str, str] = {}
+                folded: Dict[str, int] = {}
+                for n, rep in replies:
+                    # Namespace remote pids: across hosts they collide.
+                    tag = n["node_id"].hex()[:12]
+                    for pid, text in (rep.get("stacks") or {}).items():
+                        stacks[f"{pid}@{tag}"] = text
+                    for k, v in (rep.get("folded") or {}).items():
+                        folded[k] = folded.get(k, 0) + v
+                merge_part(stacks, folded)
+
+            threading.Thread(target=fanout, daemon=True,
+                             name="rtpu-stack-fanout").start()
+
+        self._request_worker_stacks(workers, wait_s, merge_part,
+                                    samples=samples,
+                                    interval_s=interval_s)
 
     def _h_stacks_reply(self, ctx: _ConnCtx, m: dict) -> None:
         with self.lock:
             rec = self._stack_dumps.get(m["token"])
             if rec is None or rec["done"]:
                 return
-            rec["stacks"][m["pid"]] = m["text"]
+            if m.get("text"):
+                rec["stacks"][m["pid"]] = m["text"]
+            for k, v in (m.get("folded") or {}).items():
+                rec["folded"][k] = rec["folded"].get(k, 0) + v
             rec["pending"].discard(m["pid"])
             if rec["pending"]:
                 return
             rec["done"] = True
             self._stack_dumps.pop(m["token"], None)
         try:
-            rec["ctx"].reply(rec["m"], {"stacks": rec["stacks"]})
+            rec["cb"](rec["stacks"], rec["folded"])
         except Exception:
             pass
 
@@ -1774,6 +1905,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             if rec.had_deps:
                 rec.stages.setdefault("deps_fetched", now)
             rec.stages["worker_assigned"] = now
+            # Fresh attempt (restart replays reuse the rec): re-arm
+            # the stall sentinel, drop the stale executing checkpoint.
+            rec.stall_reported = False
+            rec.stages.pop("executing", None)
             actor.in_flight[rec.task_id] = rec
             actor.worker.conn_send({"type": "execute_task",
                                     "spec": rec.spec})
@@ -2008,6 +2143,46 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
     # observability: state dump + metrics (reference: util/state/api.py,
     # _private/metrics_agent.py)
     # ------------------------------------------------------------------
+    def _actor_pinned_oids_locked(self) -> set:
+        """Objects a live actor on this node holds: creation-spec
+        embedded refs (held across restarts) plus the arg/embedded refs
+        of its queued and in-flight calls.  Feeds the pinned_by_actor
+        reference kind of the memory plane.  Caller holds self.lock."""
+        pinned: set = set()
+        for a in self.actors.values():
+            if a.state == "dead":
+                continue
+            ct = a.spec.get("creation_task") or {}
+            pinned.update(ct.get("embedded") or [])
+            for rec in list(a.queue) + list(a.in_flight.values()):
+                for arg in rec.spec.get("args") or []:
+                    if arg and arg[0] == "ref":
+                        pinned.add(arg[1])
+                pinned.update(rec.spec.get("embedded") or [])
+        return pinned
+
+    def _memory_kind_bytes_locked(self) -> Dict[str, Dict[str, float]]:
+        """Per-reference-kind {bytes, count} over this node's READY
+        object directory — the ray_tpu_object_store_bytes{kind} gauge
+        source.  Cached for a few seconds: the walk is O(objects +
+        actor queues) under the lock, and scrapes arrive on a clock.
+        Caller holds self.lock."""
+        ts, cached = self._mem_kind_cache
+        now = time.time()
+        if now - ts < 5.0:
+            return cached
+        pinned = self._actor_pinned_oids_locked()
+        out: Dict[str, Dict[str, float]] = {}
+        for oid, e in self.objects.items():
+            if e.state != READY:
+                continue
+            kind = _reference_kind(e, oid in pinned)
+            cell = out.setdefault(kind, {"bytes": 0.0, "count": 0.0})
+            cell["bytes"] += float(e.size or 0)
+            cell["count"] += 1.0
+        self._mem_kind_cache = (now, out)
+        return out
+
     def _local_state_dump(self) -> dict:
         """Snapshot of this node's runtime state.  Caller must NOT hold
         the lock."""
@@ -2058,18 +2233,39 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     "node_id": self.node_id.hex(),
                 })
             objects = []
+            pinned = self._actor_pinned_oids_locked()
+            now = time.time()
+            my_hex = self.node_id.hex()
             for oid, e in self.objects.items():
+                kind = _reference_kind(e, oid in pinned)
                 objects.append({
                     "object_id": oid.hex(),
                     "state": ("failed" if e.state == FAILED else
                               "ready" if e.state == READY else "pending"),
                     "loc": e.loc,
                     "size": e.size,
+                    "size_bytes": e.size,
                     "refcount": e.refcount,
                     "foreign": e.foreign,
+                    "reference_kind": kind,
+                    "owner": e.owner.hex() if e.owner else None,
+                    "age_s": round(now - e.created_ts, 3),
+                    "created_ts": e.created_ts,
+                    # Local view; the cluster merge in _h_state_dump
+                    # rebuilds this across every node's copies.
+                    "holder_nodes": ([my_hex] if e.state == READY
+                                     and e.loc in ("inline", "shm",
+                                                   "spilled") else []),
                     "has_lineage": e.lineage is not None,
-                    "node_id": self.node_id.hex(),
+                    "node_id": my_hex,
                 })
+            # Live client ids (driver + workers): memory_summary uses
+            # this to flag owned objects whose owner process is gone.
+            clients = {w.worker_id.hex() for w in self.workers.values()
+                       if w.state != "dead"}
+            for c in self._conns:
+                if c.client_id is not None:
+                    clients.add(c.client_id.hex())
             pgs = []
             for pgid, pg in self.pgs.items():
                 pgs.append({
@@ -2081,11 +2277,14 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     "node_id": self.node_id.hex(),
                 })
             pending = len(self.pending_queue)
+        store = self._store().stats()
         return {"tasks": tasks, "actors": actors, "workers": workers,
                 "objects": objects, "placement_groups": pgs,
+                "clients": sorted(clients),
                 "node_id": self.node_id.hex(),
                 "pending_tasks": pending,
-                "store": self._store().stats()}
+                "store": store,
+                "stores": {self.node_id.hex(): store}}
 
     def _fanout_peers(self, request: dict, timeout: float = 2.0
                       ) -> Tuple[List[Tuple[dict, dict]], List[str]]:
@@ -2128,9 +2327,23 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                        "placement_groups")}
             replies, unreachable = self._fanout_peers(
                 {"type": "state_dump", "cluster": False})
+            clients = set(dump.get("clients") or [])
+            stores = dict(dump.get("stores") or {})
             for _, peer in replies:
                 for k in merged:
                     merged[k].extend(peer["dump"].get(k, []))
+                clients.update(peer["dump"].get("clients") or [])
+                stores.update(peer["dump"].get("stores") or {})
+            # Holder sets are a cluster-level fact: rebuild them from
+            # every node's local copies so list_objects/memory_summary
+            # show where each object's replicas actually live.
+            holders: Dict[str, set] = {}
+            for row in merged["objects"]:
+                for h in row.get("holder_nodes") or []:
+                    holders.setdefault(row["object_id"], set()).add(h)
+            for row in merged["objects"]:
+                row["holder_nodes"] = sorted(
+                    holders.get(row["object_id"], ()))
             merged["nodes"] = list(self._cluster_view)
             # Partial snapshots must say so — silently missing nodes
             # send operators debugging the wrong thing.
@@ -2138,6 +2351,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             merged["node_id"] = dump["node_id"]
             merged["pending_tasks"] = dump["pending_tasks"]
             merged["store"] = dump["store"]
+            merged["stores"] = stores
+            merged["clients"] = sorted(clients)
             ctx.reply(m, {"dump": merged})
             return
         ctx.reply(m, {"dump": dump})
@@ -2146,6 +2361,21 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
     # task-lifecycle tracing (reference: task events + state-API task
     # summaries; chrome-trace via ray.timeline)
     # ------------------------------------------------------------------
+    def _emit_event(self, ev: dict) -> None:
+        """Append one event to the bounded per-node ring, counting the
+        eviction the append forces when the ring is full — a silently
+        rolling ring hides lifecycle history from summarize_tasks()
+        and the timeline.  Safe with or without self.lock held (RLock)."""
+        from ray_tpu.util.metrics import EVENTS_DROPPED_METRIC
+        with self.lock:
+            if (self._events.maxlen is not None
+                    and len(self._events) >= self._events.maxlen):
+                self._inc_counter(
+                    EVENTS_DROPPED_METRIC, {},
+                    "lifecycle/profile events evicted from the "
+                    "bounded per-node event ring")
+            self._events.append(ev)
+
     def _emit_lifecycle(self, rec: TaskRecord, prof: Optional[dict],
                         failed: bool) -> None:
         """Record the task's stage-transition record into the event
@@ -2187,7 +2417,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             "pid": pid,
             "node_id": self.node_id.hex(),
         }
-        self._events.append(ev)
+        self._emit_event(ev)
         self._observe_stage_metrics(st)
 
     def _observe_stage_metrics(self, stages: Dict[str, float]) -> None:
@@ -2329,7 +2559,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             TASK_RETRIES_METRIC, {"reason": reason_tag},
             "task retries, by failure reason")
         now = time.time()
-        self._events.append({
+        self._emit_event({
             "kind": "retry",
             "name": (rec.spec.get("name") or "<task>") + ":retry",
             "task_id": rec.task_id.hex(),
@@ -2509,6 +2739,11 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 if rec.had_deps:
                     rec.stages.setdefault("deps_fetched", now)
                 rec.stages["worker_assigned"] = now
+                # Fresh execution attempt: re-arm the stall sentinel
+                # and drop the dead attempt's executing checkpoint
+                # (task_started's setdefault could never refresh it).
+                rec.stall_reported = False
+                rec.stages.pop("executing", None)
                 rec.worker = w
                 w.state = "busy"
                 w.current_task = rec
@@ -2963,6 +3198,113 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         if deadline - time.time() < 0.05:
             self._monitor_wake.set()
 
+    # ------------------------------------------------------------------
+    # stall sentinel (reference role: the dashboard reporter's py-spy
+    # integration made automatic — stragglers get a targeted stack
+    # capture recorded as a `stall` lifecycle event)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _hist_quantile(cell: dict, q: float) -> float:
+        """Upper-bound estimate of quantile `q` from an aggregated
+        histogram cell ({"buckets": {str(bound): n}, "count": n}).
+        Observations above every declared bucket count toward `count`
+        only, so a quantile past the top bucket returns that bound —
+        a conservative (low) estimate the multiple compensates for."""
+        count = cell.get("count") or 0
+        if count <= 0:
+            return 0.0
+        target = q * count
+        acc = 0.0
+        bounds = sorted(cell.get("buckets") or {}, key=float)
+        for b in bounds:
+            acc += cell["buckets"][b]
+            if acc >= target:
+                return float(b)
+        return float(bounds[-1]) if bounds else 0.0
+
+    def _stall_threshold_locked(self) -> float:
+        """max(stall_min_seconds, stall_p95_multiple * executing-stage
+        p95) — the floor alone until enough tasks completed to make
+        the histogram meaningful.  Caller holds self.lock."""
+        from ray_tpu.util.metrics import TASK_STAGE_METRIC
+        floor = config.stall_min_seconds
+        key = (TASK_STAGE_METRIC, "histogram",
+               (("stage", "executing"),))
+        cell = self._metrics.get(key)
+        if cell is None or (cell.get("count") or 0) \
+                < config.stall_min_samples:
+            return floor
+        p95 = self._hist_quantile(cell, 0.95)
+        return max(floor, config.stall_p95_multiple * p95)
+
+    def _executing_tasks_locked(self):
+        """(TaskRecord, WorkerHandle) pairs for everything currently
+        executing user code on this node.  Caller holds self.lock."""
+        for w in self.workers.values():
+            rec = w.current_task
+            if (rec is not None and w.state in ("busy", "blocked")
+                    and rec.state == "dispatched"):
+                yield rec, w
+        for a in self.actors.values():
+            if a.worker is None or a.worker.state == "dead":
+                continue
+            for rec in a.in_flight.values():
+                # Dispatched-but-unstarted actor calls sit in the
+                # worker's queue — queued, not stalled.
+                if rec.started and rec.worker is None:
+                    yield rec, a.worker
+
+    def _stall_sentinel_tick(self) -> None:
+        if not config.stall_detection_enabled \
+                or config.stall_min_seconds <= 0:
+            return
+        now = time.time()
+        flagged = []
+        with self.lock:
+            threshold = self._stall_threshold_locked()
+            for rec, w in self._executing_tasks_locked():
+                if rec.stall_reported:
+                    continue
+                start = (rec.stages.get("executing")
+                         or rec.stages.get("worker_assigned"))
+                if start is None or now - start < threshold:
+                    continue
+                rec.stall_reported = True
+                flagged.append((rec, w, now - start, threshold))
+        for rec, w, elapsed, threshold in flagged:
+            self._capture_stall(rec, w, elapsed, threshold)
+
+    def _capture_stall(self, rec: TaskRecord, w: WorkerHandle,
+                       elapsed: float, threshold: float) -> None:
+        """Targeted stack capture of the straggler's worker, recorded
+        into the event ring as a `stall` lifecycle event (surfaced in
+        summarize_tasks() and the chrome timeline)."""
+        from ray_tpu.util.metrics import TASK_STALLS_METRIC
+        name = rec.spec.get("name") or "<task>"
+
+        def finish(stacks: dict, folded: dict) -> None:
+            now = time.time()
+            text = "\n".join(str(v) for v in stacks.values())
+            with self.lock:
+                self._inc_counter(
+                    TASK_STALLS_METRIC, {},
+                    "executing tasks flagged by the stall sentinel")
+            self._emit_event({
+                "kind": "stall",
+                "name": name + ":stall",
+                "task_name": name,
+                "task_id": rec.task_id.hex(),
+                "actor": rec.actor_id is not None,
+                "elapsed_s": round(elapsed, 3),
+                "threshold_s": round(threshold, 3),
+                "stack": text,
+                "pid": w.pid,
+                "start": now, "end": now,
+                "node_id": self.node_id.hex(),
+            })
+
+        self._request_worker_stacks([w], timeout=5.0, cb=finish)
+
     def _monitor_loop(self) -> None:
         # Event wait, not a fixed sleep (an RT005-class self-finding of
         # devtools/lint): shutdown() and a newly-registered near
@@ -2970,7 +3312,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         # on time instead of quantized to the next 50ms tick, and
         # shutdown never pays a last stale sleep.
         next_spill = next_infeasible = next_mem = next_scan = 0.0
-        next_drain = 0.0
+        next_drain = next_stall = 0.0
         while not self._shutdown:
             with self.lock:
                 nearest = min(
@@ -3002,6 +3344,13 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 next_drain = now + 0.25   # chaos preempt / drain sweep
                 try:
                     self._drain_monitor_tick()
+                except Exception:
+                    pass
+            if now >= next_stall:    # stall sentinel sweep
+                next_stall = now + max(config.stall_check_interval_s,
+                                       0.1)
+                try:
+                    self._stall_sentinel_tick()
                 except Exception:
                     pass
             refresh_ms = config.memory_monitor_refresh_ms

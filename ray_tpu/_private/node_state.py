@@ -27,7 +27,8 @@ FAILED = "error"
 class ObjectEntry:
     __slots__ = ("state", "loc", "data", "size", "refcount", "waiters",
                  "producing_task", "deleted", "embedded", "foreign",
-                 "lineage", "reconstructions", "spill_path", "spilling")
+                 "lineage", "reconstructions", "spill_path", "spilling",
+                 "owner", "created_ts", "drain_replica")
 
     def __init__(self) -> None:
         self.state = PENDING
@@ -35,6 +36,13 @@ class ObjectEntry:
         self.data: Optional[bytes] = None
         self.size = 0
         self.refcount = 1
+        # Memory accounting (state.memory_summary / `ray_tpu memory`):
+        # which client (driver or worker id) created this object, when
+        # the entry was born, and whether it is a copy adopted from a
+        # draining peer (those outlive ordinary borrow refcounting).
+        self.owner: Optional[bytes] = None
+        self.created_ts = time.time()
+        self.drain_replica = False
         self.waiters: List[Callable[[], None]] = []
         self.producing_task: Optional[bytes] = None  # lineage hook
         self.deleted = False
@@ -58,7 +66,7 @@ class TaskRecord:
     __slots__ = ("task_id", "spec", "deps", "state", "worker",
                  "retries_left", "is_actor_creation", "actor_id",
                  "cancelled", "stages", "had_deps", "started",
-                 "locality_deadline", "drain_keep")
+                 "locality_deadline", "drain_keep", "stall_reported")
 
     def __init__(self, spec: dict) -> None:
         self.task_id: bytes = spec["task_id"]
@@ -86,6 +94,9 @@ class TaskRecord:
         # task — it may dispatch locally within the drain grace instead
         # of waiting to be handed off.
         self.drain_keep = False
+        # Stall sentinel: a stack capture was already taken for this
+        # execution attempt (one capture per attempt, not per sweep).
+        self.stall_reported = False
         self.actor_id: Optional[bytes] = spec.get("actor_id")
         # Lifecycle checkpoints (reference: task events feeding
         # ray.util.state task summaries): submitted -> queued ->
@@ -279,6 +290,23 @@ def _place_bundles(bundles: List[Dict[str, float]], strategy: str,
                     return None
         return assignment      # type: ignore[return-value]
     raise ValueError(f"unknown placement strategy {strategy!r}")
+
+
+def _reference_kind(e: ObjectEntry, pinned_by_actor: bool) -> str:
+    """Classify one directory entry for the memory-accounting plane
+    (state.memory_summary / list_objects reference_kind /
+    ray_tpu_object_store_bytes{kind}).  Precedence: a drain-adopted
+    replica stays visible as such even when later pinned or spilled."""
+    if e.drain_replica:
+        return "drain_replica"
+    if e.loc == "spilled" or (e.spill_path is not None
+                              and e.loc != "shm"):
+        return "spilled"
+    if pinned_by_actor:
+        return "pinned_by_actor"
+    if e.foreign:
+        return "borrowed"
+    return "owned"
 
 
 def _unregister_waiter(entries: List[ObjectEntry], cb) -> None:

@@ -377,7 +377,7 @@ class StreamChannelMixin:
         # DIFFERENT node (a draining peer preserving its drain record)
         # already carry the originating node id — keep it.
         ev.setdefault("node_id", self.node_id.hex())
-        self._events.append(ev)
+        self._emit_event(ev)
 
     def _h_timeline(self, ctx: _ConnCtx, m: dict) -> None:
         events = list(self._events)
@@ -417,6 +417,7 @@ class StreamChannelMixin:
 
     def _h_metrics_scrape(self, ctx: _ConnCtx, m: dict) -> None:
         """All aggregated series + built-in runtime gauges."""
+        from ray_tpu.util.metrics import OBJECT_STORE_BYTES_METRIC
         with self.lock:
             series = [dict(v, buckets=dict(v["buckets"]))
                       for v in self._metrics.values()]
@@ -429,6 +430,16 @@ class StreamChannelMixin:
                 "ray_tpu_workers": float(len(self.workers)),
                 "ray_tpu_objects_local": float(len(self.objects)),
             }
+            # Memory-accounting gauges: object directory bytes by
+            # reference kind (owned/borrowed/pinned_by_actor/spilled/
+            # drain_replica) — the Prometheus face of memory_summary().
+            for kind, cell in self._memory_kind_bytes_locked().items():
+                series.append({
+                    "name": OBJECT_STORE_BYTES_METRIC, "kind": "gauge",
+                    "tags": {"kind": kind}, "value": cell["bytes"],
+                    "buckets": {}, "sum": 0.0, "count": 0.0,
+                    "description": "object directory bytes by "
+                                   "reference kind"})
         stats = self._store().stats()
         builtin["ray_tpu_object_store_bytes_used"] = float(
             stats.get("used_bytes", 0))

@@ -49,23 +49,70 @@ class WorkerRuntime:
             # On-demand stack profiling (reference: dashboard
             # reporter's py-spy role): formatted stacks of every
             # thread, answered out-of-band so a busy task can't block
-            # the observation of what it's busy ON.
-            import sys
-            import traceback
+            # the observation of what it's busy ON.  samples>0 switches
+            # to low-rate sampling (N captures, interval_s apart) whose
+            # folded-stack counts feed cluster flamegraphs — that mode
+            # sleeps between captures, so it runs on its own thread.
+            if msg.get("samples"):
+                threading.Thread(target=self._sample_stacks,
+                                 args=(msg,), daemon=True,
+                                 name="rtpu-stack-sampler").start()
+            else:
+                self.client.conn.notify({
+                    "type": "stacks_reply", "token": msg["token"],
+                    "pid": os.getpid(),
+                    "text": self._format_stacks()})
+        elif msg["type"] == "exit":
+            os._exit(0)
+
+    @staticmethod
+    def _format_stacks() -> str:
+        """Formatted stacks of every thread (one-shot dump)."""
+        import sys
+        import traceback
+        frames = sys._current_frames()
+        out = []
+        for t in threading.enumerate():
+            f = frames.get(t.ident)
+            if f is None:
+                continue
+            out.append(f"--- thread {t.name} (tid={t.ident}) ---")
+            out.extend(s.rstrip() for s in
+                       traceback.format_stack(f))
+        return "\n".join(out)
+
+    def _sample_stacks(self, msg: dict) -> None:
+        """Low-rate stack sampling: capture every live thread's stack
+        `samples` times, `interval_s` apart, folding each capture into
+        root→leaf 'a;b;c' stack strings with counts (the flamegraph.pl
+        folded format the node merges across workers and nodes)."""
+        import sys
+        import time
+        import traceback
+        samples = int(msg["samples"])
+        interval = float(msg.get("interval_s") or 0.02)
+        me = threading.get_ident()
+        folded: Dict[str, int] = {}
+        for i in range(samples):
             frames = sys._current_frames()
-            out = []
             for t in threading.enumerate():
+                if t.ident == me:
+                    continue    # the sampler observing itself is noise
                 f = frames.get(t.ident)
                 if f is None:
                     continue
-                out.append(f"--- thread {t.name} (tid={t.ident}) ---")
-                out.extend(s.rstrip() for s in
-                           traceback.format_stack(f))
+                names = [fs.name for fs in traceback.extract_stack(f)]
+                key = ";".join([t.name] + names)
+                folded[key] = folded.get(key, 0) + 1
+            if i + 1 < samples:
+                time.sleep(interval)
+        try:
             self.client.conn.notify({
                 "type": "stacks_reply", "token": msg["token"],
-                "pid": os.getpid(), "text": "\n".join(out)})
-        elif msg["type"] == "exit":
-            os._exit(0)
+                "pid": os.getpid(), "text": self._format_stacks(),
+                "folded": folded})
+        except Exception:
+            pass
 
     def run(self) -> None:
         worker_id = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])

@@ -12,7 +12,24 @@ exists here as JSON):
     GET /api/summary    task/actor/object rollups (incl. per-stage
                         task-lifecycle latency percentiles)
     GET /api/timeline   chrome-trace export of the runtime timeline
-                        (lifecycle stages + spans, trace_id-linked)
+                        (lifecycle stages + spans + stall captures,
+                        trace_id-linked)
+    GET /api/memory     cluster memory accounting: per-object size /
+                        owner / reference kind (owned, borrowed,
+                        pinned_by_actor, spilled, drain_replica) /
+                        holder nodes / age, rolled up by kind, owner,
+                        and node next to each node's real shm store
+                        usage; ?min_age_s=N tunes the leak-suspect
+                        age floor (backs `ray_tpu memory`)
+    GET /api/stack      on-demand worker stack dumps, cluster-wide;
+                        ?task_id=<hex prefix> targets just the
+                        worker(s) executing that task
+                        (backs `ray_tpu stack`)
+    GET /api/flamegraph cluster flamegraph: low-rate stack sampling
+                        (?samples=N&interval_s=S) across every live
+                        worker, merged into flamegraph.pl folded
+                        format (text/plain; backs
+                        `ray_tpu stack --flame`)
     GET /metrics        Prometheus exposition (scrape endpoint)
     GET /graphs         self-contained metrics graphs (canvas
                         sparklines over /api/metrics.json samples —
@@ -207,6 +224,39 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu.util import profiling
                 self._send(200, json.dumps(profiling.timeline(),
                                            default=str).encode())
+            elif self.path.startswith("/api/memory"):
+                from urllib.parse import parse_qs, urlparse
+                q = parse_qs(urlparse(self.path).query)
+                min_age = float(q.get("min_age_s", ["60"])[0])
+                self._send(200, json.dumps(
+                    state.memory_summary(leak_min_age_s=min_age),
+                    default=str).encode())
+            elif self.path.startswith("/api/stack"):
+                from urllib.parse import parse_qs, urlparse
+                from ray_tpu.util import profiling
+                q = parse_qs(urlparse(self.path).query)
+                timeout = float(q.get("timeout", ["10"])[0])
+                task_id = q.get("task_id", [None])[0]
+                if task_id:
+                    stacks = profiling.stack_task(task_id,
+                                                  timeout=timeout)
+                else:
+                    stacks = profiling.stack_traces(timeout=timeout)
+                self._send(200, json.dumps(
+                    {"stacks": {str(k): v for k, v in stacks.items()}}
+                ).encode())
+            elif self.path.startswith("/api/flamegraph"):
+                from urllib.parse import parse_qs, urlparse
+                from ray_tpu.util import profiling
+                q = parse_qs(urlparse(self.path).query)
+                samples = int(q.get("samples", ["40"])[0])
+                interval = float(q.get("interval_s", ["0.02"])[0])
+                task_id = q.get("task_id", [None])[0]
+                text = profiling.flamegraph(samples=samples,
+                                            interval_s=interval,
+                                            task_id=task_id)
+                self._send(200, text.encode(),
+                           "text/plain; charset=utf-8")
             elif self.path == "/metrics":
                 self._send(200, metrics.prometheus_text().encode(),
                            "text/plain; version=0.0.4")

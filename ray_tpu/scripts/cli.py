@@ -11,7 +11,12 @@ status`) + `ray list/summary` (util/state CLI) + `ray job` (job CLI).
     status [--address H:P]    cluster nodes + resources
     list {tasks,actors,workers,objects,nodes,pgs}
     summary                   task/actor/object rollups
-    memory                    object-store usage
+    memory [--group-by node|owner] [--leak-suspects]
+                              cluster memory accounting: object bytes
+                              by reference kind/owner/node vs real shm
+                              store usage, plus leak suspects
+    stack [task_id] [--flame] cluster-wide worker stack dumps; target
+                              one task, or sample into a flamegraph
     metrics                   Prometheus text from the head
     job {submit,status,logs,list,stop}
     microbench                core-runtime perf harness
@@ -267,19 +272,70 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def _fmt_bytes(n: float) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
 def cmd_memory(args) -> int:
-    dump = _fetch_json("/api/state", args)
-    store = dump.get("store", {})
-    objs = dump.get("objects", [])
-    print(f"store: {store.get('used_bytes', 0)}/"
-          f"{store.get('capacity_bytes', 0)} bytes, "
-          f"{store.get('num_objects', 0)} objects, "
-          f"{store.get('num_evictions', 0)} evictions")
-    by_loc: Dict[str, int] = {}
-    for o in objs:
-        by_loc[str(o["loc"])] = by_loc.get(str(o["loc"]), 0) + 1
-    for loc, n in sorted(by_loc.items()):
-        print(f"  {loc}: {n}")
+    """Cluster memory accounting (reference: `ray memory`): per-node
+    object-store breakdown by reference kind (owned / borrowed /
+    pinned_by_actor / spilled / drain_replica) and by owner, next to
+    each node's real shm store usage; --leak-suspects flags old
+    objects whose owner client is dead or whose borrow count is
+    zero."""
+    summary = _fetch_json(
+        f"/api/memory?min_age_s={args.min_age_s:g}", args)
+    print(f"cluster objects: {summary.get('object_count', 0)} ready, "
+          f"{_fmt_bytes(summary.get('total_bytes', 0))}")
+    for kind, cell in sorted((summary.get("by_kind") or {}).items()):
+        print(f"  {kind}: {cell['count']} objects, "
+              f"{_fmt_bytes(cell['bytes'])}")
+    group = getattr(args, "group_by", "node")
+    if group == "owner":
+        rows = [{"owner": (o[:16] if isinstance(o, str) else o),
+                 "objects": c["count"],
+                 "bytes": _fmt_bytes(c["bytes"])}
+                for o, c in sorted((summary.get("by_owner") or {})
+                                   .items(),
+                                   key=lambda kv: -kv[1]["bytes"])]
+        print("\nby owner:")
+        _print_table(rows, ["owner", "objects", "bytes"])
+    else:
+        rows = []
+        for nid, c in sorted((summary.get("by_node") or {}).items()):
+            rows.append({
+                "node": nid[:12],
+                "objects": c.get("count", 0),
+                "bytes": _fmt_bytes(c.get("bytes", 0)),
+                "store_used": _fmt_bytes(c.get("store_used_bytes", 0)),
+                "store_capacity": _fmt_bytes(
+                    c.get("store_capacity_bytes", 0)),
+            })
+        print("\nby node:")
+        _print_table(rows, ["node", "objects", "bytes", "store_used",
+                            "store_capacity"])
+    if getattr(args, "leak_suspects", False):
+        suspects = summary.get("leak_suspects") or []
+        print(f"\nleak suspects ({len(suspects)}):")
+        rows = [{"object_id": s.get("object_id", "")[:16],
+                 "node": (s.get("node_id") or "")[:12],
+                 "kind": s.get("reference_kind"),
+                 "bytes": _fmt_bytes(s.get("size_bytes", 0)),
+                 "age_s": s.get("age_s"),
+                 "reason": s.get("leak_reason")}
+                for s in suspects]
+        _print_table(rows, ["object_id", "node", "kind", "bytes",
+                            "age_s", "reason"])
+    unreachable = summary.get("unreachable_nodes") or []
+    if unreachable:
+        print(f"\nWARNING: partial snapshot — unreachable nodes: "
+              f"{', '.join(n[:12] for n in unreachable)}")
     return 0
 
 
@@ -315,23 +371,46 @@ def _job_client(args):
 
 
 def cmd_stack(args) -> int:
-    """On-demand stack dump of every live worker (reference: `ray
-    stack` / the dashboard's py-spy role)."""
-    from ray_tpu.util import client as thin
-    addr = getattr(args, "address", None) or _head_address(args)
-    if not addr:
-        raise SystemExit("no cluster on record; pass --address H:P")
-    ctx = thin.connect(addr)
-    try:
-        from ray_tpu.util.profiling import stack_traces
-        stacks = stack_traces(timeout=args.timeout)
-        if not stacks:
-            print("no live workers")
-        for pid, text in sorted(stacks.items()):
-            print(f"===== worker pid {pid} =====")
-            print(text)
-    finally:
-        ctx.disconnect()
+    """On-demand stack dump of every live worker in the cluster
+    (reference: `ray stack` / the dashboard's py-spy role), served by
+    the head's dashboard.  With a task_id hex prefix, dumps only the
+    worker(s) executing that task; --flame switches to low-rate stack
+    sampling merged into flamegraph.pl folded format."""
+    if args.flame:
+        url = _dashboard_url(args) + (
+            f"/api/flamegraph?samples={args.samples}"
+            f"&interval_s={args.interval:g}")
+        if args.task_id:
+            url += f"&task_id={args.task_id}"
+        # The server blocks for the whole sampling window — scale the
+        # HTTP timeout with it instead of racing a fixed constant.
+        http_timeout = args.samples * args.interval + 60.0
+        with urllib.request.urlopen(url, timeout=http_timeout) as r:
+            folded = r.read().decode()
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(folded + ("\n" if folded else ""))
+            print(f"wrote folded stacks to {args.out} "
+                  f"(render with flamegraph.pl or speedscope)")
+        else:
+            print(folded if folded else "(no samples collected)")
+        return 0
+    path = f"/api/stack?timeout={args.timeout:g}"
+    if args.task_id:
+        path += f"&task_id={args.task_id}"
+    # Dashboard + node fanout wait up to args.timeout (+5s margin
+    # each) before replying — outlast them.
+    url = _dashboard_url(args)
+    with urllib.request.urlopen(f"{url}{path}",
+                                timeout=args.timeout + 30.0) as r:
+        stacks = (json.loads(r.read()) or {}).get("stacks") or {}
+    if not stacks:
+        print("no matching live workers" if args.task_id
+              else "no live workers")
+        return 1 if args.task_id else 0
+    for pid, text in sorted(stacks.items(), key=lambda kv: str(kv[0])):
+        print(f"===== worker {pid} =====")
+        print(text)
     return 0
 
 
@@ -509,8 +588,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--dashboard-url", default=None)
     p.set_defaults(fn=cmd_summary)
 
-    p = sub.add_parser("memory", help="object store usage")
+    p = sub.add_parser(
+        "memory", help="cluster memory accounting (by kind/owner/node)")
     p.add_argument("--dashboard-url", default=None)
+    p.add_argument("--group-by", choices=["node", "owner"],
+                   default="node", dest="group_by")
+    p.add_argument("--leak-suspects", action="store_true",
+                   dest="leak_suspects",
+                   help="flag old objects whose owner is dead or "
+                        "whose borrow count is zero")
+    p.add_argument("--min-age-s", type=float, default=60.0,
+                   dest="min_age_s",
+                   help="minimum age before an object can be a leak "
+                        "suspect")
     p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("metrics", help="Prometheus metrics dump")
@@ -539,9 +629,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     j.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_job)
 
-    p = sub.add_parser("stack", help="dump live worker stack traces")
-    p.add_argument("--address", default=None)
+    p = sub.add_parser(
+        "stack",
+        help="dump live worker stack traces (cluster-wide; optional "
+             "task targeting and flamegraph sampling)")
+    p.add_argument("task_id", nargs="?", default=None,
+                   help="task id hex prefix: dump only the worker(s) "
+                        "executing that task")
+    p.add_argument("--dashboard-url", default=None)
     p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument("--flame", action="store_true",
+                   help="sample stacks and emit flamegraph.pl folded "
+                        "format instead of one-shot dumps")
+    p.add_argument("--samples", type=int, default=40,
+                   help="samples per worker in --flame mode")
+    p.add_argument("--interval", type=float, default=0.02,
+                   help="seconds between samples in --flame mode")
+    p.add_argument("--out", default=None,
+                   help="write --flame output to this file")
     p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("serve", help="declarative serve config")

@@ -53,6 +53,18 @@ DRAIN_DURATION_METRIC = "ray_tpu_drain_duration_seconds"
 DRAIN_OBJECTS_REPLICATED_METRIC = "ray_tpu_drain_objects_replicated_total"
 DRAIN_DURATION_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 300.0)
 
+# Memory-and-stall observability plane, auto-recorded node-side.
+# object_store_bytes tags: kind = owned | borrowed | pinned_by_actor |
+# spilled | drain_replica (per-node object-directory breakdown behind
+# `ray_tpu memory` / state.memory_summary()).  task_stalls counts
+# executing tasks the stall sentinel flagged (each also gets a `stall`
+# lifecycle event carrying the worker's captured stack).
+# events_dropped counts lifecycle/profile events evicted from the
+# bounded per-node event ring (capacity: event_ring_capacity config).
+OBJECT_STORE_BYTES_METRIC = "ray_tpu_object_store_bytes"
+TASK_STALLS_METRIC = "ray_tpu_task_stalls_total"
+EVENTS_DROPPED_METRIC = "ray_tpu_events_dropped_total"
+
 # Inter-node object-transfer plane, auto-recorded node-side.
 # bytes_total tags: direction = in | out.  seconds tags: path =
 # stream (windowed binary plane) | multi (range-split, several

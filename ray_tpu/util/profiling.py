@@ -61,10 +61,18 @@ def timeline(filename: Optional[str] = None) -> Any:
     for ev in timeline_events():
         args = {k: v for k, v in ev.items() if k in _TRACE_ARG_KEYS
                 and v is not None}
+        if ev.get("kind") == "stall":
+            # Sentinel capture: elapsed/threshold plus (a bounded
+            # slice of) the worker stack ride in the span args.
+            args["elapsed_s"] = ev.get("elapsed_s")
+            args["threshold_s"] = ev.get("threshold_s")
+            stack = ev.get("stack") or ""
+            args["stack"] = stack[:4000]
         row = {
             "name": ev.get("name", "<span>"),
             "cat": ("lifecycle" if ev.get("kind") == "lifecycle" else
                     "drain" if ev.get("kind") == "drain" else
+                    "stall" if ev.get("kind") == "stall" else
                     "actor" if ev.get("actor") else
                     "user" if ev.get("user") else "task"),
             "ph": "X",
@@ -235,10 +243,65 @@ def export_otlp(filename: Optional[str] = None,
     return payload
 
 
-def stack_traces(timeout: float = 10.0) -> Dict[int, str]:
-    """On-demand stack dump of every live worker process on this node
-    (reference: the dashboard reporter's py-spy integration).  Returns
-    {pid: formatted stacks}."""
+def stack_traces(timeout: float = 10.0,
+                 cluster: bool = True) -> Dict[Any, str]:
+    """On-demand stack dump of every live worker process in the
+    cluster (reference: the dashboard reporter's py-spy integration).
+    Returns {pid: formatted stacks}; workers on remote nodes appear
+    under "pid@node" keys (pids collide across hosts).  cluster=False
+    restricts to the local node — which used to be the silent behavior
+    of this documented "every live worker" API."""
     return _client().conn.call({"type": "stack_dump",
-                                "timeout": timeout},
-                               timeout=timeout + 10.0)["stacks"]
+                                "timeout": timeout,
+                                "cluster": cluster},
+                               timeout=timeout + 15.0)["stacks"]
+
+
+def stack_task(task_id: str, timeout: float = 10.0) -> Dict[Any, str]:
+    """Targeted stack capture of the worker(s) currently executing the
+    task whose id matches the hex prefix `task_id` (anywhere in the
+    cluster) — the on-demand face of the stall sentinel's automatic
+    captures.  Returns {} when the task is not executing."""
+    return _client().conn.call({"type": "stack_dump",
+                                "timeout": timeout,
+                                "task_id": task_id,
+                                "cluster": True},
+                               timeout=timeout + 15.0)["stacks"]
+
+
+def folded_stacks(samples: int = 40, interval_s: float = 0.02,
+                  timeout: float = 10.0, cluster: bool = True,
+                  task_id: Optional[str] = None) -> Dict[str, int]:
+    """Cluster flamegraph sampling: every live worker captures its
+    thread stacks `samples` times, `interval_s` apart; the node layer
+    merges the folded-stack counts across workers and nodes.  With a
+    `task_id` hex prefix, only the worker(s) executing that task are
+    sampled.  Returns {"thread;frame;frame;...": count}."""
+    msg = {"type": "stack_dump", "timeout": timeout,
+           "cluster": cluster, "samples": samples,
+           "interval_s": interval_s}
+    if task_id:
+        msg["task_id"] = task_id
+    reply = _client().conn.call(
+        msg, timeout=timeout + samples * interval_s + 15.0)
+    return reply.get("folded") or {}
+
+
+def flamegraph(samples: int = 40, interval_s: float = 0.02,
+               timeout: float = 10.0, cluster: bool = True,
+               task_id: Optional[str] = None,
+               filename: Optional[str] = None) -> str:
+    """`folded_stacks()` rendered in the flamegraph.pl folded format
+    (one "stack count" line per distinct stack) — pipe the output into
+    flamegraph.pl / speedscope, or read hot frames straight off the
+    counts.  Writes to `filename` when given; returns the text."""
+    folded = folded_stacks(samples=samples, interval_s=interval_s,
+                           timeout=timeout, cluster=cluster,
+                           task_id=task_id)
+    text = "\n".join(f"{stack} {count}" for stack, count in
+                     sorted(folded.items(),
+                            key=lambda kv: (-kv[1], kv[0])))
+    if filename:
+        with open(filename, "w") as f:
+            f.write(text + ("\n" if text else ""))
+    return text

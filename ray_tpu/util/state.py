@@ -137,6 +137,18 @@ def summarize_tasks() -> Dict[str, Dict[str, Any]]:
                 ("node_id", "reason", "grace_s", "tasks_handed_back",
                  "actors_migrated", "objects_moved", "completed")})
             continue
+        if ev.get("kind") == "stall":
+            # Stall-sentinel captures: count + the captured stacks, so
+            # "why has this been executing for ten minutes" is
+            # answerable from the summary alone.
+            per = out.setdefault(ev.get("task_name") or "<anonymous>",
+                                 {})
+            per["stalls"] = per.get("stalls", 0) + 1
+            per.setdefault("stall_events", []).append({
+                k: ev.get(k) for k in
+                ("task_id", "elapsed_s", "threshold_s", "node_id",
+                 "pid", "stack")})
+            continue
         if ev.get("kind") != "lifecycle":
             continue
         name = ev.get("task_name") or "<anonymous>"
@@ -175,3 +187,93 @@ def summarize_objects() -> Dict[str, Any]:
         by_loc[str(o["loc"])] = by_loc.get(str(o["loc"]), 0) + 1
         total += o["size"] or 0
     return {"count": len(objs), "total_bytes": total, "by_loc": by_loc}
+
+
+def memory_summary(leak_min_age_s: float = 60.0,
+                   top_n: int = 200) -> Dict[str, Any]:
+    """Cluster-wide object-store memory accounting (reference surface:
+    `ray memory` / memory_summary in _private/state.py).
+
+    Every node reports its object-directory breakdown — per-object
+    size, owner (creating client), reference kind (owned / borrowed /
+    pinned_by_actor / spilled / drain_replica), holder set, and age —
+    and the head aggregates:
+
+    * by_kind / by_owner: {count, bytes} rollups;
+    * by_node: per-node {count, bytes, by_kind} next to the node's
+      actual shm store {used_bytes, capacity_bytes} so directory
+      accounting can be reconciled against real store usage;
+    * leak_suspects: READY objects at least `leak_min_age_s` old whose
+      owner client is dead (nothing will ever delete them) or whose
+      borrowed replica's refcount dropped to zero;
+    * objects: the `top_n` largest rows for drill-down.
+
+    The same data serves `/api/memory` on the dashboard and the
+    `ray_tpu memory` CLI table."""
+    dump = _dump()
+    objs = dump.get("objects") or []
+    live_clients = set(dump.get("clients") or [])
+    stores = dict(dump.get("stores") or {})
+    if not stores and dump.get("store"):
+        stores = {dump.get("node_id", "node"): dump["store"]}
+    by_kind: Dict[str, Dict[str, int]] = {}
+    by_owner: Dict[str, Dict[str, int]] = {}
+    by_node: Dict[str, Dict[str, Any]] = {}
+    suspects: List[dict] = []
+    total = 0
+    ready = 0
+    for row in objs:
+        size = row.get("size_bytes") or row.get("size") or 0
+        kind = row.get("reference_kind") or "owned"
+        owner = row.get("owner") or "<unknown>"
+        node = row.get("node_id") or "<node>"
+        nrec = by_node.setdefault(node, {
+            "count": 0, "bytes": 0, "shm_bytes": 0, "by_kind": {}})
+        if row.get("state") != "ready":
+            continue
+        ready += 1
+        total += size
+        kcell = by_kind.setdefault(kind, {"count": 0, "bytes": 0})
+        kcell["count"] += 1
+        kcell["bytes"] += size
+        ocell = by_owner.setdefault(owner, {"count": 0, "bytes": 0})
+        ocell["count"] += 1
+        ocell["bytes"] += size
+        nrec["count"] += 1
+        nrec["bytes"] += size
+        if row.get("loc") == "shm":
+            nrec["shm_bytes"] += size
+        nk = nrec["by_kind"].setdefault(kind, {"count": 0, "bytes": 0})
+        nk["count"] += 1
+        nk["bytes"] += size
+        age = row.get("age_s") or 0.0
+        if age < leak_min_age_s:
+            continue
+        reason = None
+        if (kind in ("owned", "spilled")
+                and row.get("owner")
+                and row["owner"] not in live_clients):
+            reason = "owner client is dead"
+        elif kind == "borrowed" and (row.get("refcount") or 0) <= 0:
+            reason = "borrowed replica with zero borrow count"
+        if reason is not None:
+            suspects.append(dict(row, leak_reason=reason))
+    for node, store in stores.items():
+        nrec = by_node.setdefault(node, {
+            "count": 0, "bytes": 0, "shm_bytes": 0, "by_kind": {}})
+        nrec["store_used_bytes"] = store.get("used_bytes", 0)
+        nrec["store_capacity_bytes"] = store.get("capacity_bytes", 0)
+        nrec["store_num_objects"] = store.get("num_objects", 0)
+    suspects.sort(key=lambda r: -(r.get("size_bytes") or 0))
+    top = sorted((r for r in objs if r.get("state") == "ready"),
+                 key=lambda r: -(r.get("size_bytes") or 0))[:top_n]
+    return {
+        "total_bytes": total,
+        "object_count": ready,
+        "by_kind": by_kind,
+        "by_owner": by_owner,
+        "by_node": by_node,
+        "leak_suspects": suspects,
+        "objects": top,
+        "unreachable_nodes": dump.get("unreachable_nodes") or [],
+    }

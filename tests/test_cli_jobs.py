@@ -98,7 +98,13 @@ def test_cli_cluster_lifecycle(cli_env):
     assert "_JobSupervisor" in r.stdout
 
     r = _cli(cli_env, "memory")
-    assert "store:" in r.stdout
+    assert "cluster objects:" in r.stdout
+    assert "by node:" in r.stdout
+
+    r = _cli(cli_env, "memory", "--group-by", "owner",
+             "--leak-suspects")
+    assert "by owner:" in r.stdout
+    assert "leak suspects" in r.stdout
 
     r = _cli(cli_env, "stop")
     assert "stopped" in r.stdout

@@ -53,8 +53,15 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.NODE_DRAINS_METRIC)
     assert _NAME.match(metrics.DRAIN_DURATION_METRIC)
     assert _NAME.match(metrics.DRAIN_OBJECTS_REPLICATED_METRIC)
+    assert _NAME.match(metrics.OBJECT_STORE_BYTES_METRIC)
+    assert _NAME.match(metrics.TASK_STALLS_METRIC)
+    assert _NAME.match(metrics.EVENTS_DROPPED_METRIC)
     assert metrics.NODE_DRAINS_METRIC.endswith("_total")
     assert metrics.DRAIN_OBJECTS_REPLICATED_METRIC.endswith("_total")
+    assert metrics.TASK_STALLS_METRIC.endswith("_total")
+    assert metrics.EVENTS_DROPPED_METRIC.endswith("_total")
+    # The by-kind store gauge is a gauge, not a counter — no _total.
+    assert not metrics.OBJECT_STORE_BYTES_METRIC.endswith("_total")
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
                metrics.OBJECT_TRANSFER_BUCKETS,
                metrics.DRAIN_DURATION_BUCKETS):
