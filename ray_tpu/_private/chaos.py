@@ -27,6 +27,10 @@ a node-level hook (``dispatch``, ``serve.assign``, ``partition``), or
     deadline_s
             kind=preempt: seconds between the simulated termination
             notice and the "VM" disappearing (0 = config.drain_grace_s)
+    down_s  kind=kill_gcs: seconds the supervised GCS stays down before
+            restart (default 1.0).  kind=gcs_partition: seconds the
+            client<->GCS partition holds from first activation (0 =
+            standing until clear())
 
 Fault kinds and where they act:
 
@@ -50,6 +54,14 @@ Fault kinds and where they act:
   simulated TPU-preemption notice with ``deadline_s`` of grace — the
   node begins a graceful drain; work that cannot finish or move by the
   deadline falls back to the ordinary kill-and-retry path.
+* ``kill_gcs`` — at the cluster supervisor (site ``gcs``): SIGKILL the
+  GCS process (or tear down an in-process server statefully-cold), then
+  restart it from its WAL/snapshot after ``down_s`` — the kill-9
+  control-plane drill (``cluster_utils.Cluster`` runs the supervisor).
+* ``gcs_partition`` — standing condition at the GcsClient: drop
+  client<->GCS traffic only (peer control + object transfer keep
+  flowing), healing after ``down_s`` seconds — exercises the client
+  reconnect/queueing path without killing the server.
 
 The legacy env specs ``testing_rpc_failure`` ("method:N" → kind=error,
 p=0.5, n=N) and ``testing_asio_delay_us`` ("method:lo:hi" microseconds)
@@ -76,7 +88,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.config import config
 
 FAULT_KINDS = ("error", "drop", "delay", "kill_worker", "evict",
-               "kill_replica", "partition", "preempt")
+               "kill_replica", "partition", "preempt", "kill_gcs",
+               "gcs_partition")
 
 # How often (at most) the env/config spec is re-read on the hot path.
 _REFRESH_INTERVAL_S = 0.25
@@ -84,11 +97,12 @@ _REFRESH_INTERVAL_S = 0.25
 
 class FaultSpec:
     __slots__ = ("site", "kind", "p", "budget", "lo_ms", "hi_ms", "node",
-                 "deadline_s", "announced")
+                 "deadline_s", "down_s", "announced", "activated_ts")
 
     def __init__(self, site: str, kind: str = "error", p: float = 1.0,
                  n: int = -1, lo_ms: float = 0.0, hi_ms: float = 0.0,
-                 node: str = "", deadline_s: float = 0.0) -> None:
+                 node: str = "", deadline_s: float = 0.0,
+                 down_s: float = 0.0) -> None:
         if kind not in FAULT_KINDS:
             raise ValueError(
                 f"unknown fault kind {kind!r} (valid: "
@@ -105,6 +119,11 @@ class FaultSpec:
             raise ValueError(f"deadline_s {deadline_s} < 0")
         if deadline_s and kind != "preempt":
             raise ValueError("deadline_s only applies to kind=preempt")
+        if down_s < 0.0:
+            raise ValueError(f"down_s {down_s} < 0")
+        if down_s and kind not in ("kill_gcs", "gcs_partition"):
+            raise ValueError(
+                "down_s only applies to kind=kill_gcs/gcs_partition")
         self.site = site
         self.kind = kind
         self.p = p
@@ -116,7 +135,13 @@ class FaultSpec:
         # the drained node has this long before the "VM" is gone
         # (0.0 = use config.drain_grace_s).
         self.deadline_s = deadline_s
+        # kind=kill_gcs: restart delay; kind=gcs_partition: partition
+        # duration from first activation (0.0 = standing).
+        self.down_s = down_s
         self.announced = False     # partition: trace once, not per check
+        # gcs_partition: wall time the standing condition first matched
+        # (its down_s window counts from here).
+        self.activated_ts = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         out = {"site": self.site, "kind": self.kind, "p": self.p,
@@ -125,6 +150,8 @@ class FaultSpec:
             out["lo_ms"], out["hi_ms"] = self.lo_ms, self.hi_ms
         if self.kind == "preempt":
             out["deadline_s"] = self.deadline_s
+        if self.kind in ("kill_gcs", "gcs_partition"):
+            out["down_s"] = self.down_s
         if self.node:
             out["node"] = self.node
         return out
@@ -156,7 +183,7 @@ def parse_spec(spec: str) -> List[FaultSpec]:
                     kwargs["p"] = float(value)
                 elif key == "n":
                     kwargs["n"] = int(value)
-                elif key in ("lo_ms", "hi_ms", "deadline_s"):
+                elif key in ("lo_ms", "hi_ms", "deadline_s", "down_s"):
                     kwargs[key] = float(value)
                 elif key == "node":
                     kwargs["node"] = value
@@ -284,10 +311,12 @@ class ChaosController:
     # -- runtime API ----------------------------------------------------
     def inject(self, site: str, kind: str = "error", p: float = 1.0,
                n: int = -1, lo_ms: float = 0.0, hi_ms: float = 0.0,
-               node: str = "", deadline_s: float = 0.0) -> None:
+               node: str = "", deadline_s: float = 0.0,
+               down_s: float = 0.0) -> None:
         """Add a fault spec at runtime (this process)."""
         spec = FaultSpec(site, kind=kind, p=p, n=n, lo_ms=lo_ms,
-                         hi_ms=hi_ms, node=node, deadline_s=deadline_s)
+                         hi_ms=hi_ms, node=node, deadline_s=deadline_s,
+                         down_s=down_s)
         with self._lock:
             self._runtime_specs.append(spec)
             self._enabled = True
@@ -325,7 +354,8 @@ class ChaosController:
                 return None
             for spec in self._match(site):
                 if spec.kind in ("kill_worker", "evict", "kill_replica",
-                                 "partition", "preempt"):
+                                 "partition", "preempt", "kill_gcs",
+                                 "gcs_partition"):
                     continue    # node-level kinds don't fire on rpcs
                 if spec.budget == 0:
                     continue
@@ -403,6 +433,32 @@ class ChaosController:
                         spec.announced = True
                         self._record_locked("partition", "partition")
                     return True
+        return False
+
+    def gcs_partitioned(self) -> bool:
+        """Standing client<->GCS partition check (GcsClient call/notify
+        + reconnect paths).  Does not consume budget; traced once per
+        spec.  A spec with down_s > 0 heals that many seconds after its
+        first activation (the window starts at the first check that
+        matches, i.e. the first GCS op attempted under the partition),
+        after which the spec disarms itself."""
+        if not self._enabled and time.monotonic() < self._next_check:
+            return False
+        now = time.time()
+        with self._lock:
+            self._refresh_locked()
+            for spec in self._env_specs + self._runtime_specs:
+                if spec.kind != "gcs_partition" or spec.budget == 0:
+                    continue
+                if not spec.activated_ts:
+                    spec.activated_ts = now
+                if spec.down_s and now - spec.activated_ts >= spec.down_s:
+                    spec.budget = 0     # healed: disarm for good
+                    continue
+                if not spec.announced:
+                    spec.announced = True
+                    self._record_locked("gcs", "gcs_partition")
+                return True
         return False
 
     def jitter(self) -> float:

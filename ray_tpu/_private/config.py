@@ -147,6 +147,41 @@ _D("preemption_notice_file", str, "",
    "graceful drain.  File contents: empty (use drain_grace_s), a "
    "float (seconds until the deadline), or JSON {\"deadline_s\": N}. "
    "A GCE metadata-watcher shim or a test writes this file.")
+_D("gcs_wal_fsync", bool, True,
+   "fsync the GCS write-ahead log.  Critical records (named-actor /"
+   " node-membership transitions, snapshots) fsync on append; hot-path"
+   " records (KV, small-object payloads) batch into one fsync per"
+   " gcs_wal_fsync_batch_s window.  Off trades an OS-crash durability"
+   " window for append latency (a GCS process crash alone never loses"
+   " flushed records).")
+_D("gcs_wal_fsync_batch_s", float, 0.05,
+   "Max seconds of flushed-but-unsynced hot-path WAL records an OS "
+   "crash may lose when gcs_wal_fsync is on.")
+_D("gcs_wal_compact_ops", int, 2000,
+   "WAL records appended since the last snapshot that trigger "
+   "snapshot + log compaction (gcs.snap written, gcs.wal truncated).")
+_D("gcs_wal_compact_bytes", int, 8 * 1024 * 1024,
+   "WAL size in bytes that triggers snapshot + log compaction "
+   "regardless of record count.")
+_D("gcs_call_timeout_s", float, 10.0,
+   "Default per-call deadline for node->GCS rpcs: a dead-but-connected "
+   "GCS surfaces as a timeout into the reconnect/retry path instead of "
+   "wedging the caller forever.")
+_D("gcs_reconnect_max_s", float, 60.0,
+   "Total time a GCS call rides out an outage (transparent reconnect "
+   "with exponential backoff) before surfacing ConnectionLost; nodes "
+   "keep working on cached locations/actor homes meanwhile.")
+_D("gcs_reconnect_delay_ms", int, 50,
+   "Base backoff between GCS reconnect attempts; doubles per attempt "
+   "with jitter up to gcs_reconnect_max_delay_ms.")
+_D("gcs_reconnect_max_delay_ms", int, 2000,
+   "Upper bound on the per-attempt GCS reconnect backoff.")
+_D("gcs_resync_grace_s", float, 10.0,
+   "After a GCS restart, recovered (stale) node records get this long "
+   "to reconnect and re-sync before the health check reaps them.")
+_D("gcs_status_interval_s", float, 10.0,
+   "How often the node monitor polls gcs_status (feeds the "
+   "ray_tpu_gcs_wal_bytes gauge and epoch-change detection).")
 _D("task_retry_delay_ms", int, 50,
    "Base backoff before a task retry is resubmitted; doubles per "
    "attempt with jitter (reference role: task resubmit backoff).")

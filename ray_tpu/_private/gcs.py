@@ -13,19 +13,56 @@ Single-node deployments embed this in the head node service; multi-node
 clusters serve the same object over TCP via gcs_service.GcsServer.
 All methods are thread-safe.  Pubsub: `sub_*` callbacks fire inline
 under no lock contention guarantees beyond per-call atomicity.
+
+Durability split (GCS fault tolerance — reference: Ray HA GCS over
+external Redis, gcs/store_client/redis_store_client.h:106):
+
+* HARD state goes to the write-ahead log and survives `kill -9`:
+  durable KV namespaces, the function table, named actors, node
+  registrations (including an in-progress drain and its deadline),
+  the actor -> node directory, inline/error small-object payloads,
+  and lost-object markers.
+* SOFT state is deliberately NOT logged and is rebuilt by node
+  re-sync after a restart: shm object locations, heartbeats /
+  resource views, pubsub subscriptions, and kv-wait parking — exactly
+  like the reference's restarted GCS rebuilding from raylet
+  resubscription.
+
+Every construction against a persist_dir begins a new *recovery
+epoch* (stamped on every server reply): nodes that observe the bump —
+or simply reconnect — re-register and bulk re-publish their
+authoritative local state via ``resync_node``.  Until a recovered
+node re-syncs, its last-known record is served tagged ``stale``
+rather than dropped, and the health check gives it
+``gcs_resync_grace_s`` instead of the plain heartbeat timeout.
 """
 
 from __future__ import annotations
 
+import os
+import pickle
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import config
+
+# WAL ops that fsync immediately (when gcs_wal_fsync is on): acked
+# control-plane transitions whose loss would strand a caller that saw
+# the ack.  Hot-path ops (kv churn, forwarded small results) batch
+# into one fsync per gcs_wal_fsync_batch_s window instead.
+_FSYNC_CRITICAL_OPS = frozenset((
+    "actor_put", "actor_del", "node_reg", "node_drain", "node_dead",
+    "epoch"))
+
+_SNAPSHOT_VERSION = 1
 
 
 class NodeInfo:
     __slots__ = ("node_id", "host", "control_port", "transfer_port",
                  "resources_total", "resources_avail", "last_heartbeat",
-                 "state", "load", "drain_deadline", "drain_reason")
+                 "state", "load", "drain_deadline", "drain_reason",
+                 "stale")
 
     def __init__(self, node_id: bytes, host: str, control_port: int,
                  transfer_port: int, resources_total: Dict[str, float]
@@ -53,6 +90,12 @@ class NodeInfo:
         # demand signal): {"pending": N, "shapes": [resource dicts],
         # "idle_since": ts | None}.
         self.load: Dict[str, object] = {}
+        # True for a record recovered from the WAL/snapshot after a GCS
+        # restart that the node has not yet re-confirmed via resync:
+        # served (locations, actor homes, cluster views keep working on
+        # last-known data) but tagged, and reaped by the health check
+        # only after gcs_resync_grace_s.
+        self.stale = False
 
     def to_dict(self) -> dict:
         return {"node_id": self.node_id, "host": self.host,
@@ -62,21 +105,21 @@ class NodeInfo:
                 "resources_avail": dict(self.resources_avail),
                 "state": self.state, "load": dict(self.load),
                 "drain_deadline": self.drain_deadline,
-                "drain_reason": self.drain_reason}
+                "drain_reason": self.drain_reason,
+                "stale": self.stale}
 
 
 class GlobalControlState:
     """In-memory control-plane tables, optionally durable.
 
-    `persist_dir` enables the reference's GCS-FT role
-    (gcs/store_client/redis_store_client.h:106, swapped for a local
-    write-ahead log): every DURABLE mutation (KV, function table, named
-    actors) appends one pickled op to `gcs.wal`, replayed by the next
-    GlobalControlState pointed at the same directory — so detached-actor
-    names, job records, and workflow/meta KV survive a GCS restart.
-    Node membership and object locations are deliberately ephemeral:
-    nodes re-register and re-report on reconnect, exactly like the
-    reference's restarted GCS rebuilding from raylet resubscription."""
+    `persist_dir` enables the reference's GCS-FT role: every HARD
+    mutation appends one pickled op to `gcs.wal` (fsync policy:
+    `gcs_wal_fsync`), periodically folded into a `gcs.snap` full-state
+    snapshot with the log truncated (compaction) so the WAL stops
+    growing unbounded.  The next GlobalControlState pointed at the same
+    directory replays snapshot + log — so detached-actor names, node
+    membership, the actor directory, and inline results survive a GCS
+    `kill -9`.  See the module docstring for the hard/soft split."""
 
     # KV namespaces worth durability.  High-frequency transient channels
     # (tune/train report queues, collective rendezvous boards) would
@@ -114,34 +157,101 @@ class GlobalControlState:
         # of 2ms polling; reference: pubsub long-poll, src/ray/pubsub/)
         self._kv_waiters: Dict[tuple, List[Callable[[bytes], None]]] = {}
         self._node_subs: List[Callable[[str, dict], None]] = []
+        # Recovery epoch: bumps once per construction-with-persistence,
+        # stamped on every server reply so clients detect a restart
+        # even when their TCP reconnect raced the outage.  Epoch 1 = a
+        # fresh (or non-durable) control plane.
+        self.epoch = 1
+        self.started = time.time()
+        # Wall time of the last WAL/snapshot recovery (None = clean
+        # first boot): anchors the resync grace for stale records.
+        self._recovered_ts: Optional[float] = None
         self._wal = None
+        self._wal_path: Optional[str] = None
+        self._snap_path: Optional[str] = None
+        self._wal_ops = 0               # records since the last snapshot
+        self._last_fsync = 0.0
+        self._last_snapshot_ts: Optional[float] = None
+        # Backoff after a FAILED snapshot (e.g. disk full): without it
+        # the still-exceeded compaction thresholds would re-attempt a
+        # full-state dump on every subsequent durable mutation.
+        self._next_snapshot_try = 0.0
         if persist_dir:
-            import os
-            import pickle
-            os.makedirs(persist_dir, exist_ok=True)
-            path = os.path.join(persist_dir, "gcs.wal")
+            self._open_persistence(persist_dir)
+
+    # -- durability: snapshot + WAL ----------------------------------------
+    def _open_persistence(self, persist_dir: str) -> None:
+        os.makedirs(persist_dir, exist_ok=True)
+        self._wal_path = os.path.join(persist_dir, "gcs.wal")
+        self._snap_path = os.path.join(persist_dir, "gcs.snap")
+        recovered_epoch = 0
+        had_state = False
+        if os.path.exists(self._snap_path):
+            try:
+                with open(self._snap_path, "rb") as f:
+                    snap = pickle.load(f)
+                recovered_epoch = self._load_snapshot(snap)
+                had_state = True
+            except Exception:
+                # A torn snapshot (crash mid-replace should be
+                # impossible with os.replace, but a truncated disk is
+                # not): fall back to whatever the WAL holds.
+                pass
+        if os.path.exists(self._wal_path):
             good_end = 0
-            if os.path.exists(path):
-                with open(path, "rb") as f:
-                    while True:
-                        try:
-                            op, args = pickle.load(f)
-                        except EOFError:
-                            good_end = f.tell()
-                            break
-                        except Exception:
-                            # Torn tail write (crash mid-append): keep
-                            # the good prefix only.  Appending AFTER the
-                            # garbage would make every later record
-                            # unreachable to the next replay.
-                            break
+            with open(self._wal_path, "rb") as f:
+                while True:
+                    try:
+                        op, args = pickle.load(f)
+                    except EOFError:
                         good_end = f.tell()
+                        break
+                    except Exception:
+                        # Torn tail write (crash mid-append): keep
+                        # the good prefix only.  Appending AFTER the
+                        # garbage would make every later record
+                        # unreachable to the next replay.
+                        break
+                    good_end = f.tell()
+                    if op == "epoch":
+                        recovered_epoch = max(recovered_epoch,
+                                              int(args[0]))
+                    else:
                         self._replay(op, args)
-                size = os.path.getsize(path)
-                if good_end < size:
-                    with open(path, "r+b") as f:
-                        f.truncate(good_end)
-            self._wal = open(path, "ab")
+                    had_state = True
+            size = os.path.getsize(self._wal_path)
+            if good_end < size:
+                with open(self._wal_path, "r+b") as f:
+                    f.truncate(good_end)
+        self.epoch = recovered_epoch + 1
+        if had_state:
+            self._recovered_ts = time.time()
+            # Recovered non-dead nodes are last-known, not confirmed:
+            # tag stale and restart their heartbeat clock so the health
+            # check gives them the resync grace instead of reaping them
+            # for silence that happened while the GCS itself was down.
+            for n in self._nodes.values():
+                if n.state != "dead":
+                    n.stale = True
+                    n.last_heartbeat = self._recovered_ts
+        self._wal = open(self._wal_path, "ab")
+        self._log("epoch", self.epoch)
+
+    def _load_snapshot(self, snap: dict) -> int:
+        self._kv = {ns: dict(t) for ns, t in snap.get("kv", {}).items()}
+        self._functions = dict(snap.get("functions", {}))
+        self._named_actors = dict(snap.get("named_actors", {}))
+        self._actor_nodes = dict(snap.get("actor_nodes", {}))
+        self._small_objects = dict(snap.get("small_objects", {}))
+        self._lost_objects = set(snap.get("lost_objects", ()))
+        for nd in snap.get("nodes", ()):
+            n = NodeInfo(nd["node_id"], nd["host"], nd["control_port"],
+                         nd["transfer_port"], nd["resources_total"])
+            n.state = nd.get("state", "alive")
+            n.drain_deadline = nd.get("drain_deadline")
+            n.drain_reason = nd.get("drain_reason", "")
+            self._nodes[n.node_id] = n
+        return int(snap.get("epoch", 0))
 
     def _replay(self, op: str, args: tuple) -> None:
         if op == "kv_put":
@@ -156,14 +266,154 @@ class GlobalControlState:
             self._named_actors[args[0]] = args[1]
         elif op == "actor_del":
             self._named_actors.pop(args[0], None)
+        elif op == "node_reg":
+            node_id, host, cp, tp, res = args
+            self._nodes[node_id] = NodeInfo(node_id, host, cp, tp, res)
+        elif op == "node_drain":
+            node_id, deadline, reason = args
+            n = self._nodes.get(node_id)
+            if n is not None and n.state != "dead":
+                n.state = "draining"
+                n.drain_deadline = deadline
+                n.drain_reason = reason
+        elif op == "node_dead":
+            n = self._nodes.get(args[0])
+            if n is not None:
+                n.state = "dead"
+            for aid in [a for a, nid in self._actor_nodes.items()
+                        if nid == args[0]]:
+                del self._actor_nodes[aid]
+        elif op == "actor_node":
+            self._actor_nodes[args[0]] = args[1]
+        elif op == "actor_node_del":
+            self._actor_nodes.pop(args[0], None)
+        elif op == "small_obj":
+            oid, kind, data = args
+            self._small_objects[oid] = (kind, data)
+        elif op == "small_obj_del":
+            self._small_objects.pop(args[0], None)
+        elif op == "lost_add":
+            self._lost_objects.add(args[0])
+        elif op == "lost_del":
+            self._lost_objects.discard(args[0])
 
     def _log(self, op: str, *args) -> None:
         """Append one durable op.  Caller holds the lock."""
         if self._wal is None:
             return
-        import pickle
         pickle.dump((op, args), self._wal)
         self._wal.flush()
+        if config.gcs_wal_fsync:
+            now = time.monotonic()
+            if (op in _FSYNC_CRITICAL_OPS
+                    or now - self._last_fsync
+                    >= config.gcs_wal_fsync_batch_s):
+                try:
+                    os.fsync(self._wal.fileno())
+                except OSError:
+                    pass
+                self._last_fsync = now
+        self._wal_ops += 1
+        self._maybe_compact_locked()
+
+    def _maybe_compact_locked(self) -> None:
+        if self._wal is None:
+            return
+        try:
+            wal_bytes = self._wal.tell()
+        except (OSError, ValueError):
+            return
+        if (self._wal_ops < config.gcs_wal_compact_ops
+                and wal_bytes < config.gcs_wal_compact_bytes):
+            return
+        if time.monotonic() < self._next_snapshot_try:
+            return      # last snapshot failed; don't retry per-append
+        self.snapshot()
+
+    def snapshot(self) -> None:
+        """Fold the full hard state into `gcs.snap` and truncate the
+        WAL (log compaction).  Crash-safe: the snapshot is written to a
+        temp file, fsynced, and atomically renamed BEFORE the log is
+        truncated — a crash between the two replays snapshot + old log,
+        which is idempotent (replay ops are last-writer-wins)."""
+        with self._lock:
+            if self._wal is None or self._snap_path is None:
+                return
+            snap = {
+                "version": _SNAPSHOT_VERSION,
+                "epoch": self.epoch,
+                "ts": time.time(),
+                "kv": {ns: dict(t) for ns, t in self._kv.items()
+                       if ns in self._durable_ns},
+                "functions": dict(self._functions),
+                "named_actors": dict(self._named_actors),
+                "actor_nodes": dict(self._actor_nodes),
+                "small_objects": dict(self._small_objects),
+                "lost_objects": set(self._lost_objects),
+                # Dead nodes are dropped at snapshot time: their
+                # node_dead cleanup already published, and an
+                # ever-growing tombstone list defeats compaction.
+                "nodes": [n.to_dict() for n in self._nodes.values()
+                          if n.state != "dead"],
+            }
+            tmp = self._snap_path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    pickle.dump(snap, f, protocol=5)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._snap_path)
+            except OSError:
+                # Snapshot failed (disk full is the likely way): back
+                # off instead of re-dumping full state on every later
+                # append, and don't leave the torn temp file behind.
+                self._next_snapshot_try = time.monotonic() + 30.0
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return
+            self._wal.close()
+            self._wal = open(self._wal_path, "wb")
+            self._wal_ops = 0
+            self._last_snapshot_ts = time.time()
+            # The fresh log still carries the epoch so a WAL-only
+            # reader (snapshot deleted by an operator) stays correct.
+            self._log("epoch", self.epoch)
+
+    def status(self) -> dict:
+        """Control-plane health card: epoch, uptime, WAL size,
+        last-snapshot age, membership counts (`ray_tpu gcs` CLI)."""
+        with self._lock:
+            wal_bytes = 0
+            if self._wal is not None:
+                try:
+                    wal_bytes = self._wal.tell()
+                except (OSError, ValueError):
+                    pass
+            states: Dict[str, int] = {}
+            stale = 0
+            for n in self._nodes.values():
+                states[n.state] = states.get(n.state, 0) + 1
+                stale += 1 if n.stale and n.state != "dead" else 0
+            now = time.time()
+            return {
+                "epoch": self.epoch,
+                "uptime_s": now - self.started,
+                "persistent": self._wal is not None,
+                "wal_bytes": wal_bytes,
+                "wal_ops_since_snapshot": self._wal_ops,
+                "last_snapshot_age_s": (
+                    None if self._last_snapshot_ts is None
+                    else now - self._last_snapshot_ts),
+                "recovered": self._recovered_ts is not None,
+                "nodes": states,
+                "stale_nodes": stale,
+                "named_actors": len(self._named_actors),
+                "actor_directory": len(self._actor_nodes),
+                "objects_tracked": len(self._locations),
+                "small_objects": len(self._small_objects),
+            }
 
     # -- internal KV -------------------------------------------------------
     def kv_put(self, ns: str, key: bytes, value: bytes,
@@ -267,7 +517,85 @@ class GlobalControlState:
         with self._lock:
             self._nodes[node_id] = NodeInfo(
                 node_id, host, control_port, transfer_port, resources_total)
+            self._log("node_reg", node_id, host, control_port,
+                      transfer_port, dict(resources_total))
         self._publish_node("node_added", self._nodes[node_id].to_dict())
+
+    def resync_node(self, node_id: bytes, host: str, control_port: int,
+                    transfer_port: int,
+                    resources_total: Dict[str, float],
+                    objects: Iterable[Tuple[bytes, int]] = (),
+                    inline: Iterable[Tuple[bytes, int, str, bytes]] = (),
+                    actors: Iterable[bytes] = (),
+                    draining: Optional[dict] = None) -> dict:
+        """A node's bulk re-publication of its authoritative local
+        state after a GCS restart or reconnect (reference: raylet
+        resubscription rebuilding the restarted GCS).  Re-registers the
+        node (clearing any stale tag), repopulates the soft object
+        directory with its held copies, re-points the actor directory
+        at its resident actors, and restores an in-progress drain.
+        Idempotent — a node may resync on every reconnect.
+
+        Returns {"epoch", "redrain": grace_s | None}: redrain is set
+        when the GCS recovered a drain for this node that the node
+        itself didn't report (GCS-initiated drain whose event was lost
+        with the old process) — the server re-publishes node_draining
+        so the node picks the drain back up."""
+        redrain: Optional[float] = None
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None or n.state == "dead":
+                # Unknown (joined during the outage, or record already
+                # reaped): a resync is as good as a registration.
+                n = NodeInfo(node_id, host, control_port, transfer_port,
+                             resources_total)
+                self._nodes[node_id] = n
+            else:
+                n.host = host
+                n.control_port = control_port
+                n.transfer_port = transfer_port
+                n.resources_total = dict(resources_total)
+            n.stale = False
+            n.last_heartbeat = time.time()
+            self._log("node_reg", node_id, host, control_port,
+                      transfer_port, dict(resources_total))
+            if draining is not None:
+                n.state = "draining"
+                n.drain_deadline = float(draining.get("deadline")
+                                         or time.time())
+                n.drain_reason = draining.get("reason", "drain")
+                self._log("node_drain", node_id, n.drain_deadline,
+                          n.drain_reason)
+            elif n.state == "draining":
+                # Recovered drain the node doesn't know about (the
+                # node_draining push died with the old GCS process).
+                # Re-log it: the node_reg record above replays to a
+                # fresh "alive" NodeInfo, so the drain must follow it
+                # in the log or a second restart would forget it.
+                redrain = max(0.0, (n.drain_deadline or time.time())
+                              - time.time())
+                self._log("node_drain", node_id,
+                          n.drain_deadline or time.time(),
+                          n.drain_reason)
+            for aid in actors:
+                self._actor_nodes[aid] = node_id
+                self._log("actor_node", aid, node_id)
+            info = n.to_dict()
+        # Locations are soft state: re-add through the ordinary path so
+        # parked location subscribers (readers that waited out the
+        # outage) wake on the re-published copies.
+        for oid, size in objects:
+            self.add_location(oid, node_id, size, kind="shm")
+        for oid, size, kind, data in inline:
+            self.add_location(oid, None, size, kind=kind, data=data)
+        self._publish_node("node_resynced", info)
+        if redrain is not None:
+            info = dict(info)
+            info["reason"] = info.get("drain_reason") or "drain"
+            info["grace_s"] = redrain
+            self._publish_node("node_draining", info)
+        return {"epoch": self.epoch,
+                "redrain": redrain}
 
     def heartbeat(self, node_id: bytes,
                   resources_avail: Dict[str, float],
@@ -300,6 +628,7 @@ class GlobalControlState:
             n.state = "draining"
             n.drain_deadline = time.time() + max(grace_s, 0.0)
             n.drain_reason = reason
+            self._log("node_drain", node_id, n.drain_deadline, reason)
             info = n.to_dict()
         info["reason"] = reason
         info["grace_s"] = max(grace_s, 0.0)
@@ -317,6 +646,7 @@ class GlobalControlState:
                 # node_dead actor/object cleanup publishes exactly once.
                 return
             n.state = "dead"
+            self._log("node_dead", node_id)
             # Copies on a dead node are gone.  Subscribers waiting on an
             # object whose LAST copy just vanished must hear about it
             # (kind="lost") or they would block forever.
@@ -326,6 +656,7 @@ class GlobalControlState:
                 if not holders and oid not in self._small_objects:
                     del self._locations[oid]
                     self._lost_objects.add(oid)
+                    self._log("lost_add", oid)
                     subs = self._loc_subs.pop(oid, [])
                     if subs:
                         lost_notifies.append((oid, size, subs))
@@ -333,6 +664,7 @@ class GlobalControlState:
                            if nid == node_id]
             for a in dead_actors:
                 del self._actor_nodes[a]
+                self._log("actor_node_del", a)
                 self.drop_named_actor(a)
             info = n.to_dict()
         for oid, size, subs in lost_notifies:
@@ -352,7 +684,10 @@ class GlobalControlState:
         reachable and still serving (objects pull from them, their
         actors answer until migrated), so they stay in the cluster
         view — consumers that must not target them filter on
-        state == "alive" (spill targets, placement, feasibility)."""
+        state == "alive" (spill targets, placement, feasibility).
+        Stale records (recovered, not yet re-synced) stay in the view
+        too, tagged "stale": last-known is better than nothing while
+        the cluster converges on a restarted GCS."""
         with self._lock:
             return [n.to_dict() for n in self._nodes.values()
                     if not alive_only or n.state != "dead"]
@@ -369,12 +704,24 @@ class GlobalControlState:
         plain heartbeat timeout: heartbeats naturally stop while a
         node finishes its drain sequence and exits, so silence alone
         is not death until the deadline has passed (a cleanly drained
-        node reports itself dead before that)."""
+        node reports itself dead before that).
+
+        Stale records (recovered after a GCS restart, not yet
+        re-synced) get gcs_resync_grace_s from the recovery instant:
+        the silence the plain timeout would punish happened while the
+        GCS itself was down."""
         now = time.time()
+        resync_grace = max(config.gcs_resync_grace_s, timeout_s)
         with self._lock:
             stale = []
             for n in self._nodes.values():
                 hb_stale = now - n.last_heartbeat > timeout_s
+                if n.stale and n.state != "dead":
+                    if now - n.last_heartbeat > resync_grace:
+                        stale.append((n.node_id,
+                                      "never re-synced after GCS "
+                                      "restart"))
+                    continue
                 if n.state == "alive" and hb_stale:
                     stale.append((n.node_id, "missed heartbeats"))
                 elif n.state == "draining" and hb_stale:
@@ -410,9 +757,12 @@ class GlobalControlState:
             if node_id is not None:
                 holders.add(node_id)
             self._locations[oid] = (holders, size)
-            self._lost_objects.discard(oid)
+            if oid in self._lost_objects:
+                self._lost_objects.discard(oid)
+                self._log("lost_del", oid)
             if kind in ("inline", "error") and data is not None:
                 self._small_objects[oid] = (kind, data)
+                self._log("small_obj", oid, kind, data)
             subs = list(self._loc_subs.get(oid, ()))
         evt = {"object_id": oid, "node_id": node_id, "size": size,
                "kind": kind}
@@ -434,6 +784,12 @@ class GlobalControlState:
                      and self._nodes[h].state != "dead"]
             lost = oid in self._lost_objects
         out = {"nodes": alive, "size": size}
+        if alive and all(n.get("stale") for n in alive):
+            # Every holder is a recovered record not yet re-confirmed:
+            # serve it (last-known beats nothing) but tagged, so pullers
+            # know a fetch failure here means "wait for re-sync", not
+            # "object lost".
+            out["stale"] = True
         if small is not None:
             out["kind"], out["data"] = small
         else:
@@ -449,8 +805,11 @@ class GlobalControlState:
         instead of polling a vanished record forever."""
         with self._lock:
             holders, size = self._locations.pop(oid, (set(), 0))
-            self._small_objects.pop(oid, None)
-            self._lost_objects.discard(oid)
+            if self._small_objects.pop(oid, None) is not None:
+                self._log("small_obj_del", oid)
+            if oid in self._lost_objects:
+                self._lost_objects.discard(oid)
+                self._log("lost_del", oid)
             subs = self._loc_subs.pop(oid, [])
         evt = {"object_id": oid, "node_id": None, "size": size,
                "kind": "lost"}
@@ -500,6 +859,7 @@ class GlobalControlState:
     def set_actor_node(self, actor_id: bytes, node_id: bytes) -> None:
         with self._lock:
             self._actor_nodes[actor_id] = node_id
+            self._log("actor_node", actor_id, node_id)
 
     def get_actor_node(self, actor_id: bytes) -> Optional[bytes]:
         with self._lock:
@@ -507,7 +867,8 @@ class GlobalControlState:
 
     def drop_actor(self, actor_id: bytes) -> None:
         with self._lock:
-            self._actor_nodes.pop(actor_id, None)
+            if self._actor_nodes.pop(actor_id, None) is not None:
+                self._log("actor_node_del", actor_id)
         self.drop_named_actor(actor_id)
 
     # -- node event pubsub -------------------------------------------------

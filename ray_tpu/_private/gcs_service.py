@@ -8,6 +8,16 @@ GCS protocol over TCP; pubsub events ride the same connection as
 unsolicited pushes (matched by the absence of __reply_to__), exactly how
 task-execution pushes work on the worker<->node connection.
 
+Fault tolerance (ISSUE 7): every server reply is stamped with the
+state's recovery epoch (``__gcs_epoch__``), and ``GcsClient`` survives
+a GCS ``kill -9``: calls carry a default per-call deadline
+(``gcs_call_timeout_s``) so a dead-but-connected peer surfaces as a
+timeout, failures feed a transparent reconnect loop with exponential
+backoff (``gcs_reconnect_*``), subscriptions are re-established on the
+fresh connection, and an ``on_reconnect(epoch)`` callback lets the node
+service bulk re-publish its local state (``resync_node``) — the
+reference's raylet resubscription to a restarted GCS.
+
 Run standalone:  python -m ray_tpu._private.gcs_service --port 0
 (prints the bound port on stdout; the Cluster fixture scrapes it).
 """
@@ -19,6 +29,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ray_tpu._private.chaos import chaos
 from ray_tpu._private.config import config
 from ray_tpu._private.gcs import GlobalControlState
 from ray_tpu._private.protocol import (Connection, ConnectionLost,
@@ -26,14 +37,19 @@ from ray_tpu._private.protocol import (Connection, ConnectionLost,
 
 
 class _GcsConn:
-    __slots__ = ("sock", "send_lock", "node_id", "loc_subs", "sub_nodes_cb")
+    __slots__ = ("sock", "send_lock", "node_id", "loc_subs",
+                 "sub_nodes_cb", "epoch")
 
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(self, sock: socket.socket, epoch: int = 1) -> None:
         self.sock = sock
         self.send_lock = threading.Lock()
         self.node_id: Optional[bytes] = None
         self.loc_subs: set = set()
         self.sub_nodes_cb = None
+        # The serving state's recovery epoch, stamped on every reply so
+        # clients detect a GCS restart even when their reconnect raced
+        # the outage (epoch is fixed for a server instance's lifetime).
+        self.epoch = epoch
 
     def send(self, msg: dict) -> None:
         try:
@@ -46,6 +62,7 @@ class _GcsConn:
         if rid is None:
             return
         payload["__reply_to__"] = rid
+        payload["__gcs_epoch__"] = self.epoch
         self.send(payload)
 
 
@@ -56,7 +73,7 @@ class GcsServer:
     def __init__(self, state: Optional[GlobalControlState] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  persist_dir: Optional[str] = None) -> None:
-        # persist_dir: durable KV/function/named-actor tables via a WAL
+        # persist_dir: durable hard-state tables via WAL + snapshot
         # (GCS fault tolerance — see GlobalControlState docstring).
         self.state = state or GlobalControlState(persist_dir=persist_dir)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -85,6 +102,15 @@ class GcsServer:
             self._listener.close()
         except OSError:
             pass
+        # Drop client connections so their reconnect loops notice the
+        # outage instead of waiting on a silent half-open socket.
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -100,7 +126,7 @@ class GcsServer:
                     pass
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn = _GcsConn(sock)
+            conn = _GcsConn(sock, epoch=self.state.epoch)
             with self._lock:
                 self._conns.append(conn)
             threading.Thread(target=self._conn_loop, args=(conn,),
@@ -152,6 +178,23 @@ class GcsServer:
                                  m["resources_total"])
         conn.node_id = m["node_id"]
         conn.reply(m, {"ok": True})
+
+    def _h_resync_node(self, conn, m):
+        """Bulk re-publication of a node's authoritative local state
+        after a GCS restart/reconnect (the re-sync half of the
+        restart protocol; see GlobalControlState.resync_node)."""
+        out = self.state.resync_node(
+            m["node_id"], m["host"], m["control_port"],
+            m["transfer_port"], m["resources_total"],
+            objects=m.get("objects") or (),
+            inline=m.get("inline") or (),
+            actors=m.get("actors") or (),
+            draining=m.get("draining"))
+        conn.node_id = m["node_id"]
+        conn.reply(m, out)
+
+    def _h_gcs_status(self, conn, m):
+        conn.reply(m, self.state.status())
 
     def _h_heartbeat(self, conn, m):
         self.state.heartbeat(m["node_id"], m["resources_avail"],
@@ -300,23 +343,249 @@ class GcsServer:
         conn.reply(m, {"ok": True})
 
 
+def _count_reconnect() -> None:
+    """ray_tpu_gcs_reconnects_total — flushed to the node like any app
+    metric (lazy import: metrics -> client -> protocol would otherwise
+    cycle at import time)."""
+    try:
+        from ray_tpu.util.metrics import (GCS_RECONNECTS_METRIC,
+                                          shared_counter)
+        shared_counter(
+            GCS_RECONNECTS_METRIC,
+            description="successful GCS client reconnects").inc()
+    except Exception:
+        pass
+
+
 class GcsClient:
     """Node-side client: the same surface GlobalControlState exposes,
     shipped over TCP, plus location/node subscriptions delivered via the
-    connection's push channel."""
+    connection's push channel.
+
+    Reconnect-transparent: a lost/partitioned/wedged connection is
+    re-dialed with exponential backoff for up to gcs_reconnect_max_s
+    while calls queue (per-call deadline gcs_call_timeout_s turns a
+    dead-but-connected peer into a retriable failure instead of a
+    forever-hang); subscriptions re-establish on the fresh connection
+    and `on_reconnect(epoch)` fires so the owner can re-sync."""
 
     def __init__(self, host: str, port: int,
-                 push_handler: Optional[Callable[[dict], None]] = None
+                 push_handler: Optional[Callable[[dict], None]] = None,
+                 on_reconnect: Optional[Callable[[int], None]] = None
                  ) -> None:
         self.host, self.port = host, port
         self._push_handler = push_handler
-        self.conn = Connection(connect_tcp(host, port),
-                               push_handler=self._on_push)
+        self._on_reconnect = on_reconnect
         self._loc_cbs: Dict[bytes, List[Callable]] = {}
         self._node_cbs: List[Callable] = []
         self._lock = threading.Lock()
+        # Serializes connection swaps; RLock so a reconnect can check
+        # state re-entrantly.  self.conn is swapped atomically under it.
+        self._conn_lock = threading.RLock()
+        self._closed = False
+        self._reconnecting = False
+        self._epoch: Optional[int] = None
+        self.conn = self._dial()
+
+    # -- connection management ---------------------------------------------
+    def _dial(self, deadline_s: float = 10.0) -> Connection:
+        sock = connect_tcp(self.host, self.port, deadline_s=deadline_s)
+        return Connection(sock, push_handler=self._on_push,
+                          on_disconnect=self._note_disconnect)
+
+    def _note_disconnect(self) -> None:
+        """Fired from a dying connection's receiver thread (and failed
+        notifies): kick one background reconnect so pushes (location/
+        node events) resume even when no caller is blocked in call().
+        Non-blocking: if the lock is busy, a reconnect/swap is already
+        in flight — hot paths (task_done publishing locations) must
+        never queue behind a dial attempt just to report a failure."""
+        if self._closed:
+            return
+        if not self._conn_lock.acquire(blocking=False):
+            return
+        try:
+            if self._reconnecting:
+                return
+            self._reconnecting = True
+        finally:
+            self._conn_lock.release()
+        threading.Thread(target=self._reconnect_watch, daemon=True,
+                         name="rtpu-gcs-reconnect").start()
+
+    def _reconnect_watch(self) -> None:
+        try:
+            self._ensure_connected(
+                time.time() + config.gcs_reconnect_max_s)
+        except Exception:
+            pass
+        finally:
+            with self._conn_lock:
+                self._reconnecting = False
+
+    def _ensure_connected(self, deadline: float) -> Connection:
+        """Return a live connection, re-dialing with exponential
+        backoff (seeded jitter stream, PR-3) until `deadline`.  The
+        dial + resubscribe happen OUTSIDE _conn_lock — holding it
+        through a ~1s connect attempt would convoy every other caller
+        (including non-blocking _note_disconnect probes) behind one
+        reconnector; concurrent dial races resolve at the swap."""
+        attempt = 0
+        while True:
+            if self._closed:
+                raise ConnectionLost("gcs client closed")
+            with self._conn_lock:
+                cur = self.conn
+            if not cur._closed and not chaos.gcs_partitioned():
+                return cur
+            conn = None
+            if not chaos.gcs_partitioned():
+                # Short per-attempt dial (connect_tcp retries refused
+                # connections internally): the overall outage budget
+                # lives in THIS loop's deadline, not in one attempt.
+                try:
+                    conn = self._dial(deadline_s=min(
+                        1.0, max(0.05, deadline - time.time())))
+                except OSError:
+                    conn = None
+            if conn is not None:
+                try:
+                    self._resubscribe(conn)
+                except Exception:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    conn = None
+            if conn is not None:
+                with self._conn_lock:
+                    if not self.conn._closed:
+                        # Lost the swap race to a concurrent
+                        # reconnector whose conn is already live.
+                        try:
+                            conn.close()
+                        except Exception:
+                            pass
+                        return self.conn
+                    old, self.conn = self.conn, conn
+                try:
+                    old.close()
+                except Exception:
+                    pass
+                _count_reconnect()
+                if self._on_reconnect is not None:
+                    try:
+                        self._on_reconnect(self._epoch or 0)
+                    except Exception:
+                        pass
+                return conn
+            if time.time() >= deadline:
+                raise ConnectionLost(
+                    f"GCS at {self.host}:{self.port} unreachable for "
+                    f"{config.gcs_reconnect_max_s:g}s")
+            base = max(config.gcs_reconnect_delay_ms, 1) / 1000.0
+            cap = max(config.gcs_reconnect_max_delay_ms, 1) / 1000.0
+            delay = min(cap, base * (2 ** attempt))
+            attempt += 1
+            time.sleep(delay * (0.5 + 0.5 * chaos.jitter()))
+
+    def _resubscribe(self, conn: Connection) -> None:
+        """Re-establish pubsub on a fresh connection (the server-side
+        registrations died with the old one)."""
+        t = config.gcs_call_timeout_s
+        reply = conn.call({"type": "ping"}, timeout=t)
+        self._note_epoch(reply)
+        with self._lock:
+            oids = list(self._loc_cbs)
+            want_nodes = bool(self._node_cbs)
+        if want_nodes:
+            conn.call({"type": "sub_nodes"}, timeout=t)
+        for oid in oids:
+            conn.call({"type": "sub_location", "object_id": oid},
+                      timeout=t)
+
+    def _note_epoch(self, reply: dict) -> None:
+        ep = reply.get("__gcs_epoch__")
+        if ep is not None:
+            self._epoch = ep
+
+    @property
+    def gcs_epoch(self) -> Optional[int]:
+        """Last recovery epoch observed on any reply (None before the
+        first stamped reply)."""
+        return self._epoch
+
+    def _call(self, msg: dict, timeout: Optional[float] = None,
+              max_wait_s: Optional[float] = None) -> dict:
+        """Request/reply with per-call deadline + transparent
+        reconnect: failures (lost connection, injected gcs_partition,
+        a dead-but-connected peer timing out) retry against a fresh
+        connection until gcs_reconnect_max_s, so callers ride out a
+        GCS restart instead of wedging or erroring.
+
+        `max_wait_s` bounds the TOTAL wait including reconnects —
+        for call sites that hold a scarce slot (a node conn thread, a
+        pull-pool worker) and have a cached-state fallback or their
+        own retry loop: those must fail fast and ride the outage out
+        elsewhere, not queue here.
+
+        Delivery is AT-LEAST-ONCE: an attempt whose reply died with
+        the connection is re-sent, so a conditional mutation
+        (kv_put overwrite=False, register_named_actor) can observe its
+        OWN committed first attempt and report False.  Callers that
+        need the distinction re-read after a False (see the
+        register_named_actor caller in node_service._h_create_actor);
+        everything else on this surface is idempotent."""
+        per_call = (timeout if timeout is not None
+                    else config.gcs_call_timeout_s)
+        if max_wait_s is not None:
+            per_call = min(per_call, max_wait_s)
+            deadline = time.time() + max_wait_s
+        else:
+            deadline = time.time() + max(config.gcs_reconnect_max_s,
+                                         per_call)
+        while True:
+            conn = self.conn
+            try:
+                if chaos.gcs_partitioned():
+                    raise ConnectionLost("chaos: gcs partition")
+                if conn._closed:
+                    conn = self._ensure_connected(deadline)
+                reply = conn.call(msg, timeout=per_call)
+                self._note_epoch(reply)
+                return reply
+            except (ConnectionLost, TimeoutError, OSError):
+                if self._closed or time.time() >= deadline:
+                    raise
+                # A timeout on a live socket means a wedged peer: close
+                # it so the redial below replaces it (in-flight calls
+                # from other threads fail into their own retry loops).
+                if not conn._closed:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                self._ensure_connected(deadline)
+
+    def _notify(self, msg: dict) -> None:
+        """One-way send.  Lossy across an outage BY DESIGN: heartbeats
+        are periodic and locations re-publish via resync_node on
+        reconnect — blocking a notify caller for the reconnect window
+        would wedge hot paths for data the re-sync restores anyway."""
+        conn = self.conn
+        if conn._closed:
+            self._note_disconnect()     # drop; resync restores it
+            return
+        try:
+            if chaos.gcs_partitioned():
+                raise ConnectionLost("chaos: gcs partition")
+            conn.notify(msg)
+        except (ConnectionLost, OSError):
+            if not self._closed:
+                self._note_disconnect()
 
     def close(self) -> None:
+        self._closed = True
         self.conn.close()
 
     def _on_push(self, msg: dict) -> None:
@@ -337,93 +606,116 @@ class GcsClient:
     # -- mirrored surface --------------------------------------------------
     def register_node(self, node_id, host, control_port, transfer_port,
                       resources_total):
-        self.conn.call({"type": "register_node", "node_id": node_id,
-                        "host": host, "control_port": control_port,
-                        "transfer_port": transfer_port,
-                        "resources_total": resources_total})
+        self._call({"type": "register_node", "node_id": node_id,
+                    "host": host, "control_port": control_port,
+                    "transfer_port": transfer_port,
+                    "resources_total": resources_total})
+
+    def resync_node(self, node_id, host, control_port, transfer_port,
+                    resources_total, objects=(), inline=(), actors=(),
+                    draining=None):
+        return self._call({"type": "resync_node", "node_id": node_id,
+                           "host": host, "control_port": control_port,
+                           "transfer_port": transfer_port,
+                           "resources_total": resources_total,
+                           "objects": list(objects),
+                           "inline": list(inline),
+                           "actors": list(actors),
+                           "draining": draining})
+
+    def status(self):
+        return self._call({"type": "gcs_status"})
 
     def heartbeat(self, node_id, resources_avail, load=None):
-        self.conn.notify({"type": "heartbeat", "node_id": node_id,
-                          "resources_avail": resources_avail,
-                          "load": load})
+        self._notify({"type": "heartbeat", "node_id": node_id,
+                      "resources_avail": resources_avail,
+                      "load": load})
 
-    def nodes(self, alive_only: bool = True):
-        return self.conn.call({"type": "nodes",
-                               "alive_only": alive_only})["nodes"]
+    def nodes(self, alive_only: bool = True,
+              max_wait_s: Optional[float] = None):
+        return self._call({"type": "nodes",
+                           "alive_only": alive_only},
+                          max_wait_s=max_wait_s)["nodes"]
 
     def mark_node_dead(self, node_id, reason=""):
-        self.conn.call({"type": "mark_node_dead", "node_id": node_id,
-                        "reason": reason})
+        self._call({"type": "mark_node_dead", "node_id": node_id,
+                    "reason": reason})
 
     def drain_node(self, node_id, grace_s=30.0,
                    reason="drain requested"):
-        return self.conn.call({"type": "drain_node", "node_id": node_id,
-                               "grace_s": grace_s,
-                               "reason": reason})["ok"]
+        return self._call({"type": "drain_node", "node_id": node_id,
+                           "grace_s": grace_s,
+                           "reason": reason})["ok"]
 
     def kv_put(self, ns, key, value, overwrite=True):
-        return self.conn.call({"type": "kv_put", "ns": ns, "key": key,
-                               "value": value,
-                               "overwrite": overwrite})["ok"]
+        return self._call({"type": "kv_put", "ns": ns, "key": key,
+                           "value": value,
+                           "overwrite": overwrite})["ok"]
 
     def kv_wait(self, ns, key, timeout):
-        return self.conn.call({"type": "kv_wait", "ns": ns, "key": key,
-                               "timeout": timeout},
-                              timeout=timeout + 15.0)["value"]
+        return self._call({"type": "kv_wait", "ns": ns, "key": key,
+                           "timeout": timeout},
+                          timeout=timeout + 15.0)["value"]
 
     def kv_get(self, ns, key):
-        return self.conn.call({"type": "kv_get", "ns": ns,
-                               "key": key})["value"]
+        return self._call({"type": "kv_get", "ns": ns,
+                           "key": key})["value"]
 
     def kv_del(self, ns, key):
-        return self.conn.call({"type": "kv_del", "ns": ns, "key": key})["ok"]
+        return self._call({"type": "kv_del", "ns": ns, "key": key})["ok"]
 
     def kv_keys(self, ns, prefix=b""):
-        return self.conn.call({"type": "kv_keys", "ns": ns,
-                               "prefix": prefix})["keys"]
+        return self._call({"type": "kv_keys", "ns": ns,
+                           "prefix": prefix})["keys"]
 
     def register_function(self, function_id, blob):
-        self.conn.call({"type": "fn_register", "function_id": function_id,
-                        "blob": blob})
+        self._call({"type": "fn_register", "function_id": function_id,
+                    "blob": blob})
 
     def fetch_function(self, function_id):
-        return self.conn.call({"type": "fn_fetch",
-                               "function_id": function_id})["blob"]
+        return self._call({"type": "fn_fetch",
+                           "function_id": function_id})["blob"]
 
     def register_named_actor(self, ns, name, actor_id):
-        return self.conn.call({"type": "register_named_actor", "ns": ns,
-                               "name": name, "actor_id": actor_id})["ok"]
+        return self._call({"type": "register_named_actor", "ns": ns,
+                           "name": name, "actor_id": actor_id})["ok"]
 
     def lookup_named_actor(self, ns, name):
-        return self.conn.call({"type": "lookup_named_actor", "ns": ns,
-                               "name": name})["actor_id"]
+        return self._call({"type": "lookup_named_actor", "ns": ns,
+                           "name": name})["actor_id"]
 
     def drop_named_actor(self, actor_id):
-        self.conn.notify({"type": "drop_named_actor", "actor_id": actor_id})
+        self._notify({"type": "drop_named_actor", "actor_id": actor_id})
 
     def list_named_actors(self, ns=None):
-        return self.conn.call({"type": "list_named_actors",
-                               "ns": ns})["names"]
+        return self._call({"type": "list_named_actors",
+                           "ns": ns})["names"]
 
     def add_location(self, oid, node_id, size, kind="shm", data=None):
-        self.conn.notify({"type": "add_location", "object_id": oid,
-                          "node_id": node_id, "size": size, "kind": kind,
-                          "data": data})
+        self._notify({"type": "add_location", "object_id": oid,
+                      "node_id": node_id, "size": size, "kind": kind,
+                      "data": data})
 
-    def get_locations(self, oid):
-        return self.conn.call({"type": "get_locations", "object_id": oid})
+    def get_locations(self, oid, max_wait_s: Optional[float] = None):
+        return self._call({"type": "get_locations", "object_id": oid},
+                          max_wait_s=max_wait_s)
 
     def remove_object(self, oid):
-        self.conn.notify({"type": "remove_object", "object_id": oid})
+        self._notify({"type": "remove_object", "object_id": oid})
 
     def remove_location(self, oid, node_id):
-        self.conn.notify({"type": "remove_location", "object_id": oid,
-                          "node_id": node_id})
+        self._notify({"type": "remove_location", "object_id": oid,
+                      "node_id": node_id})
 
-    def sub_location(self, oid, cb):
+    def sub_location(self, oid, cb, max_wait_s: Optional[float] = None):
+        """Register a location-event callback.  The local registration
+        always lands: if the server call fails (outage), the next
+        successful reconnect's resubscription establishes it — so a
+        bounded-wait caller may treat this as fire-and-forget."""
         with self._lock:
             self._loc_cbs.setdefault(oid, []).append(cb)
-        self.conn.call({"type": "sub_location", "object_id": oid})
+        self._call({"type": "sub_location", "object_id": oid},
+                   max_wait_s=max_wait_s)
 
     def unsub_location(self, oid, cb=None):
         with self._lock:
@@ -435,27 +727,29 @@ class GcsClient:
                     cbs.remove(cb)
                 if not cbs:
                     self._loc_cbs.pop(oid, None)
-        self.conn.notify({"type": "unsub_location", "object_id": oid})
+        self._notify({"type": "unsub_location", "object_id": oid})
 
     def sub_nodes(self, cb):
         with self._lock:
             self._node_cbs.append(cb)
-        self.conn.call({"type": "sub_nodes"})
+        self._call({"type": "sub_nodes"})
 
     def set_actor_node(self, actor_id, node_id):
-        self.conn.notify({"type": "set_actor_node", "actor_id": actor_id,
-                          "node_id": node_id})
+        self._notify({"type": "set_actor_node", "actor_id": actor_id,
+                      "node_id": node_id})
 
     def get_actor_node(self, actor_id):
-        return self.conn.call({"type": "get_actor_node",
-                               "actor_id": actor_id})["node_id"]
+        return self._call({"type": "get_actor_node",
+                           "actor_id": actor_id})["node_id"]
 
     def drop_actor(self, actor_id):
-        self.conn.notify({"type": "drop_actor", "actor_id": actor_id})
+        self._notify({"type": "drop_actor", "actor_id": actor_id})
 
     def ping(self) -> bool:
         try:
-            return self.conn.call({"type": "ping"}, timeout=5.0)["ok"]
+            reply = self.conn.call({"type": "ping"}, timeout=5.0)
+            self._note_epoch(reply)
+            return reply["ok"]
         except Exception:
             return False
 
@@ -466,10 +760,15 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--persist-dir", default=None,
+                    help="WAL+snapshot directory: hard state survives "
+                         "kill -9 (GCS fault tolerance)")
     args = ap.parse_args()
-    server = GcsServer(host=args.host, port=args.port)
+    server = GcsServer(host=args.host, port=args.port,
+                       persist_dir=args.persist_dir)
     server.start()
     print(f"GCS_PORT={server.port}", flush=True)
+    print(f"GCS_EPOCH={server.state.epoch}", flush=True)
     try:
         while True:
             time.sleep(3600)

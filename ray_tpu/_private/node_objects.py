@@ -138,11 +138,15 @@ class ObjectPlaneMixin:
 
             st["cb"] = on_loc
             self._pull_state[oid] = st
+            # Bounded wait: a pull-pool worker must not camp on its
+            # slot through a GCS outage — the local registration lands
+            # regardless and the client's reconnect resubscription
+            # re-arms the server side, so the attempt just requeues.
             try:
-                self.gcs.sub_location(oid, on_loc)
-                st["subscribed"] = True
+                self.gcs.sub_location(oid, on_loc, max_wait_s=2.0)
             except Exception:
                 pass
+            st["subscribed"] = True
         with self.lock:
             if oid in self._cancelled_pulls or self._shutdown:
                 return True   # local entry deleted mid-pull
@@ -150,7 +154,9 @@ class ObjectPlaneMixin:
             if ent is not None and ent.state in (READY, FAILED):
                 return True
         try:
-            locs = self.gcs.get_locations(oid)
+            # Bounded for the same reason as the subscribe above: ride
+            # a GCS outage out in the requeue loop, not on this slot.
+            locs = self.gcs.get_locations(oid, max_wait_s=2.0)
         except Exception:
             return False
         size = locs.get("size", 0)
@@ -1061,7 +1067,24 @@ class ObjectPlaneMixin:
 
     def _h_forward_done(self, ctx: _ConnCtx, m: dict) -> None:
         with self.lock:
+            # Inline/error results ride the notify itself (peer-to-
+            # peer): register them exactly as a pull of the GCS inline
+            # record would, so the owner's waiters wake without a GCS
+            # round-trip — results keep flowing through a GCS outage.
+            # Pre-existing owner entries keep their ownership
+            # (_register_object: decided at birth, never flipped); a
+            # racing pull finds the entry READY and short-circuits.
+            for oid, loc, data, size in m.get("returns") or ():
+                e = self.objects.get(oid)
+                if e is not None and (e.deleted
+                                      or e.state in (READY, FAILED)):
+                    continue
+                self._register_object(
+                    oid, loc, data, size,
+                    state=READY if loc == "inline" else FAILED,
+                    foreign=True)
             self._complete_forwarded(m["task_id"])
+            self._schedule()
 
     def _h_forward_task(self, ctx: _ConnCtx, m: dict) -> None:
         """A peer spilled a task (or actor call) over to this node."""

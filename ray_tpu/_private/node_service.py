@@ -78,9 +78,18 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             from ray_tpu._private.gcs_service import GcsClient
             self.gcs = GcsClient(gcs_address[0], gcs_address[1],
                                  push_handler=lambda m:
-                                 self._gcs_events.put(("push", m)))
+                                 self._gcs_events.put(("push", m)),
+                                 on_reconnect=lambda epoch:
+                                 self._gcs_events.put(("resync", epoch)))
         else:
             self.gcs = gcs or GlobalControlState()
+        # Last GCS recovery epoch this node confirmed (via registration
+        # or resync); a bump means the control plane restarted and this
+        # node re-published its state (ray_tpu_gcs_restarts_total).
+        self._gcs_epoch: Optional[int] = None
+        # Periodic gcs_status poll (wal size gauge, `ray_tpu gcs`).
+        self._gcs_status: dict = {}
+        self._next_gcs_status = 0.0
         # node_id -> Connection to that node's control listener
         self._peer_conns: Dict[bytes, Any] = {}
         self._peer_lock = threading.Lock()
@@ -472,6 +481,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._gcs_event_thread.start()
         self.gcs.register_node(self.node_id, host, self.control_port,
                                self.transfer_port, self.resources_total)
+        self._gcs_epoch = self.gcs.gcs_epoch
         self.gcs.sub_nodes(lambda ev, info:
                            self._gcs_events.put(("node", ev, info)))
         self._cluster_view = self.gcs.nodes()
@@ -544,6 +554,16 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 except Exception:
                     pass
                 self._cluster_view = self.gcs.nodes()
+                # Control-plane status card (epoch / WAL size /
+                # last-snapshot age): polled at a slow cadence for the
+                # ray_tpu_gcs_wal_bytes gauge and `ray_tpu gcs`.
+                if time.time() >= self._next_gcs_status:
+                    self._next_gcs_status = (time.time()
+                                             + config.gcs_status_interval_s)
+                    try:
+                        self._gcs_status = self.gcs.status()
+                    except Exception:
+                        pass
                 with self.lock:
                     self._schedule()   # peer capacity may have freed up
             except Exception:
@@ -561,6 +581,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     self._on_node_event(item[1], item[2])
                 elif item[0] == "push":
                     self._on_gcs_push(item[1])
+                elif item[0] == "resync":
+                    self._gcs_resync()
             except Exception:
                 pass
 
@@ -721,6 +743,85 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                         self._decref(dep)
             self._schedule()
 
+    def _gcs_resync(self) -> None:
+        """Bulk re-publication of this node's authoritative local state
+        to the GCS after a reconnect (re-sync half of the GCS restart
+        protocol; reference: raylet resubscription rebuilding a
+        restarted GCS).  Re-registers the node, re-announces every
+        READY object copy this node serves (the GCS object directory is
+        soft state), re-points the actor directory at resident actors,
+        and restores an in-progress drain.  Idempotent — runs on every
+        reconnect, restart or not."""
+        if not self.multinode or self._shutdown:
+            return
+        t0 = time.time()
+        objs: List[Tuple[bytes, int]] = []
+        inline: List[Tuple[bytes, int, str, bytes]] = []
+        with self.lock:
+            for oid, e in self.objects.items():
+                if e.state not in (READY, FAILED) or e.deleted:
+                    continue
+                if e.foreign and e.loc != "shm":
+                    continue    # pulled inline copies: record not ours
+                if e.loc in ("shm", "spilled", "inline"):
+                    # Same publication rule as task_done: local values
+                    # (including spilled ones this node still serves)
+                    # announce a holder; readers fetch from here.
+                    objs.append((oid, e.size))
+                elif e.loc == "error" and e.data is not None:
+                    # Error blobs ride in the GCS record itself so they
+                    # survive this node's death too.
+                    inline.append((oid, e.size, "error", bytes(e.data)))
+            actors = [aid for aid, a in self.actors.items()
+                      if a.state != "dead"]
+            draining = None
+            if self.draining:
+                draining = {"deadline": self._drain_deadline,
+                            "reason": self._drain_reason}
+            resources_total = dict(self.resources_total)
+        try:
+            out = self.gcs.resync_node(
+                self.node_id, self.host, self.control_port,
+                self.transfer_port, resources_total,
+                objects=objs, inline=inline, actors=actors,
+                draining=draining)
+        except Exception:
+            return      # still down; the next reconnect resyncs
+        dt = time.time() - t0
+        new_epoch = out.get("epoch") or self.gcs.gcs_epoch
+        restarted = (new_epoch is not None
+                     and self._gcs_epoch is not None
+                     and new_epoch != self._gcs_epoch)
+        self._gcs_epoch = new_epoch
+        from ray_tpu.util.metrics import (GCS_RESTARTS_METRIC,
+                                          GCS_RESYNC_BUCKETS,
+                                          GCS_RESYNC_SECONDS_METRIC)
+        with self.lock:
+            self._observe_hist(GCS_RESYNC_SECONDS_METRIC, {}, dt,
+                               GCS_RESYNC_BUCKETS,
+                               "node-side GCS re-sync duration")
+            if restarted:
+                self._inc_counter(GCS_RESTARTS_METRIC, {},
+                                  "GCS restarts observed (recovery "
+                                  "epoch bumps)")
+        if restarted:
+            # Lifecycle event: surfaces in summarize_tasks() under
+            # "node:gcs_restart" and in the timeline (like drains).
+            self._emit_event({
+                "kind": "gcs_restart", "name": "gcs:restart",
+                "epoch": new_epoch, "resync_s": dt,
+                "objects_republished": len(objs) + len(inline),
+                "actors_republished": len(actors),
+                "start": t0, "end": time.time(),
+                "pid": 0, "node_id": self.node_id.hex()})
+        self._next_gcs_status = 0.0     # refresh the status card now
+        try:
+            self._cluster_view = self.gcs.nodes()
+        except Exception:
+            pass
+        with self.lock:
+            self._schedule()
+
     # -- peer connections --------------------------------------------------
     def _peer_conn_to(self, ninfo: dict):
         """Get (or open) the persistent Connection to a peer node."""
@@ -752,7 +853,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             if n["node_id"] == nid:
                 return n
         try:
-            self._cluster_view = self.gcs.nodes()
+            # Bounded: this runs on conn/forward threads whose serial
+            # dispatch must not wedge through a GCS outage — the cached
+            # view above is the ride-it-out answer.
+            self._cluster_view = self.gcs.nodes(max_wait_s=2.0)
         except Exception:
             return None
         for n in self._cluster_view:
@@ -1180,6 +1284,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
 
     def _h_task_done(self, ctx: _ConnCtx, m: dict) -> None:
         notify_owner: Optional[bytes] = None
+        fwd_returns: List[tuple] = []
         prof = m.get("profile")
         if prof is not None:
             prof["node_id"] = self.node_id.hex()
@@ -1226,6 +1331,16 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     embedded=embedded, creator_pid=ctx.pid,
                     owner=(rec.spec.get("owner")
                            if rec is not None else None))
+                if (notify_owner is not None
+                        and loc in ("inline", "error")
+                        and data is not None):
+                    # Piggyback inline/error results on the peer-to-peer
+                    # forward_done so the owner registers them without a
+                    # GCS location lookup — a forwarded actor call (the
+                    # Serve hot path) keeps answering through a full GCS
+                    # outage.  shm-sized results still travel via the
+                    # location directory + transfer plane.
+                    fwd_returns.append((oid, loc, data, size))
                 if oid in self._streams:
                     self.finish_stream(oid)   # wake parked consumers
             if rec is not None:
@@ -1267,7 +1382,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         if notify_owner is not None:
             self._peer_notify(notify_owner,
                               {"type": "forward_done",
-                               "task_id": m["task_id"]})
+                               "task_id": m["task_id"],
+                               "returns": fwd_returns})
 
     def _peer_notify(self, nid: bytes, msg: dict) -> None:
         """One-way message to a peer, reusing that peer's FIFO sender
@@ -1433,13 +1549,58 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 pass
         ctx.reply(m, {"ok": True, "state": "dispatched"})
 
+    def _gcs_proxy(self, ctx: _ConnCtx, m: dict, fn) -> None:
+        """Run a blocking GCS-dependent handler off the conn thread,
+        in THIS client's submission order, and reply asynchronously.
+
+        A connection dispatches its client's rpcs serially, and
+        GcsClient calls queue through a GCS outage (reconnect with
+        backoff, up to gcs_reconnect_max_s): executed inline, one kv
+        op during an outage would wedge every later rpc from the same
+        client — including task_done from a worker, stalling results
+        that never needed the GCS.  Only the CALLER of a GCS-dependent
+        op should wait out the outage.  Single-node (embedded state,
+        never blocks) stays inline."""
+        if not self.multinode:
+            try:
+                ctx.reply(m, fn())
+            except Exception as e:
+                ctx.reply(m, {"__error__": e})
+            return
+        q = ctx.gcs_q
+        if q is None:
+            q = ctx.gcs_q = queue.Queue()
+
+            def drain(_q=q, _ctx=ctx) -> None:
+                while not self._shutdown:
+                    try:
+                        item = _q.get(timeout=5.0)
+                    except queue.Empty:
+                        # Reap the drainer once its conn is gone.
+                        if _ctx not in self._conns:
+                            return
+                        continue
+                    req, job = item
+                    try:
+                        out = job()
+                    except Exception as e:
+                        out = {"__error__": e}
+                    try:
+                        _ctx.reply(req, out)
+                    except Exception:
+                        pass
+
+            threading.Thread(target=drain, daemon=True,
+                             name="rtpu-gcs-proxy").start()
+        q.put((m, fn))
+
     def _h_kv_put(self, ctx: _ConnCtx, m: dict) -> None:
-        ok = self.gcs.kv_put(m["ns"], m["key"], m["value"],
-                             m.get("overwrite", True))
-        ctx.reply(m, {"ok": ok})
+        self._gcs_proxy(ctx, m, lambda: {"ok": self.gcs.kv_put(
+            m["ns"], m["key"], m["value"], m.get("overwrite", True))})
 
     def _h_kv_get(self, ctx: _ConnCtx, m: dict) -> None:
-        ctx.reply(m, {"value": self.gcs.kv_get(m["ns"], m["key"])})
+        self._gcs_proxy(ctx, m, lambda: {
+            "value": self.gcs.kv_get(m["ns"], m["key"])})
 
     def _h_kv_wait(self, ctx: _ConnCtx, m: dict) -> None:
         """Long-poll kv read: parked until the key is put or timeout.
@@ -1653,17 +1814,22 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             pass
 
     def _h_kv_del(self, ctx: _ConnCtx, m: dict) -> None:
-        ctx.reply(m, {"ok": self.gcs.kv_del(m["ns"], m["key"])})
+        self._gcs_proxy(ctx, m, lambda: {
+            "ok": self.gcs.kv_del(m["ns"], m["key"])})
 
     def _h_kv_keys(self, ctx: _ConnCtx, m: dict) -> None:
-        ctx.reply(m, {"keys": self.gcs.kv_keys(m["ns"], m.get("prefix", b""))})
+        self._gcs_proxy(ctx, m, lambda: {
+            "keys": self.gcs.kv_keys(m["ns"], m.get("prefix", b""))})
 
     def _h_fn_register(self, ctx: _ConnCtx, m: dict) -> None:
-        self.gcs.register_function(m["function_id"], m["blob"])
-        ctx.reply(m, {"ok": True})
+        def job():
+            self.gcs.register_function(m["function_id"], m["blob"])
+            return {"ok": True}
+        self._gcs_proxy(ctx, m, job)
 
     def _h_fn_fetch(self, ctx: _ConnCtx, m: dict) -> None:
-        ctx.reply(m, {"blob": self.gcs.fetch_function(m["function_id"])})
+        self._gcs_proxy(ctx, m, lambda: {
+            "blob": self.gcs.fetch_function(m["function_id"])})
 
     # -- actors ------------------------------------------------------------
     def _h_create_actor(self, ctx: _ConnCtx, m: dict) -> None:
@@ -2084,27 +2250,34 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         ctx.reply(m, {"state": "unknown", "reason": ""})
 
     def _h_lookup_named_actor(self, ctx: _ConnCtx, m: dict) -> None:
-        aid = self.gcs.lookup_named_actor(m["namespace"], m["name"])
-        spec = None
-        with self.lock:
-            if aid is not None and aid in self.actors:
-                spec = {k: v for k, v in self.actors[aid].spec.items()
-                        if k != "creation_task"}
-        if spec is None and aid is not None and self.multinode:
-            fwd = self._forward_actor_rpc(aid, {"type": "actor_spec",
-                                                "actor_id": aid})
-            if fwd is not None:
-                spec = fwd.get("spec")
-        ctx.reply(m, {"actor_id": aid, "spec": spec})
+        def job():
+            aid = self.gcs.lookup_named_actor(m["namespace"], m["name"])
+            spec = None
+            with self.lock:
+                if aid is not None and aid in self.actors:
+                    spec = {k: v for k, v in self.actors[aid].spec.items()
+                            if k != "creation_task"}
+            if spec is None and aid is not None and self.multinode:
+                fwd = self._forward_actor_rpc(aid, {"type": "actor_spec",
+                                                    "actor_id": aid})
+                if fwd is not None:
+                    spec = fwd.get("spec")
+            return {"actor_id": aid, "spec": spec}
+        self._gcs_proxy(ctx, m, job)
 
     def _h_list_named_actors(self, ctx: _ConnCtx, m: dict) -> None:
-        ctx.reply(m, {"names": self.gcs.list_named_actors(m.get("namespace"))})
+        self._gcs_proxy(ctx, m, lambda: {
+            "names": self.gcs.list_named_actors(m.get("namespace"))})
 
     # -- cluster info ------------------------------------------------------
     def _h_cluster_resources(self, ctx: _ConnCtx, m: dict) -> None:
         if self.multinode:
             try:
-                self._cluster_view = self.gcs.nodes()
+                # Bounded: a conn thread serves every rpc from its
+                # client serially — a GCS outage must degrade this to
+                # the cached cluster view, not park the connection
+                # (and everything queued behind it) for the wait.
+                self._cluster_view = self.gcs.nodes(max_wait_s=2.0)
             except Exception:
                 pass
             total: Dict[str, float] = {}

@@ -181,7 +181,8 @@ class WorkerHandle:
 class _ConnCtx:
     """Per-connection server-side context."""
 
-    __slots__ = ("sock", "send_lock", "kind", "worker", "client_id", "pid")
+    __slots__ = ("sock", "send_lock", "kind", "worker", "client_id",
+                 "pid", "gcs_q")
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
@@ -190,6 +191,11 @@ class _ConnCtx:
         self.worker: Optional[WorkerHandle] = None
         self.client_id: Optional[bytes] = None
         self.pid = 0
+        # Lazily-created FIFO for GCS-proxied rpcs (node_service
+        # _gcs_proxy): blocking GCS calls run off the conn thread, in
+        # this client's submission order, so a GCS outage queues only
+        # the GCS-dependent ops — not every later rpc on the conn.
+        self.gcs_q = None
 
     def send(self, msg: dict) -> None:
         try:

@@ -440,6 +440,18 @@ class StreamChannelMixin:
                     "buckets": {}, "sum": 0.0, "count": 0.0,
                     "description": "object directory bytes by "
                                    "reference kind"})
+            # Control-plane WAL size (from the periodic gcs_status
+            # poll): growth between saw-tooth compaction drops is the
+            # durable-mutation rate, a flat high line means compaction
+            # stopped firing.
+            gst = getattr(self, "_gcs_status", None) or {}
+            if gst.get("persistent"):
+                from ray_tpu.util.metrics import GCS_WAL_BYTES_METRIC
+                series.append({
+                    "name": GCS_WAL_BYTES_METRIC, "kind": "gauge",
+                    "tags": {}, "value": float(gst.get("wal_bytes", 0)),
+                    "buckets": {}, "sum": 0.0, "count": 0.0,
+                    "description": "GCS write-ahead-log bytes"})
         stats = self._store().stats()
         builtin["ray_tpu_object_store_bytes_used"] = float(
             stats.get("used_bytes", 0))

@@ -3,10 +3,19 @@
 Analog of the reference's cluster_utils.Cluster
 (python/ray/cluster_utils.py:135) — SURVEY §4 calls this the single
 highest-leverage piece of test infrastructure.  The GCS server runs
-in-process (threads); each added node is a real separate OS process
+in-process (threads) by default, or as a real separate OS process with
+``external_gcs=True``; each added node is a real separate OS process
 (`python -m ray_tpu._private.node_service`) with its own shm store,
 worker pool, and TCP peer endpoints, so object transfer, spillback, and
 node-death paths are exercised for real.
+
+GCS fault tolerance (ISSUE 7): with a ``persist_dir``, the control
+plane survives ``kill_gcs()`` — SIGKILL for an external GCS, a cold
+state-discarding teardown for the in-process one — and ``restart_gcs()``
+brings a fresh server up on the SAME port recovering from WAL+snapshot,
+so every node's GcsClient reconnects and re-syncs.  The seeded chaos
+kind ``kill_gcs`` (site ``gcs``, ``down_s`` restart delay) drives the
+same pair from a supervisor thread, replayably.
 
 Usage:
     cluster = Cluster()
@@ -52,19 +61,170 @@ class NodeProc:
 
 
 class Cluster:
-    """One GCS (in-process) + N worker-node subprocesses."""
+    """One GCS (in-process or subprocess) + N worker-node subprocesses."""
 
     def __init__(self, host: str = "127.0.0.1",
                  env: Optional[Dict[str, str]] = None,
-                 persist_dir: Optional[str] = None) -> None:
-        from ray_tpu._private.gcs_service import GcsServer
-        self._server = GcsServer(host=host, persist_dir=persist_dir)
-        self._server.start()
+                 persist_dir: Optional[str] = None,
+                 external_gcs: bool = False) -> None:
         self.host = host
-        self.gcs_address = (host, self._server.port)
-        self.nodes: List[NodeProc] = []
         self._env = dict(env or {})
+        self.external_gcs = external_gcs
+        if external_gcs and persist_dir is None:
+            # A subprocess GCS without persistence could never survive
+            # kill_gcs — give it a scratch WAL dir by default.
+            import tempfile
+            persist_dir = tempfile.mkdtemp(prefix="rtpu_gcs_")
+        self.persist_dir = persist_dir
+        self._server = None
+        self._gcs_proc: Optional[subprocess.Popen] = None
+        self._gcs_client = None
+        self._gcs_lock = threading.Lock()
+        self._closing = False
+        if external_gcs:
+            self._gcs_port = self._spawn_gcs(port=0)
+        else:
+            from ray_tpu._private.gcs_service import GcsServer
+            self._server = GcsServer(host=host, persist_dir=persist_dir)
+            self._server.start()
+            self._gcs_port = self._server.port
+        self.gcs_address = (host, self._gcs_port)
+        self.nodes: List[NodeProc] = []
+        # Seeded chaos kind kill_gcs fires HERE: the fixture is the
+        # GCS supervisor (the role a k8s restart policy or systemd
+        # plays in production), so the kill + timed restart is driven
+        # by the driver process's deterministic chaos schedule.
+        threading.Thread(target=self._chaos_supervisor_loop, daemon=True,
+                         name="rtpu-gcs-supervisor").start()
 
+    # -- GCS lifecycle -----------------------------------------------------
+    def _spawn_gcs(self, port: int) -> int:
+        env = dict(os.environ)
+        env.update(self._env)
+        import ray_tpu
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(
+            [pkg_parent] + env.get("PYTHONPATH", "").split(os.pathsep)))
+        cmd = [sys.executable, "-m", "ray_tpu._private.gcs_service",
+               "--host", self.host, "--port", str(port),
+               "--persist-dir", self.persist_dir]
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                text=True)
+        bound = 0
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"GCS process exited (rc={proc.poll()})")
+            if line.startswith("GCS_PORT="):
+                bound = int(line.strip().split("=", 1)[1])
+                break
+        if not bound:
+            proc.kill()
+            raise TimeoutError("GCS process did not come up")
+        threading.Thread(target=_drain, args=(proc.stdout,), daemon=True,
+                         name="rtpu-gcs-stdout").start()
+        self._gcs_proc = proc
+        return bound
+
+    def kill_gcs(self) -> None:
+        """kill -9 the control plane.  External GCS: a literal SIGKILL.
+        In-process GCS: the server is torn down and its state object
+        DISCARDED, so a later restart_gcs() recovers exclusively from
+        the WAL/snapshot — the same cold-restart semantics without the
+        subprocess."""
+        if self.persist_dir is None:
+            raise RuntimeError(
+                "kill_gcs without persist_dir would lose the cluster "
+                "for good; construct Cluster(persist_dir=...)")
+        with self._gcs_lock:
+            if self.external_gcs:
+                proc = self._gcs_proc
+                if proc is not None and proc.poll() is None:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    proc.wait(timeout=10)
+            else:
+                server, self._server = self._server, None
+                if server is not None:
+                    server.shutdown()
+
+    def restart_gcs(self) -> None:
+        """Bring the GCS back on the SAME port, recovering hard state
+        from the WAL/snapshot.  Nodes' GcsClients reconnect on their
+        own and re-sync (epoch bump), rebuilding the soft state."""
+        with self._gcs_lock:
+            if self.external_gcs:
+                if self._gcs_proc is not None \
+                        and self._gcs_proc.poll() is None:
+                    return
+                self._spawn_gcs(port=self._gcs_port)
+                return
+            if self._server is not None:
+                return
+            from ray_tpu._private.gcs_service import GcsServer
+            deadline = time.time() + 10.0
+            while True:
+                try:
+                    self._server = GcsServer(host=self.host,
+                                             port=self._gcs_port,
+                                             persist_dir=self.persist_dir)
+                    break
+                except OSError:
+                    if time.time() >= deadline:
+                        raise
+                    time.sleep(0.1)
+            self._server.start()
+
+    def _chaos_supervisor_loop(self) -> None:
+        from ray_tpu._private.chaos import chaos
+        while not self._closing:
+            time.sleep(0.25)
+            try:
+                spec = chaos.fire_spec("gcs", "kill_gcs")
+            except Exception:
+                continue
+            if spec is None:
+                continue
+            down = spec.get("down_s") or 1.0
+            try:
+                self.kill_gcs()
+                time.sleep(down)
+                if not self._closing:
+                    self.restart_gcs()
+            except Exception:
+                pass
+
+    # -- control-plane access (works across GCS restarts) ------------------
+    def _state_client(self):
+        """Reconnect-capable client for fixture-side control-plane
+        queries (external mode; the in-process server is used
+        directly)."""
+        from ray_tpu._private.gcs_service import GcsClient
+        with self._gcs_lock:
+            if self._gcs_client is None:
+                self._gcs_client = GcsClient(self.host, self._gcs_port)
+            return self._gcs_client
+
+    def gcs_nodes(self, alive_only: bool = True) -> List[dict]:
+        if self._server is not None:
+            return self._server.state.nodes(alive_only=alive_only)
+        return self._state_client().nodes(alive_only=alive_only)
+
+    def gcs_status(self) -> dict:
+        """Epoch / uptime / WAL size card (see `ray_tpu gcs`)."""
+        if self._server is not None:
+            return self._server.state.status()
+        return self._state_client().status()
+
+    def _gcs_drain_node(self, node_id: bytes, grace_s: float,
+                        reason: str) -> bool:
+        if self._server is not None:
+            return self._server.state.drain_node(node_id, grace_s, reason)
+        return self._state_client().drain_node(node_id, grace_s, reason)
+
+    # -- nodes -------------------------------------------------------------
     def add_node(self, resources: Optional[Dict[str, float]] = None,
                  store_capacity: int = 0,
                  timeout_s: float = 30.0) -> NodeProc:
@@ -115,13 +275,17 @@ class Cluster:
     def wait_for_nodes(self, n: int, timeout_s: float = 30.0) -> None:
         """Block until the GCS reports n alive nodes."""
         deadline = time.time() + timeout_s
+        count = 0
         while time.time() < deadline:
-            if len(self._server.state.nodes(alive_only=True)) >= n:
+            try:
+                count = len(self.gcs_nodes(alive_only=True))
+            except Exception:
+                count = 0       # GCS mid-restart: keep waiting
+            if count >= n:
                 return
             time.sleep(0.05)
         raise TimeoutError(
-            f"cluster did not reach {n} nodes "
-            f"(have {len(self._server.state.nodes(alive_only=True))})")
+            f"cluster did not reach {n} nodes (have {count})")
 
     def kill_node(self, node: NodeProc, sig: int = signal.SIGKILL) -> None:
         node.kill(sig)
@@ -136,12 +300,13 @@ class Cluster:
         from the node's signal handler (with its configured grace), so
         tests can exercise graceful vs. hard departure side by side
         next to the SIGKILL `kill_node` default."""
-        self._server.state.drain_node(node.node_id, grace_s,
-                                      "cluster_utils.drain_node")
+        self._gcs_drain_node(node.node_id, grace_s,
+                             "cluster_utils.drain_node")
         if wait:
             node.proc.wait(timeout=timeout_s or grace_s + 30.0)
 
     def shutdown(self) -> None:
+        self._closing = True
         # Flip EVERY node to draining before the SIGTERMs: each node's
         # signal-handler drain then sees no healthy peer to replicate
         # objects or migrate actors to and exits promptly — a teardown
@@ -150,7 +315,7 @@ class Cluster:
         for n in self.nodes:
             if n.proc.poll() is None:
                 try:
-                    draining |= self._server.state.drain_node(
+                    draining |= self._gcs_drain_node(
                         n.node_id, 0.5, "cluster shutdown")
                 except Exception:
                     pass
@@ -168,4 +333,16 @@ class Cluster:
                 n.proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 n.proc.kill()
-        self._server.shutdown()
+        if self._gcs_client is not None:
+            try:
+                self._gcs_client.close()
+            except Exception:
+                pass
+        if self._server is not None:
+            self._server.shutdown()
+        if self._gcs_proc is not None and self._gcs_proc.poll() is None:
+            self._gcs_proc.terminate()
+            try:
+                self._gcs_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._gcs_proc.kill()

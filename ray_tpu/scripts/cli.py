@@ -521,6 +521,54 @@ def cmd_drain(args) -> int:
     return 0
 
 
+def cmd_gcs(args) -> int:
+    """Control-plane fault-tolerance card (reference: the HA-GCS face
+    of `ray status`): recovery epoch, uptime, WAL size + ops since the
+    last snapshot/compaction, last-snapshot age, and node membership
+    counts including stale (recovered-but-not-yet-resynced) records."""
+    addr = _head_address(args)
+    if not addr:
+        print("no cluster on record; pass --address H:P",
+              file=sys.stderr)
+        return 1
+    from ray_tpu._private.gcs_service import GcsClient
+    host, port = _parse_addr(addr)
+    try:
+        gcs = GcsClient(host, port)
+    except OSError as e:
+        print(f"GCS at {addr} unreachable: {e}", file=sys.stderr)
+        return 1
+    try:
+        st = gcs.status()
+    finally:
+        gcs.close()
+    if getattr(args, "json", False):
+        print(json.dumps(st, indent=1, default=str))
+        return 0
+    print(f"GCS at {addr}")
+    print(f"  epoch:         {st['epoch']}"
+          + ("  (recovered from WAL/snapshot)" if st.get("recovered")
+             else ""))
+    print(f"  uptime:        {st['uptime_s']:.1f}s")
+    print(f"  durable:       {'yes (WAL+snapshot)' if st['persistent'] else 'NO — head death loses the cluster'}")
+    if st["persistent"]:
+        print(f"  wal:           {_fmt_bytes(st['wal_bytes'])} "
+              f"({st['wal_ops_since_snapshot']} ops since snapshot)")
+        age = st.get("last_snapshot_age_s")
+        print(f"  last snapshot: "
+              f"{'never (no compaction yet)' if age is None else f'{age:.1f}s ago'}")
+    counts = ", ".join(f"{k}={v}" for k, v in
+                       sorted(st.get("nodes", {}).items())) or "none"
+    print(f"  nodes:         {counts}"
+          + (f"  ({st['stale_nodes']} stale, awaiting re-sync)"
+             if st.get("stale_nodes") else ""))
+    print(f"  named actors:  {st['named_actors']}  "
+          f"actor directory: {st['actor_directory']}")
+    print(f"  objects:       {st['objects_tracked']} tracked, "
+          f"{st['small_objects']} inline/error payloads")
+    return 0
+
+
 def cmd_chaos(args) -> int:
     """Print/validate a chaos fault-injection spec (the schedule from
     --spec, or the ambient RAY_TPU_CHAOS_SPEC / config + legacy env
@@ -671,6 +719,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="seconds the node gets to hand off its work")
     p.add_argument("--address", default=None, help="GCS address H:P")
     p.set_defaults(fn=cmd_drain)
+
+    p = sub.add_parser(
+        "gcs", help="control-plane status: epoch / uptime / WAL / "
+                    "last snapshot")
+    p.add_argument("--address", default=None, help="GCS address H:P")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_gcs)
 
     p = sub.add_parser(
         "chaos", help="print/validate a chaos fault-injection spec")

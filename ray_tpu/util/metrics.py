@@ -65,6 +65,20 @@ OBJECT_STORE_BYTES_METRIC = "ray_tpu_object_store_bytes"
 TASK_STALLS_METRIC = "ray_tpu_task_stalls_total"
 EVENTS_DROPPED_METRIC = "ray_tpu_events_dropped_total"
 
+# Control-plane fault tolerance (GCS kill -9 survivability),
+# auto-recorded node-side.  restarts counts recovery-epoch bumps a
+# node observed (one per node per GCS restart); reconnects counts
+# successful GcsClient re-dials (outages without a restart count
+# too); wal_bytes is the GCS write-ahead-log size gauge (from the
+# periodic gcs_status poll — watch it saw-tooth with compaction);
+# resync_seconds observes the node's bulk state re-publication after
+# a reconnect.
+GCS_RESTARTS_METRIC = "ray_tpu_gcs_restarts_total"
+GCS_RECONNECTS_METRIC = "ray_tpu_gcs_reconnects_total"
+GCS_WAL_BYTES_METRIC = "ray_tpu_gcs_wal_bytes"
+GCS_RESYNC_SECONDS_METRIC = "ray_tpu_gcs_resync_seconds"
+GCS_RESYNC_BUCKETS = (0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
 # Inter-node object-transfer plane, auto-recorded node-side.
 # bytes_total tags: direction = in | out.  seconds tags: path =
 # stream (windowed binary plane) | multi (range-split, several

@@ -61,6 +61,9 @@ def timeline(filename: Optional[str] = None) -> Any:
     for ev in timeline_events():
         args = {k: v for k, v in ev.items() if k in _TRACE_ARG_KEYS
                 and v is not None}
+        if ev.get("kind") == "gcs_restart":
+            args["epoch"] = ev.get("epoch")
+            args["resync_s"] = ev.get("resync_s")
         if ev.get("kind") == "stall":
             # Sentinel capture: elapsed/threshold plus (a bounded
             # slice of) the worker stack ride in the span args.
@@ -73,7 +76,8 @@ def timeline(filename: Optional[str] = None) -> Any:
             "cat": ("lifecycle" if ev.get("kind") == "lifecycle" else
                     "drain" if ev.get("kind") == "drain" else
                     "stall" if ev.get("kind") == "stall" else
-                    "actor" if ev.get("actor") else
+                    "gcs_restart" if ev.get("kind") == "gcs_restart"
+                    else "actor" if ev.get("actor") else
                     "user" if ev.get("user") else "task"),
             "ph": "X",
             "ts": ev["start"] * 1e6,

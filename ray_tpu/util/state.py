@@ -137,6 +137,17 @@ def summarize_tasks() -> Dict[str, Dict[str, Any]]:
                 ("node_id", "reason", "grace_s", "tasks_handed_back",
                  "actors_migrated", "objects_moved", "completed")})
             continue
+        if ev.get("kind") == "gcs_restart":
+            # Control-plane restarts a node rode out (reconnect +
+            # re-sync): a survived kill -9 of the GCS should be
+            # visible in the rollup, not silent.
+            per = out.setdefault("node:gcs_restart", {})
+            per["restarts"] = per.get("restarts", 0) + 1
+            per.setdefault("events", []).append({
+                k: ev.get(k) for k in
+                ("node_id", "epoch", "resync_s",
+                 "objects_republished", "actors_republished")})
+            continue
         if ev.get("kind") == "stall":
             # Stall-sentinel captures: count + the captured stacks, so
             # "why has this been executing for ten minutes" is

@@ -19,8 +19,11 @@ def test_state_survives_restart(tmp_path):
     assert not s1.register_named_actor("default", "svc", b"b" * 16)
     s1.register_named_actor("default", "gone", b"c" * 16)
     s1.drop_named_actor(b"c" * 16)
-    # ephemeral tables must NOT persist
+    # hard state: node registrations persist (served tagged stale
+    # until the node re-syncs — ISSUE 7 durability split); soft state
+    # (object locations, heartbeats) is rebuilt by re-sync instead.
     s1.register_node(b"n" * 16, "127.0.0.1", 1, 1, {"CPU": 4})
+    s1.add_location(b"o" * 16, b"n" * 16, 123)
 
     s2 = GlobalControlState(persist_dir=d)
     assert s2.kv_get("jobs", b"j1/meta") == b'{"status": "RUNNING"}'
@@ -28,7 +31,12 @@ def test_state_survives_restart(tmp_path):
     assert s2.fetch_function(b"f" * 16) == b"blob-bytes"
     assert s2.lookup_named_actor("default", "svc") == b"a" * 16
     assert s2.lookup_named_actor("default", "gone") is None
-    assert s2.nodes() == []
+    recovered = s2.nodes()
+    assert [n["node_id"] for n in recovered] == [b"n" * 16]
+    assert recovered[0]["stale"] is True
+    assert s2.epoch == s1.epoch + 1
+    # object locations are soft: gone until the holder re-syncs
+    assert s2.get_locations(b"o" * 16)["kind"] is None
 
 
 def test_torn_tail_write_tolerated(tmp_path):

@@ -56,6 +56,15 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.OBJECT_STORE_BYTES_METRIC)
     assert _NAME.match(metrics.TASK_STALLS_METRIC)
     assert _NAME.match(metrics.EVENTS_DROPPED_METRIC)
+    assert _NAME.match(metrics.GCS_RESTARTS_METRIC)
+    assert _NAME.match(metrics.GCS_RECONNECTS_METRIC)
+    assert _NAME.match(metrics.GCS_WAL_BYTES_METRIC)
+    assert _NAME.match(metrics.GCS_RESYNC_SECONDS_METRIC)
+    assert metrics.GCS_RESTARTS_METRIC.endswith("_total")
+    assert metrics.GCS_RECONNECTS_METRIC.endswith("_total")
+    # wal_bytes is a gauge, resync_seconds a histogram — no _total.
+    assert not metrics.GCS_WAL_BYTES_METRIC.endswith("_total")
+    assert not metrics.GCS_RESYNC_SECONDS_METRIC.endswith("_total")
     assert metrics.NODE_DRAINS_METRIC.endswith("_total")
     assert metrics.DRAIN_OBJECTS_REPLICATED_METRIC.endswith("_total")
     assert metrics.TASK_STALLS_METRIC.endswith("_total")
@@ -64,7 +73,8 @@ def test_declared_builtin_names_are_legal():
     assert not metrics.OBJECT_STORE_BYTES_METRIC.endswith("_total")
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
                metrics.OBJECT_TRANSFER_BUCKETS,
-               metrics.DRAIN_DURATION_BUCKETS):
+               metrics.DRAIN_DURATION_BUCKETS,
+               metrics.GCS_RESYNC_BUCKETS):
         assert all(a < b for a, b in zip(bs, bs[1:]))
 
 
