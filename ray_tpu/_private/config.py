@@ -229,6 +229,18 @@ _D("locality_spill_threshold_bytes", int, 1024 * 1024,
 _D("locality_spill_wait_s", float, 1.0,
    "How long a locality-dominant task waits for local capacity before "
    "spilling anyway.")
+_D("dag_spin_us", int, 50,
+   "Compiled-graph channel wait: microseconds of pure spin before the "
+   "wait degrades to sched_yield (~20ms) and then escalating sleeps.  "
+   "Spin covers the hot pipelined case (peer answers within µs); "
+   "raise it on dedicated cores, lower it (or 0) when executors "
+   "outnumber cores — a spinning waiter steals cycles the producing "
+   "stage needs.")
+_D("serve_compiled_pipeline", bool, False,
+   "Serve fast lane: route unary deployment requests through a "
+   "per-replica compiled graph (router handoff writes into the "
+   "graph's input channel) instead of a scheduled actor task per "
+   "call.  Streaming requests always use the task path.")
 
 # ---------------------------------------------------------------------------
 # TPU / mesh execution layer

@@ -27,8 +27,9 @@ from ray_tpu._private import serialization as ser
 from ray_tpu._private.chaos import chaos
 from ray_tpu._private.config import config
 from ray_tpu._private.protocol import (
-    ConnectionLost, TRANSFER_ERR, TRANSFER_MAGIC, TRANSFER_REQ,
-    TRANSFER_RESP, _recv_exact, connect_tcp, recv_exact_into)
+    CHAN_MAGIC, ConnectionLost, TRANSFER_ERR, TRANSFER_MAGIC,
+    TRANSFER_REQ, TRANSFER_REQ_BODY, TRANSFER_RESP, _recv_exact,
+    connect_tcp, recv_exact_into)
 from ray_tpu import exceptions as exc
 from ray_tpu._private.node_state import (
     FAILED, ObjectEntry, PENDING, READY, TaskRecord, _ConnCtx, _OID)
@@ -37,6 +38,19 @@ from ray_tpu._private.node_state import (
 class _TransferConnectError(ConnectionLost):
     """The peer's transfer listener did not accept a TCP connection
     (the control plane may still work — callers can degrade)."""
+
+
+def _enable_keepalive(sock: "_socket.socket") -> None:
+    """Aggressive TCP keepalive for long-lived promoted connections
+    (compiled-DAG channel streams): reap silently-dead peers in ~3
+    minutes without imposing an idle timeout on live quiet edges."""
+    try:
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_KEEPALIVE, 1)
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_KEEPIDLE, 60)
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_KEEPINTVL, 30)
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_KEEPCNT, 4)
+    except (OSError, AttributeError):
+        pass    # non-Linux / restricted env: degrade to no keepalive
 
 
 class ObjectPlaneMixin:
@@ -589,10 +603,26 @@ class ObjectPlaneMixin:
         served = 0
         try:
             while not self._shutdown:
-                magic, oid, off, ln = TRANSFER_REQ.unpack(
-                    _recv_exact(sock, TRANSFER_REQ.size))
+                magic = _recv_exact(sock, 4)
+                if magic == CHAN_MAGIC:
+                    # Promotion: this connection IS a compiled-DAG
+                    # channel stream for its remaining life (one
+                    # persistent edge per cross-node channel; see
+                    # node_streams._chan_stream_serve).  An idle live
+                    # edge must not be reaped (a quiet DAG can sit for
+                    # hours), so the dead-peer timeout is replaced by
+                    # aggressive TCP keepalive — a sender that died
+                    # without FIN stops answering probes and the recv
+                    # fails within ~3 minutes instead of pinning this
+                    # serve thread forever.
+                    sock.settimeout(None)
+                    _enable_keepalive(sock)
+                    self._chan_stream_serve(sock)
+                    break
                 if magic != TRANSFER_MAGIC:
                     break
+                oid, off, ln = TRANSFER_REQ_BODY.unpack(
+                    _recv_exact(sock, TRANSFER_REQ_BODY.size))
                 served += self._serve_transfer_chunk(sock, oid, off, ln)
                 # Batched counter flush: the per-chunk hot path must
                 # not take the scheduler lock per 4 MiB.  Fetchers

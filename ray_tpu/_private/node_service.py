@@ -206,6 +206,11 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._streams: Dict[bytes, dict] = {}
         # Per-(destination, channel-key) compiled-DAG forwarder queues.
         self._chan_fwd_queues: Dict[tuple, Any] = {}
+        # Cross-node channel items forwarded, by path ("stream" = the
+        # persistent transfer-plane edge, "rpc" = legacy per-item
+        # control-plane fallback) — state-dump visibility that the
+        # steady-state path stays off the control plane.
+        self._dag_items: Dict[str, int] = {}
         # In-flight on-demand stack dumps: token -> collection record.
         self._stack_dumps: Dict[bytes, dict] = {}
         # stream_id -> home node for streaming calls on REMOTE actors:
@@ -2457,7 +2462,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 "node_id": self.node_id.hex(),
                 "pending_tasks": pending,
                 "store": store,
-                "stores": {self.node_id.hex(): store}}
+                "stores": {self.node_id.hex(): store},
+                "dag_channel_items": {
+                    self.node_id.hex(): dict(self._dag_items)}}
 
     def _fanout_peers(self, request: dict, timeout: float = 2.0
                       ) -> Tuple[List[Tuple[dict, dict]], List[str]]:
@@ -2502,11 +2509,14 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 {"type": "state_dump", "cluster": False})
             clients = set(dump.get("clients") or [])
             stores = dict(dump.get("stores") or {})
+            dag_items = dict(dump.get("dag_channel_items") or {})
             for _, peer in replies:
                 for k in merged:
                     merged[k].extend(peer["dump"].get(k, []))
                 clients.update(peer["dump"].get("clients") or [])
                 stores.update(peer["dump"].get("stores") or {})
+                dag_items.update(
+                    peer["dump"].get("dag_channel_items") or {})
             # Holder sets are a cluster-level fact: rebuild them from
             # every node's local copies so list_objects/memory_summary
             # show where each object's replicas actually live.
@@ -2526,6 +2536,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             merged["store"] = dump["store"]
             merged["stores"] = stores
             merged["clients"] = sorted(clients)
+            merged["dag_channel_items"] = dag_items
             ctx.reply(m, {"dump": merged})
             return
         ctx.reply(m, {"dump": dump})

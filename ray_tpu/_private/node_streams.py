@@ -14,6 +14,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu._private.chaos import chaos
 from ray_tpu._private.node_state import (
     FAILED, READY, _ConnCtx)
 
@@ -228,7 +229,16 @@ class StreamChannelMixin:
         q.put((ctx, m, ninfo))
 
     def _chan_fwd_loop(self, fkey, q: "queue.Queue") -> None:
-        dst, _ = fkey
+        """Per-(destination, key) forwarder.  Steady state rides a
+        PERSISTENT streamed edge on the destination's binary transfer
+        listener (protocol.CHAN_MAGIC framing): one raw socket write
+        per item, answered by an 8-byte ack that doubles as
+        backpressure — no per-item control-plane RPC, no pickle
+        dispatch on the receiving node.  Falls back to the legacy
+        chan_send peer RPC when the peer has no transfer listener or
+        the stream breaks mid-edge."""
+        dst, key = fkey
+        stream = None       # persistent socket in channel-stream mode
         idle = 0
         while not self._shutdown:
             try:
@@ -239,20 +249,164 @@ class StreamChannelMixin:
                     with self._peer_lock:
                         if q.empty():
                             self._chan_fwd_queues.pop(fkey, None)
+                            self._chan_stream_close(stream)
                             return
                 continue
             idle = 0
-            try:
-                rep = self._peer_conn_to(ninfo).call(
-                    {"type": "chan_send", "dst": dst, "key": m["key"],
-                     "payload": m["payload"], "cap": m.get("cap", 8)},
-                    timeout=120.0)
-            except Exception as e:
-                rep = {"ok": False, "closed": True, "error": str(e)}
+            rep = None
+            if not chaos.partitioned(dst):
+                if stream is None:
+                    stream = self._chan_stream_open(ninfo, key,
+                                                    m.get("cap", 8))
+                if stream is not None:
+                    rep = self._chan_stream_send(stream, m["payload"])
+                    if rep is None:
+                        # Transport failure MID-ITEM: delivery is
+                        # ambiguous (the receiver may have enqueued
+                        # the payload before the ack was lost).
+                        # Channels are exactly-once-per-slot — a
+                        # resend (streamed or via the RPC fallback)
+                        # could deliver the item twice and silently
+                        # desync every later row's pairing.  Fail the
+                        # edge instead; the DAG layer surfaces it.
+                        self._chan_stream_close(stream)
+                        stream = None
+                        rep = {"ok": False, "closed": True,
+                               "error": "channel stream failed "
+                                        "mid-item (delivery unknown)"}
+                    elif rep.get("closed"):
+                        self._chan_stream_close(stream)
+                        stream = None
+            if rep is None:
+                # Legacy path: per-item peer RPC — only for peers
+                # without a reachable transfer listener (nothing was
+                # sent on a stream, so no duplication risk) and for
+                # chaos partitions, so injected partitions surface as
+                # ConnectionLost instead of silently bypassing.
+                try:
+                    rep = self._peer_conn_to(ninfo).call(
+                        {"type": "chan_send", "dst": dst,
+                         "key": m["key"], "payload": m["payload"],
+                         "cap": m.get("cap", 8)}, timeout=120.0)
+                    self._count_dag_item("rpc")
+                except Exception as e:
+                    rep = {"ok": False, "closed": True, "error": str(e)}
             try:
                 ctx.reply(m, rep)
             except Exception:
                 pass
+        self._chan_stream_close(stream)
+
+    # -- streamed cross-node channel edges (sender side) ----------------
+    def _chan_stream_open(self, ninfo: dict, key: bytes, cap: int):
+        """Open + promote one transfer-plane connection into a channel
+        stream for `key`; returns the socket or None (no listener /
+        connect failure — caller degrades to the RPC path)."""
+        from ray_tpu._private.protocol import (CHAN_MAGIC, CHAN_OPEN,
+                                               connect_tcp)
+        if not self._streamable(ninfo):
+            return None
+        try:
+            sock = connect_tcp(ninfo["host"], ninfo["transfer_port"],
+                               deadline_s=5.0)
+            # No ack deadline: under backpressure the receiver
+            # legitimately withholds the ack for as long as the
+            # consumer stalls.  Dead-peer reap comes from TCP
+            # keepalive instead (see node_objects._enable_keepalive).
+            sock.settimeout(None)
+            from ray_tpu._private.node_objects import _enable_keepalive
+            _enable_keepalive(sock)
+            sock.sendall(CHAN_MAGIC + CHAN_OPEN.pack(len(key), cap)
+                         + key)
+            return sock
+        except Exception:
+            return None
+
+    def _chan_stream_send(self, sock, payload) -> Optional[dict]:
+        """One item over the streamed edge; returns the reply dict or
+        None on a transport failure (caller retries / falls back).
+        The send->ack round trip is the remote hop — observed into the
+        dag hop histogram on this (sender) node."""
+        from ray_tpu._private.protocol import (CHAN_ACK, CHAN_ACK_OK,
+                                               CHAN_ITEM, _recv_exact)
+        from ray_tpu.util.metrics import (DAG_HOP_BUCKETS,
+                                          DAG_HOP_SECONDS_METRIC)
+        try:
+            t0 = time.perf_counter()
+            sock.sendall(CHAN_ITEM.pack(len(payload)))
+            sock.sendall(payload)
+            (status,) = CHAN_ACK.unpack(
+                _recv_exact(sock, CHAN_ACK.size))
+        except Exception:
+            return None
+        if status != CHAN_ACK_OK:
+            return {"ok": False, "closed": True}
+        self._count_dag_item("stream")
+        with self.lock:
+            self._observe_hist(
+                DAG_HOP_SECONDS_METRIC, {"edge": "remote"},
+                time.perf_counter() - t0, DAG_HOP_BUCKETS,
+                "compiled-DAG per-edge hop duration")
+        return {"ok": True}
+
+    @staticmethod
+    def _chan_stream_close(sock) -> None:
+        if sock is None:
+            return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _count_dag_item(self, path: str) -> None:
+        """Per-path cross-node channel item tally (stream vs rpc
+        fallback) — surfaced in the state dump so tests and operators
+        can verify the steady-state path stays off the control plane."""
+        with self.lock:
+            self._dag_items[path] = self._dag_items.get(path, 0) + 1
+
+    # -- streamed cross-node channel edges (receiver side) --------------
+    def _chan_stream_serve(self, sock) -> None:
+        """Receiver half of a promoted channel-stream connection (the
+        transfer accept loop hands over after reading CHAN_MAGIC):
+        read length-prefixed items, deliver into the bounded dag queue,
+        ack each item.  The ack is withheld while the queue is full —
+        that parked ack is the cross-node backpressure."""
+        from ray_tpu._private.protocol import (CHAN_ACK, CHAN_ACK_CLOSED,
+                                               CHAN_ACK_OK, CHAN_ITEM,
+                                               CHAN_OPEN, _recv_exact)
+        klen, cap = CHAN_OPEN.unpack(_recv_exact(sock, CHAN_OPEN.size))
+        key = _recv_exact(sock, klen)
+        while not self._shutdown:
+            (n,) = CHAN_ITEM.unpack(_recv_exact(sock, CHAN_ITEM.size))
+            payload = _recv_exact(sock, n)
+            ok = self._chan_stream_deliver(key, payload, max(cap, 1))
+            sock.sendall(CHAN_ACK.pack(CHAN_ACK_OK if ok
+                                       else CHAN_ACK_CLOSED))
+
+    def _chan_stream_deliver(self, key: bytes, payload, cap: int) -> bool:
+        """Deliver one streamed item into the dag queue, blocking while
+        the queue is at capacity (the withheld ack blocks the sender).
+        Returns False when the channel is closed."""
+        while not self._shutdown:
+            with self.lock:
+                rec = self._dag_queue_rec(key, cap)
+                rec["cap"] = cap
+                if rec["closed"]:
+                    return False
+                while rec["recv_waiters"]:
+                    w = rec["recv_waiters"].pop(0)
+                    if not w["live"]:
+                        continue
+                    w["live"] = False
+                    w["ctx"].reply(w["m"], {"ok": True,
+                                            "payload": payload})
+                    return True
+                if len(rec["items"]) < rec["cap"]:
+                    rec["items"].append(payload)
+                    return True
+            time.sleep(0.0005)
+        return False
 
     def _chan_deliver(self, ctx: _ConnCtx, m: dict) -> None:
         with self.lock:

@@ -133,8 +133,32 @@ def recv_msg(sock: socket.socket) -> Any:
 # ---------------------------------------------------------------------------
 TRANSFER_MAGIC = b"RTX1"
 TRANSFER_REQ = struct.Struct("<4s16sQQ")
+# Request body after the 4-byte magic (the serve loop peeks the magic
+# first to tell chunk requests from channel-stream openings).
+TRANSFER_REQ_BODY = struct.Struct("<16sQQ")
 TRANSFER_RESP = struct.Struct("<QQ")
 TRANSFER_ERR = (1 << 64) - 1
+
+# ---------------------------------------------------------------------------
+# compiled-DAG channel streams over the same transfer listener.  A
+# cross-node channel edge opens ONE persistent connection and promotes
+# it with magic 'RTC1'; after the opening frame every item is one
+# length-prefixed write answered by an 8-byte ack (the ack doubles as
+# per-item flow control: the receiver withholds it while the bounded
+# destination queue is full).  No pickle framing, no control-plane
+# dispatch — a cross-node hop costs one socket write.
+#
+#   open (sender -> receiver): magic 'RTC1', u16 key_len, u64 cap,
+#                              key[key_len]
+#   item (sender -> receiver): u64 length, payload[length]
+#   ack  (receiver -> sender): u64 status (0 = ok, 1 = closed)
+# ---------------------------------------------------------------------------
+CHAN_MAGIC = b"RTC1"
+CHAN_OPEN = struct.Struct("<HQ")
+CHAN_ITEM = struct.Struct("<Q")
+CHAN_ACK = struct.Struct("<Q")
+CHAN_ACK_OK = 0
+CHAN_ACK_CLOSED = 1
 
 
 def recv_exact_into(sock: socket.socket, view: memoryview) -> None:

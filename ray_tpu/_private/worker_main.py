@@ -238,16 +238,24 @@ class WorkerRuntime:
                 spec["actor_id"].hex(), "actor instance missing in worker"))
             return
         if spec["method_name"] == "__rtpu_dag_loop__":
-            # Compiled-graph execution loop (ray_tpu.dag): runs until
-            # channel teardown; this worker is dedicated to the DAG for
-            # that duration (reference: aDAG loops pin the actor).
+            # Compiled-graph execution loop (ray_tpu.dag), dispatched
+            # ONCE at compile time and pinned to a dedicated thread:
+            # it reads ops from its in-channels in topological order
+            # until channel teardown (reference: aDAG loops pin the
+            # actor).  A thread — not the queue-consumer loop — so the
+            # actor keeps answering normal calls while the graph runs
+            # (Serve health checks / queue_len probes, DAG teardown
+            # diagnostics); the graph itself still executes its ops
+            # strictly serially.
             def loop(spec: dict) -> int:
                 from ray_tpu.experimental.dag_executor import run_dag_loop
                 self._notify_started(spec)
                 (ops,), _ = self.client.unpack_args(spec["args"])
                 return run_dag_loop(instance, ops, self.client)
 
-            self._execute_and_report(spec, loop, spec)
+            threading.Thread(
+                target=self._execute_and_report, args=(spec, loop, spec),
+                daemon=True, name="rtpu-dag-loop").start()
             return
         method = getattr(instance, spec["method_name"], None)
         if method is None:

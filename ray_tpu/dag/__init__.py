@@ -20,28 +20,54 @@ hop across hosts.  Usage:
     dag.teardown()
 
 Compilation groups nodes by actor (one long-lived loop task per actor,
-ops in topological order; same-actor edges stay in-process), allocates
-one transport per cross-process edge — an mmap SPSC ring when both
-endpoints live on the submitting node, a node-service rchan queue when
-they don't (the cross-host path; reference:
+dispatched once and pinned to its own executor thread, ops in
+topological order; same-actor edges stay in-process), allocates one
+transport per cross-process edge — an mmap SPSC ring when both
+endpoints live on the submitting node, a bounded node queue fed by a
+PERSISTENT streamed edge on the binary transfer plane when they don't
+(the cross-host path: one socket write + ack per item; reference:
 experimental/channel/shared_memory_channel.py vs the NCCL channels) —
 and returns a CompiledDAG whose `execute` writes the driver→graph
 edges and returns a ref that reads the graph→driver edges.  Pipelined:
-up to `capacity` executes may be in flight before the first `get`."""
+up to `capacity` executes may be in flight before the first `get`;
+beyond that, execute() blocks on ring backpressure.  At-most-once: an
+actor death mid-graph tears the graph down (completed rows salvaged,
+lost rows surface ActorDiedError); retries belong to the caller."""
 
 from __future__ import annotations
 
+import atexit
 import os
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
-from ray_tpu.experimental.channel import Channel
+from ray_tpu.experimental.channel import Channel, ChannelClosed
 
 __all__ = ["InputNode", "MultiOutputNode", "CompiledDAG",
            "CompiledDAGRef", "DAGNode", "CollectiveOutputNode",
            "allreduce_bind"]
+
+# Every live CompiledDAG, for the driver-exit sweep: an abnormal exit
+# (exception past the user's teardown, SIGTERM-atexit, shutdown())
+# must still unlink the /dev/shm-backed channel files — they are not
+# session-scoped temp files the OS cleans up.
+_live_dags: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _teardown_all() -> None:
+    """Tear down (and unlink the channel files of) every DAG still
+    live — called from ray_tpu.shutdown() and at interpreter exit."""
+    for dag in list(_live_dags):
+        try:
+            dag.teardown()
+        except Exception:
+            pass
+
+
+atexit.register(_teardown_all)
 
 
 class DAGNode:
@@ -190,6 +216,9 @@ class CompiledDAG:
         # (key, resident_node) of every rchan queue, for teardown
         self._rchans: List[Tuple[bytes, bytes]] = []
         self._torn_down = False
+        self._td_lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._loop_refs: List[Any] = []
 
         ninfo = client.node_info()
         drv_node: bytes = ninfo["node_id"]
@@ -325,7 +354,10 @@ class CompiledDAG:
                     ("rchan_out", key, drv_node.hex()))
                 self._out_edges.append(("rchan", key))
 
-        # launch one loop per actor (ops in topo order)
+        # launch one loop per actor (ops in topo order).  The loop is
+        # dispatched ONCE here at compile time; worker_main pins it to
+        # a dedicated executor thread so the actor keeps answering
+        # normal calls (health probes, queue_len) while the graph runs.
         self._loop_refs = []
         for aid, ops in ops_by_actor.items():
             h = handles[aid]
@@ -337,34 +369,111 @@ class CompiledDAG:
         self._read_seq = 0
         self._buffer: Dict[int, Any] = {}
         self._partial: List[Any] = []
-        self._lock = threading.Lock()
+        # Separate locks: execute() must stay non-blocking while a
+        # get() holds the read lock waiting on results (pipelining).
+        self._exec_lock = threading.Lock()
+        self._read_lock = threading.Lock()
+        # seq -> (wall start, trace ctx) for the dag.execute lifecycle
+        # span recorded when the row's results land.
+        self._exec_meta: Dict[int, tuple] = {}
+        self._last_span_ts = 0.0
+        from ray_tpu.util.metrics import (DAG_EXECUTIONS_METRIC,
+                                          DAG_HOP_BUCKETS,
+                                          DAG_HOP_SECONDS_METRIC,
+                                          shared_counter,
+                                          shared_histogram)
+        self._m_execs = shared_counter(
+            DAG_EXECUTIONS_METRIC,
+            description="compiled-DAG executions submitted")
+        self._observe_hop = shared_histogram(
+            DAG_HOP_SECONDS_METRIC,
+            description="compiled-DAG per-edge hop duration",
+            boundaries=DAG_HOP_BUCKETS,
+            tag_keys=("edge",)).observer({"edge": "local"})
+        _live_dags.add(self)
 
     # -- execution -----------------------------------------------------
     def execute(self, *args) -> CompiledDAGRef:
-        if self._torn_down:
-            raise RuntimeError("DAG was torn down")
+        self._check_usable()
         value = args[0] if len(args) == 1 else tuple(args)
-        for edge in self._in_edges:
-            if edge[0] == "mmap":
-                edge[1].write(value)
-            else:
-                self._client.chan_send(edge[2], edge[1], value,
-                                       cap=self._capacity)
-        with self._lock:
+        from ray_tpu._private import tracing
+        with self._exec_lock:
+            # Edge writes are ordered under the lock: the input rings
+            # are SPSC, so two racing execute() calls must not
+            # interleave their slot writes.
+            try:
+                for edge in self._in_edges:
+                    if edge[0] == "mmap":
+                        t0 = time.perf_counter()
+                        edge[1].write(value)
+                        self._observe_hop(time.perf_counter() - t0)
+                    else:
+                        self._client.chan_send(edge[2], edge[1], value,
+                                               cap=self._capacity)
+            except ChannelClosed:
+                self._check_usable()
+                raise
             seq = self._exec_seq
             self._exec_seq += 1
+            self._exec_meta[seq] = (time.time(), tracing.current())
+        self._m_execs.inc()
         return CompiledDAGRef(self, seq)
 
+    def _check_usable(self) -> None:
+        if self._error is not None:
+            raise self._error
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+
     def _check_loops(self) -> None:
-        """Surface a dead loop task (e.g. a user-method exception) as
-        an error on the caller instead of an indefinite hang."""
+        """Surface a dead loop task (a user-method exception, an actor
+        death, a chaos-killed worker) as an error on the caller — and
+        tear the graph down cleanly — instead of an indefinite hang."""
+        if self._torn_down:
+            return
         done, _ = ray_tpu.wait(self._loop_refs,
                                num_returns=len(self._loop_refs),
                                timeout=0)
-        if done and not self._torn_down:
+        if not done or self._torn_down:
+            return
+        try:
             ray_tpu.get(done)   # raises the loop's error if it failed
-            raise RuntimeError(
+            err: BaseException = RuntimeError(
                 "compiled DAG loop task(s) exited mid-run")
+        except BaseException as e:  # noqa: BLE001
+            err = e
+        # Rows that fully completed before the death are still sitting
+        # in the driver-side out rings — salvage them so their refs
+        # resolve to values, not to the death error (the serve pipe's
+        # retry logic keys off "salvaged vs lost").  Caller holds the
+        # read lock.
+        try:
+            while True:
+                out = self._partial
+                while len(out) < len(self._out_edges):
+                    out.append(self._read_edge_once(
+                        self._out_edges[len(out)]))
+                self._partial = []
+                self._buffer[self._read_seq] = (
+                    out if isinstance(self._root, MultiOutputNode)
+                    else out[0])
+                self._record_execute_span(self._read_seq)
+                self._read_seq += 1
+        except Exception:
+            pass        # half-written rows stay lost (at-most-once)
+        if self._error is None:
+            self._error = err
+        # At-most-once contract: a mid-graph death invalidates every
+        # outstanding execute (in-flight rows may be half-processed) —
+        # tear down now so all readers fail fast, not at timeout.
+        self.teardown()
+        raise err
+
+    def _read_edge_once(self, edge: tuple) -> Any:
+        """Single near-non-blocking edge read (salvage path only)."""
+        if edge[0] == "mmap":
+            return edge[1].read(timeout=0.05)
+        return self._client.chan_recv(edge[1], timeout=0.05)
 
     def _read_edge(self, edge: tuple,
                    deadline: Optional[float]) -> Any:
@@ -376,6 +485,9 @@ class CompiledDAG:
                 if edge[0] == "mmap":
                     return edge[1].read(timeout=step)
                 return self._client.chan_recv(edge[1], timeout=step)
+            except ChannelClosed:
+                self._check_usable()
+                raise RuntimeError("DAG was torn down")
             except TimeoutError:
                 self._check_loops()
                 if (deadline is not None
@@ -385,28 +497,77 @@ class CompiledDAG:
     def _read_result(self, seq: int, timeout: Optional[float]):
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
-        with self._lock:
+        with self._read_lock:
+            if self._read_seq > seq:
+                return self._pop_buffered(seq)
+            self._check_usable()
             while self._read_seq <= seq:
-                # Edge reads CONSUME; keep partial progress in
-                # self._partial so a get() that times out mid-row can
-                # be retried without pairing edge 0's next row with
-                # edge 1's current one.
-                out = self._partial
-                while len(out) < len(self._out_edges):
-                    out.append(self._read_edge(
-                        self._out_edges[len(out)], deadline))
-                self._partial = []
-                self._buffer[self._read_seq] = (
-                    out if isinstance(self._root, MultiOutputNode)
-                    else out[0])
-                self._read_seq += 1
-            return self._buffer.pop(seq)
+                try:
+                    # Edge reads CONSUME; keep partial progress in
+                    # self._partial so a get() that times out mid-row
+                    # can be retried without pairing edge 0's next row
+                    # with edge 1's current one.
+                    out = self._partial
+                    while len(out) < len(self._out_edges):
+                        out.append(self._read_edge(
+                            self._out_edges[len(out)], deadline))
+                    self._partial = []
+                    self._buffer[self._read_seq] = (
+                        out if isinstance(self._root, MultiOutputNode)
+                        else out[0])
+                    self._record_execute_span(self._read_seq)
+                    self._read_seq += 1
+                except TimeoutError:
+                    raise
+                except BaseException:
+                    if self._read_seq > seq:
+                        break   # this row was salvaged before the death
+                    raise
+            return self._pop_buffered(seq)
+
+    def _pop_buffered(self, seq: int):
+        if seq not in self._buffer:
+            raise RuntimeError(
+                f"compiled DAG result {seq} was already consumed")
+        return self._buffer.pop(seq)
+
+    def _record_execute_span(self, seq: int) -> None:
+        """dag.execute lifecycle span (execute() -> results read),
+        carrying the submitter's trace_ctx so compiled executions
+        appear in profiling.timeline() like task executions do.
+        Traced executions (a request span is active — the serve
+        pipeline) always emit; untraced ones are rate-limited to ~50/s
+        per DAG — at µs-scale execution rates a per-item notify would
+        both flood the event ring and dominate the hop budget
+        (measured: ~300 µs/item of socket backpressure)."""
+        meta = self._exec_meta.pop(seq, None)
+        if meta is None:
+            return
+        t0, ctx = meta
+        if ctx is None:
+            now = time.monotonic()
+            if now - self._last_span_ts < 0.02:
+                return
+            self._last_span_ts = now
+        try:
+            from ray_tpu.util import profiling
+            profiling.record_span("dag.execute", t0, time.time(),
+                                  trace_ctx=ctx,
+                                  dag_id=self._dag_id, seq=seq)
+        except Exception:
+            pass
 
     # -- teardown ------------------------------------------------------
     def teardown(self) -> None:
-        if self._torn_down:
-            return
-        self._torn_down = True
+        """Idempotent (and thread-safe) teardown: close + UNLINK every
+        mmap channel file this driver owns, close the cross-node
+        queues, then collect the loop tasks (they exit via
+        ChannelClosed; their return value is the tick count)."""
+        with self._td_lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+        _live_dags.discard(self)
         for ch in self._channels:
             ch.close(unlink=True)
         for key, resident in self._rchans:
@@ -414,9 +575,9 @@ class CompiledDAG:
                 self._client.chan_close(resident, key)
             except Exception:
                 pass
-        # loops exit via ChannelClosed; their return is the tick count
         try:
-            ray_tpu.get(self._loop_refs, timeout=10)
+            ray_tpu.get(self._loop_refs,
+                        timeout=2 if self._error is not None else 10)
         except Exception:
             pass
 

@@ -12,7 +12,9 @@ mesh — are caught here at decoration time and in CI instead.
 Rules: RT001 nested blocking get, RT002 non-picklable capture, RT003
 invalid options keys / bundle index, RT004 undeclared mesh axis in a
 PartitionSpec, RT005 blocking call in async code, RT006 dropped
-ObjectRef, RT007 metric name/bucket hygiene.
+ObjectRef, RT007 metric name/bucket hygiene, RT008 retry_exceptions on
+a submitting body, RT009 blocking .remote()/get() inside a
+compiled-DAG-bound method.
 """
 
 from ray_tpu.devtools.lint.engine import (Finding, LintResult,

@@ -714,3 +714,96 @@ def check_rt008(mod: SourceModule) -> Iterable[Finding]:
                     f"task {name!r} has retry_exceptions but calls "
                     f"put() — an app-level retry re-stores the object "
                     f"(non-idempotent)")
+
+
+# ---------------------------------------------------------------------------
+# RT009 — blocking runtime calls inside a compiled-DAG-bound method
+# ---------------------------------------------------------------------------
+@register(
+    "RT009", "blocking .remote()/get() inside a compiled-DAG-bound "
+    "method",
+    "A method bound into a compiled DAG (`actor.method.bind(...)`) "
+    "runs inside the actor's pinned executor loop: the loop processes "
+    "ops strictly serially, so a body that blocks on ray_tpu.get() — "
+    "or submits tasks and waits on them — stalls every downstream "
+    "channel of the graph and can deadlock it outright (the task it "
+    "waits on may need the very actor the loop is pinning).  Do the "
+    "blocking work outside the graph, or pass the data in through a "
+    "DAG edge.")
+def check_rt009(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    actor_classes = [cls for cls in ast.walk(mod.tree)
+                     if mod.decorator_kind(cls) == "actor"]
+    actor_names = {cls.name for cls in actor_classes}
+    # Variables holding actor handles with a resolvable class:
+    # `x = Cls.remote(...)` / `x = Cls.options(...).remote(...)`.
+    var_class: Dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _is_remote_call(node.value)):
+            continue
+        base = node.value.func.value
+        if isinstance(base, ast.Call) \
+                and isinstance(base.func, ast.Attribute) \
+                and base.func.attr == "options":
+            base = base.func.value
+        if isinstance(base, ast.Name) and base.id in actor_names:
+            var_class[node.targets[0].id] = base.id
+
+    # Method names bound into a DAG anywhere in this file:
+    # `<expr>.<method>.bind(...)` — the base must itself be an
+    # attribute access, which excludes serve's `Deployment.bind(...)`.
+    # When the receiver resolves to a known actor handle, only that
+    # class's method is implicated; an unresolvable receiver (handle
+    # passed as a parameter, etc.) implicates the method name only if
+    # EXACTLY ONE actor class in the file defines it — two same-named
+    # methods stay silent (conservative: no cross-class false
+    # positives on common names like `step`/`run`).
+    bound_exact: Set[tuple] = set()         # (class name, method)
+    bound_ambiguous: Set[str] = set()       # method name only
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "bind" \
+                and isinstance(node.func.value, ast.Attribute):
+            meth = node.func.value.attr
+            recv = node.func.value.value
+            if isinstance(recv, ast.Name) and recv.id in var_class:
+                bound_exact.add((var_class[recv.id], meth))
+            else:
+                bound_ambiguous.add(meth)
+    if not bound_exact and not bound_ambiguous:
+        return
+    defines: Dict[str, int] = {}
+    for cls in actor_classes:
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defines[fn.name] = defines.get(fn.name, 0) + 1
+    for cls in actor_classes:
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if (cls.name, fn.name) not in bound_exact \
+                    and not (fn.name in bound_ambiguous
+                             and defines.get(fn.name, 0) == 1):
+                continue
+            for sub in (s for stmt in fn.body for s in ast.walk(stmt)):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _is_remote_call(sub):
+                    yield mod.finding(
+                        "RT009", sub,
+                        f"method {cls.name}.{fn.name!r} is bound into "
+                        f"a compiled DAG but submits work with "
+                        f".remote() — the pinned executor loop must "
+                        f"not schedule (and wait on) tasks")
+                elif _resolved(sub.func, imports) in _GET_NAMES:
+                    yield mod.finding(
+                        "RT009", sub,
+                        f"method {cls.name}.{fn.name!r} is bound into "
+                        f"a compiled DAG but calls ray_tpu.get() — "
+                        f"blocking inside the pinned executor loop "
+                        f"wedges the graph")

@@ -16,8 +16,22 @@ A slot holds [8B length][payload].  On x86/ARM64 an aligned 8-byte
 store is atomic and Python's mmap writes go straight to the shared
 page, so publishing the sequence number AFTER the payload write is the
 entire synchronization protocol (same design as the reference's
-mutable-plasma seqlock).  Blocking uses adaptive spin→sleep polling:
-µs-scale latency when hot, no burned core when cold."""
+mutable-plasma seqlock).
+
+Writes are zero-copy: the value serializes straight into the mmap slot
+via SerializedObject.write_into (no intermediate bytes object), and
+reads deserialize straight out of the mapped slot (out-of-band buffers
+copied once, since the slot is recycled on release).
+
+Oversized values don't raise: a payload larger than slot_size spills
+into the shared-memory object store and the slot carries only the
+16-byte object id (length field tagged with _SPILL).  The writer holds
+the spilled ref until the reader's consumption is visible through
+read_seq; the reader borrows it for the duration of the get.
+
+Blocking uses adaptive spin -> sleep polling: pure spin for
+`dag_spin_us` microseconds (µs-scale latency when hot), then escalating
+sleeps (no burned core when cold)."""
 
 from __future__ import annotations
 
@@ -25,12 +39,17 @@ import mmap
 import os
 import struct
 import time
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import config
 
 _HEADER = 64
 _Q = struct.Struct("<Q")
+
+# Length-field flag: the slot holds a 16-byte object id of a spilled
+# oversized payload, not an inline serialized value.
+_SPILL = 1 << 63
 
 
 class ChannelClosed(Exception):
@@ -41,7 +60,8 @@ class Channel:
     """One direction, one producer process, one consumer process."""
 
     def __init__(self, path: str, capacity: int = 8,
-                 slot_size: int = 1 << 20, create: bool = False) -> None:
+                 slot_size: int = 1 << 20, create: bool = False,
+                 spin_us: Optional[int] = None) -> None:
         self.path = path
         if create:
             size = _HEADER + capacity * (8 + slot_size)
@@ -62,8 +82,15 @@ class Channel:
                 self._mm = mmap.mmap(fd, size)
             finally:
                 os.close(fd)
+        self._view = memoryview(self._mm)
         self.capacity = _Q.unpack(self._mm[0:8])[0]
         self.slot_size = _Q.unpack(self._mm[8:16])[0]
+        # Spin budget read once at construction (knob: dag_spin_us).
+        self._spin_s = (config.dag_spin_us if spin_us is None
+                        else spin_us) / 1e6
+        # Writer-side refs of spilled oversized payloads, keyed by the
+        # slot seq they rode in; pruned once read_seq moves past them.
+        self._spilled: Dict[int, Any] = {}
         self._closed = False
 
     # -- seq accessors (aligned 8-byte torn-free reads/writes) ---------
@@ -78,27 +105,37 @@ class Channel:
     def _slot_off(self, seq: int) -> int:
         return _HEADER + (seq % self.capacity) * (8 + self.slot_size)
 
-    @staticmethod
-    def _wait(poll, timeout: Optional[float]) -> None:
-        deadline = None if timeout is None else \
-            time.monotonic() + timeout
-        spins = 0
+    def _wait(self, poll, timeout: Optional[float]) -> None:
+        """Adaptive wait: pure spin for the dag_spin_us budget (the hot
+        pipelined case is satisfied in a few iterations), then a
+        sched_yield tier (~20ms: µs-scale wake-ups even when executors
+        outnumber cores — a yielding waiter hands its core straight to
+        the producer), then sleep 0.1ms escalating to 1ms (the
+        futex-style cold path: no burned core)."""
+        if poll():
+            return
+        now = time.monotonic()
+        deadline = None if timeout is None else now + timeout
+        spin_until = now + self._spin_s
+        yield_until = spin_until + 0.020
+        sleep_until = yield_until + 0.050
         while not poll():
-            spins += 1
-            if spins < 200:          # hot path: pure spin, ~µs latency
+            now = time.monotonic()
+            if now < spin_until:
                 continue
-            if deadline is not None and time.monotonic() > deadline:
+            if deadline is not None and now > deadline:
                 raise TimeoutError("channel wait timed out")
-            time.sleep(0.0001 if spins < 2000 else 0.001)
+            if now < yield_until:
+                os.sched_yield()
+            else:
+                time.sleep(0.0001 if now < sleep_until else 0.001)
 
     # -- API -----------------------------------------------------------
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
-        blob = ser.dumps(value)
-        if len(blob) > self.slot_size:
-            raise ValueError(
-                f"value of {len(blob)}B exceeds channel slot_size "
-                f"{self.slot_size}B (pass a larger slot_size at "
-                f"compile/creation time)")
+        s = ser.serialize(value)
+        if s.total_size > self.slot_size:
+            self._write_spilled(value, timeout)
+            return
         self._wait(lambda: (self._rseq() == self._CLOSED_SENTINEL
                             or self._wseq() - self._rseq()
                             < self.capacity), timeout)
@@ -106,9 +143,52 @@ class Channel:
             raise ChannelClosed(self.path)
         seq = self._wseq()
         off = self._slot_off(seq)
-        self._mm[off:off + 8] = _Q.pack(len(blob))
-        self._mm[off + 8:off + 8 + len(blob)] = blob
+        self._mm[off:off + 8] = _Q.pack(s.total_size)
+        # Zero-copy: serialize straight into the mapped slot.
+        s.write_into(self._view[off + 8:off + 8 + s.total_size])
+        self._prune_spilled()
         self._mm[16:24] = _Q.pack(seq + 1)      # publish
+
+    def _write_spilled(self, value: Any, timeout: Optional[float]) -> None:
+        """Oversized payload: store the value in the shm object store
+        and ship only its 16-byte id through the slot.  The writer's
+        ref keeps the object alive until read_seq proves consumption."""
+        from ray_tpu._private.client import get_global_client
+        client = get_global_client()
+        if client is None:
+            raise ValueError(
+                f"value exceeds channel slot_size {self.slot_size}B and "
+                f"no runtime is connected to spill it to the object "
+                f"store (pass a larger buffer_size_bytes at compile "
+                f"time, or ray_tpu.init() first)")
+        ref = client.put(value)
+        oid = ref.binary()
+        self._wait(lambda: (self._rseq() == self._CLOSED_SENTINEL
+                            or self._wseq() - self._rseq()
+                            < self.capacity), timeout)
+        if self._rseq() == self._CLOSED_SENTINEL:
+            raise ChannelClosed(self.path)
+        seq = self._wseq()
+        off = self._slot_off(seq)
+        self._mm[off:off + 8] = _Q.pack(_SPILL | len(oid))
+        self._mm[off + 8:off + 8 + len(oid)] = oid
+        self._spilled[seq] = ref
+        self._prune_spilled()
+        self._mm[16:24] = _Q.pack(seq + 1)      # publish
+
+    def _prune_spilled(self) -> None:
+        """Drop spilled-payload refs whose slot the reader has moved
+        past.  The reader borrows the object (add_ref ordered before
+        its get on the same connection) BEFORE advancing read_seq, so
+        this release can never race the consumption."""
+        if not self._spilled:
+            return
+        rseq = self._rseq()
+        if rseq == self._CLOSED_SENTINEL:
+            self._spilled.clear()
+            return
+        for seq in [s for s in self._spilled if s < rseq]:
+            del self._spilled[seq]
 
     def read(self, timeout: Optional[float] = None) -> Any:
         self._wait(lambda: (self._wseq() == self._CLOSED_SENTINEL
@@ -118,21 +198,44 @@ class Channel:
         seq = self._rseq()
         off = self._slot_off(seq)
         n = _Q.unpack(self._mm[off:off + 8])[0]
-        blob = bytes(self._mm[off + 8:off + 8 + n])
+        if n & _SPILL:
+            value = self._read_spilled(
+                bytes(self._mm[off + 8:off + 8 + (n & ~_SPILL)]))
+        else:
+            # Deserialize straight from the mapped slot; out-of-band
+            # buffers are copied (the slot is recycled on release).
+            value = ser.deserialize(self._view[off + 8:off + 8 + n],
+                                    copy_buffers=True)
         self._mm[24:32] = _Q.pack(seq + 1)      # release slot
-        return ser.loads(blob)
+        return value
+
+    @staticmethod
+    def _read_spilled(oid: bytes) -> Any:
+        from ray_tpu._private.client import get_global_client
+        from ray_tpu.object_ref import ObjectRef
+        client = get_global_client()
+        if client is None:
+            raise ChannelClosed(
+                "spilled channel payload with no connected runtime")
+        # Borrowed ref: the add_ref notify is ordered before the get on
+        # this connection, so the writer-side release (after read_seq
+        # advances) can never free the object first.
+        ref = ObjectRef._from_wire(oid)
+        return client.get([ref])[0]
 
     def close(self, unlink: bool = False) -> None:
         """Mark closed for the peer (poison both seqs), then unmap."""
         if self._closed:
             return
         self._closed = True
+        self._spilled.clear()
         try:
             self._mm[16:24] = _Q.pack(self._CLOSED_SENTINEL)
             self._mm[24:32] = _Q.pack(self._CLOSED_SENTINEL)
+            self._view.release()
             self._mm.flush()
             self._mm.close()
-        except (ValueError, OSError):
+        except (ValueError, OSError, BufferError):
             pass
         if unlink:
             try:

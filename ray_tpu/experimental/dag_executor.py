@@ -30,6 +30,7 @@ pipelined (each sender's per-queue order is FIFO)."""
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -64,6 +65,14 @@ def run_dag_loop(instance: Any, ops: List[dict],
     if client is None:
         from ray_tpu._private.client import get_global_client
         client = get_global_client()
+    from ray_tpu.util.metrics import (DAG_HOP_BUCKETS,
+                                      DAG_HOP_SECONDS_METRIC,
+                                      shared_histogram)
+    observe_hop = shared_histogram(
+        DAG_HOP_SECONDS_METRIC,
+        description="compiled-DAG per-edge hop duration",
+        boundaries=DAG_HOP_BUCKETS,
+        tag_keys=("edge",)).observer({"edge": "local"})
     chans: Dict[str, Channel] = {}
 
     def chan(path: str) -> Channel:
@@ -86,26 +95,42 @@ def run_dag_loop(instance: Any, ops: List[dict],
     def emit(slot, out, local) -> None:
         kind, *rest = slot
         if kind == "chan":
+            # Local hop = the sender-side mmap write (serialize into
+            # the slot + publish, incl. any backpressure wait).  The
+            # remote hop is observed node-side on the streamed edge.
+            t0 = time.perf_counter()
             chan(rest[0]).write(out)
+            observe_hop(time.perf_counter() - t0)
         elif kind == "rchan_out":
             client.chan_send(bytes.fromhex(rest[1]), rest[0], out)
         else:
             local[rest[0]] = out
 
+    # Pre-bound tick plan: the per-tick loop is the hot path, so
+    # method lookups and kwargs-shape checks happen once here, not
+    # per item.
+    plan = []
+    for op in ops:
+        method = (None if "collective" in op
+                  else getattr(instance, op["method"]))
+        plan.append((op.get("collective"), method, op["ins"],
+                     list((op.get("kwargs") or {}).items()),
+                     op["outs"]))
+
     ticks = 0
     try:
         while True:
             local: Dict[str, Any] = {}
-            for op in ops:
-                args = [resolve(s, local) for s in op["ins"]]
-                kwargs = {k: resolve(s, local)
-                          for k, s in (op.get("kwargs") or {}).items()}
-                if "collective" in op:
-                    out = _run_collective(op["collective"], args[0],
-                                          client)
+            for coll, method, ins, kw_items, outs in plan:
+                args = [resolve(s, local) for s in ins]
+                if coll is not None:
+                    out = _run_collective(coll, args[0], client)
+                elif kw_items:
+                    out = method(*args, **{k: resolve(s, local)
+                                           for k, s in kw_items})
                 else:
-                    out = getattr(instance, op["method"])(*args, **kwargs)
-                for slot in op["outs"]:
+                    out = method(*args)
+                for slot in outs:
                     emit(slot, out, local)
             ticks += 1
     except ChannelClosed:

@@ -9,6 +9,7 @@ in-flight count the pow-2 router probes.
 from __future__ import annotations
 
 import inspect
+import threading
 from typing import Any
 
 
@@ -22,6 +23,15 @@ class Replica:
         self._user = cls(*init_args, **(init_kwargs or {}))
         self._inflight = 0
         self._served = 0
+        # handle_request runs on the actor's event loop while
+        # pipeline_step runs on the compiled-graph executor thread:
+        # the counters the router/controller probe must not lose
+        # updates to interleaved `+=`.
+        self._count_lock = threading.Lock()
+        # Dedicated event loop for async user methods reached through
+        # the compiled pipeline (pipeline_step runs on the DAG
+        # executor thread, outside the actor's asyncio loop).
+        self._pipe_loop = None
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -44,7 +54,8 @@ class Replica:
         from ray_tpu.serve.multiplex import (_current_model_id,
                                              _set_current_model_id)
         from ray_tpu.util import profiling
-        self._inflight += 1
+        with self._count_lock:
+            self._inflight += 1
         token = _set_current_model_id(multiplexed_model_id)
         try:
             # Child of the execute span the worker opened for this
@@ -58,8 +69,57 @@ class Replica:
             return out
         finally:
             _current_model_id.reset(token)
-            self._inflight -= 1
-            self._served += 1
+            with self._count_lock:
+                self._inflight -= 1
+                self._served += 1
+
+    def pipe_config(self) -> dict:
+        """Router probe at pipe-compile time: which methods must NOT
+        ride the compiled pipeline.  @serve.batch methods depend on
+        CONCURRENT arrivals on the actor's event loop to accumulate a
+        batch — the pipe's strictly serial step loop would degrade
+        every batch to size 1."""
+        skip = [name for name, m
+                in inspect.getmembers(type(self._user))
+                if getattr(m, "_rtpu_batch_queue_factory", False)]
+        return {"skip_methods": skip}
+
+    def pipeline_step(self, request) -> Any:
+        """One request step on the compiled serve pipeline
+        (serve_compiled_pipeline): the router's handoff writes
+        (method, args, kwargs, model_id) into the graph's input
+        channel; this method — bound into a per-replica compiled DAG
+        and driven by the pinned executor loop — runs it and returns a
+        ("ok", value) / ("err", exception) envelope.  The envelope is
+        load-bearing: a raised exception would kill the executor loop
+        and tear down the whole pipe, so application errors must
+        travel as values."""
+        import asyncio
+        from ray_tpu.serve.multiplex import (_current_model_id,
+                                             _set_current_model_id)
+        from ray_tpu.util import profiling
+        method, args, kwargs, model_id = request
+        with self._count_lock:
+            self._inflight += 1
+        token = _set_current_model_id(model_id)
+        try:
+            with profiling.span("replica.handle_request",
+                                deployment=self._name, method=method,
+                                compiled=True):
+                out = getattr(self._user, method)(*args,
+                                                  **(kwargs or {}))
+                if inspect.isawaitable(out):
+                    if self._pipe_loop is None:
+                        self._pipe_loop = asyncio.new_event_loop()
+                    out = self._pipe_loop.run_until_complete(out)
+            return ("ok", out)
+        except BaseException as e:  # noqa: BLE001
+            return ("err", e)
+        finally:
+            _current_model_id.reset(token)
+            with self._count_lock:
+                self._inflight -= 1
+                self._served += 1
 
     def handle_request_stream(self, method: str, args: tuple,
                               kwargs: dict):
@@ -79,7 +139,8 @@ class Replica:
         from ray_tpu.util import profiling
         ctx = tracing.current()
         t0 = time.time()
-        self._inflight += 1
+        with self._count_lock:
+            self._inflight += 1
 
         def _stream():
             try:
@@ -91,8 +152,9 @@ class Replica:
                     "replica.handle_request", t0, time.time(),
                     trace_ctx=ctx, deployment=self._name,
                     method=method, stream=True)
-                self._inflight -= 1
-                self._served += 1
+                with self._count_lock:
+                    self._inflight -= 1
+                    self._served += 1
 
         return _stream()
 

@@ -73,6 +73,14 @@ class Router:
         self._failures: Dict[bytes, int] = {}
         self._sidelined: Dict[bytes, float] = {}
         self._lock = threading.Lock()
+        # Compiled serve pipeline (serve_compiled_pipeline): one
+        # compiled DAG per replica, requests ride its channels instead
+        # of per-call actor tasks.  actor_id -> (CompiledDAG, Lock,
+        # skip_methods).  _pipe_failed negative-caches compile
+        # failures so a replica whose pipe can't build degrades to the
+        # task path without paying probe+compile on every request.
+        self._pipes: Dict[bytes, tuple] = {}
+        self._pipe_failed: Dict[bytes, float] = {}
         self._last_refresh = 0.0
         self._last_probe = 0.0
         self._probe_thread = None
@@ -107,6 +115,11 @@ class Router:
             self._version = info["version"]
             self._last_refresh = time.time()
             live = {r._actor_id for r in self._replicas}
+            dead_pipes = [self._pipes.pop(k) for k in
+                          list(self._pipes) if k not in live]
+            self._pipe_failed = {k: v for k, v
+                                 in self._pipe_failed.items()
+                                 if k in live}
             self._outstanding = {
                 r._actor_id: self._outstanding.get(r._actor_id, 0)
                 for r in self._replicas}
@@ -120,6 +133,8 @@ class Router:
                               if k in live}
             self._sidelined = {k: v for k, v in self._sidelined.items()
                                if k in live}
+        for ent in dead_pipes:
+            self._teardown_pipe_async(ent)
 
     # -- long-poll push (reference: long_poll.py LongPollClient) --------
     def _ensure_poll_thread(self) -> None:
@@ -268,6 +283,120 @@ class Router:
             if self._outstanding.get(k, 0) > 0:
                 self._outstanding[k] -= 1
 
+    # -- compiled serve pipeline (serve_compiled_pipeline) --------------
+    @staticmethod
+    def _compiled_enabled() -> bool:
+        from ray_tpu._private.config import config
+        return bool(config.serve_compiled_pipeline)
+
+    def _try_pipe(self, replica):
+        """Get (or compile) the replica's request pipe as
+        (CompiledDAG, Lock, skip_methods); None on any compile failure
+        — the caller degrades to the task path."""
+        import ray_tpu
+        k = replica._actor_id
+        with self._lock:
+            ent = self._pipes.get(k)
+            if ent is None and \
+                    time.time() - self._pipe_failed.get(k, 0.0) < 30.0:
+                return None     # recent compile failure: task path
+        if ent is not None:
+            return ent
+        try:
+            skip = set(ray_tpu.get(replica.pipe_config.remote(),
+                                   timeout=30)["skip_methods"])
+            # Importing ray_tpu.dag activates .bind on actor methods.
+            from ray_tpu.dag import InputNode
+            with InputNode() as inp:
+                out = replica.pipeline_step.bind(inp)
+            dag = out.experimental_compile(capacity=16)
+        except Exception:
+            with self._lock:
+                self._pipe_failed[k] = time.time()
+            return None
+        ent = (dag, threading.Lock(), skip)
+        with self._lock:
+            self._pipe_failed.pop(k, None)
+            cur = self._pipes.get(k)
+            if cur is None and k in self._outstanding:
+                self._pipes[k] = ent
+                return ent
+        # Lost the race (or the replica vanished mid-compile).
+        self._teardown_pipe_async(ent)
+        return cur
+
+    def _drop_pipe(self, actor_id: bytes) -> None:
+        with self._lock:
+            ent = self._pipes.pop(actor_id, None)
+        if ent is not None:
+            self._teardown_pipe_async(ent)
+
+    @staticmethod
+    def _teardown_pipe_async(ent) -> None:
+        """Teardown waits for the executor loop to exit — never on the
+        request path."""
+        threading.Thread(target=ent[0].teardown, daemon=True,
+                         name="rtpu-serve-pipe-td").start()
+
+    def _watch_pipe(self, relay_ref, dag_ref, replica, method: str,
+                    args: tuple, kwargs: dict, model_id: str) -> None:
+        """Compiled-path waiter: read the pipe's ("ok"|"err", value)
+        envelope and bridge it onto the relay.  The graph itself is
+        at-most-once; requests it LOSES on a replica death (envelope
+        neither returned nor salvaged from the out ring) retry once
+        through the ordinary task path on another replica — the same
+        replay window actor max_task_retries accepts.  Either way the
+        pipe is dropped, so later requests compile a fresh one on the
+        controller's replacement replica."""
+        relay = relay_ref.binary()
+
+        def waiter() -> None:
+            from ray_tpu import exceptions as exc
+            _pin = relay_ref     # hold until the bridge lands
+            try:
+                # No deadline: one slow request must not tear down the
+                # SHARED pipe (a TimeoutError here would close the
+                # channels under up-to-capacity unrelated in-flight
+                # requests).  Matches the task path's indefinite wait;
+                # a dead replica still surfaces via the loop-death
+                # check inside get().
+                status, value = dag_ref.get()
+            except BaseException as e:  # noqa: BLE001
+                self.done(replica)
+                self._drop_pipe(replica._actor_id)
+                death = isinstance(e, (exc.ActorDiedError,
+                                       exc.WorkerCrashedError,
+                                       exc.ActorUnavailableError))
+                if death:
+                    self._note_replica_failure(replica, e)
+                    failed = (set()
+                              if isinstance(e, exc.ActorUnavailableError)
+                              else {replica._actor_id})
+                    nxt = self._pick_for_failover(failed, model_id)
+                    if nxt is not None:
+                        self._count_failover()
+                        try:
+                            ref2 = nxt.handle_request.remote(
+                                method, args, kwargs, model_id)
+                        except Exception:
+                            self.done(nxt)
+                            self._bridge(relay, e, as_error=True)
+                            return
+                        # Hand the second attempt to the ordinary
+                        # waiter (it owns bridge + one more failover).
+                        self._watch(relay_ref, ref2, nxt, method,
+                                    args, kwargs, model_id)
+                        return
+                self._bridge(relay, e, as_error=True)
+                return
+            self.done(replica)
+            if status == "ok":
+                self._record_success(replica._actor_id)
+            self._bridge(relay, value, as_error=(status != "ok"))
+
+        threading.Thread(target=waiter, daemon=True,
+                         name="rtpu-serve-pipe").start()
+
     # -- request assignment + failover ----------------------------------
     def assign(self, method: str, args: tuple, kwargs: dict,
                model_id: str = ""):
@@ -293,6 +422,27 @@ class Router:
             relay_ref = ObjectRef(relay, owned=True)
             replica = self.pick(model_id)
             self._maybe_chaos_kill(chaos, replica)
+            if self._compiled_enabled():
+                ent = self._try_pipe(replica)
+                if ent is not None and method not in ent[2]:
+                    dag, plock, _ = ent
+                    dag_ref = None
+                    try:
+                        with plock:
+                            # Router handoff: the request goes straight
+                            # into the graph's input channel — no
+                            # scheduled task on the hot path.
+                            dag_ref = dag.execute(
+                                (method, args, kwargs, model_id))
+                    except BaseException:  # noqa: BLE001
+                        # Pipe broken before the request entered the
+                        # graph: safe to fall through to the task path.
+                        self._drop_pipe(replica._actor_id)
+                    if dag_ref is not None:
+                        self._watch_pipe(relay_ref, dag_ref, replica,
+                                         method, args, kwargs,
+                                         model_id)
+                        return relay_ref, replica
             ref = replica.handle_request.remote(method, args, kwargs,
                                                 model_id)
         self._watch(relay_ref, ref, replica, method, args, kwargs,
@@ -495,3 +645,8 @@ class Router:
 
     def close(self) -> None:
         self._closed.set()
+        with self._lock:
+            pipes = list(self._pipes.values())
+            self._pipes.clear()
+        for ent in pipes:
+            self._teardown_pipe_async(ent)

@@ -79,6 +79,17 @@ GCS_WAL_BYTES_METRIC = "ray_tpu_gcs_wal_bytes"
 GCS_RESYNC_SECONDS_METRIC = "ray_tpu_gcs_resync_seconds"
 GCS_RESYNC_BUCKETS = (0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
 
+# Compiled-graph (ray_tpu.dag) fast lane, auto-recorded.  hop_seconds
+# tags: edge = local (same-node mmap ring / in-process write) | remote
+# (cross-node streamed transfer-plane edge, send->ack round trip).
+# executions_total counts CompiledDAG.execute() calls driver-side.
+# Bucket floor is 10 µs: the whole point of compiled graphs is hops
+# two orders of magnitude below the task path's buckets.
+DAG_HOP_SECONDS_METRIC = "ray_tpu_dag_hop_seconds"
+DAG_EXECUTIONS_METRIC = "ray_tpu_dag_executions_total"
+DAG_HOP_BUCKETS = (0.00001, 0.00005, 0.0001, 0.0005, 0.001, 0.005,
+                   0.01, 0.05, 0.25, 1.0)
+
 # Inter-node object-transfer plane, auto-recorded node-side.
 # bytes_total tags: direction = in | out.  seconds tags: path =
 # stream (windowed binary plane) | multi (range-split, several
@@ -247,6 +258,34 @@ class Histogram(_Metric):
             cell["sum"] += value
             cell["count"] += 1
 
+    def observer(self, tags: Optional[Dict[str, str]] = None):
+        """Pre-resolved observe callable for one tag set — hot paths
+        (compiled-DAG hops at µs rates) skip the per-call tag
+        merge/sort.  The tagset key is pinned; _drain resets the cell
+        dict in place is NOT done (drain replaces the cell), so the
+        callable re-resolves through _cells each call by key."""
+        ts = self._tagset(tags)
+        lock = self._lock
+        boundaries = self.boundaries
+        cells = self._cells
+        with lock:
+            if ts not in cells:
+                cells[ts] = self._new_cell()
+
+        def obs(value: float) -> None:
+            with lock:
+                cell = cells.get(ts)
+                if cell is None:
+                    cell = cells[ts] = self._new_cell()
+                for b in boundaries:
+                    if value <= b:
+                        cell["buckets"][str(b)] += 1
+                        break
+                cell["sum"] += value
+                cell["count"] += 1
+
+        return obs
+
     def _drain(self) -> List[dict]:
         out = []
         with self._lock:
@@ -264,6 +303,7 @@ class Histogram(_Metric):
 
 
 _shared_counters: Dict[Tuple[str, Tuple[str, ...]], "Counter"] = {}
+_shared_histograms: Dict[Tuple[str, Tuple[str, ...]], "Histogram"] = {}
 
 
 def shared_counter(name: str, description: str = "",
@@ -279,6 +319,22 @@ def shared_counter(name: str, description: str = "",
                         tag_keys=tag_keys)
             _shared_counters[key] = c
         return c
+
+
+def shared_histogram(name: str, description: str = "",
+                     boundaries: Sequence[float] = (),
+                     tag_keys: Sequence[str] = ()) -> "Histogram":
+    """shared_counter's Histogram sibling (compiled-DAG executors
+    observe per-hop latencies from worker processes)."""
+    key = (name, tuple(tag_keys))
+    with _lock:
+        h = _shared_histograms.get(key)
+        if h is None:
+            h = Histogram(name, description=description,
+                          boundaries=list(boundaries) or None,
+                          tag_keys=tag_keys)
+            _shared_histograms[key] = h
+        return h
 
 
 # ---------------------------------------------------------------------------

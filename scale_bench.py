@@ -148,6 +148,175 @@ def measure_object_transfer(size_mb: int = 256) -> dict:
     return out
 
 
+def _percentiles_us(lat_s: List[float], hops: int) -> Dict[str, float]:
+    import numpy as np
+    arr = np.asarray(sorted(lat_s)) * 1e6
+    return {
+        "round_trip_us_p50": round(float(np.percentile(arr, 50)), 1),
+        "round_trip_us_p95": round(float(np.percentile(arr, 95)), 1),
+        "per_hop_us_p50": round(float(np.percentile(arr, 50)) / hops, 1),
+        "per_hop_us_p95": round(float(np.percentile(arr, 95)) / hops, 1),
+        "hops": hops,
+    }
+
+
+def _measure_compiled_chain(ray_tpu, actors, iters: int,
+                            warm: int) -> Dict[str, float]:
+    """Compiled actor chain, two views: serial execute+get round trips
+    (latency; per-hop = round trip / edges) and a pipelined window of
+    in-flight executes (throughput; per-hop = wall / items / edges —
+    the steady-state overhead the fast lane is built for: waits
+    overlap, every stage's channel poll stays in its spin budget)."""
+    from ray_tpu.dag import InputNode
+    hops = len(actors) + 1
+    with InputNode() as inp:
+        out = inp
+        for a in actors:
+            out = a.step.bind(out)
+    dag = out.experimental_compile(capacity=16)
+    try:
+        for _ in range(warm):
+            assert dag.execute(1).get(timeout=60) == 1
+        lat = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            dag.execute(1).get(timeout=60)
+            lat.append(time.perf_counter() - t0)
+        # Pipelined: a sliding window of 8 in-flight executes.  The
+        # steady-state per-hop overhead — the number the fast lane is
+        # built for — is the p50/p95 of inter-completion times over
+        # the edge count (waits overlap across stages, so every
+        # stage's channel poll stays inside its spin budget).
+        window, pending = 8, []
+        t0 = time.perf_counter()
+        last = None
+        deltas = []
+        for i in range(iters):
+            pending.append(dag.execute(1))
+            if len(pending) >= window:
+                pending.pop(0).get(timeout=60)
+                now = time.perf_counter()
+                if last is not None:
+                    deltas.append(now - last)
+                last = now
+        for r in pending:
+            r.get(timeout=60)
+        wall = time.perf_counter() - t0
+    finally:
+        dag.teardown()
+    res = {f"serial_{k}": v
+           for k, v in _percentiles_us(lat, hops).items()}
+    piped = _percentiles_us(deltas, hops)
+    res.update({
+        "hops": hops,
+        "per_hop_us_p50": piped["per_hop_us_p50"],
+        "per_hop_us_p95": piped["per_hop_us_p95"],
+        "pipelined_items_per_s": round(iters / wall, 1),
+    })
+    return res
+
+
+def _measure_legacy_chain(ray_tpu, actors, iters: int,
+                          warm: int) -> Dict[str, float]:
+    """The per-call baseline: the same chain as chained actor tasks
+    (each hop pays Python scheduling + dispatch), measured the same
+    two ways — serial round trips and a pipelined window of chains —
+    and normalized to the same hop count."""
+    hops = len(actors) + 1
+
+    def submit():
+        ref = 1
+        for a in actors:
+            ref = a.step.remote(ref)
+        return ref
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        ray_tpu.get(submit(), timeout=60)
+        return time.perf_counter() - t0
+
+    for _ in range(warm):
+        once()
+    lat = [once() for _ in range(iters)]
+    window, pending = 8, []
+    last = None
+    deltas = []
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        pending.append(submit())
+        if len(pending) >= window:
+            ray_tpu.get(pending.pop(0), timeout=60)
+            now = time.perf_counter()
+            if last is not None:
+                deltas.append(now - last)
+            last = now
+    for r in pending:
+        ray_tpu.get(r, timeout=60)
+    wall = time.perf_counter() - t0
+    res = {f"serial_{k}": v for k, v in _percentiles_us(lat, hops).items()}
+    piped = _percentiles_us(deltas, hops)
+    res.update({
+        "hops": hops,
+        "per_hop_us_p50": piped["per_hop_us_p50"],
+        "per_hop_us_p95": piped["per_hop_us_p95"],
+        "pipelined_items_per_s": round(iters / wall, 1),
+    })
+    return res
+
+
+def measure_dag(quick: bool = False) -> dict:
+    """Compiled-graph microbench (SCALE_DAG=1): p50/p95 per-hop
+    overhead of a 3-stage actor pipeline on compiled channels vs the
+    legacy per-call task path — same-node, plus a 2-node loopback leg
+    (skipped under SCALE_QUICK) whose cross-node edges ride the binary
+    transfer plane."""
+    import ray_tpu
+
+    iters = 300 if quick else 2000
+    warm = 20 if quick else 100
+
+    @ray_tpu.remote
+    class Stage:
+        def step(self, x):
+            return x
+
+    out: dict = {"stages": 3, "iters": iters}
+    ray_tpu.init(num_cpus=4)
+    try:
+        actors = [Stage.remote() for _ in range(3)]
+        out["same_node"] = _measure_compiled_chain(ray_tpu, actors,
+                                                   iters, warm)
+        out["same_node_legacy"] = _measure_legacy_chain(
+            ray_tpu, actors, iters, warm)
+        out["speedup_p50"] = round(
+            out["same_node_legacy"]["per_hop_us_p50"]
+            / max(out["same_node"]["per_hop_us_p50"], 1e-9), 2)
+        out["serial_speedup_p50"] = round(
+            out["same_node_legacy"]["serial_per_hop_us_p50"]
+            / max(out["same_node"]["serial_per_hop_us_p50"], 1e-9), 2)
+    finally:
+        ray_tpu.shutdown()
+    if quick:
+        return out
+
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster()
+    cluster.add_node(resources={"CPU": 2.0, "remote": 1.0})
+    ray_tpu.init(num_cpus=2, gcs_address=cluster.gcs_address)
+    try:
+        cluster.wait_for_nodes(2)
+        mid = Stage.options(resources={"remote": 1}).remote()
+        actors = [Stage.remote(), mid, Stage.remote()]
+        out["two_node"] = _measure_compiled_chain(
+            ray_tpu, actors, max(iters // 4, 100), warm)
+        out["two_node_legacy"] = _measure_legacy_chain(
+            ray_tpu, actors, max(iters // 4, 100), warm)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+    return out
+
+
 def run_envelope(node_counts: List[int], n_tasks: int, n_actors: int,
                  n_pgs: int, churn: int) -> dict:
     import ray_tpu
@@ -189,8 +358,29 @@ def run_envelope(node_counts: List[int], n_tasks: int, n_actors: int,
     }
 
 
+def _merge_microbench(rnd: str, key: str, res: dict) -> None:
+    path = f"MICROBENCH_{rnd}.json"
+    blob = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            blob = json.load(f)
+    blob[key] = res
+    with open(path, "w") as f:
+        json.dump(blob, f, indent=1)
+
+
 def main() -> None:
-    rnd = os.environ.get("SCALE_ROUND", "r05")
+    rnd = os.environ.get("SCALE_ROUND", "r06")
+    quick = os.environ.get("SCALE_QUICK", "") not in ("", "0", "false")
+    if os.environ.get("SCALE_DAG", "") not in ("", "0", "false"):
+        # Compiled-graph microbench: 3-stage actor pipeline, per-hop
+        # overhead on compiled channels vs the legacy per-call path.
+        # SCALE_QUICK shrinks iterations and skips the 2-node leg so
+        # it runs in seconds locally.
+        res = measure_dag(quick=quick)
+        _merge_microbench(rnd, "dag", res)
+        print(json.dumps({"metric": "dag", **res}))
+        return
     if os.environ.get("SCALE_OBJECT_TRANSFER", "") not in ("", "0",
                                                            "false"):
         # Object-transfer microbench only: loopback two-node pull of a
@@ -199,17 +389,9 @@ def main() -> None:
         # single-node microbench numbers.
         size = int(os.environ.get("SCALE_TRANSFER_MB", "256"))
         res = measure_object_transfer(size)
-        path = f"MICROBENCH_{rnd}.json"
-        blob = {}
-        if os.path.exists(path):
-            with open(path) as f:
-                blob = json.load(f)
-        blob["object_transfer"] = res
-        with open(path, "w") as f:
-            json.dump(blob, f, indent=1)
+        _merge_microbench(rnd, "object_transfer", res)
         print(json.dumps({"metric": "object_transfer", **res}))
         return
-    quick = os.environ.get("SCALE_QUICK", "") not in ("", "0", "false")
     if quick:
         out = run_envelope([1, 2], n_tasks=60, n_actors=8, n_pgs=5,
                            churn=20)

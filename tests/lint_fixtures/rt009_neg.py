@@ -1,0 +1,45 @@
+"""RT009 negative: pure bound methods; blocking calls only in methods
+NOT bound into a DAG; serve's Deployment.bind is not a DAG bind."""
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+def helper(x):
+    return x + 1
+
+
+@ray_tpu.remote
+class Stage:
+    def step(self, x):
+        return x * 2                     # pure: fine in the loop
+
+    def prepare(self, x):
+        # Not bound into any DAG: ordinary actor method, blocking OK.
+        return ray_tpu.get(helper.remote(x))
+
+
+def build(actor):
+    with InputNode() as inp:
+        out = actor.step.bind(inp)
+    return out.experimental_compile()
+
+
+@ray_tpu.remote
+class OtherStage:
+    def step(self, x):
+        # Same method NAME as the bound Stage.step, but this class is
+        # never bound into a DAG — with the receiver above
+        # unresolvable and TWO actor classes defining `step`, the
+        # conservative rule stays silent rather than guess.
+        return ray_tpu.get(helper.remote(x))
+
+
+@serve.deployment
+class Model:
+    def __call__(self, x):
+        return ray_tpu.get(helper.remote(x))
+
+
+app = Model.bind()                       # serve bind, not a DAG bind

@@ -1,0 +1,26 @@
+"""RT009 positive: blocking runtime calls inside compiled-DAG-bound
+methods wedge the pinned executor loop."""
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+def helper(x):
+    return x + 1
+
+
+@ray_tpu.remote
+class Stage:
+    def step(self, x):
+        ref = helper.remote(x)           # RT009: submits inside the loop
+        return ray_tpu.get(ref)          # RT009: blocks inside the loop
+
+    def other(self, x):
+        # Not bound into a DAG below: silent.
+        return ray_tpu.get(helper.remote(x))
+
+
+def build(actor):
+    with InputNode() as inp:
+        out = actor.step.bind(inp)
+    return out.experimental_compile()

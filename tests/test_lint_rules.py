@@ -14,7 +14,7 @@ from ray_tpu.devtools.lint import engine
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 RULE_IDS = ["RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
-            "RT007", "RT008"]
+            "RT007", "RT008", "RT009"]
 
 
 def _fixture(rule_id: str, kind: str) -> str:

@@ -60,6 +60,11 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.GCS_RECONNECTS_METRIC)
     assert _NAME.match(metrics.GCS_WAL_BYTES_METRIC)
     assert _NAME.match(metrics.GCS_RESYNC_SECONDS_METRIC)
+    assert _NAME.match(metrics.DAG_HOP_SECONDS_METRIC)
+    assert _NAME.match(metrics.DAG_EXECUTIONS_METRIC)
+    assert metrics.DAG_EXECUTIONS_METRIC.endswith("_total")
+    # hop_seconds is a histogram — no _total.
+    assert not metrics.DAG_HOP_SECONDS_METRIC.endswith("_total")
     assert metrics.GCS_RESTARTS_METRIC.endswith("_total")
     assert metrics.GCS_RECONNECTS_METRIC.endswith("_total")
     # wal_bytes is a gauge, resync_seconds a histogram — no _total.
@@ -74,7 +79,7 @@ def test_declared_builtin_names_are_legal():
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
                metrics.OBJECT_TRANSFER_BUCKETS,
                metrics.DRAIN_DURATION_BUCKETS,
-               metrics.GCS_RESYNC_BUCKETS):
+               metrics.GCS_RESYNC_BUCKETS, metrics.DAG_HOP_BUCKETS):
         assert all(a < b for a, b in zip(bs, bs[1:]))
 
 
