@@ -236,6 +236,24 @@ _D("dag_spin_us", int, 50,
    "raise it on dedicated cores, lower it (or 0) when executors "
    "outnumber cores — a spinning waiter steals cycles the producing "
    "stage needs.")
+_D("kv_block_size", int, 16,
+   "Paged-KV serving: tokens per KV block.  Every request's cache is a "
+   "list of fixed-size blocks from a shared pool (vLLM/RPA-style paged "
+   "attention); only FULL blocks are prefix-shareable, so smaller "
+   "blocks share more but cost more gather indices per decode step.")
+_D("kv_num_blocks", int, 0,
+   "Paged-KV serving: usable blocks in the shared pool.  0 = auto "
+   "(num_slots * ceil(max_len / kv_block_size) — same HBM footprint "
+   "as the dense per-slot cache, with sharing as pure upside).")
+_D("prefix_cache_enabled", bool, True,
+   "Paged-KV serving: keep retired requests' full prompt blocks in a "
+   "per-model radix tree so later prompts sharing the prefix decode "
+   "from cached blocks (prefill runs only the uncached suffix).")
+_D("kv_eviction_policy", str, "lru",
+   "Paged-KV serving: how cached (refcount-0) prefix blocks are "
+   "reclaimed when the free pool empties.  Only 'lru' is implemented; "
+   "the knob exists so a different policy is a config change, not an "
+   "API change.")
 _D("serve_compiled_pipeline", bool, False,
    "Serve fast lane: route unary deployment requests through a "
    "per-replica compiled graph (router handoff writes into the "

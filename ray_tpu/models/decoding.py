@@ -4,16 +4,22 @@ The reference serves LLMs by delegating to vLLM on GPU (e.g.
 doc/source/serve/doc_code/vllm_example.py); the TPU-native build owns
 the decode loop itself, shaped for XLA:
 
-* FIXED shapes everywhere: a slot-based cache [B, M, Hkv, Dh] per layer
-  with B decode slots and M max positions — prefill and decode_step
-  compile ONCE and are reused for the server's lifetime.
+* FIXED shapes everywhere — prefill and decode_step compile ONCE and
+  are reused for the server's lifetime.  Two cache layouts share that
+  property: the dense per-slot cache [B, M, Hkv, Dh] (DecodeCaches,
+  every slot reserves M max positions) and the PAGED cache
+  (PagedDecodeCaches below: a [NB, bs, Hkv, Dh] block pool addressed
+  through per-slot block tables, so memory scales with tokens actually
+  cached and full blocks are shareable across requests).
 * decode_step advances every active slot one token per call (the inner
   loop of continuous batching): one [B,1,D] layer pass, scatter the new
   k/v into the caches with static-shape advanced indexing, attend
-  against the full cache under a per-slot length mask.
+  against the full cache under a per-slot length mask (paged variants
+  scatter/gather through the block table instead).
 * prefill runs the prompt through the stacked layers once (causal
   within the prompt), returning per-layer k/v to be inserted into a
-  free slot.
+  free slot; the paged analog prefills only the prompt's uncached
+  SUFFIX against a gathered cached-prefix window.
 
 Everything reuses transformer.py's parameter layout (init_params),
 norms and RoPE, so any trained checkpoint serves unchanged.
@@ -359,3 +365,293 @@ def insert_slot(caches: DecodeCaches, slot: jax.Array, k: jax.Array,
 def set_last_tokens(caches: DecodeCaches,
                     tokens: jax.Array) -> DecodeCaches:
     return caches._replace(last_token=tokens)
+
+
+# ===========================================================================
+# Paged KV cache (block pool + per-slot block tables)
+# ===========================================================================
+# The dense DecodeCaches above reserves max_len positions per slot; the
+# paged variant stores KV in fixed-size blocks from a shared pool and
+# addresses them through per-slot block tables, so short sequences use
+# blocks proportional to their length and FULL prompt blocks are
+# refcount-shareable across requests (the serve/llm.py prefix cache).
+# Decode attention goes through ops/paged_attention.py (Pallas ragged
+# paged attention on TPU, jnp.take gather reference elsewhere).
+#
+# Invariants the engine (serve/llm.py) maintains, which these kernels
+# rely on:
+#   * pool block 0 is a reserved scratch block: never allocated, table
+#     padding points at it, and gated/over-capacity writes are
+#     redirected to it — so duplicate scatter targets always carry the
+#     same value and garbage positions are always masked by length;
+#   * a request's prefix_len is a multiple of the block size (only
+#     FULL blocks are shared), so every suffix/decode write lands in a
+#     block owned exclusively by that slot;
+#   * admission pre-allocates blocks for prompt + max_new tokens, so
+#     decode never needs to allocate (and never runs out mid-decode).
+
+
+class PagedDecodeCaches(NamedTuple):
+    """Block-pool KV + per-slot tables (all fixed-shape)."""
+
+    kp: jax.Array            # [L, NB, bs, Hkv, Dh] block pool
+    vp: jax.Array            # [L, NB, bs, Hkv, Dh]
+    block_tables: jax.Array  # [B, W] int32 — physical block per logical
+    lengths: jax.Array       # [B] int32 — tokens currently cached
+    last_token: jax.Array    # [B] int32 — input to the next decode step
+
+
+def paged_table_width(max_len: int, block_size: int) -> int:
+    """Logical blocks per slot (ceil)."""
+    return -(-max_len // block_size)
+
+
+def init_paged_caches(cfg: TransformerConfig, num_slots: int,
+                      num_blocks: int, block_size: int,
+                      max_len: int) -> PagedDecodeCaches:
+    """`num_blocks` USABLE blocks; one extra scratch block (id 0) is
+    added internally, so pool ids run 0..num_blocks inclusive."""
+    w = paged_table_width(max_len, block_size)
+    shape = (cfg.n_layers, num_blocks + 1, block_size, cfg.kv_heads,
+             cfg.head_dim)
+    return PagedDecodeCaches(
+        kp=jnp.zeros(shape, cfg.dtype),
+        vp=jnp.zeros(shape, cfg.dtype),
+        block_tables=jnp.zeros((num_slots, w), jnp.int32),
+        lengths=jnp.zeros((num_slots,), jnp.int32),
+        last_token=jnp.zeros((num_slots,), jnp.int32))
+
+
+def _paged_decode_core(params: Dict[str, Any], caches: PagedDecodeCaches,
+                       active: jax.Array, cfg: TransformerConfig,
+                       attn_impl: str = "auto"
+                       ) -> Tuple[PagedDecodeCaches, jax.Array]:
+    """One decode step over the block pool (traceable).  Mirrors
+    _decode_core exactly, with the scatter routed through the block
+    table and attention through ops.paged_attention.  Safe to run extra
+    steps on retired/drained slots: their writes are clamped into their
+    own private tail blocks or redirected to scratch block 0, and their
+    garbage outputs are dropped host-side."""
+    from ray_tpu.ops import paged_attention as _pa
+
+    B = caches.lengths.shape[0]
+    bs = caches.kp.shape[2]
+    M = caches.block_tables.shape[1] * bs
+    tokens = caches.last_token[:, None]                      # [B,1]
+    pos = caches.lengths[:, None]                            # [B,1]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)        # [B,1,D]
+    if cfg.arch == "gpt2":
+        x = x + params["pos_embed"][
+            jnp.clip(pos, 0, cfg.max_seq - 1)].astype(cfg.dtype)
+    rms = cfg.arch == "llama"
+    batch_ix = jnp.arange(B)
+    # Clamp the write position for slots decoding past their
+    # allocation (drained slots kept hot by the dispatcher); the
+    # active gate below redirects inactive slots to scratch block 0.
+    pos_c = jnp.minimum(caches.lengths, M - 1)
+    blk_w = jnp.where(active,
+                      caches.block_tables[batch_ix, pos_c // bs], 0)
+    off_w = pos_c % bs
+    # Valid positions INCLUDE the token scattered this step.
+    ctx_lens = jnp.minimum(caches.lengths + 1, M)
+
+    def layer(x, inputs):
+        p, k_pool, v_pool = inputs
+        h = _norm(x, p["attn_norm"], p.get("attn_norm_b"),
+                  cfg.norm_eps, rms)
+        q, k_new, v_new = _qkv(p, h, cfg, pos)
+        gate = active[:, None, None]
+        k_pool = k_pool.at[blk_w, off_w].set(
+            jnp.where(gate, k_new[:, 0].astype(k_pool.dtype),
+                      k_pool[blk_w, off_w]))
+        v_pool = v_pool.at[blk_w, off_w].set(
+            jnp.where(gate, v_new[:, 0].astype(v_pool.dtype),
+                      v_pool[blk_w, off_w]))
+        o = _pa.paged_attention(q[:, 0], k_pool, v_pool,
+                                caches.block_tables, ctx_lens,
+                                impl=attn_impl)              # [B,H,Dh]
+        attn = jnp.einsum("bshk,hkd->bsd", o[:, None].astype(cfg.dtype),
+                          p["wo"].astype(cfg.dtype))
+        x = x + attn
+        x = _mlp(p, x, cfg)
+        return x, (k_pool, v_pool)
+
+    x, (kp_all, vp_all) = jax.lax.scan(
+        layer, x, (params["layers"], caches.kp, caches.vp))
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"),
+              cfg.norm_eps, rms)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x.astype(jnp.float32),
+        _w_out(params, cfg).astype(jnp.float32))[:, 0]       # [B,V]
+    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    new_last = jnp.where(active, next_tok, caches.last_token)
+    new_len = jnp.where(active, caches.lengths + 1, caches.lengths)
+    return PagedDecodeCaches(kp=kp_all, vp=vp_all,
+                             block_tables=caches.block_tables,
+                             lengths=new_len,
+                             last_token=new_last), next_tok
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "attn_impl"),
+                   donate_argnums=(1,))
+def paged_decode_step(params: Dict[str, Any], caches: PagedDecodeCaches,
+                      active: jax.Array, cfg: TransformerConfig,
+                      attn_impl: str = "auto"
+                      ) -> Tuple[PagedDecodeCaches, jax.Array]:
+    """One token for every slot; returns (caches', next_tokens [B])."""
+    return _paged_decode_core(params, caches, active, cfg, attn_impl)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "num_steps", "attn_impl"),
+                   donate_argnums=(1,))
+def paged_decode_steps(params: Dict[str, Any], caches: PagedDecodeCaches,
+                       active: jax.Array, cfg: TransformerConfig,
+                       num_steps: int, attn_impl: str = "auto"
+                       ) -> Tuple[PagedDecodeCaches, jax.Array]:
+    """num_steps tokens per slot in ONE dispatch (lax.scan): returns
+    (caches', tokens [num_steps, B])."""
+
+    def body(c, _):
+        return _paged_decode_core(params, c, active, cfg, attn_impl)
+
+    caches, toks = jax.lax.scan(body, caches, None, length=num_steps)
+    return caches, toks
+
+
+def _paged_prefill_core(params: Dict[str, Any],
+                        caches: PagedDecodeCaches, tokens: jax.Array,
+                        suffix_lens: jax.Array, prefix_lens: jax.Array,
+                        slots: jax.Array, valid: jax.Array,
+                        new_bt: jax.Array, cfg: TransformerConfig
+                        ) -> Tuple[PagedDecodeCaches, jax.Array]:
+    """Suffix prefill against a paged prefix (traceable).
+
+    tokens [N, P] hold only each prompt's UNCACHED suffix; the cached
+    prefix (prefix_lens tokens, whole blocks, already resident in the
+    pool via the request's block table) is attended by gather, never
+    recomputed — this is where a prefix-cache hit saves its FLOPs.
+    Suffix queries sit at absolute positions prefix_len + i (RoPE /
+    learned positions stay correct), attend all prefix positions plus
+    causally within the suffix, and their K/V are scattered into the
+    slot's private blocks.  prefix_lens == 0 degenerates to the dense
+    prefill math.  Invalid rows rewrite existing state (gather-then-
+    scatter no-op), exactly like _prefill_insert_core."""
+    N, P = tokens.shape
+    bs = caches.kp.shape[2]
+    W = caches.block_tables.shape[1]
+    M = W * bs
+    bt = caches.block_tables.at[slots].set(
+        jnp.where(valid[:, None], new_bt, caches.block_tables[slots]))
+    bt_rows = bt[slots]                                      # [N, W]
+    positions = prefix_lens[:, None] + jnp.arange(P, dtype=jnp.int32)
+    x = params["tok_embed"][tokens].astype(cfg.dtype)        # [N,P,D]
+    if cfg.arch == "gpt2":
+        x = x + params["pos_embed"][
+            jnp.clip(positions, 0, cfg.max_seq - 1)].astype(cfg.dtype)
+    rms = cfg.arch == "llama"
+    causal = (jnp.arange(P)[:, None] >= jnp.arange(P)[None, :])
+    padmask = jnp.arange(P)[None, :] < suffix_lens[:, None]  # [N,P]
+    ctx_mask = jnp.arange(M)[None, :] < prefix_lens[:, None]  # [N,M]
+    # keys layout: [0..M) gathered pool window, [M..M+P) in-flight
+    # suffix — full mask [N, P, M+P].
+    mask_full = jnp.concatenate([
+        jnp.broadcast_to(ctx_mask[:, None, :], (N, P, M)),
+        causal[None] & padmask[:, None, :],
+    ], axis=-1)
+    # Scatter targets for the suffix K/V (clamped + gated to scratch).
+    abs_pos = jnp.minimum(positions, M - 1)                  # [N,P]
+    blkidx = jnp.take_along_axis(bt_rows, abs_pos // bs, axis=1)
+    offidx = abs_pos % bs
+    wgate = valid[:, None] & padmask                         # [N,P]
+    blk_w = jnp.where(wgate, blkidx, 0)
+    groups = cfg.n_heads // cfg.kv_heads
+
+    def layer(x, inputs):
+        p, k_pool, v_pool = inputs
+        h = _norm(x, p["attn_norm"], p.get("attn_norm_b"),
+                  cfg.norm_eps, rms)
+        q, k, v = _qkv(p, h, cfg, positions)
+        k_pool = k_pool.at[blk_w, offidx].set(
+            jnp.where(wgate[..., None, None], k.astype(k_pool.dtype),
+                      k_pool[blk_w, offidx]))
+        v_pool = v_pool.at[blk_w, offidx].set(
+            jnp.where(wgate[..., None, None], v.astype(v_pool.dtype),
+                      v_pool[blk_w, offidx]))
+        # Prefix window gather (suffix positions in it are masked off).
+        k_ctx = jnp.take(k_pool, bt_rows, axis=0).reshape(
+            N, M, cfg.kv_heads, cfg.head_dim)
+        v_ctx = jnp.take(v_pool, bt_rows, axis=0).reshape(
+            N, M, cfg.kv_heads, cfg.head_dim)
+        k_all = jnp.concatenate([k_ctx.astype(k.dtype), k], axis=1)
+        v_all = jnp.concatenate([v_ctx.astype(v.dtype), v], axis=1)
+        qg = q.reshape(N, P, cfg.kv_heads, groups, cfg.head_dim)
+        s = jnp.einsum("bqhgk,bmhk->bhgqm", qg.astype(jnp.float32),
+                       k_all.astype(jnp.float32)) / (cfg.head_dim ** 0.5)
+        s = jnp.where(mask_full[:, None, None], s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhgqm,bmhk->bqhgk", w, v_all.astype(jnp.float32))
+        o = o.reshape(N, P, cfg.n_heads, cfg.head_dim).astype(cfg.dtype)
+        attn = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
+        x = x + attn
+        x = _mlp(p, x, cfg)
+        return x, (k_pool, v_pool)
+
+    x, (kp_all, vp_all) = jax.lax.scan(
+        layer, x, (params["layers"], caches.kp, caches.vp))
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"),
+              cfg.norm_eps, rms)
+    last_ix = jnp.clip(suffix_lens - 1, 0, P - 1)
+    last = x[jnp.arange(N), last_ix]                         # [N,D]
+    logits = last.astype(jnp.float32) @ _w_out(params, cfg).astype(
+        jnp.float32)
+    first_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    total = prefix_lens + suffix_lens
+    new_len = caches.lengths.at[slots].set(
+        jnp.where(valid, total, caches.lengths[slots]))
+    new_last = caches.last_token.at[slots].set(
+        jnp.where(valid, first_tok, caches.last_token[slots]))
+    return PagedDecodeCaches(kp=kp_all, vp=vp_all, block_tables=bt,
+                             lengths=new_len,
+                             last_token=new_last), first_tok
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_steps",
+                                             "prompt_pad", "attn_impl"),
+                   donate_argnums=(1,))
+def paged_prefill_decode_packed(params: Dict[str, Any],
+                                caches: PagedDecodeCaches,
+                                packed: jax.Array,
+                                cfg: TransformerConfig, num_steps: int,
+                                prompt_pad: int, attn_impl: str = "auto"
+                                ) -> Tuple[PagedDecodeCaches, jax.Array,
+                                           jax.Array]:
+    """Fused suffix-prefill + chunked decode with ALL host inputs in
+    ONE int32 upload (the paged analog of prefill_decode_packed).
+
+    packed: [N+1, Wp] int32 with W = table width and
+    Wp = max(prompt_pad + 4 + W, num_slots);
+      rows 0..N-1: [suffix_tokens[0:P] | suffix_len | prefix_len |
+                    slot | valid | block_table[0:W]]
+      row  N:      active mask for the B decode slots in cols 0..B-1.
+    """
+    P = prompt_pad
+    B = caches.lengths.shape[0]
+    W = caches.block_tables.shape[1]
+    tokens = packed[:-1, :P]
+    suffix_lens = packed[:-1, P]
+    prefix_lens = packed[:-1, P + 1]
+    slots = packed[:-1, P + 2]
+    valid = packed[:-1, P + 3] > 0
+    new_bt = packed[:-1, P + 4:P + 4 + W]
+    active = packed[-1, :B] > 0
+    caches, first = _paged_prefill_core(params, caches, tokens,
+                                        suffix_lens, prefix_lens, slots,
+                                        valid, new_bt, cfg)
+    active = active.at[slots].set(jnp.where(valid, True, active[slots]))
+
+    def body(c, _):
+        return _paged_decode_core(params, c, active, cfg, attn_impl)
+
+    caches, toks = jax.lax.scan(body, caches, None, length=num_steps)
+    return caches, first, toks

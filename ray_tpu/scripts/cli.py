@@ -296,6 +296,11 @@ def cmd_memory(args) -> int:
     for kind, cell in sorted((summary.get("by_kind") or {}).items()):
         print(f"  {kind}: {cell['count']} objects, "
               f"{_fmt_bytes(cell['bytes'])}")
+    kv = summary.get("kv_blocks") or {}
+    if kv:
+        parts = " ".join(f"{s}={int(kv.get(s, 0))}"
+                         for s in ("used", "cached", "free"))
+        print(f"paged-KV blocks (serve LLM engines): {parts}")
     group = getattr(args, "group_by", "node")
     if group == "owner":
         rows = [{"owner": (o[:16] if isinstance(o, str) else o),

@@ -119,7 +119,14 @@ def multiplexed(_fn: Optional[Callable] = None, *,
 
 
 def resident_model_ids(user_instance) -> list:
-    """Model ids currently loaded on this replica (router probe)."""
+    """Model ids currently loaded on this replica (router probe).
+
+    Two sources: @serve.multiplexed loader caches (class attributes
+    carrying __rtpu_multiplex_cache__) and an optional instance-level
+    `__rtpu_resident_models__` callable — the hook the LLM engine's
+    built-in adapter multiplexing (serve/llm.py PagedBatcher) uses to
+    report its merged-weight LRU without going through the decorator.
+    """
     out = []
     for name in dir(type(user_instance)):
         try:
@@ -129,4 +136,77 @@ def resident_model_ids(user_instance) -> list:
         cache = getattr(attr, "__rtpu_multiplex_cache__", None)
         if cache is not None:
             out.extend(cache.model_ids())
+    hook = getattr(user_instance, "__rtpu_resident_models__", None)
+    if callable(hook):
+        try:
+            out.extend(hook())
+        except Exception:
+            pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Adapter merging (the LLM engine's multiplex path)
+# ---------------------------------------------------------------------------
+def merge_adapter(base_params: dict, adapter: dict) -> dict:
+    """Merge a LoRA adapter (or raw weight deltas) into a COPY of the
+    base parameter tree — the paged LLM engine's hot-swap primitive.
+    Merged weights keep the base shapes/dtypes, so the engine's
+    compiled prefill/decode steps are reused across adapters (swap
+    cost = this merge + the weight upload, never a recompile).
+
+    Adapter spec (plain dict, typically shipped as an ObjectRef and
+    fetched over the binary transfer plane):
+
+      {"lora":  {name: (A, B), ...},   # delta = scale * A @ B
+       "delta": {name: D, ...},        # delta added verbatim
+       "scale": float (default 1.0)}
+
+    `name` resolves inside base_params["layers"] first (the stacked
+    per-layer tree: A [L, d, r] @ B [L, r, k...] via einsum), then at
+    the top level (A [d, r] @ B [r, k]).  The product is reshaped to
+    the target weight's shape, so a fused head like wq [L, d, H, Dh]
+    takes a B of [L, r, H * Dh].
+    """
+    import jax.numpy as jnp
+
+    scale = float(adapter.get("scale", 1.0))
+    layers = dict(base_params.get("layers", {}))
+    top = {k: v for k, v in base_params.items() if k != "layers"}
+
+    def _apply(tree: dict, name: str, delta) -> None:
+        w = tree[name]
+        tree[name] = (w + delta.reshape(w.shape).astype(w.dtype))
+
+    for name, (a, b) in (adapter.get("lora") or {}).items():
+        a = jnp.asarray(a)
+        b = jnp.asarray(b)
+        if name in layers:
+            if a.ndim != 3:
+                raise ValueError(
+                    f"lora factor for stacked layer weight {name!r} "
+                    f"must be [L, d, r] (got {a.shape})")
+            d = jnp.einsum("ldr,lrk->ldk", a.astype(jnp.float32),
+                           b.reshape(b.shape[0], b.shape[1], -1)
+                           .astype(jnp.float32)) * scale
+            _apply(layers, name, d)
+        elif name in top:
+            d = (a.astype(jnp.float32)
+                 @ b.reshape(b.shape[0], -1).astype(jnp.float32)) * scale
+            _apply(top, name, d)
+        else:
+            raise KeyError(f"adapter weight {name!r} not in base params")
+    # `scale` applies to the LoRA factorization only; raw deltas are
+    # added verbatim (the spec author already computed them).
+    for name, d in (adapter.get("delta") or {}).items():
+        d = jnp.asarray(d)
+        if name in layers:
+            _apply(layers, name, d)
+        elif name in top:
+            _apply(top, name, d)
+        else:
+            raise KeyError(f"adapter delta {name!r} not in base params")
+    out = dict(top)
+    if "layers" in base_params:
+        out["layers"] = layers
     return out

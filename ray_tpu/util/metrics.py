@@ -90,6 +90,20 @@ DAG_EXECUTIONS_METRIC = "ray_tpu_dag_executions_total"
 DAG_HOP_BUCKETS = (0.00001, 0.00005, 0.0001, 0.0005, 0.001, 0.005,
                    0.01, 0.05, 0.25, 1.0)
 
+# Paged-KV LLM serving plane (serve/llm.py PagedBatcher), recorded by
+# the engine thread.  kv_blocks tags: state = used (refcount > 0) |
+# cached (refcount 0, retained in the prefix radix tree) | free, plus
+# a per-engine tag (the node-side gauge merge is last-write-wins per
+# tagset; sum over engines per state for totals — engines zero their
+# series on clean stop).
+# prefix_cache hits/queries count admission-time radix lookups (hit =
+# at least one full block reused); evictions counts cached blocks
+# LRU-reclaimed back to the free pool under allocation pressure.
+KV_BLOCKS_METRIC = "ray_tpu_kv_blocks"
+PREFIX_CACHE_HITS_METRIC = "ray_tpu_prefix_cache_hits_total"
+PREFIX_CACHE_QUERIES_METRIC = "ray_tpu_prefix_cache_queries_total"
+KV_EVICTIONS_METRIC = "ray_tpu_kv_evictions_total"
+
 # Inter-node object-transfer plane, auto-recorded node-side.
 # bytes_total tags: direction = in | out.  seconds tags: path =
 # stream (windowed binary plane) | multi (range-split, several
@@ -220,6 +234,23 @@ class Gauge(_Metric):
                                 "description": self.description})
                     cell["dirty"] = False
         return out
+
+    def remove(self, tags: Optional[Dict[str, str]] = None) -> None:
+        """Drop one series' cell from this process's registry,
+        queueing a final zero sample so the node-side aggregate
+        (push-model: series are never deleted there) reads 0 rather
+        than the last live value.  For per-instance-tagged gauges
+        (e.g. the paged-KV engine series) this keeps repeated
+        construct/stop cycles from accumulating dead cells forever."""
+        global _pending
+        ts = self._tagset(tags)
+        with self._lock:
+            existed = self._cells.pop(ts, None) is not None
+        if existed:
+            with _lock:
+                _pending.append({"name": self.name, "kind": "gauge",
+                                 "tags": dict(ts), "value": 0.0,
+                                 "description": self.description})
 
 
 class Histogram(_Metric):

@@ -217,7 +217,17 @@ def memory_summary(leak_min_age_s: float = 60.0,
     * leak_suspects: READY objects at least `leak_min_age_s` old whose
       owner client is dead (nothing will ever delete them) or whose
       borrowed replica's refcount dropped to zero;
-    * objects: the `top_n` largest rows for drill-down.
+    * objects: the `top_n` largest rows for drill-down;
+    * kv_blocks: paged-KV serving block-pool occupancy
+      {used, cached, free} summed over the ray_tpu_kv_blocks gauges
+      (all engines' series) flushed to THIS node's metric aggregator
+      (empty when no paged LLM engine is running; replicas on other
+      nodes report to their own node's scrape) — HBM the serve
+      engines hold OUTSIDE the object store.  Caveat: gauges are
+      push-model, so a replica killed UNCLEANLY (SIGKILL/OOM — its
+      engine never ran stop()'s series removal) leaves its last
+      samples in the aggregate until the node restarts; nonzero
+      kv_blocks with no running engine is that artifact, not a leak.
 
     The same data serves `/api/memory` on the dashboard and the
     `ray_tpu memory` CLI table."""
@@ -278,6 +288,16 @@ def memory_summary(leak_min_age_s: float = 60.0,
     suspects.sort(key=lambda r: -(r.get("size_bytes") or 0))
     top = sorted((r for r in objs if r.get("state") == "ready"),
                  key=lambda r: -(r.get("size_bytes") or 0))[:top_n]
+    kv_blocks: Dict[str, float] = {}
+    try:
+        from ray_tpu.util import metrics as _metrics
+        for s in _metrics.scrape():
+            if s.get("name") == _metrics.KV_BLOCKS_METRIC:
+                st = (s.get("tags") or {}).get("state", "unknown")
+                kv_blocks[st] = kv_blocks.get(st, 0) + (
+                    s.get("value") or 0)
+    except Exception:
+        pass
     return {
         "total_bytes": total,
         "object_count": ready,
@@ -286,5 +306,6 @@ def memory_summary(leak_min_age_s: float = 60.0,
         "by_node": by_node,
         "leak_suspects": suspects,
         "objects": top,
+        "kv_blocks": kv_blocks,
         "unreachable_nodes": dump.get("unreachable_nodes") or [],
     }

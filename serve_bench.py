@@ -176,6 +176,202 @@ def _measure(bat, cfg, *, num_slots, decode_chunk, pipeline_depth,
     }
 
 
+def _pct(sorted_vals, q):
+    from ray_tpu.util.state import _percentile
+    return _percentile(sorted_vals, q)
+
+
+def _shared_prefix_workload(cfg, n_requests, n_lat, *, sys_len,
+                            tail_len, block_size, seed=0):
+    """The millions-of-users shape (ROADMAP open item 1): 80% of
+    requests are one of 4 long system prompts + a tiny unique tail,
+    20% are fully unique.  sys_len is block-aligned so the whole
+    system prompt is prefix-shareable.  Returns (throughput_prompts,
+    latency_prompts) drawn from the SAME system prompts, so the
+    latency phase runs against a warm cache."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    sys_len = (sys_len // block_size) * block_size
+    sys_prompts = [rng.randint(0, cfg.vocab_size,
+                               size=(sys_len,)).tolist()
+                   for _ in range(4)]
+
+    def draw():
+        if rng.random() < 0.8:
+            return sys_prompts[rng.randint(4)] + rng.randint(
+                0, cfg.vocab_size, size=(tail_len,)).tolist()
+        return rng.randint(0, cfg.vocab_size,
+                           size=(sys_len + tail_len,)).tolist()
+
+    return ([draw() for _ in range(n_requests)],
+            [draw() for _ in range(n_lat)])
+
+
+def _ttft_split(results):
+    hits = sorted(r["ttft_s"] for r in results if r["cache_hit"])
+    misses = sorted(r["ttft_s"] for r in results if not r["cache_hit"])
+    cell = lambda xs: {  # noqa: E731
+        "n": len(xs),
+        "p50_ms": round(_pct(xs, 0.50) * 1e3, 1) if xs else None,
+        "p95_ms": round(_pct(xs, 0.95) * 1e3, 1) if xs else None}
+    return {"hit": cell(hits), "miss": cell(misses)}
+
+
+def _measure_shared_prefix(bat, prompts, lat_prompts, max_new,
+                           n_clients):
+    """Two phases over the shared-prefix workload.
+
+    Throughput: open-loop saturation — all requests submitted up front
+    (the >= 48-concurrent-clients shape without 48 Python threads on a
+    1-vCPU host).  TTFT under saturation is queue-position, so it is
+    NOT reported from this phase.
+
+    Latency: n_clients closed-loop clients against the now-warm prefix
+    cache — the TTFT a user actually sees, split by cache_hit (this is
+    where a hit's suffix-only narrow prefill shows up).  On CPU one
+    client keeps the serial host from charging concurrent decode
+    compute to TTFT; on TPU extra decode width is near-free, so 4."""
+    bat.generate(prompts[0][:8], max_new=2)   # compile warmup
+    t0 = time.time()
+    reqs = [bat.submit(p, max_new=max_new) for p in prompts]
+    for r in reqs:
+        if not r.done.wait(600):
+            raise TimeoutError("shared_prefix run stalled")
+        if r.error is not None:
+            raise r.error
+    wall = time.time() - t0
+    total_tokens = sum(len(r.tokens) for r in reqs)
+
+    lat_results = []
+    lock = threading.Lock()
+    work = list(lat_prompts)
+
+    def client():
+        while True:
+            with lock:
+                if not work:
+                    return
+                p = work.pop()
+            out = bat.generate(p, max_new=max_new, timeout=600)
+            with lock:
+                lat_results.append(out)
+
+    threads = [threading.Thread(target=client)
+               for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    out = {
+        "requests": len(reqs),
+        "wall_s": round(wall, 2),
+        "decode_tokens_per_s": round(total_tokens / wall, 1),
+        "ttft_load": f"{n_clients} closed-loop clients (unsaturated), "
+                     f"{len(lat_results)} samples, warm cache",
+        "ttft_p50_ms": round(_pct(sorted(
+            r["ttft_s"] for r in lat_results), 0.50) * 1e3, 1),
+        "ttft_p95_ms": round(_pct(sorted(
+            r["ttft_s"] for r in lat_results), 0.95) * 1e3, 1),
+        "ttft_by_cache_hit": _ttft_split(lat_results),
+        "finish_reasons": {
+            fr: sum(1 for r in reqs if r.finish_reason == fr)
+            for fr in sorted({r.finish_reason for r in reqs})},
+    }
+    stats = getattr(bat, "kv_stats", None)
+    if stats is not None:
+        st = stats()
+        pc = st["prefix_cache"]
+        out["prefix_cache"] = {
+            "hit_ratio": round(pc["hits"] / max(pc["queries"], 1), 3),
+            "queries": pc["queries"],
+            "hits": pc["hits"],
+            "hit_tokens": pc["hit_tokens"],
+            "evictions": pc["evictions"],
+            "cached_blocks": pc["cached_blocks"],
+        }
+        out["kv_blocks"] = st["blocks"]
+    return out
+
+
+def _run_shared_prefix(cfg, params, label, dev, on_tpu) -> dict:
+    """Paged vs dense at KV-MEMORY PARITY: the dense engine provisions
+    max_len positions per slot, so a fixed HBM budget caps its slot
+    count; the paged engine spends the SAME budget as a block pool and
+    runs more slots because requests only hold blocks for tokens they
+    actually have (and 80% of them SHARE their system-prompt blocks).
+    The win measured here is the paged-KV thesis: more concurrency and
+    earlier admission per byte of KV, not a faster kernel."""
+    from ray_tpu.serve.llm import ContinuousBatcher, PagedBatcher
+
+    block = 16
+    if on_tpu:
+        # max_len must cover prompt (192+8) + max_new (64) = 264 with
+        # one cap-margin position to spare, or every request truncates
+        # with finish_reason "cache" and the tok/s compare is bogus.
+        dense_slots, paged_slots, max_len = 16, 48, 288
+        chunk, depth, max_new, n_requests = 16, 3, 64, 256
+        prompt_pad, sys_len, tail_len = 224, 192, 8
+    else:
+        dense_slots, paged_slots, max_len = 4, 8, 128
+        chunk, depth, max_new, n_requests = 4, 2, 16, 48
+        prompt_pad, sys_len, tail_len = 64, 48, 4
+    kv_budget_blocks = dense_slots * (max_len // block)
+    n_clients = 4 if on_tpu else 1
+    n_lat = 96 if on_tpu else 24
+    prompts, lat_prompts = _shared_prefix_workload(
+        cfg, n_requests, n_lat, sys_len=sys_len, tail_len=tail_len,
+        block_size=block)
+    engines = {}
+    dense = ContinuousBatcher(params, cfg, num_slots=dense_slots,
+                              max_len=max_len, prompt_pad=prompt_pad,
+                              decode_chunk=chunk, pipeline_depth=depth)
+    try:
+        engines["dense"] = {
+            "num_slots": dense_slots, "max_len": max_len,
+            "kv_positions": dense_slots * max_len,
+            **_measure_shared_prefix(dense, prompts, lat_prompts,
+                                     max_new, n_clients)}
+    finally:
+        dense.stop()
+    paged = PagedBatcher(params, cfg, num_slots=paged_slots,
+                         max_len=max_len, prompt_pad=prompt_pad,
+                         decode_chunk=chunk, pipeline_depth=depth,
+                         kv_block_size=block,
+                         kv_num_blocks=kv_budget_blocks)
+    try:
+        engines["paged"] = {
+            "num_slots": paged_slots, "max_len": max_len,
+            "kv_block_size": block, "kv_num_blocks": kv_budget_blocks,
+            "kv_positions": kv_budget_blocks * block,
+            **_measure_shared_prefix(paged, prompts, lat_prompts,
+                                     max_new, n_clients)}
+    finally:
+        paged.stop()
+    d, p = engines["dense"], engines["paged"]
+    hit_p50 = p["ttft_by_cache_hit"]["hit"]["p50_ms"]
+    return {
+        "metric": "serve_shared_prefix",
+        "scenario": "shared_prefix (80% of requests share one of 4 "
+                    "long system prompts)",
+        "model": label,
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+        "platform": "tpu" if on_tpu else "cpu",
+        "kv_budget_note": "both engines hold the same KV positions; "
+                          "dense spends them as per-slot max_len "
+                          "slabs, paged as a shared block pool",
+        "engines": engines,
+        "paged_vs_dense": {
+            "decode_tps_speedup": round(
+                p["decode_tokens_per_s"]
+                / max(d["decode_tokens_per_s"], 1e-9), 2),
+            "ttft_p50_cache_hit_vs_dense": (
+                round(hit_p50 / max(d["ttft_p50_ms"], 1e-9), 3)
+                if hit_p50 is not None else None),
+        },
+    }
+
+
 def main() -> None:
     """Retry-once wrapper: a tunnel that probes healthy can still wedge
     between the probe and first device use (the round-3/4 evidence-loss
@@ -222,6 +418,25 @@ def _run() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     cfg, params, label = _build(model)
+
+    if os.environ.get("SERVE_SCENARIO") == "shared_prefix":
+        out = _run_shared_prefix(cfg, params, label, dev, on_tpu)
+        rnd = os.environ.get("SERVE_ROUND", "r07")
+        # Platform is recorded IN the JSON, so a CPU capture is a
+        # legitimate record for this scenario (paged-vs-dense at
+        # memory parity is an engine property, not a device one).
+        with open(f"SERVE_BENCH_{rnd}.json", "w") as f:
+            json.dump(out, f, indent=1)
+        if on_tpu:
+            # Own last-good key: this record is shaped {engines: ...},
+            # not the default serve-bench payload — writing it under
+            # lg_name would clobber the default scenario's regression
+            # record (and get emitted as its stale fallback).
+            hwprobe.record_last_good(
+                hwprobe.lg_name("SERVE_BENCH_SHARED_PREFIX", model,
+                                "gpt2s"), out)
+        print(json.dumps(out))
+        return
 
     slots = int(os.environ.get("SERVE_SLOTS", 16 if on_tpu else 4))
     chunk = int(os.environ.get("SERVE_CHUNK", 16 if on_tpu else 4))

@@ -62,6 +62,10 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.GCS_RESYNC_SECONDS_METRIC)
     assert _NAME.match(metrics.DAG_HOP_SECONDS_METRIC)
     assert _NAME.match(metrics.DAG_EXECUTIONS_METRIC)
+    assert _NAME.match(metrics.KV_BLOCKS_METRIC)
+    assert _NAME.match(metrics.PREFIX_CACHE_HITS_METRIC)
+    assert _NAME.match(metrics.PREFIX_CACHE_QUERIES_METRIC)
+    assert _NAME.match(metrics.KV_EVICTIONS_METRIC)
     assert metrics.DAG_EXECUTIONS_METRIC.endswith("_total")
     # hop_seconds is a histogram — no _total.
     assert not metrics.DAG_HOP_SECONDS_METRIC.endswith("_total")
@@ -76,6 +80,12 @@ def test_declared_builtin_names_are_legal():
     assert metrics.EVENTS_DROPPED_METRIC.endswith("_total")
     # The by-kind store gauge is a gauge, not a counter — no _total.
     assert not metrics.OBJECT_STORE_BYTES_METRIC.endswith("_total")
+    # Paged-KV serving: hits/queries/evictions are counters, the
+    # block-occupancy-by-state metric is a gauge.
+    assert metrics.PREFIX_CACHE_HITS_METRIC.endswith("_total")
+    assert metrics.PREFIX_CACHE_QUERIES_METRIC.endswith("_total")
+    assert metrics.KV_EVICTIONS_METRIC.endswith("_total")
+    assert not metrics.KV_BLOCKS_METRIC.endswith("_total")
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
                metrics.OBJECT_TRANSFER_BUCKETS,
                metrics.DRAIN_DURATION_BUCKETS,

@@ -1,0 +1,653 @@
+"""Paged-KV serving tests: block allocator invariants, radix prefix
+cache, LRU eviction, paged-vs-dense decode numerics (JAX reference
+path), backpressure/finish-reason semantics, and multiplexed per-model
+prefix-cache isolation (serve/llm.py PagedBatcher +
+ops/paged_attention.py)."""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.llm import (BlockAllocator, ContinuousBatcher,
+                               PagedBatcher, RadixCache)
+
+
+def _tiny_cfg():
+    import jax.numpy as jnp
+    from ray_tpu.models.transformer import TransformerConfig
+    return TransformerConfig(vocab_size=97, d_model=32, n_heads=4,
+                             n_kv_heads=2, n_layers=2, d_ff=64,
+                             max_seq=128, dtype=jnp.float32,
+                             remat=False)
+
+
+def _tiny_params(seed=0):
+    import jax
+    from ray_tpu.models import transformer
+    return transformer.init_params(_tiny_cfg(), jax.random.PRNGKey(seed))
+
+
+def _paged(params, cfg, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("prompt_pad", 16)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("pipeline_depth", 2)
+    kw.setdefault("kv_block_size", 4)
+    return PagedBatcher(params, cfg, **kw)
+
+
+# ===========================================================================
+# BlockAllocator
+# ===========================================================================
+def test_allocator_alloc_free_refcount():
+    a = BlockAllocator(8)
+    assert a.available() == 8
+    blocks = a.alloc(3)
+    assert len(blocks) == 3 and len(set(blocks)) == 3
+    assert 0 not in blocks                    # scratch block never issued
+    assert a.available() == 5
+    assert all(a.refcount(b) == 1 for b in blocks)
+    # Share one block: refcount 2, one decref keeps it used.
+    a.incref(blocks[0])
+    assert a.refcount(blocks[0]) == 2
+    a.decref(blocks[0])
+    assert a.refcount(blocks[0]) == 1
+    assert a.counts() == {"used": 3, "cached": 0, "free": 5}
+    for b in blocks:
+        a.decref(b)
+    assert a.counts() == {"used": 0, "cached": 0, "free": 8}
+
+
+def test_allocator_double_free_raises():
+    a = BlockAllocator(2)
+    (b,) = a.alloc(1)
+    a.decref(b)
+    with pytest.raises(RuntimeError, match="double-free"):
+        a.decref(b)
+
+
+def test_allocator_never_partial():
+    a = BlockAllocator(4)
+    held = a.alloc(3)
+    assert a.alloc(2) is None                 # only 1 left: all-or-nothing
+    assert a.available() == 1                 # nothing leaked by the miss
+    assert a.alloc(1) is not None
+    assert held is not None
+
+
+def test_allocator_cached_state_transitions():
+    a = BlockAllocator(4)
+    (b,) = a.alloc(1)
+    a.mark_cached(b)
+    # Still referenced: used, not cached.
+    assert a.counts() == {"used": 1, "cached": 0, "free": 3}
+    a.decref(b)                               # refcount 0 + cached: retained
+    assert a.counts() == {"used": 0, "cached": 1, "free": 3}
+    a.incref(b)                               # prefix hit re-uses it
+    assert a.counts() == {"used": 1, "cached": 0, "free": 3}
+    a.decref(b)
+    a.release_cached(b)                       # eviction returns it
+    assert a.counts() == {"used": 0, "cached": 0, "free": 4}
+
+
+def test_allocator_no_leak_random_lifecycles():
+    """N random request lifecycles (alloc / share / cache / evict /
+    free in random order) conserve blocks exactly: used + cached +
+    free == num_blocks at every step, all free at the end."""
+    rng = random.Random(7)
+    a = BlockAllocator(32)
+    live = []                                 # [(blocks, cached_flags)]
+    cached_pool = []                          # refcount-0 cached blocks
+    for step in range(400):
+        c = a.counts()
+        assert c["used"] + c["cached"] + c["free"] == 32, (step, c)
+        op = rng.random()
+        if op < 0.35:                         # admit: maybe share a cached
+            share = [b for b in cached_pool if rng.random() < 0.5]
+            fresh = a.alloc(rng.randint(1, 4))
+            if fresh is None:
+                continue
+            for b in share:
+                a.incref(b)
+                cached_pool.remove(b)
+            live.append((share + fresh, share[:]))
+        elif op < 0.7 and live:               # retire: maybe cache blocks
+            blocks, shared = live.pop(rng.randrange(len(live)))
+            for b in blocks:
+                if b not in shared and rng.random() < 0.3:
+                    a.mark_cached(b)
+                    shared.append(b)
+            for b in blocks:
+                a.decref(b)
+            for b in shared:
+                if a.refcount(b) == 0 and b not in cached_pool:
+                    cached_pool.append(b)
+        elif cached_pool:                     # evict a cached block
+            b = cached_pool.pop(rng.randrange(len(cached_pool)))
+            a.release_cached(b)
+    for blocks, shared in live:
+        for b in blocks:
+            a.decref(b)
+        for b in shared:
+            if a.refcount(b) == 0:
+                a.release_cached(b)
+            cached_pool.append(b)
+    for b in cached_pool:
+        a.release_cached(b)
+    assert a.counts() == {"used": 0, "cached": 0, "free": 32}
+
+
+# ===========================================================================
+# RadixCache
+# ===========================================================================
+def test_radix_hit_miss_partial():
+    a = BlockAllocator(16)
+    tree = RadixCache(block_size=4)
+    toks = list(range(1, 13))                 # 3 full blocks
+    blocks = a.alloc(3)
+    assert tree.insert(toks, blocks, a) == 3
+    # Full-prefix hit -- but capped at len-1 so a suffix always remains:
+    assert tree.match(toks) == blocks[:2]
+    assert tree.match(toks + [99]) == blocks  # one more token: all 3 hit
+    # Partial prefix: first block shared, divergence stops the walk.
+    assert tree.match(toks[:4] + [55, 56, 57, 58, 99]) == blocks[:1]
+    # Miss from the first token.
+    assert tree.match([70, 71, 72, 73, 74]) == []
+    # Sub-block prompts can never hit (only FULL blocks shareable).
+    assert tree.match(toks[:4]) == []
+
+
+def test_radix_insert_collision_keeps_existing():
+    a = BlockAllocator(16)
+    tree = RadixCache(block_size=2)
+    b1 = a.alloc(1)
+    b2 = a.alloc(1)
+    assert tree.insert([1, 2], b1, a) == 1
+    assert tree.insert([1, 2], b2, a) == 0    # duplicate path: no new node
+    assert tree.match([1, 2, 3]) == b1        # existing node wins
+    assert a.refcount(b2[0]) == 1             # caller keeps its private copy
+
+
+def test_radix_eviction_lru_leaf_only_respects_refcounts():
+    """LRU eviction order over refcount-0 leaves; a block some request
+    still references is NEVER evicted, and interior nodes are only
+    evictable once their children are gone (prefix property)."""
+    a = BlockAllocator(16)
+    tree = RadixCache(block_size=2)
+    blocks = a.alloc(3)
+    tree.insert([1, 2, 3, 4, 5, 6], blocks, a)      # one chain of 3
+    other = a.alloc(1)
+    tree.insert([9, 9], other, a)                   # separate branch
+    for b in blocks + other:
+        a.decref(b)                                 # all cached now
+    tree.match([9, 9, 0])                           # touch: most recent
+    # Only leaves are candidates: the chain tail + the other branch.
+    cands = sorted(tree.evictable())
+    assert {n.block for _, n in cands} == {blocks[2], other[0]}
+    # Oldest leaf first == the chain tail (match() touched `other`).
+    assert cands[0][1].block == blocks[2]
+    # A referenced leaf must survive any eviction sweep.
+    a.incref(other[0])
+    protected = [(t, n) for t, n in tree.evictable()
+                 if a.refcount(n.block) == 0]
+    assert {n.block for _, n in protected} == {blocks[2]}
+    tree.remove_leaf(protected[0][1], a)
+    assert blocks[2] in a._free and other[0] not in a._free
+    # Its parent became a leaf -> now evictable; walk the chain down.
+    assert {n.block for _, n in tree.evictable()
+            if a.refcount(n.block) == 0} == {blocks[1]}
+    with pytest.raises(RuntimeError):
+        tree.remove_leaf(tree.root, a)
+
+
+def test_radix_shared_clock_orders_lru_across_models():
+    """Per-model trees share ONE LRU clock, so eviction recency is
+    comparable across models: a high-traffic model's stale block must
+    sort older than a low-traffic model's just-touched block (per-tree
+    ticks would evict the low-traffic model's hot prefix first)."""
+    import itertools
+    a = BlockAllocator(8)
+    counter = itertools.count(1)
+    t1 = RadixCache(2, clock=lambda: next(counter))
+    t2 = RadixCache(2, clock=lambda: next(counter))
+    b1 = a.alloc(1)
+    t1.insert([1, 2], b1, a)
+    for _ in range(5):                  # heavy traffic on model 1
+        t1.match([1, 2, 9])
+    b2 = a.alloc(1)
+    t2.insert([3, 4], b2, a)            # model 2: one FRESH block
+    for b in b1 + b2:
+        a.decref(b)
+    cands = sorted((last, node) for tree in (t1, t2)
+                   for last, node in tree.evictable())
+    # Globally-oldest is model 1's block (touched before model 2's
+    # insert) even though its per-tree tick count is far higher.
+    assert cands[0][1].block == b1[0]
+
+
+def test_eviction_pressure_never_clobbers_shared_blocks():
+    """End-to-end pressure: a pool sized for ~1.5 requests forces the
+    engine to LRU-evict the previous request's cached prefix while the
+    current one still holds blocks; every request must still finish
+    with exact greedy tokens (shared blocks never clobbered)."""
+    import jax
+    from ray_tpu.models import transformer
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    bat = _paged(params, cfg, num_slots=2, max_len=32,
+                 kv_block_size=4, kv_num_blocks=4)
+    try:
+        outs = {}
+        for i in range(6):
+            p = [10 * (i % 3) + 1, 2, 3, 4, 5]    # 3 distinct prompts
+            outs.setdefault(i % 3, []).append(
+                bat.generate(p, max_new=6, timeout=120)["tokens"])
+        for runs in outs.values():
+            assert all(r == runs[0] for r in runs), runs
+        st = bat.kv_stats()
+        assert st["prefix_cache"]["evictions"] > 0
+        c = st["blocks"]
+        assert c["used"] + c["cached"] + c["free"] == bat.num_blocks
+    finally:
+        bat.stop()
+
+
+# ===========================================================================
+# Numerics: paged == dense on the JAX reference path
+# ===========================================================================
+def test_paged_attention_reference_matches_dense_math():
+    """Gather-based paged attention == dense attention over the same
+    (contiguously laid out) KV, for ragged context lengths."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.paged_attention import paged_attention_reference
+    B, H, HKV, D, BS, W = 3, 4, 2, 16, 4, 5
+    NB = 1 + B * W
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, H, D), jnp.float32)
+    kp = jax.random.normal(k2, (NB, BS, HKV, D), jnp.float32)
+    vp = jax.random.normal(k3, (NB, BS, HKV, D), jnp.float32)
+    bt = (1 + np.arange(B * W, dtype=np.int32)).reshape(B, W)
+    lens = np.asarray([3, 11, 20], np.int32)
+    out = paged_attention_reference(q, kp, vp, jnp.asarray(bt),
+                                    jnp.asarray(lens))
+    # Dense oracle: materialize each row's window and do plain attention.
+    kd = np.asarray(kp)[bt].reshape(B, W * BS, HKV, D)
+    vd = np.asarray(vp)[bt].reshape(B, W * BS, HKV, D)
+    groups = H // HKV
+    qg = np.asarray(q).reshape(B, HKV, groups, D)
+    s = np.einsum("bhgk,bmhk->bhgm", qg, kd) / np.sqrt(D)
+    mask = np.arange(W * BS)[None, :] < lens[:, None]
+    s = np.where(mask[:, None, None, :], s, -np.inf)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    want = np.einsum("bhgm,bmhk->bhgk", w, vd).reshape(B, H, D)
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+
+
+def test_paged_attention_kernel_matches_reference():
+    """Pallas kernel (interpret mode off-TPU) == gather reference."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.paged_attention import (paged_attention_kernel,
+                                             paged_attention_reference)
+    B, H, HKV, D, BS, W = 2, 4, 2, 16, 4, 4
+    NB = 1 + B * W
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(k1, (B, H, D), jnp.float32)
+    kp = jax.random.normal(k2, (NB, BS, HKV, D), jnp.float32)
+    vp = jax.random.normal(k3, (NB, BS, HKV, D), jnp.float32)
+    rng = np.random.RandomState(0)
+    bt = rng.permutation(np.arange(1, NB, dtype=np.int32)).reshape(B, W)
+    lens = np.asarray([6, 15], np.int32)
+    ref = paged_attention_reference(q, kp, vp, jnp.asarray(bt),
+                                    jnp.asarray(lens))
+    out = paged_attention_kernel(q, kp, vp, jnp.asarray(bt),
+                                 jnp.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_paged_decode_matches_dense_decode_step():
+    """paged_decode_step == decode_step logits/tokens for the same
+    model state (the tier-1 CPU reference-path parity check)."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import decoding, transformer
+    cfg = _tiny_cfg()
+    params = _tiny_params(seed=3)
+    num_slots, max_len, bs = 2, 32, 4
+    prompts = [[5, 9, 11, 2], [60, 2, 8]]
+    # Dense: packed prefill + N decode steps.
+    dense = decoding.init_caches(cfg, num_slots, max_len)
+    W = max_len // bs
+    paged = decoding.init_paged_caches(cfg, num_slots,
+                                       num_slots * W, bs, max_len)
+    P = 8
+    packed_d = np.zeros((num_slots + 1, max(P + 3, num_slots)), np.int32)
+    packed_p = np.zeros((num_slots + 1,
+                         max(P + 4 + W, num_slots)), np.int32)
+    for row, p in enumerate(prompts):
+        packed_d[row, :len(p)] = p
+        packed_d[row, P:P + 3] = (len(p), row, 1)
+        packed_p[row, :len(p)] = p
+        packed_p[row, P] = len(p)          # suffix == whole prompt
+        packed_p[row, P + 1] = 0           # no cached prefix
+        packed_p[row, P + 2:P + 4] = (row, 1)
+        packed_p[row, P + 4:P + 4 + W] = np.arange(
+            1 + row * W, 1 + (row + 1) * W)
+    packed_d[num_slots, :num_slots] = 0
+    packed_p[num_slots, :num_slots] = 0
+    steps = 6
+    dense, fd, td = decoding.prefill_decode_packed(
+        params, dense, jnp.asarray(packed_d), cfg, steps, P)
+    paged, fp, tp = decoding.paged_prefill_decode_packed(
+        params, paged, jnp.asarray(packed_p), cfg, steps, P,
+        attn_impl="reference")
+    np.testing.assert_array_equal(np.asarray(fd), np.asarray(fp))
+    np.testing.assert_array_equal(np.asarray(td), np.asarray(tp))
+    np.testing.assert_array_equal(np.asarray(dense.lengths),
+                                  np.asarray(paged.lengths))
+
+
+def test_paged_engine_matches_dense_engine_and_oracle():
+    """End-to-end: PagedBatcher greedy tokens == ContinuousBatcher ==
+    full-forward oracle, including a prefix-cache-hit re-run."""
+    import jax
+    from ray_tpu.models import transformer
+    cfg = _tiny_cfg()
+    params = _tiny_params(seed=0)
+    prompts = [[5, 9, 11], [3], [60, 2, 8, 40, 7]]
+    dense = ContinuousBatcher(params, cfg, num_slots=2, max_len=48,
+                              prompt_pad=16, decode_chunk=4)
+    paged = _paged(params, cfg)
+    try:
+        outs_d = [dense.generate(p, max_new=8, timeout=120)
+                  for p in prompts]
+        outs_p = [paged.generate(p, max_new=8, timeout=120)
+                  for p in prompts]
+        # Re-run: the 5-token prompt now hits its cached first block.
+        hit = paged.generate(prompts[2], max_new=8, timeout=120)
+        assert hit["cache_hit"] and hit["cached_tokens"] == 4
+    finally:
+        dense.stop()
+        paged.stop()
+    for p, od, op in zip(prompts, outs_d, outs_p):
+        assert od["tokens"] == op["tokens"], (p, od["tokens"],
+                                              op["tokens"])
+        seq = list(p)
+        for _ in range(8):
+            logits = transformer.forward(
+                params, np.asarray([seq], np.int32), cfg)
+            seq.append(int(np.argmax(np.asarray(logits[0, -1]))))
+        assert op["tokens"] == seq[len(p):]
+    assert hit["tokens"] == outs_p[2]["tokens"]
+
+
+# ===========================================================================
+# Backpressure + finish-reason "cache" semantics
+# ===========================================================================
+def test_kv_exhaustion_queues_then_completes():
+    """Transient pool exhaustion QUEUES requests for blocks instead of
+    killing them: with a pool fitting ~one request, N concurrent
+    requests all finish with reason length, never "cache"."""
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    # 5 usable blocks of 4 = 20 positions; each request needs
+    # ceil((5 + 8)/4) = 4 blocks, so two can never run concurrently.
+    bat = _paged(params, cfg, num_slots=2, max_len=32,
+                 kv_block_size=4, kv_num_blocks=5, prefix_cache=False)
+    try:
+        reqs = [bat.submit([i, 2, 3, 4, 5], max_new=8)
+                for i in range(4)]
+        for r in reqs:
+            assert r.done.wait(120)
+            assert r.error is None
+            assert r.finish_reason == "length", r.finish_reason
+            assert len(r.tokens) == 8
+        c = bat.kv_stats()["blocks"]
+        assert c == {"used": 0, "cached": 0, "free": 5}
+    finally:
+        bat.stop()
+
+
+def test_oversized_request_reports_cache():
+    """finish-reason "cache" is reserved for a single request that can
+    NEVER fit (exceeds the whole pool or its block table)."""
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    bat = _paged(params, cfg, num_slots=2, max_len=32,
+                 kv_block_size=4, kv_num_blocks=3)
+    try:
+        # Needs ceil((5 + 24)/4) = 8 > 3 total blocks -> rejected, but
+        # pool pressure alone never reports "cache" (prior test).
+        req = bat.submit([1, 2, 3, 4, 5], max_new=24)
+        assert req.done.wait(120)
+        assert req.finish_reason == "cache"
+        assert req.tokens == []
+        # The pool is untouched and the engine still serves.
+        out = bat.generate([1, 2, 3], max_new=4, timeout=120)
+        assert out["finish_reason"] == "length"
+    finally:
+        bat.stop()
+
+
+def test_request_capped_by_table_width_truncates_with_cache():
+    """A request whose allocation is clamped to its table width decodes
+    to the cap and reports "cache" (the dense-engine semantic kept for
+    the one case it still means something)."""
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    bat = _paged(params, cfg, num_slots=2, max_len=16, kv_block_size=4,
+                 kv_num_blocks=16, prompt_pad=8)
+    try:
+        req = bat.submit([1, 2, 3, 4, 5], max_new=64)
+        assert req.done.wait(120)
+        assert req.finish_reason == "cache"
+        # Decoded to the table cap: 16 positions - 5 prompt = 11.
+        assert len(req.tokens) == 11
+    finally:
+        bat.stop()
+
+
+def test_unaligned_max_len_caps_at_max_len_not_table():
+    """max_len that is NOT a block multiple: the per-request cap stays
+    at max_len (regression: it was table_width*block_size, letting
+    requests decode into the rounding slack past max_len and
+    potentially past cfg.max_seq)."""
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    bat = _paged(params, cfg, num_slots=2, max_len=10, kv_block_size=4,
+                 kv_num_blocks=16, prompt_pad=8)
+    try:
+        req = bat.submit([1, 2, 3, 4, 5], max_new=64)
+        assert req.done.wait(120)
+        assert req.finish_reason == "cache"
+        # 10 positions - 5 prompt = 5, NOT table cap 12 - 5 = 7.
+        assert len(req.tokens) == 5
+    finally:
+        bat.stop()
+
+
+# ===========================================================================
+# Multiplexing
+# ===========================================================================
+def test_multiplex_adapter_swap_isolates_prefix_caches():
+    """Two adapters through one engine: per-model radix trees never
+    cross (same prompt, different model -> different tokens, no
+    cross-model cache_hit on first use), and swaps are LRU-resident."""
+    import jax
+    import jax.numpy as jnp
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    # A large delta on the output head changes greedy argmax.
+    d = np.zeros((cfg.d_model, cfg.vocab_size), np.float32)
+    rng = np.random.RandomState(5)
+    d[:, :] = rng.randn(cfg.d_model, cfg.vocab_size) * 0.5
+    adapters = {"m1": {"delta": {"tok_embed": np.zeros(
+        (cfg.vocab_size, cfg.d_model), np.float32)}},
+        "m2": {"delta": {"tok_embed": rng.randn(
+            cfg.vocab_size, cfg.d_model).astype(np.float32) * 0.5}}}
+    bat = _paged(params, cfg, adapters=adapters)
+    try:
+        prompt = [7, 8, 9, 10, 11]
+        base = bat.generate(prompt, max_new=6, timeout=120)
+        m1 = bat.generate(prompt, max_new=6, timeout=120,
+                          model_id="m1")
+        m2 = bat.generate(prompt, max_new=6, timeout=120,
+                          model_id="m2")
+        # m1's adapter is a zero delta == base numerics; m2 differs.
+        assert m1["tokens"] == base["tokens"]
+        assert m2["tokens"] != base["tokens"]
+        # First use per model never cache-hits across models even
+        # though the BASE model already cached this exact prompt.
+        assert base["cache_hit"] is False
+        assert m1["cache_hit"] is False and m2["cache_hit"] is False
+        # Second pass per model: each hits ITS OWN tree, tokens stable.
+        m2b = bat.generate(prompt, max_new=6, timeout=120,
+                           model_id="m2")
+        assert m2b["cache_hit"] and m2b["tokens"] == m2["tokens"]
+        baseb = bat.generate(prompt, max_new=6, timeout=120)
+        assert baseb["cache_hit"] and baseb["tokens"] == base["tokens"]
+        assert set(bat.resident_models()) == {"m1", "m2"}
+        st = bat.kv_stats()
+        assert st["model_id"] == ""            # base was last active
+    finally:
+        bat.stop()
+
+
+def test_multiplex_unknown_model_fails_request_not_engine():
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    bat = _paged(params, cfg, adapters={})
+    try:
+        with pytest.raises(KeyError):
+            bat.generate([1, 2, 3], max_new=4, timeout=120,
+                         model_id="nope")
+        out = bat.generate([1, 2, 3], max_new=4, timeout=120)
+        assert out["finish_reason"] == "length"
+    finally:
+        bat.stop()
+
+
+def test_kv_metrics_recorded():
+    """Engine activity lands in the registered metric cells: the
+    block-state gauges (the series state.memory_summary() folds into
+    kv_blocks) sum to the pool size and the query/hit counters move.
+    Cells are read directly — no runtime client in this test, so
+    nothing has drained them."""
+    from ray_tpu.serve.llm import _get_kv_metrics
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    km = _get_kv_metrics()
+    assert km is not None
+    before_q = sum(c["delta"] for c in km["queries"]._cells.values())
+    before_h = sum(c["delta"] for c in km["hits"]._cells.values())
+    bat = _paged(params, cfg, kv_num_blocks=16)
+    try:
+        bat.generate([1, 2, 3, 4, 5], max_new=4, timeout=120)
+        hit = bat.generate([1, 2, 3, 4, 5], max_new=4, timeout=120)
+        assert hit["cache_hit"]
+        # Series are tagged per engine (so co-located engines don't
+        # clobber each other); THIS engine's states sum to its pool.
+        gauges = {dict(ts)["state"]: cell["value"]
+                  for ts, cell in km["blocks"]._cells.items()
+                  if dict(ts).get("engine") == bat._engine_tag}
+    finally:
+        bat.stop()
+    assert set(gauges) >= {"used", "cached", "free"}
+    assert gauges["used"] + gauges["cached"] + gauges["free"] == 16
+    # A cleanly-stopped engine REMOVES its per-engine series (no dead
+    # cells accumulating across construct/stop cycles), queueing one
+    # final zero sample per state for the node-side aggregate.
+    stopped = {dict(ts)["state"]: cell["value"]
+               for ts, cell in km["blocks"]._cells.items()
+               if dict(ts).get("engine") == bat._engine_tag}
+    assert stopped == {}
+    from ray_tpu.util import metrics as _metrics
+    zeros = [s for s in _metrics._pending
+             if s["name"] == _metrics.KV_BLOCKS_METRIC
+             and s["tags"].get("engine") == bat._engine_tag]
+    assert len(zeros) == 3 and all(s["value"] == 0.0 for s in zeros)
+    d_q = sum(c["delta"] for c in km["queries"]._cells.values()) \
+        - before_q
+    d_h = sum(c["delta"] for c in km["hits"]._cells.values()) \
+        - before_h
+    assert d_h >= 1
+    assert d_q >= d_h
+
+
+def test_engine_failure_flushes_prefix_cache():
+    """An engine failure drops the whole prefix cache (regression:
+    _post_admit inserts blocks at launch, so a dispatch that fails
+    device-side left cached blocks holding never-written KV — a later
+    prefix hit decoded garbage).  After the flush the same prompt must
+    MISS, re-prefill, and still produce the exact pre-failure tokens;
+    the pool must conserve."""
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    bat = _paged(params, cfg, kv_num_blocks=16)
+    try:
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        before = bat.generate(prompt, max_new=6, timeout=120)
+        hit = bat.generate(prompt, max_new=6, timeout=120)
+        assert hit["cache_hit"] is True
+        # Processor-thread-style engine failure.
+        bat._fail_all(RuntimeError("injected device failure"))
+        time.sleep(0.3)            # dispatcher consumes parked error
+        assert bat.kv_stats()["blocks"]["cached"] == 0
+        after = bat.generate(prompt, max_new=6, timeout=120)
+        assert after["cache_hit"] is False       # cache was flushed
+        assert after["tokens"] == before["tokens"]
+        c = bat.kv_stats()["blocks"]
+        assert c["used"] + c["cached"] + c["free"] == bat.num_blocks
+    finally:
+        bat.stop()
+
+
+def test_multiplex_single_resident_model_swaps():
+    """max_resident_models=1: the eviction sweep must never evict the
+    adapter being swapped IN (regression: it deleted the just-loaded
+    entry and the activation KeyError'd, permanently failing every
+    multiplexed request)."""
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    rng = np.random.RandomState(5)
+    adapters = {"m1": {"delta": {"tok_embed": np.zeros(
+        (cfg.vocab_size, cfg.d_model), np.float32)}},
+        "m2": {"delta": {"tok_embed": rng.randn(
+            cfg.vocab_size, cfg.d_model).astype(np.float32) * 0.5}}}
+    bat = _paged(params, cfg, adapters=adapters, max_resident_models=1)
+    try:
+        prompt = [7, 8, 9, 10, 11]
+        base = bat.generate(prompt, max_new=6, timeout=120)
+        m1 = bat.generate(prompt, max_new=6, timeout=120,
+                          model_id="m1")
+        m2 = bat.generate(prompt, max_new=6, timeout=120,
+                          model_id="m2")
+        assert m1["tokens"] == base["tokens"]   # zero delta == base
+        assert m2["tokens"] != base["tokens"]
+        # Cap of 1 holds: base is pinned, only the active adapter stays.
+        assert set(bat.resident_models()) == {"m2"}
+        # Swap back: m1 reloads from its spec and still decodes right.
+        m1b = bat.generate(prompt, max_new=6, timeout=120,
+                           model_id="m1")
+        assert m1b["tokens"] == m1["tokens"]
+    finally:
+        bat.stop()
+
+
+def test_dense_engine_rejects_model_id():
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    bat = ContinuousBatcher(params, cfg, num_slots=2, max_len=48,
+                            prompt_pad=16)
+    try:
+        with pytest.raises(ValueError, match="paged engine"):
+            bat.submit([1, 2, 3], model_id="m1")
+    finally:
+        bat.stop()

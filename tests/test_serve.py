@@ -142,17 +142,32 @@ def _tiny_cfg():
                              remat=False)
 
 
-def test_continuous_batcher_matches_full_forward():
+def _make_batcher(paged, params, cfg, num_slots, max_len,
+                  prompt_pad=16):
+    """Either engine behind one knob, mirroring LLMDeployment's
+    paged_kv flag (the dense engine is the paged_kv=False escape
+    hatch for one release — both must serve identically)."""
+    from ray_tpu.serve.llm import ContinuousBatcher, PagedBatcher
+    if paged:
+        return PagedBatcher(params, cfg, num_slots=num_slots,
+                            max_len=max_len, prompt_pad=prompt_pad,
+                            kv_block_size=4)
+    return ContinuousBatcher(params, cfg, num_slots=num_slots,
+                             max_len=max_len, prompt_pad=prompt_pad)
+
+
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["dense", "paged"])
+def test_continuous_batcher_matches_full_forward(paged):
     """Greedy decode through the KV-cache engine == greedy decode via
-    repeated full forward passes (the no-cache oracle)."""
+    repeated full forward passes (the no-cache oracle), in BOTH the
+    paged and dense (escape-hatch) modes."""
     import jax
     from ray_tpu.models import transformer
-    from ray_tpu.serve.llm import ContinuousBatcher
 
     cfg = _tiny_cfg()
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-    bat = ContinuousBatcher(params, cfg, num_slots=4, max_len=64,
-                            prompt_pad=16)
+    bat = _make_batcher(paged, params, cfg, num_slots=4, max_len=64)
     prompts = [[5, 9, 11], [3], [60, 2, 8, 40, 7]]
     outs = [bat.generate(p, max_new=8) for p in prompts]
     bat.stop()
@@ -169,16 +184,17 @@ def test_continuous_batcher_matches_full_forward():
         assert out["tokens"] == want, (prompt, out["tokens"], want)
 
 
-def test_continuous_batcher_concurrent_slots():
-    """Interleaved requests (continuous batching) decode correctly."""
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["dense", "paged"])
+def test_continuous_batcher_concurrent_slots(paged):
+    """Interleaved requests (continuous batching) decode correctly in
+    both engine modes."""
     import jax
     from ray_tpu.models import transformer
-    from ray_tpu.serve.llm import ContinuousBatcher
 
     cfg = _tiny_cfg()
     params = transformer.init_params(cfg, jax.random.PRNGKey(1))
-    bat = ContinuousBatcher(params, cfg, num_slots=2, max_len=64,
-                            prompt_pad=16)
+    bat = _make_batcher(paged, params, cfg, num_slots=2, max_len=64)
     # 5 concurrent requests through 2 slots forces queueing + slot reuse.
     reqs = [bat.submit([i + 1, i + 2], max_new=6) for i in range(5)]
     for r in reqs:

@@ -93,7 +93,9 @@ def test_http_sse_streaming(serve_session):
     assert events[-1]["event"] == "end"
 
 
-def test_llm_engine_stream_matches_generate(serve_session):
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["dense", "paged"])
+def test_llm_engine_stream_matches_generate(serve_session, paged):
     from ray_tpu.models import transformer
     import jax
     cfg = transformer.TransformerConfig(
@@ -101,9 +103,13 @@ def test_llm_engine_stream_matches_generate(serve_session):
         arch="llama", remat=False, xent_chunk=None,
         attn_impl="reference")
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-    from ray_tpu.serve.llm import ContinuousBatcher
-    bat = ContinuousBatcher(params, cfg, num_slots=2, max_len=48,
-                            prompt_pad=8)
+    from ray_tpu.serve.llm import ContinuousBatcher, PagedBatcher
+    if paged:
+        bat = PagedBatcher(params, cfg, num_slots=2, max_len=48,
+                           prompt_pad=8, kv_block_size=4)
+    else:
+        bat = ContinuousBatcher(params, cfg, num_slots=2, max_len=48,
+                                prompt_pad=8)
     try:
         ref_out = bat.generate([1, 2, 3], max_new=6)
         streamed = list(bat.generate_stream([1, 2, 3], max_new=6))
@@ -112,13 +118,15 @@ def test_llm_engine_stream_matches_generate(serve_session):
         bat.stop()
 
 
-def test_llm_deployment_streams_tokens(serve_session):
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["dense", "paged"])
+def test_llm_deployment_streams_tokens(serve_session, paged):
     from ray_tpu.serve.llm import LLMDeployment
     dep = serve.deployment(LLMDeployment).bind(
         cfg_kwargs=dict(vocab_size=128, d_model=64, n_layers=2,
                         n_heads=2, max_seq=64, arch="llama",
                         remat=False, attn_impl="reference"),
-        num_slots=2, max_len=48, prompt_pad=8)
+        num_slots=2, max_len=48, prompt_pad=8, paged_kv=paged)
     h = serve.run(dep, name="llm")
     # Generous timeouts: under a full parallel suite on the 1-vCPU
     # host, engine warmup compiles contend with every other test.
