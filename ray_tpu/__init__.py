@@ -15,6 +15,16 @@ import time
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+# Concurrency sanitizer: must install BEFORE the runtime modules
+# below create their module/instance locks, or they escape
+# instrumentation.  Env-gated (never config: workers inherit the
+# env).  locksan imports stdlib only, so the unconditional import is
+# cheap and keeps the flag parse in one place.
+from ray_tpu.devtools import locksan as _locksan
+
+if _locksan.enabled():
+    _locksan.install()
+
 from ray_tpu._private.config import config
 from ray_tpu import exceptions
 from ray_tpu.object_ref import ObjectRef

@@ -286,7 +286,11 @@ class ChaosController:
             self._refresh_locked(force=True)
 
     def _match(self, site: str) -> List[FaultSpec]:
-        return [s for s in self._env_specs + self._runtime_specs
+        # Deliberately lock-free (hot path, every protocol message):
+        # the spec lists are only rebound or appended to under the
+        # lock — list reads under the GIL never crash on either, and
+        # a one-message-stale schedule view is within contract.
+        return [s for s in self._env_specs + self._runtime_specs  # ray-tpu: noqa[RT010]
                 if s.site == site or s.site == "*"]
 
     # -- recording ------------------------------------------------------

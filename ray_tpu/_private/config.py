@@ -310,3 +310,18 @@ _D("lint_mode", str, "warn",
    "Decoration-time static analysis on @remote/@actor (devtools/lint): "
    "'warn' emits RayTpuLintWarning, 'error' raises LintError, 'off' "
    "disables the check.")
+# The lock sanitizer itself has NO config knob on purpose: it is
+# enabled ONLY by the RAY_TPU_LOCKSAN env var, read at `import
+# ray_tpu` (devtools/locksan.py) — _system_config is applied far too
+# late to instrument import-time locks and would not inherit into
+# spawned node/worker processes, so a config switch would be a
+# silent no-op trap.
+_D("lock_hold_warn_ms", float, 500.0,
+   "Locksan: a lock held longer than this is recorded as a long-hold "
+   "finding (site, duration, holder stack) in the locksan report — "
+   "the live counterpart of lint rule RT011's "
+   "blocking-call-under-lock class.")
+_D("locksan_dir", str, "",
+   "Locksan: directory where each process drops its <pid>.json "
+   "report for `ray_tpu locksan` / state.locksan_report() to merge "
+   "(default /tmp/ray_tpu_locksan; RAY_TPU_LOCKSAN_DIR overrides).")

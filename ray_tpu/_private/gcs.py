@@ -515,11 +515,16 @@ class GlobalControlState:
                       transfer_port: int,
                       resources_total: Dict[str, float]) -> None:
         with self._lock:
-            self._nodes[node_id] = NodeInfo(
+            info = NodeInfo(
                 node_id, host, control_port, transfer_port, resources_total)
+            self._nodes[node_id] = info
             self._log("node_reg", node_id, host, control_port,
                       transfer_port, dict(resources_total))
-        self._publish_node("node_added", self._nodes[node_id].to_dict())
+            snapshot = info.to_dict()
+        # Publish the snapshot taken under the lock: re-reading
+        # self._nodes[node_id] here raced a concurrent health-check
+        # reap (KeyError on the conn thread) — an RT010 self-finding.
+        self._publish_node("node_added", snapshot)
 
     def resync_node(self, node_id: bytes, host: str, control_port: int,
                     transfer_port: int,

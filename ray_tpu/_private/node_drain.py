@@ -121,7 +121,10 @@ class DrainMixin:
         notice — file-based (GCE metadata shims / tests write it) or
         the seeded chaos kind `preempt` — and, while draining, sweep
         stragglers (work that arrived after the first handback pass)."""
-        if self.draining:
+        # Racy-but-benign bool probe (rebound under self.lock at
+        # _begin_drain): one 0.25s-tick-stale read just delays the
+        # sweep a tick; the handback itself takes the lock.
+        if self.draining:  # ray-tpu: noqa[RT010]
             try:
                 self._drain_handback_tasks()
             except Exception:

@@ -257,8 +257,11 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         if self.multinode:
             self._start_multinode()
         self._start_agent()     # per-node dashboard agent (node_agent)
-        for _ in range(config.worker_pool_prestart):
-            self._spawn_worker(tpu=False)
+        # The accept/monitor threads are already running here: worker
+        # prestart mutates self.workers like any other spawn path.
+        with self.lock:
+            for _ in range(config.worker_pool_prestart):
+                self._spawn_worker(tpu=False)
 
     def shutdown(self) -> None:
         with self.lock:
@@ -1045,6 +1048,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         ctx.reply(m, {"ok": True})
 
     def _object_ready(self, oid: bytes) -> bool:
+        """Caller holds self.lock."""
         e = self.objects.get(oid)
         return e is not None and e.state in (READY, FAILED)
 
@@ -1069,6 +1073,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                          creator_pid: int = 0,
                          foreign: bool = False,
                          owner: Optional[bytes] = None) -> None:
+        """Register/overwrite an object directory entry.  Caller
+        holds self.lock."""
         if loc == "shm" and creator_pid and creator_pid != os.getpid():
             # Adopt the creator's pin into the directory's ledger so
             # reaping the (possibly dead) creator leaves it pinned.
@@ -1446,6 +1452,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             self._decref(m["object_id"])
 
     def _delete_object(self, oid: bytes, e: ObjectEntry) -> None:
+        """Caller holds self.lock."""
         e.deleted = True
         e.data = None
         self.objects.pop(oid, None)
@@ -1487,6 +1494,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             self._decref(dep)
 
     def _decref(self, oid: bytes) -> None:
+        """Caller holds self.lock."""
         e = self.objects.get(oid)
         if e is None:
             return
@@ -1582,7 +1590,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                         item = _q.get(timeout=5.0)
                     except queue.Empty:
                         # Reap the drainer once its conn is gone.
-                        if _ctx not in self._conns:
+                        # Lock-free membership probe: list scans are
+                        # GIL-safe and a stale answer only costs one
+                        # extra 5s idle loop.
+                        if _ctx not in self._conns:  # ray-tpu: noqa[RT010]
                             return
                         continue
                     req, job = item
@@ -1997,6 +2008,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         ctx.reply(m, {"ok": True})
 
     def _on_actor_created(self, rec: TaskRecord, failed: bool) -> None:
+        """Caller holds self.lock."""
         actor = self.actors.get(rec.actor_id)
         if actor is None:
             return
@@ -2027,6 +2039,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         self._maybe_release_actor(actor)
 
     def _enqueue_actor_task(self, rec: TaskRecord) -> None:
+        """Caller holds self.lock."""
         actor = self.actors.get(rec.actor_id)
         if actor is None and self.multinode:
             # A call routed here on a stale home hint after the actor
@@ -2963,6 +2976,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
     def _find_idle_worker(self, tpu: bool,
                           image: Optional[str] = None
                           ) -> Optional[WorkerHandle]:
+        """Caller holds self.lock."""
         for w in self.workers.values():
             if (w.state == "idle" and w.tpu == tpu
                     and w.actor_id is None and w.image == image):
@@ -2971,6 +2985,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
 
     def _maybe_spawn(self, tpu: bool,
                      image: Optional[str] = None) -> None:
+        """Caller holds self.lock."""
         from ray_tpu._private.container import image_of
         starting = sum(1 for w in self.workers.values()
                        if w.state == "starting" and w.tpu == tpu
@@ -2991,6 +3006,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
     def _spawn_worker(self, tpu: bool,
                       image: Optional[str] = None
                       ) -> Optional[WorkerHandle]:
+        """Caller holds self.lock."""
         self._next_worker_seq += 1
         worker_id = os.urandom(16)
         env = dict(os.environ)
@@ -3111,6 +3127,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
     def _handle_worker_death(self, w: WorkerHandle, reason: str,
                              actor_already_handled: bool = False,
                              oom: bool = False) -> None:
+        """Caller holds self.lock."""
         if w.state == "dead":
             return
         if w.state == "starting":
@@ -3157,6 +3174,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 self._on_actor_worker_death(actor, reason)
 
     def _on_actor_worker_death(self, actor: ActorRecord, reason: str) -> None:
+        """Caller holds self.lock."""
         # Fail or retry in-flight calls; restart if budget remains.  An
         # exit announced via exit_actor() keeps its intentional reason.
         if actor.intentional_exit:
@@ -3241,6 +3259,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                                   teardown_worker=False)
 
     def _fail_task_returns(self, rec: TaskRecord, error: Exception) -> None:
+        """Caller holds self.lock."""
         blob = ser.dumps(error)
         rec.state = "done"
         self._emit_lifecycle(rec, prof=None, failed=True)
@@ -3377,8 +3396,14 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                              cb: Callable[[], None]) -> None:
         """Register a timeout callback for the monitor to fire.  Wakes
         the monitor when the deadline lands inside the current tick so
-        sub-50ms get/wait timeouts are honored precisely."""
-        self._deadline_waiters.append((deadline, cb))
+        sub-50ms get/wait timeouts are honored precisely.
+
+        Takes self.lock itself (reentrant — most callers already hold
+        it): the monitor REBINDS _deadline_waiters under the lock each
+        sweep, so an unlocked append can land on the superseded list
+        and silently never fire (an RT010 self-finding)."""
+        with self.lock:
+            self._deadline_waiters.append((deadline, cb))
         if deadline - time.time() < 0.05:
             self._monitor_wake.set()
 

@@ -470,8 +470,8 @@ class StreamChannelMixin:
                     except Exception:
                         pass
 
-                self._deadline_waiters.append(
-                    (time.time() + block_ms / 1000.0, expire))
+                self._add_deadline_waiter(
+                    time.time() + block_ms / 1000.0, expire)
 
     def _h_chan_close(self, ctx: _ConnCtx, m: dict) -> None:
         dst = m["dst"]

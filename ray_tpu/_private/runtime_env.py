@@ -111,10 +111,17 @@ def _ensure_extracted(archive: dict, session_dir: str) -> str:
     from ray_tpu.object_ref import ObjectRef
 
     dest = os.path.join(session_dir, "runtime_envs", archive["hash"])
+    if os.path.isdir(dest):
+        return dest
+    # Fetch OUTSIDE the lock (an RT011 self-finding): a blocking get
+    # under _extract_lock convoys every other task on this worker
+    # behind one slow pull — and can deadlock outright if the pull
+    # needs this worker's attention.  Double-checked under the lock;
+    # a redundant fetch is cheap, a held-lock fetch is not.
+    blob = ray_tpu.get(ObjectRef._from_wire(archive["ref"]))
     with _extract_lock:
         if os.path.isdir(dest):
             return dest
-        blob = ray_tpu.get(ObjectRef._from_wire(archive["ref"]))
         tmp = dest + f".tmp.{os.getpid()}"
         with zipfile.ZipFile(io.BytesIO(blob)) as z:
             z.extractall(tmp)

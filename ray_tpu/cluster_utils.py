@@ -139,16 +139,26 @@ class Cluster:
             raise RuntimeError(
                 "kill_gcs without persist_dir would lose the cluster "
                 "for good; construct Cluster(persist_dir=...)")
+        # In-process teardown runs OUTSIDE the lock: blocking in here
+        # convoyed every gcs_status() poller behind the shutdown (an
+        # RT011 self-finding); restart_gcs's port-retry loop already
+        # tolerates a server mid-teardown.  The external reap stays
+        # UNDER the lock: restart_gcs's early-return relies on never
+        # observing a SIGKILLed-but-unreaped child (poll() would still
+        # be None and it would skip the respawn, leaving the control
+        # plane down for good) — and reaping a SIGKILLed process is
+        # prompt, so the convoy concern doesn't apply.
+        server = None
         with self._gcs_lock:
             if self.external_gcs:
                 proc = self._gcs_proc
                 if proc is not None and proc.poll() is None:
                     os.kill(proc.pid, signal.SIGKILL)
-                    proc.wait(timeout=10)
+                    proc.wait(timeout=10)  # ray-tpu: noqa[RT011]
             else:
                 server, self._server = self._server, None
-                if server is not None:
-                    server.shutdown()
+        if server is not None:
+            server.shutdown()
 
     def restart_gcs(self) -> None:
         """Bring the GCS back on the SAME port, recovering hard state
@@ -174,7 +184,10 @@ class Cluster:
                 except OSError:
                     if time.time() >= deadline:
                         raise
-                    time.sleep(0.1)
+                    # Port-release retry must stay serialized vs a
+                    # concurrent kill/restart — holding the lock
+                    # through the backoff is the point.
+                    time.sleep(0.1)  # ray-tpu: noqa[RT011]
             self._server.start()
 
     def _chaos_supervisor_loop(self) -> None:

@@ -28,6 +28,41 @@ def rule_table_text() -> str:
     return "\n".join(lines)
 
 
+def _run_lock_graph(args) -> int:
+    """`ray_tpu lint --lock-graph <paths>`: dump RT012's lock-order
+    graph for humans.  Nodes are lock identities (Class.attr, unified
+    across a class hierarchy, or module.name); an edge A -> B means
+    some code path acquires B while holding A.  Exit 1 when a cycle
+    (potential deadlock) exists, 0 otherwise."""
+    import json as _json
+
+    from ray_tpu.devtools.lint.rules import build_lock_graph
+    try:
+        mods, errors = engine.load_modules(args.paths)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    graph = build_lock_graph(mods)
+    if args.format == "json":
+        print(_json.dumps(dict(graph, errors=errors), indent=1))
+    else:
+        print(f"lock-order graph: {len(graph['nodes'])} lock(s), "
+              f"{len(graph['edges'])} ordered edge(s)")
+        for e in graph["edges"]:
+            print(f"  {e['from']} -> {e['to']}  (x{e['count']}, "
+                  f"first at {e['site']})")
+        if graph["cycles"]:
+            print(f"\nCYCLES ({len(graph['cycles'])}) — potential "
+                  f"deadlocks:")
+            for comp in graph["cycles"]:
+                print("  " + " <-> ".join(comp))
+        else:
+            print("\nno cycles: a global acquisition order exists")
+        for err in errors:
+            print(f"error: {err}", file=sys.stderr)
+    return 1 if graph["cycles"] else 0
+
+
 def add_arguments(parser) -> None:
     parser.add_argument("paths", nargs="+",
                         help="files or directories to lint")
@@ -44,12 +79,20 @@ def add_arguments(parser) -> None:
     parser.add_argument("--rel-root", default=None,
                         help="root paths are reported/keyed relative "
                              "to (default: cwd)")
+    parser.add_argument("--lock-graph", action="store_true",
+                        dest="lock_graph",
+                        help="print the package-wide lock-"
+                             "acquisition-order graph (RT012's "
+                             "input) instead of linting; exit 1 if "
+                             "the graph has a cycle")
 
 
 def run(args) -> int:
     rel_root = os.path.abspath(args.rel_root or os.getcwd())
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
+    if getattr(args, "lock_graph", False):
+        return _run_lock_graph(args)
     try:
         res = engine.lint_paths(args.paths, select=select)
     except (FileNotFoundError, KeyError) as e:

@@ -64,20 +64,27 @@ def _relpath(path: str, rel_root: Optional[str]) -> str:
 @dataclass
 class Rule:
     """One lint rule: an id, a one-line summary, and a checker run over
-    a parsed module."""
+    a parsed module.  A rule may additionally declare a
+    `project_finalize` that runs once over EVERY parsed module after
+    the per-file pass — for whole-package properties (RT012's
+    lock-order graph) that no single file can decide."""
     rule_id: str
     summary: str
     check: Callable[["SourceModule"], Iterable[Finding]]
     doc: str = ""
+    project_finalize: Optional[
+        Callable[[List["SourceModule"]], Iterable[Finding]]] = None
 
 
 _REGISTRY: Dict[str, Rule] = {}
 
 
-def register(rule_id: str, summary: str, doc: str = ""):
+def register(rule_id: str, summary: str, doc: str = "",
+             project_finalize=None):
     """Decorator registering a checker function as a rule."""
     def deco(fn):
-        _REGISTRY[rule_id] = Rule(rule_id, summary, fn, doc or summary)
+        _REGISTRY[rule_id] = Rule(rule_id, summary, fn, doc or summary,
+                                  project_finalize)
         return fn
     return deco
 
@@ -251,16 +258,7 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return list(dict.fromkeys(out))
 
 
-def lint_source(source: str, path: str = "<string>",
-                select: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Run (selected) rules over one source string; noqa applied."""
-    res = LintResult()
-    _lint_one(source, path, select, res)
-    return res.findings
-
-
-def _lint_one(source: str, path: str,
-              select: Optional[Sequence[str]], res: LintResult) -> None:
+def _select_rules(select: Optional[Sequence[str]]) -> Dict[str, Rule]:
     rules = all_rules()
     if select:
         sel = {s.upper() for s in select}
@@ -268,13 +266,51 @@ def _lint_one(source: str, path: str,
         if unknown:
             raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
         rules = {k: v for k, v in rules.items() if k in sel}
+    return rules
+
+
+def load_modules(paths: Sequence[str]
+                 ) -> tuple:
+    """Parse every python file under `paths` into SourceModules.
+    Returns (modules, errors); unreadable/unparsable files become
+    error strings.  Shared by lint_paths and the CLI's --lock-graph
+    dump so the iterate/open/parse/error handling exists once."""
+    mods: List[SourceModule] = []
+    errors: List[str] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            errors.append(f"{path}: {e}")
+            continue
+        try:
+            mods.append(SourceModule(path, source))
+        except SyntaxError as e:
+            errors.append(f"{path}: syntax error: {e}")
+    return mods, errors
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run (selected) rules over one source string; noqa applied."""
+    res = LintResult()
+    rules = _select_rules(select)
     try:
         mod = SourceModule(path, source)
     except SyntaxError as e:
         res.errors.append(f"{path}: syntax error: {e}")
-        return
-    res._line_cache[path] = mod.lines
+        return res.findings
     noqa = noqa_codes_by_line(source)
+    _check_module(mod, rules, noqa, res)
+    _finalize_project(rules, [mod], {path: noqa}, res)
+    return res.findings
+
+
+def _check_module(mod: SourceModule, rules: Dict[str, Rule],
+                  noqa: Dict[int, Optional[set]],
+                  res: LintResult) -> None:
+    res._line_cache[mod.path] = mod.lines
     for rule in rules.values():
         for f in rule.check(mod):
             if _suppressed(f, noqa):
@@ -283,17 +319,33 @@ def _lint_one(source: str, path: str,
                 res.findings.append(f)
 
 
+def _finalize_project(rules: Dict[str, Rule],
+                      mods: List[SourceModule],
+                      noqa_by_path: Dict[str, Dict[int, Optional[set]]],
+                      res: LintResult) -> None:
+    """Run the whole-package finalizers (RT012-style rules) over every
+    module parsed this run; per-file noqa still suppresses."""
+    for rule in rules.values():
+        if rule.project_finalize is None:
+            continue
+        for f in rule.project_finalize(mods):
+            if _suppressed(f, noqa_by_path.get(f.path, {})):
+                res.suppressed += 1
+            else:
+                res.findings.append(f)
+
+
 def lint_paths(paths: Sequence[str],
                select: Optional[Sequence[str]] = None) -> LintResult:
     res = LintResult()
-    for path in iter_python_files(paths):
-        try:
-            with open(path, encoding="utf-8") as f:
-                source = f.read()
-        except (OSError, UnicodeDecodeError) as e:
-            res.errors.append(f"{path}: {e}")
-            continue
-        _lint_one(source, path, select, res)
+    rules = _select_rules(select)
+    mods, errors = load_modules(paths)
+    res.errors.extend(errors)
+    noqa_by_path: Dict[str, Dict[int, Optional[set]]] = {}
+    for mod in mods:
+        noqa_by_path[mod.path] = noqa = noqa_codes_by_line(mod.source)
+        _check_module(mod, rules, noqa, res)
+    _finalize_project(rules, mods, noqa_by_path, res)
     res.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
     return res
 

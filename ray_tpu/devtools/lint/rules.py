@@ -11,7 +11,9 @@ time) live in `decoration.py`.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from ray_tpu._private.options import (ACTOR_OPTIONS, TASK_OPTIONS,
                                       suggest)
@@ -807,3 +809,693 @@ def check_rt009(mod: SourceModule) -> Iterable[Finding]:
                         f"a compiled DAG but calls ray_tpu.get() — "
                         f"blocking inside the pinned executor loop "
                         f"wedges the graph")
+
+
+# ---------------------------------------------------------------------------
+# RT010-RT012 — concurrency discipline (shared lock analysis)
+# ---------------------------------------------------------------------------
+# The three rules share one model of "what is a lock":
+#   * an attribute assigned from a lock constructor (self._x = Lock()),
+#   * or an attribute whose NAME says lock (self.lock, self._conn_lock,
+#     self._pull_cond) — needed because mixin classes acquire locks
+#     their host class constructs in another file.
+_LOCK_CTOR_FULL = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "multiprocessing.Lock", "multiprocessing.RLock",
+    "ray_tpu.devtools.locksan.SanLock",
+}
+_LOCK_ATTR_RE = re.compile(r"(?:^|_)(?:lock|cond|mutex|mu)$")
+
+
+def _lockish_name(name: str) -> bool:
+    return bool(_LOCK_ATTR_RE.search(name))
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _self_lock_item(expr: ast.AST, lock_attrs: Set[str]
+                    ) -> Optional[str]:
+    """`self.<attr>` when <attr> is a known/lock-named attribute."""
+    if _is_self_attr(expr) and (expr.attr in lock_attrs
+                                or _lockish_name(expr.attr)):
+        return f"self.{expr.attr}"
+    return None
+
+
+def _any_lock_item(expr: ast.AST, lock_attrs: Set[str],
+                   local_locks: Set[str]) -> Optional[str]:
+    """Lock display name for ANY with-item that acquires a lock:
+    self attrs, lock-named globals/locals, and names assigned from a
+    lock constructor in this file."""
+    got = _self_lock_item(expr, lock_attrs)
+    if got:
+        return got
+    name = _dotted_name(expr)
+    if name is None:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    if name in local_locks or _lockish_name(tail):
+        return name
+    return None
+
+
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+    "setdefault", "sort",
+}
+
+
+def _is_mutating_use(mod: SourceModule, node: ast.Attribute) -> bool:
+    """Does this `self._x` access mutate the attribute (rebind it,
+    store/del through it, or call a container mutator on it)?"""
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True
+    parent = mod.parent.get(node)
+    if isinstance(parent, ast.Subscript) \
+            and isinstance(parent.ctx, (ast.Store, ast.Del)):
+        return True
+    if isinstance(parent, ast.Attribute) \
+            and parent.attr in _MUTATOR_METHODS:
+        gp = mod.parent.get(parent)
+        if isinstance(gp, ast.Call) and gp.func is parent:
+            return True
+    return False
+
+
+def _method_docstring(fn: ast.AST) -> str:
+    try:
+        return ast.get_docstring(fn) or ""
+    except TypeError:
+        return ""
+
+
+_HOLDS_DOC_RE = re.compile(r"caller\s+(?:must\s+)?holds?\b",
+                           re.IGNORECASE)
+
+
+_INIT_NAME_RE = re.compile(r"(?:^|_)init(?:_|$)")
+
+
+class _MethodCtx:
+    """Lexical context of one method body for the lock rules."""
+
+    __slots__ = ("fn", "exempt", "whole_guarded")
+
+    def __init__(self, fn) -> None:
+        self.fn = fn
+        # Construction/destruction runs before/after the object is
+        # shared — bare accesses there are not races.  Mixin classes
+        # follow the same convention with named init helpers
+        # (`_native_init`, `_init_drain_state`) called from the host
+        # class's __init__.
+        self.exempt = (fn.name in ("__init__", "__new__", "__del__")
+                       or bool(_INIT_NAME_RE.search(fn.name)))
+        # Repo convention: `_foo_locked` helpers (and methods whose
+        # docstring says "Caller holds ...") run with the lock held.
+        self.whole_guarded = (
+            fn.name.endswith("_locked")
+            or bool(_HOLDS_DOC_RE.search(_method_docstring(fn))))
+
+
+def _class_lock_attrs(cls: ast.ClassDef,
+                      imports: Dict[str, str],
+                      mod: Optional[SourceModule] = None) -> Set[str]:
+    """Attributes of `cls` assigned from a lock constructor."""
+    if mod is not None:
+        cache = _mod_cached(mod, "rt_lock_attrs", dict)
+        got = cache.get(id(cls))
+        if got is not None:
+            return got
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and _is_self_attr(node.targets[0]) \
+                and isinstance(node.value, ast.Call) \
+                and _call_name(node.value, imports) in _LOCK_CTOR_FULL:
+            out.add(node.targets[0].attr)
+    if mod is not None:
+        cache[id(cls)] = out
+    return out
+
+
+def _init_only_methods(cls: ast.ClassDef) -> Set[str]:
+    """Method names reachable ONLY from __init__/__new__/__del__
+    within this class — construction-phase helpers (_load_snapshot,
+    _replay) whose bare attribute accesses are not races because the
+    object is not yet shared."""
+    exempt_roots = {"__init__", "__new__", "__del__"}
+    calls: Dict[str, Set[str]] = {}
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))}
+    for name, fn in methods.items():
+        callees: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and _is_self_attr(node.func) \
+                    and node.func.attr in methods:
+                callees.add(node.func.attr)
+        calls[name] = callees
+    # callers-of map, then: a method is init-only if every caller is
+    # init-only and it has at least one caller (unreferenced methods
+    # are entry points — assume shared-phase).
+    callers: Dict[str, Set[str]] = {n: set() for n in methods}
+    for caller, callees in calls.items():
+        for callee in callees:
+            callers[callee].add(caller)
+    init_only: Set[str] = set(exempt_roots & set(methods))
+    changed = True
+    while changed:
+        changed = False
+        for name in methods:
+            if name in init_only or not callers[name]:
+                continue
+            if all(c in init_only for c in callers[name]):
+                init_only.add(name)
+                changed = True
+    return init_only
+
+
+def _guard_of(mod: SourceModule, node: ast.AST, stop: ast.AST,
+              lock_attrs: Set[str]) -> Optional[str]:
+    """Nearest enclosing `with self.<lock>` between node and `stop`
+    (the method def), or None."""
+    cur = mod.parent.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                got = _self_lock_item(item.context_expr, lock_attrs)
+                if got:
+                    return got
+        cur = mod.parent.get(cur)
+    return None
+
+
+def _module_lock_names(mod: SourceModule,
+                       imports: Dict[str, str]) -> Set[str]:
+    """Bare names assigned from a lock constructor anywhere in the
+    file (module globals like `_lock = threading.RLock()` and
+    function-locals alike)."""
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _call_name(node.value, imports) in _LOCK_CTOR_FULL:
+            out.add(node.targets[0].id)
+    return out
+
+
+@register(
+    "RT010", "attribute guarded by a lock elsewhere is accessed bare",
+    "Per class, infers which attributes are predominantly read/written "
+    "under a `with self.<lock>` block and flags bare accesses of the "
+    "same attribute from other methods — the cross-thread mutation "
+    "class (iterating a dict another thread mutates, check-then-act "
+    "on shared maps).  Construction (__init__) is exempt; so are "
+    "`_locked`-suffixed helpers and methods whose docstring says "
+    "'Caller holds ...' (the repo's held-lock conventions).  Fires "
+    "only when the attribute is mutated somewhere and >=75% of its "
+    "accesses are lock-guarded.")
+def check_rt010(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        lock_attrs = _class_lock_attrs(cls, imports, mod)
+        init_only = _init_only_methods(cls)
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # attr -> list of (node, guard name | None, mutating, method)
+        accesses: Dict[str, List[tuple]] = {}
+        saw_lock_with = False
+        for fn in methods:
+            ctx = _MethodCtx(fn)
+            if fn.name in init_only:
+                ctx.exempt = True
+            # A method that CONSTRUCTS the class's lock is the
+            # construction phase of everything that lock guards
+            # (mixin `_start_*` helpers building their own state).
+            if not ctx.exempt and any(
+                    isinstance(n, ast.Assign)
+                    and len(n.targets) == 1
+                    and _is_self_attr(n.targets[0])
+                    and n.targets[0].attr in lock_attrs
+                    for n in ast.walk(fn)):
+                ctx.exempt = True
+            for node in ast.walk(fn):
+                if not _is_self_attr(node) \
+                        or not isinstance(node.ctx,
+                                          (ast.Load, ast.Store,
+                                           ast.Del)):
+                    continue
+                attr = node.attr
+                if attr in lock_attrs or _lockish_name(attr) \
+                        or attr.startswith("__"):
+                    continue
+                if ctx.exempt:
+                    continue
+                guard = _guard_of(mod, node, fn, lock_attrs)
+                if guard:
+                    saw_lock_with = True
+                elif ctx.whole_guarded:
+                    guard = "<held-lock convention>"
+                accesses.setdefault(attr, []).append(
+                    (node, guard, _is_mutating_use(mod, node), fn))
+        if not saw_lock_with:
+            continue
+        for attr, uses in accesses.items():
+            guarded = [u for u in uses if u[1]]
+            bare = [u for u in uses if not u[1]]
+            if len(guarded) < 2 or not bare:
+                continue
+            if not any(u[2] for u in uses):
+                continue           # read-only attribute: no race
+            if len(guarded) / (len(guarded) + len(bare)) < 0.75:
+                continue
+            # The lock that predominantly guards this attribute.
+            names = [u[1] for u in guarded
+                     if u[1] != "<held-lock convention>"]
+            lock = max(set(names), key=names.count) if names \
+                else "the class lock"
+            for node, _, mutating, fn in bare:
+                verb = "mutated" if mutating else "read"
+                yield mod.finding(
+                    "RT010", node,
+                    f"attribute {attr!r} of {cls.name!r} is guarded "
+                    f"by {lock} in {len(guarded)} place(s) but {verb} "
+                    f"bare in {fn.name!r} — cross-thread access "
+                    f"without the lock")
+
+
+_RT011_FULL_CALLS = {
+    "time.sleep": "time.sleep() under a lock convoys every waiter",
+    "ray_tpu.get": "blocking ray_tpu.get() under a lock can deadlock "
+                   "(the producing task may need the lock)",
+    "ray.get": "blocking ray.get() under a lock can deadlock",
+    "ray_tpu.wait": "blocking ray_tpu.wait() under a lock",
+    "ray.wait": "blocking ray.wait() under a lock",
+    "socket.create_connection": "dialing under a lock convoys every "
+                                "waiter behind connect latency",
+    "subprocess.run": "subprocess under a lock blocks all waiters",
+    "subprocess.check_output": "subprocess under a lock blocks all "
+                               "waiters",
+    "subprocess.check_call": "subprocess under a lock blocks all "
+                             "waiters",
+    "subprocess.call": "subprocess under a lock blocks all waiters",
+}
+_RT011_SOCKET_METHODS = {"connect", "accept", "recv", "recv_into",
+                         "recvfrom"}
+_RT011_GCS_RECEIVERS = {"gcs", "_gcs", "gcs_client"}
+
+
+def _rt011_blocking_kind(call: ast.Call, imports: Dict[str, str],
+                         lock_names: List[str]) -> Optional[str]:
+    """Blocking-call classification given EVERY lock held at the call
+    site (innermost first) — the send-lock exemption must see all of
+    them, or `with stats_lock, send_lock: sendall(...)` false-fires."""
+    send_held = any("send" in n for n in lock_names)
+    name = _call_name(call, imports) or ""
+    msg = _RT011_FULL_CALLS.get(name)
+    if msg:
+        return f"{name}: {msg}"
+    tail = name.rsplit(".", 1)[-1]
+    if tail in ("send_msg", "recv_msg") and not send_held:
+        return (f"{tail}(): wire send/recv under a lock serializes "
+                f"the whole connection behind one slow peer")
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    meth = call.func.attr
+    recv_name = _dotted_name(call.func.value) or ""
+    recv_tail = recv_name.rsplit(".", 1)[-1]
+    if meth in _RT011_SOCKET_METHODS:
+        return (f".{meth}(): socket I/O while holding a lock convoys "
+                f"every other acquirer (PR-7 '_conn_lock dial' class)")
+    if meth == "sendall" and not send_held:
+        return (".sendall(): socket send while holding a non-send "
+                "lock convoys unrelated acquirers")
+    if meth == "result":
+        return (".result(): waiting on a future while holding a lock "
+                "can deadlock if the producer needs it")
+    if meth == "wait" and "cond" not in recv_tail.lower() \
+            and not _lockish_name(recv_tail):
+        return (f".wait() on {recv_tail or 'an event'}: unlike "
+                f"Condition.wait, this does NOT release the held lock")
+    if recv_tail in _RT011_GCS_RECEIVERS:
+        return (f"GCS rpc .{meth}() under a lock: a slow/partitioned "
+                f"control plane wedges every lock waiter")
+    return None
+
+
+def _enclosing_lock_names(mod: SourceModule, node: ast.AST,
+                          imports: Dict[str, str],
+                          local_locks: Set[str]) -> List[str]:
+    """Lock display names held at `node`, innermost first: every
+    lock-like item of every enclosing `with`, stopping at function/
+    class boundaries (a nested def's body runs later, lock-free).
+    Multi-item withs acquire left to right, so a node inside item N's
+    context expression holds items 0..N-1 but not N itself — `with
+    self._conn_lock, sock.connect(...):` dials under the lock (the
+    PR-7 class), while the first item's expression runs lock-free."""
+    out: List[str] = []
+    child = node
+    cur = mod.parent.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef)):
+            break
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            items = list(cur.items)
+            if isinstance(child, ast.withitem):
+                items = items[:items.index(child)]
+            cls = _enclosing_class(mod, cur)
+            lock_attrs = _class_lock_attrs(cls, imports, mod) if cls \
+                else set()
+            for item in items:
+                got = _any_lock_item(item.context_expr, lock_attrs,
+                                     local_locks)
+                if got:
+                    out.append(got)
+        child = cur
+        cur = mod.parent.get(cur)
+    return out
+
+
+@register(
+    "RT011", "blocking call while holding a lock",
+    "GCS/rpc calls, socket dial/send/recv, time.sleep, future "
+    ".result(), subprocess, and blocking ray_tpu.get() inside a "
+    "`with <lock>` body: every other acquirer convoys behind the "
+    "slow operation (and a get() whose producer needs the same lock "
+    "deadlocks).  Move the blocking work outside the critical "
+    "section; snapshot state under the lock, then operate on the "
+    "snapshot.")
+def check_rt011(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    local_locks = _mod_cached(
+        mod, "rt_local_locks",
+        lambda: _module_lock_names(mod, imports))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        lock_names = _enclosing_lock_names(mod, node, imports,
+                                           local_locks)
+        if not lock_names:
+            continue
+        kind = _rt011_blocking_kind(node, imports, lock_names)
+        if kind:
+            yield mod.finding(
+                "RT011", node,
+                f"blocking call while holding {lock_names[0]}: "
+                f"{kind}")
+
+
+def _enclosing_class(mod: SourceModule,
+                     node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = mod.parent.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = mod.parent.get(cur)
+    return None
+
+
+# -- RT012: whole-package lock-order graph ----------------------------------
+def _rt012_collect(mod: SourceModule) -> dict:
+    """Per-module facts for the package-wide lock-order pass: class
+    bases, which (class, attr) pairs ASSIGN a lock, and every nested
+    acquisition pair `with A: ... with B:` observed in a function."""
+    imports = _imports(mod)
+    local_locks = _module_lock_names(mod, imports)
+    modname = os.path.splitext(os.path.basename(mod.path))[0]
+    classes: Dict[str, List[str]] = {}
+    owners: Set[Tuple[str, str]] = set()
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        classes[cls.name] = [b for b in
+                             (_dotted_name(b) for b in cls.bases) if b]
+        for attr in _class_lock_attrs(cls, imports):
+            owners.add((cls.name, attr))
+
+    def lock_id(expr: ast.AST, cls: Optional[ast.ClassDef]
+                ) -> Optional[tuple]:
+        lock_attrs = _class_lock_attrs(cls, imports, mod) if cls \
+            else set()
+        if _self_lock_item(expr, lock_attrs):
+            return ("C", cls.name if cls else "?", expr.attr)
+        name = _dotted_name(expr)
+        if name is None:
+            return None
+        tail = name.rsplit(".", 1)[-1]
+        if name in local_locks or _lockish_name(tail):
+            head = name.rsplit(".", 1)[0] if "." in name else modname
+            return ("G", head, tail)
+        return None
+
+    pairs: List[tuple] = []   # (outer_id, inner_id, line, col)
+
+    def visit_with(node, held: List[tuple], cls) -> None:
+        ids: List[tuple] = []
+        for item in node.items:
+            lid = lock_id(item.context_expr, cls)
+            if lid is not None:
+                ids.append(lid)
+        # multi-item `with a, b:` acquires left-to-right
+        for i, a in enumerate(ids):
+            for b in ids[i + 1:]:
+                if a != b:
+                    pairs.append((a, b, node.lineno, node.col_offset))
+        for h in held:
+            for lid in ids:
+                if h != lid:
+                    pairs.append((h, lid, node.lineno,
+                                  node.col_offset))
+        walk_body(node.body, held + ids, cls)
+
+    def walk_body(body, held: List[tuple], cls) -> None:
+        # Manual traversal preserving the held-set: nested defs and
+        # classes are NOT descended into here — every FunctionDef is
+        # traversed exactly once by the loop below, with an empty
+        # held-set (deferred execution).
+        stack = [(s, held) for s in reversed(body)]
+        while stack:
+            node, h = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                visit_with(node, h, cls)
+                continue
+            stack.extend((c, h) for c in
+                         reversed(list(ast.iter_child_nodes(node))))
+
+    walk_body(mod.tree.body, [], None)
+    for fn in ast.walk(mod.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = _enclosing_class(mod, fn)
+            walk_body(fn.body, [], cls)
+
+    return {"classes": classes, "owners": owners, "pairs": pairs,
+            "path": mod.path}
+
+
+def _rt012_cached(mod: SourceModule) -> dict:
+    return _mod_cached(mod, "rt012", lambda: _rt012_collect(mod))
+
+
+def build_lock_graph(mods: List[SourceModule]) -> dict:
+    """Package-wide lock-acquisition-order graph.
+
+    Returns {"nodes": [label], "edges": [{"from", "to", "count",
+    "site"}], "cycles": [[labels...]]}.  Lock identity is
+    (class, attr) for self-attribute locks — unified across a class
+    hierarchy so a mixin's `with self.lock` and its host class's
+    `with self.lock` are the same lock — and (module, name) for
+    globals."""
+    data = [_rt012_cached(m) for m in mods]
+    classes: Dict[str, Set[str]] = {}
+    owners: Set[Tuple[str, str]] = set()
+    for d in data:
+        for cname, bases in d["classes"].items():
+            classes.setdefault(cname, set()).update(
+                b.rsplit(".", 1)[-1] for b in bases)
+        owners.update(d["owners"])
+
+    def base_closure(cname: str) -> Set[str]:
+        seen: Set[str] = set()
+        work = [cname]
+        while work:
+            cur = work.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            work.extend(classes.get(cur, ()))
+        return seen
+
+    # Union-find over class-attr lock ids across each class hierarchy.
+    parent: Dict[tuple, tuple] = {}
+
+    def find(x: tuple) -> tuple:
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a: tuple, b: tuple) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    all_ids: Set[tuple] = {("C", cls, attr) for cls, attr in owners}
+    for d in data:
+        for a, b, _, _ in d["pairs"]:
+            all_ids.add(a)
+            all_ids.add(b)
+    by_attr: Dict[str, List[tuple]] = {}
+    for lid in all_ids:
+        if lid[0] == "C":
+            by_attr.setdefault(lid[2], []).append(lid)
+    for attr, ids in by_attr.items():
+        for lid in ids:
+            closure = base_closure(lid[1])
+            for other in ids:
+                if other is not lid and other[1] in closure:
+                    union(lid, other)
+
+    def label(lid: tuple) -> str:
+        root = find(lid) if lid[0] == "C" else lid
+        if lid[0] == "C":
+            attr = root[2]
+            # Prefer the class that ASSIGNS the lock for the label.
+            cands = [c for (c, a) in owners if a == attr
+                     and find(("C", c, a)) == root]
+            cname = sorted(cands)[0] if cands else root[1]
+            return f"{cname}.{attr}"
+        return f"{lid[1]}.{lid[2]}"
+
+    edges: Dict[Tuple[str, str], dict] = {}
+    for d in data:
+        rel = "/".join(d["path"].replace(os.sep, "/").split("/")[-2:])
+        for a, b, line, col in d["pairs"]:
+            ka = label(find(a) if a[0] == "C" else a)
+            kb = label(find(b) if b[0] == "C" else b)
+            if ka == kb:
+                continue
+            e = edges.get((ka, kb))
+            if e is None:
+                e = edges[(ka, kb)] = {
+                    "from": ka, "to": kb, "count": 0,
+                    "site": f"{rel}:{line}",
+                    "path": d["path"], "line": line, "col": col}
+            e["count"] += 1
+
+    # Cycle detection: Tarjan SCC over the label graph.  Known locks
+    # with no ordered edges still appear as isolated nodes so the
+    # human dump shows the full lock population, not just the nested
+    # subset.
+    graph: Dict[str, Set[str]] = {}
+    for lid in all_ids:
+        graph.setdefault(label(find(lid) if lid[0] == "C" else lid),
+                         set())
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (avoid recursion limits on big graphs)
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    return {
+        "nodes": sorted(graph),
+        "edges": sorted(edges.values(),
+                        key=lambda e: (e["from"], e["to"])),
+        "cycles": sorted(sccs),
+    }
+
+
+def _rt012_finalize(mods: List[SourceModule]) -> Iterable[Finding]:
+    graph = build_lock_graph(mods)
+    if not graph["cycles"]:
+        return
+    edge_map = {(e["from"], e["to"]): e for e in graph["edges"]}
+    for comp in graph["cycles"]:
+        members = set(comp)
+        internal = [e for (a, b), e in sorted(edge_map.items())
+                    if a in members and b in members]
+        if not internal:
+            continue
+        witness = internal[0]
+        detail = "; ".join(f"{e['from']} -> {e['to']} at {e['site']}"
+                           for e in internal[:6])
+        yield Finding(
+            "RT012", witness["path"], witness["line"], witness["col"],
+            f"lock-order cycle between {', '.join(comp)} — threads "
+            f"acquiring these locks in different orders can deadlock "
+            f"({detail}); pick one global order or drop the nesting")
+
+
+@register(
+    "RT012", "lock-acquisition-order cycle (potential deadlock)",
+    "Collects every nested `with lockA: ... with lockB:` acquisition "
+    "pair across the whole package, builds the lock-order graph "
+    "(class-attribute locks unified across a class hierarchy, so a "
+    "mixin's `self.lock` matches its host's), and reports strongly "
+    "connected components — two threads taking the same pair of "
+    "locks in opposite orders is a deadlock waiting for load.  Dump "
+    "the graph for humans with `ray_tpu lint --lock-graph`.",
+    project_finalize=_rt012_finalize)
+def check_rt012(mod: SourceModule) -> Iterable[Finding]:
+    _rt012_cached(mod)      # collect per-module facts; finalize reports
+    return ()

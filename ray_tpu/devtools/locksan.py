@@ -1,0 +1,533 @@
+"""Runtime lock-order sentinel (the dynamic half of the concurrency
+sanitizer; the static half is lint rules RT010-RT012).
+
+Enable with ``RAY_TPU_LOCKSAN=1`` in the environment BEFORE the first
+``import ray_tpu``: the package __init__ then swaps
+``threading.Lock``/``threading.RLock`` for instrumented wrappers, so
+every lock the runtime (and its spawned node/worker processes — the
+env var inherits) creates afterwards is tracked:
+
+* **lock-order inversions** — each thread's held-set is recorded at
+  acquire; taking B while holding A adds the edge A→B to a global
+  order graph, and an acquire that closes a cycle (B→A already
+  witnessed) is reported as a real inversion with both stacks — the
+  deadlock two loaded threads would eventually hit, caught on the
+  first crossing even when the timing happened to be safe.
+* **long holds** — a lock held longer than ``lock_hold_warn_ms`` is
+  recorded with the holder's stack (the RT011 convoy class, observed
+  live).
+* **contention/wait metrics** — ``ray_tpu_lock_wait_seconds`` and
+  ``ray_tpu_lock_contention_total{site=...}`` feed the normal metric
+  plane; sites are lock *creation* sites (file:line).
+
+Reports: each process appends its findings to
+``<locksan_dir>/<pid>.json`` (atexit + write-through on every
+inversion, so even a killed worker leaves evidence);
+``merged_report()`` — surfaced as ``ray_tpu.util.state
+.locksan_report()`` and the ``ray_tpu locksan`` CLI — merges the
+directory with the in-process state.
+
+Tests can also use :class:`SanLock` directly (no global install) to
+assert the detector itself works.
+
+Known limitation: a plain ``threading.Lock`` may legally be released
+by a different thread than the acquirer (handoff patterns).  The
+held-set is per-thread, so such a release leaves a stale entry in the
+acquirer's held-set and its later acquires can record spurious edges.
+Every lock in this codebase is ``with``-scoped, so the pattern does
+not occur here; treat inversions involving a handoff lock with
+suspicion before hunting the deadlock.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+# Real primitives, captured before install() ever swaps them.
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+ENV_FLAG = "RAY_TPU_LOCKSAN"
+ENV_DIR = "RAY_TPU_LOCKSAN_DIR"
+DEFAULT_DIR = "/tmp/ray_tpu_locksan"
+
+_MAX_LONG_HOLDS = 200
+_MAX_INVERSIONS = 200
+
+_tls = threading.local()
+
+# Global sanitizer state, guarded by a RAW lock (never instrumented).
+_state_lock = _REAL_LOCK()
+_edges: Dict[tuple, int] = {}           # (site_a, site_b) -> count
+_edge_witness: Dict[tuple, dict] = {}   # first observation per edge
+_inversions: List[dict] = []
+_inversion_pairs: set = set()           # frozenset({a, b}) dedup
+_long_holds: List[dict] = []
+_contention: Dict[str, int] = {}
+# site -> {count, first-witness}: DISTINCT lock instances born at the
+# same source line nested inside each other.  Site-keyed edges cannot
+# order these (A||A carries no direction), so instead of silently
+# dropping them — a clean verdict the user would trust — they surface
+# as their own hazard class: verify the code orders the instances
+# consistently (by address, by id) or the nesting is a latent
+# deadlock no site-level check can see.
+_same_site: Dict[str, dict] = {}
+_acquires = 0
+_lock_sites: Dict[str, int] = {}        # creation site -> locks made
+_installed = False
+_dump_registered = False
+
+_metrics: Optional[tuple] = None        # (wait_hist_obs, contention)
+_metrics_state = 0                      # 0 unbuilt / 1 building / 2 ready
+_hold_warn_s: Optional[float] = None
+
+
+def _busy() -> bool:
+    return getattr(_tls, "busy", False)
+
+
+class _Busy:
+    """Reentrancy guard: sanitizer bookkeeping (and the metric pushes
+    it makes) must pass through instrumented locks untracked."""
+
+    def __enter__(self):
+        _tls.busy = True
+
+    def __exit__(self, *exc):
+        _tls.busy = False
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _creation_site() -> str:
+    """file:line of the frame that constructed the lock — the first
+    caller outside this module and threading.py."""
+    f = sys._getframe(2)
+    here = __file__
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != here and not fn.endswith("threading.py"):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _short_stack(limit: int = 12) -> List[str]:
+    return [ln.strip() for ln in
+            traceback.format_stack(sys._getframe(3), limit=limit)]
+
+
+def _hold_warn_threshold() -> float:
+    global _hold_warn_s
+    if _hold_warn_s is None:
+        try:
+            from ray_tpu._private.config import config
+            _hold_warn_s = max(config.lock_hold_warn_ms, 0.0) / 1000.0
+        except Exception:
+            _hold_warn_s = 0.5
+    return _hold_warn_s
+
+
+def _metric_sinks() -> Optional[tuple]:
+    """(wait_observer, contention_counter), built lazily so importing
+    locksan never drags the metric plane in.
+
+    Exactly ONE thread may build (the 0→1 transition under the raw
+    state lock); every other thread skips while building is in
+    flight.  Without this, the metric constructor's own flusher
+    Thread.start() handshake deadlocks: the starter holds the metric
+    registry lock while the new thread's first tracked acquire
+    re-enters metric construction and blocks on that same lock."""
+    global _metrics, _metrics_state
+    if _metrics_state == 2:
+        return _metrics
+    with _state_lock:
+        if _metrics_state != 0:
+            return None
+        _metrics_state = 1
+    try:
+        from ray_tpu.util import metrics as um
+        wait = um.shared_histogram(
+            um.LOCK_WAIT_SECONDS_METRIC,
+            "seconds acquire() blocked on instrumented locks",
+            boundaries=um.LOCK_WAIT_BUCKETS).observer()
+        cont = um.shared_counter(
+            um.LOCK_CONTENTION_METRIC,
+            "lock acquires that found the lock already held",
+            tag_keys=("site",))
+        _metrics = (wait, cont)
+        _metrics_state = 2
+        return _metrics
+    except Exception:
+        _metrics_state = 0      # transient (mid-import): retry later
+        return None
+
+
+class SanLock:
+    """Instrumented Lock/RLock lookalike.
+
+    Wraps a real primitive; acquire/release bookkeeping feeds the
+    global order graph.  Implements the private Condition protocol
+    (_release_save/_acquire_restore/_is_owned) so
+    ``threading.Condition(SanLock(...))`` — and Condition() built on a
+    patched RLock — keeps working.
+    """
+
+    __slots__ = ("_lock", "site", "reentrant")
+
+    def __init__(self, reentrant: bool = False,
+                 site: Optional[str] = None) -> None:
+        self._lock = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+        self.reentrant = reentrant
+        self.site = site or _creation_site()
+        if not _busy():
+            with _state_lock:
+                _lock_sites[self.site] = \
+                    _lock_sites.get(self.site, 0) + 1
+
+    # -- core protocol --------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if _busy():
+            return self._lock.acquire(blocking, timeout)
+        t0 = time.perf_counter()
+        got = self._lock.acquire(False)
+        contended = not got
+        if not got:
+            if not blocking:
+                with _Busy():
+                    self._note_contention(0.0)
+                return False
+            got = self._lock.acquire(True, timeout)
+        wait = time.perf_counter() - t0
+        if got:
+            with _Busy():
+                self._note_acquire(wait, contended)
+        elif contended:
+            with _Busy():
+                self._note_contention(wait)
+        return got
+
+    def release(self) -> None:
+        if not _busy():
+            with _Busy():
+                self._note_release()
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self) -> bool:
+        if hasattr(self._lock, "locked"):
+            return self._lock.locked()
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        return (f"<SanLock {'RLock' if self.reentrant else 'Lock'} "
+                f"site={self.site}>")
+
+    # -- Condition protocol (threading.Condition private hooks) ---------
+    def _release_save(self):
+        if not _busy():
+            with _Busy():
+                self._note_release(all_counts=True)
+        if hasattr(self._lock, "_release_save"):
+            return self._lock._release_save()
+        self._lock.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        if hasattr(self._lock, "_acquire_restore"):
+            self._lock._acquire_restore(state)
+        else:
+            self._lock.acquire()
+        if not _busy():
+            with _Busy():
+                self._note_acquire(0.0, False)
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._lock, "_is_owned"):
+            return self._lock._is_owned()
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self) -> None:
+        if hasattr(self._lock, "_at_fork_reinit"):
+            self._lock._at_fork_reinit()
+        else:
+            self._lock = (_REAL_RLOCK() if self.reentrant
+                          else _REAL_LOCK())
+
+    # -- bookkeeping (always under _Busy) --------------------------------
+    def _note_contention(self, wait: float) -> None:
+        with _state_lock:
+            _contention[self.site] = _contention.get(self.site, 0) + 1
+        sinks = _metric_sinks()
+        if sinks is not None:
+            sinks[1].inc(1, {"site": self.site})
+            if wait > 0:
+                sinks[0](wait)
+
+    def _note_acquire(self, wait: float, contended: bool) -> None:
+        global _acquires
+        held = _held()
+        for ent in held:
+            if ent[0] is self:          # reentrant re-acquire
+                ent[1] += 1
+                return
+        inversion = None
+        with _state_lock:
+            _acquires += 1
+            if contended:
+                _contention[self.site] = \
+                    _contention.get(self.site, 0) + 1
+            for ent in held:
+                a, b = ent[0].site, self.site
+                if a == b:
+                    # Different instances from one creation site:
+                    # direction is unknowable by site — record the
+                    # hazard instead of dropping it.
+                    cell = _same_site.get(a)
+                    if cell is None:
+                        cell = _same_site[a] = {
+                            "count": 0,
+                            "thread":
+                                threading.current_thread().name,
+                            "stack": _short_stack()}
+                    cell["count"] += 1
+                    continue
+                pair = (a, b)
+                _edges[pair] = _edges.get(pair, 0) + 1
+                if pair not in _edge_witness:
+                    _edge_witness[pair] = {
+                        "thread": threading.current_thread().name,
+                        "stack": _short_stack(),
+                        "t": time.time(),
+                    }
+                rev = (b, a)
+                key = frozenset(pair)
+                if rev in _edges and key not in _inversion_pairs \
+                        and len(_inversions) < _MAX_INVERSIONS:
+                    _inversion_pairs.add(key)
+                    inversion = {
+                        "locks": [a, b],
+                        "order_here": f"{a} -> {b}",
+                        "order_before": f"{b} -> {a}",
+                        "thread": threading.current_thread().name,
+                        "stack_here": _short_stack(),
+                        "first_seen": _edge_witness.get(rev, {}),
+                        "t": time.time(),
+                    }
+                    _inversions.append(inversion)
+        held.append([self, 1, time.perf_counter()])
+        sinks = _metric_sinks()
+        if sinks is not None and contended:
+            sinks[0](wait)
+            sinks[1].inc(1, {"site": self.site})
+        if inversion is not None:
+            # Write-through: inversions are the headline finding and
+            # must survive a process that never reaches atexit.
+            try:
+                dump()
+            except Exception:
+                pass
+
+    def _note_release(self, all_counts: bool = False) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            ent = held[i]
+            if ent[0] is not self:
+                continue
+            if not all_counts and ent[1] > 1:
+                ent[1] -= 1
+                return
+            held.pop(i)
+            dur = time.perf_counter() - ent[2]
+            if dur >= _hold_warn_threshold():
+                with _state_lock:
+                    if len(_long_holds) < _MAX_LONG_HOLDS:
+                        _long_holds.append({
+                            "site": self.site,
+                            "held_s": round(dur, 4),
+                            "thread":
+                                threading.current_thread().name,
+                            "stack": _short_stack(),
+                            "t": time.time(),
+                        })
+            return
+
+
+def _make_lock() -> SanLock:
+    return SanLock(reentrant=False)
+
+
+def _make_rlock() -> SanLock:
+    return SanLock(reentrant=True)
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def install() -> bool:
+    """Swap threading.Lock/RLock for SanLock factories (idempotent).
+    Called from ray_tpu/__init__ when RAY_TPU_LOCKSAN is set."""
+    global _installed, _dump_registered
+    if _installed:
+        return True
+    threading.Lock = _make_lock              # type: ignore[assignment]
+    threading.RLock = _make_rlock            # type: ignore[assignment]
+    _installed = True
+    if not _dump_registered:
+        _dump_registered = True
+        atexit.register(dump)
+    return True
+
+
+def report_dir() -> str:
+    d = os.environ.get(ENV_DIR, "").strip()
+    if not d:
+        try:
+            from ray_tpu._private.config import config
+            d = config.locksan_dir
+        except Exception:
+            d = ""
+    return d or DEFAULT_DIR
+
+
+def report() -> dict:
+    """This process's sanitizer state as a plain dict."""
+    with _state_lock:
+        return {
+            "pid": os.getpid(),
+            "argv": " ".join(sys.argv[:3]),
+            "installed": _installed,
+            "acquires": _acquires,
+            "lock_sites": dict(_lock_sites),
+            "edges": {f"{a} || {b}": n
+                      for (a, b), n in _edges.items()},
+            "contention": dict(_contention),
+            "inversions": [dict(i) for i in _inversions],
+            "long_holds": [dict(h) for h in _long_holds],
+            "same_site_nesting": {k: dict(v)
+                                  for k, v in _same_site.items()},
+        }
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """Write this process's report (atomically) for the merger; no-op
+    when nothing was ever tracked."""
+    rep = report()
+    if not rep["acquires"] and not rep["lock_sites"]:
+        return None
+    if path is None:
+        d = report_dir()
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            return None
+        path = os.path.join(d, f"{os.getpid()}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(rep, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def merged_report(directory: Optional[str] = None) -> dict:
+    """Merge every per-process report in `directory` (default: the
+    ambient locksan dir) with the live in-process state."""
+    directory = directory or report_dir()
+    reports: List[dict] = []
+    if os.path.isdir(directory):
+        for name in sorted(os.listdir(directory)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(directory, name),
+                          encoding="utf-8") as f:
+                    reports.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+    live = report()
+    if live["acquires"] or live["lock_sites"]:
+        reports = [r for r in reports if r.get("pid") != live["pid"]]
+        reports.append(live)
+    merged: Dict[str, Any] = {
+        "processes": len(reports),
+        "acquires": 0,
+        "edges": {},
+        "contention": {},
+        "inversions": [],
+        "long_holds": [],
+        "lock_sites": {},
+        "same_site_nesting": {},
+    }
+    seen_pairs = set()
+    for r in reports:
+        merged["acquires"] += r.get("acquires", 0)
+        for k, n in (r.get("edges") or {}).items():
+            merged["edges"][k] = merged["edges"].get(k, 0) + n
+        for k, n in (r.get("contention") or {}).items():
+            merged["contention"][k] = \
+                merged["contention"].get(k, 0) + n
+        for k, n in (r.get("lock_sites") or {}).items():
+            merged["lock_sites"][k] = \
+                merged["lock_sites"].get(k, 0) + n
+        for inv in r.get("inversions") or []:
+            key = frozenset(inv.get("locks") or [])
+            if key in seen_pairs:
+                continue
+            seen_pairs.add(key)
+            merged["inversions"].append(
+                dict(inv, pid=r.get("pid")))
+        for h in r.get("long_holds") or []:
+            merged["long_holds"].append(dict(h, pid=r.get("pid")))
+        for site, cell in (r.get("same_site_nesting") or {}).items():
+            cur = merged["same_site_nesting"].get(site)
+            if cur is None:
+                merged["same_site_nesting"][site] = dict(cell)
+            else:
+                cur["count"] += cell.get("count", 0)
+    merged["long_holds"].sort(key=lambda h: -h.get("held_s", 0))
+    merged["long_holds"] = merged["long_holds"][:_MAX_LONG_HOLDS]
+    return merged
+
+
+def reset() -> None:
+    """Drop all in-process state (test isolation)."""
+    global _acquires
+    with _state_lock:
+        _edges.clear()
+        _edge_witness.clear()
+        _inversions.clear()
+        _inversion_pairs.clear()
+        _long_holds.clear()
+        _contention.clear()
+        _lock_sites.clear()
+        _same_site.clear()
+        _acquires = 0

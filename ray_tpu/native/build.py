@@ -49,7 +49,11 @@ def build_library(name: str, sources: list[str], force: bool = False) -> str:
         tmp = so_path + f".tmp.{os.getpid()}"
         cmd = [_CXX, *_FLAGS, "-o", tmp, *srcs]
         try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            # Serializing concurrent builds of the same .so is the
+            # lock's entire job — waiters NEED to block until the
+            # compile finishes.
+            subprocess.run(cmd, check=True, capture_output=True,  # ray-tpu: noqa[RT011]
+                           text=True)
         except subprocess.CalledProcessError as e:
             raise RuntimeError(
                 f"native build failed: {' '.join(cmd)}\n{e.stderr}") from e

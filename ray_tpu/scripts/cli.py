@@ -20,8 +20,11 @@ status`) + `ray list/summary` (util/state CLI) + `ray job` (job CLI).
     metrics                   Prometheus text from the head
     job {submit,status,logs,list,stop}
     microbench                core-runtime perf harness
-    lint <path>...            static analysis (RT001-RT007) for
-                              remote/actor/sharding code
+    lint <path>...            static analysis (RT001-RT012) for
+                              remote/actor/sharding/concurrency code
+                              (--lock-graph dumps the lock-order graph)
+    locksan                   merged runtime lock-sanitizer report
+                              from a RAY_TPU_LOCKSAN=1 run
 
 State (started pids, head address) persists in ~/.ray_tpu_cli.json so
 `stop`/`status` work from a fresh shell."""
@@ -488,6 +491,52 @@ def cmd_lint(args) -> int:
     return lint_cli.run(args)
 
 
+def cmd_locksan(args) -> int:
+    """Merged runtime lock-sanitizer report (devtools/locksan.py).
+    Run the workload with RAY_TPU_LOCKSAN=1 first; every process
+    drops a <pid>.json report into the locksan dir.  Exit 1 when any
+    lock-order inversion was witnessed, 0 on a clean run."""
+    from ray_tpu.devtools import locksan
+    rep = locksan.merged_report(args.dir)
+    if args.json:
+        print(json.dumps(rep, indent=1, default=str))
+        return 1 if rep["inversions"] else 0
+    print(f"locksan report ({rep['processes']} process(es), "
+          f"{rep['acquires']} tracked acquires, dir "
+          f"{args.dir or locksan.report_dir()})")
+    if not rep["processes"]:
+        print("no reports found — run the workload with "
+              "RAY_TPU_LOCKSAN=1")
+        return 0
+    inv = rep["inversions"]
+    print(f"\nlock-order inversions: {len(inv)}")
+    for i in inv:
+        print(f"  {i.get('order_here')}  (reverse order seen "
+              f"earlier; thread {i.get('thread')}, pid "
+              f"{i.get('pid')})")
+        for ln in (i.get("stack_here") or [])[-4:]:
+            print(f"    {ln}")
+    holds = rep["long_holds"]
+    print(f"\nlong holds (> lock_hold_warn_ms): {len(holds)}")
+    for h in holds[:10]:
+        print(f"  {h.get('held_s'):>8}s  {h.get('site')}  "
+              f"(thread {h.get('thread')}, pid {h.get('pid')})")
+    same = rep.get("same_site_nesting") or {}
+    if same:
+        print(f"\nsame-site lock nesting (direction not checkable "
+              f"by site — verify instance ordering): {len(same)}")
+        for site, cell in sorted(same.items(),
+                                 key=lambda kv: -kv[1]["count"]):
+            print(f"  x{cell['count']}  {site}")
+    cont = sorted(rep["contention"].items(), key=lambda kv: -kv[1])
+    print(f"\nmost contended lock sites:")
+    for site, n in cont[:10]:
+        print(f"  {n:>6}  {site}")
+    if not cont:
+        print("  (no contention observed)")
+    return 1 if inv else 0
+
+
 def cmd_drain(args) -> int:
     """Gracefully drain one node (reference: `ray drain-node`): the
     GCS flips it alive -> draining and the node hands back queued
@@ -739,6 +788,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "config/env schedule)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "locksan",
+        help="merged lock-sanitizer report (inversions / long holds "
+             "/ contention) from a RAY_TPU_LOCKSAN=1 run")
+    p.add_argument("--dir", default=None,
+                   help="report directory (default: the ambient "
+                        "locksan dir)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_locksan)
 
     # The rule-table epilog imports + registers the whole lint rule
     # set; only `ray_tpu lint -h` ever renders a subparser epilog, so

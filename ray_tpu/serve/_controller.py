@@ -37,6 +37,11 @@ class ServeController:
         self._deployments: Dict[str, dict] = {}
         self._version = 0
         self._autoscale_thread = None
+        # Loop-thread stop flag: the health/drain/autoscale daemons
+        # wait on it instead of sleeping, so shutdown_all can stop and
+        # JOIN them — a daemon loop still probing replicas through
+        # interpreter teardown is the PR-9 stop()-segfault class.
+        self._loops_stop = threading.Event()
         # Guards deployment state: the autoscale daemon thread mutates
         # it concurrently with actor-method execution.
         self._state_lock = threading.RLock()
@@ -191,8 +196,27 @@ class ServeController:
         return True
 
     def shutdown_all(self) -> None:
+        import threading
         for name in list(self._deployments):
             self.delete(name)
+        # Stop + join the daemon loops (bounded: they wake on the
+        # event).  Controller teardown with loops mid-probe otherwise
+        # races interpreter shutdown.  Swap the event and detach the
+        # threads UNDER the lock (see _loop_needs_start), then signal
+        # and join outside it.
+        with self._state_lock:
+            stop, self._loops_stop = self._loops_stop, \
+                threading.Event()
+            threads = [getattr(self, a, None) for a in
+                       ("_health_thread", "_drain_thread",
+                        "_autoscale_thread")]
+            for a in ("_health_thread", "_drain_thread",
+                      "_autoscale_thread"):
+                setattr(self, a, None)
+        stop.set()
+        for t in threads:
+            if t is not None and t.is_alive():
+                t.join(timeout=5.0)
 
     # -- data-plane queries ------------------------------------------------
     def get_replicas(self, name: str) -> dict:
@@ -315,31 +339,49 @@ class ServeController:
     # runs the autoscaling policy (serve/_private/autoscaling_state.py,
     # serve/autoscaling_policy.py): desired = total_ongoing / target,
     # clamped to [min, max], with upscale/downscale smoothing delays.
+    def _start_loop(self, attr: str, name: str, make_loop) -> None:
+        """Start the named daemon loop unless it is already running —
+        check, claim (attr assignment), and start all happen UNDER
+        _state_lock, because the controller actor runs with
+        max_concurrency > 1 and two concurrent deploy()s must not
+        both start a loop.  `make_loop(stop)` builds the loop body
+        around the stop Event captured under the same lock:
+        shutdown_all SWAPS in a fresh Event rather than anyone ever
+        clear()ing a shared one, so a loop started concurrently with
+        a shutdown either runs on the new event (untouched by the old
+        set()) or on the old one (and exits with the rest).  A
+        deploy() after shutdown_all() therefore gets live loops again
+        instead of stale dead threads."""
+        import threading
+        with self._state_lock:
+            t = getattr(self, attr, None)
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(target=make_loop(self._loops_stop),
+                                 daemon=True, name=name)
+            setattr(self, attr, t)
+            t.start()
+
     def _ensure_health_loop(self) -> None:
         """Active replica health probing (reference:
         deployment_state.py health checking: the controller calls
         check_health on every replica each period; a probe that errors
         or times out replaces the replica)."""
-        import threading
-        if getattr(self, "_health_thread", None) is not None:
-            return
+        def make_loop(stop):
+            def loop() -> None:
+                import ray_tpu
+                # (name, actor_id) -> (probe ref, deadline, replica)
+                pending: dict = {}
+                while not stop.is_set():
+                    try:
+                        self._health_tick(pending)
+                    except Exception:
+                        pass   # transient error: keep probing
+                    stop.wait(self._health_period())
+            return loop
 
-        def loop() -> None:
-            import time
-
-            import ray_tpu
-            # (name, actor_id) -> (probe ref, deadline, replica)
-            pending: dict = {}
-            while True:
-                try:
-                    self._health_tick(pending)
-                except Exception:
-                    pass   # transient control-plane error: keep probing
-                time.sleep(self._health_period())
-
-        self._health_thread = threading.Thread(
-            target=loop, daemon=True, name="rtpu-serve-health")
-        self._health_thread.start()
+        self._start_loop("_health_thread", "rtpu-serve-health",
+                         make_loop)
 
     def _health_period(self) -> float:
         with self._state_lock:
@@ -394,33 +436,28 @@ class ServeController:
     # with the reactive path (report_replica_failure after a request
     # already died): a drain produces zero user-visible errors.
     def _ensure_drain_loop(self) -> None:
-        import threading
-        if getattr(self, "_drain_thread", None) is not None:
-            return
-
-        def loop() -> None:
-            import time
-
-            import ray_tpu
-            try:
-                # Single-node sessions have no node to drain: exit
-                # instead of polling the control plane once a second
-                # for the controller's whole lifetime.
-                if not ray_tpu._ensure_connected().node_info().get(
-                        "multinode"):
-                    return
-            except Exception:
-                pass
-            while True:
+        def make_loop(stop):
+            def loop() -> None:
+                import ray_tpu
                 try:
-                    self._drain_tick()
+                    # Single-node sessions have no node to drain: exit
+                    # instead of polling the control plane once a
+                    # second for the controller's whole lifetime.
+                    if not ray_tpu._ensure_connected().node_info().get(
+                            "multinode"):
+                        return
                 except Exception:
                     pass
-                time.sleep(1.0)
+                while not stop.is_set():
+                    try:
+                        self._drain_tick()
+                    except Exception:
+                        pass
+                    stop.wait(1.0)
+            return loop
 
-        self._drain_thread = threading.Thread(
-            target=loop, daemon=True, name="rtpu-serve-drain")
-        self._drain_thread.start()
+        self._start_loop("_drain_thread", "rtpu-serve-drain",
+                         make_loop)
 
     def _drain_tick(self) -> None:
         """Find replicas homed on DRAINING nodes and proactively move
@@ -515,28 +552,25 @@ class ServeController:
         self.report_replica_failure(name, replica._actor_id)
 
     def _ensure_autoscale_loop(self) -> None:
-        import threading
-        if self._autoscale_thread is not None:
-            return
+        def make_loop(stop):
+            def loop() -> None:
+                while not stop.is_set():
+                    intervals = []
+                    try:
+                        for name in list(self._deployments):
+                            d = self._deployments.get(name)
+                            if d is None or not d.get("autoscaling"):
+                                continue
+                            intervals.append(
+                                d["autoscaling"]["interval_s"])
+                            self._autoscale_tick(name, d)
+                    except Exception:
+                        pass
+                    stop.wait(min(intervals) if intervals else 0.5)
+            return loop
 
-        def loop() -> None:
-            import time
-            while True:
-                intervals = []
-                try:
-                    for name in list(self._deployments):
-                        d = self._deployments.get(name)
-                        if d is None or not d.get("autoscaling"):
-                            continue
-                        intervals.append(d["autoscaling"]["interval_s"])
-                        self._autoscale_tick(name, d)
-                except Exception:
-                    pass
-                time.sleep(min(intervals) if intervals else 0.5)
-
-        self._autoscale_thread = threading.Thread(
-            target=loop, daemon=True, name="rtpu-serve-autoscale")
-        self._autoscale_thread.start()
+        self._start_loop("_autoscale_thread", "rtpu-serve-autoscale",
+                         make_loop)
 
     def _autoscale_tick(self, name: str, d: dict) -> None:
         import math

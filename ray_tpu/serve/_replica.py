@@ -173,14 +173,18 @@ class Replica:
     def queue_len(self) -> int:
         """Probed by the pow-2 router (reference: replica queue-length
         probing in pow_2_scheduler.py)."""
-        return self._inflight
+        with self._count_lock:
+            return self._inflight
 
     def replica_info(self) -> dict:
         """Router probe: queue length + resident multiplexed models
         (reference: multiplex-aware pow-2 scheduling)."""
         from ray_tpu.serve.multiplex import resident_model_ids
-        return {"qlen": self._inflight,
+        with self._count_lock:
+            qlen = self._inflight
+        return {"qlen": qlen,
                 "model_ids": resident_model_ids(self._user)}
 
     def stats(self) -> dict:
-        return {"inflight": self._inflight, "served": self._served}
+        with self._count_lock:
+            return {"inflight": self._inflight, "served": self._served}

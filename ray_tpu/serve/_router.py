@@ -237,6 +237,7 @@ class Router:
             self._sidelined.pop(actor_id, None)
 
     def _load(self, replica) -> int:
+        """Caller holds self._lock (pick's pow-2 comparison)."""
         k = replica._actor_id
         return self._outstanding.get(k, 0) + self._probed.get(k, 0)
 

@@ -327,10 +327,15 @@ class ContinuousBatcher:
             req.stream_q.put(_STREAM_END)
 
     def _fail_all(self, e: Exception) -> None:
-        for i, req in enumerate(self._owner):
-            if req is not None:
-                req.error = e
-                self._retire(i, req)
+        # Snapshot the slot table under _state_lock (the dispatcher
+        # mutates _owner concurrently; an RT010 self-finding), then
+        # retire outside it — _retire takes the lock itself.
+        with self._state_lock:
+            owned = [(i, req) for i, req in enumerate(self._owner)
+                     if req is not None]
+        for i, req in owned:
+            req.error = e
+            self._retire(i, req)
         while not self._pending.empty():
             try:
                 req = self._pending.get_nowait()

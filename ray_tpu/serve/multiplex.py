@@ -55,9 +55,21 @@ class _ModelCache:
         self._models: "OrderedDict[str, Any]" = OrderedDict()
         self._loading: dict = {}
         self._lock = asyncio.Lock()
+        # Immutable snapshot of the resident + loading ids, rebound
+        # (atomically, GIL) after every membership change: the router
+        # probe reads from the actor's MAIN thread while get() mutates
+        # the dicts on the event loop — iterating those dicts there
+        # raced a concurrent load/evict (RuntimeError: dict mutated
+        # during iteration; an RT010 self-finding), and the asyncio
+        # lock cannot be taken from a plain thread.
+        self._ids_snapshot: tuple = ()
+
+    def _refresh_ids_locked(self) -> None:
+        """Caller holds self._lock (the asyncio one)."""
+        self._ids_snapshot = tuple(self._models) + tuple(self._loading)
 
     def model_ids(self):
-        return list(self._models) + list(self._loading)
+        return list(self._ids_snapshot)
 
     async def get(self, owner, model_id: str):
         async with self._lock:
@@ -68,6 +80,7 @@ class _ModelCache:
             if fut is None:
                 fut = asyncio.get_running_loop().create_future()
                 self._loading[model_id] = fut
+                self._refresh_ids_locked()
                 load_here = True
             else:
                 load_here = False
@@ -80,6 +93,7 @@ class _ModelCache:
         except BaseException as e:      # noqa: BLE001
             async with self._lock:
                 self._loading.pop(model_id, None)
+                self._refresh_ids_locked()
             fut.set_exception(e)
             raise
         async with self._lock:
@@ -88,6 +102,7 @@ class _ModelCache:
             evicted = None
             if len(self._models) > self._max:
                 _, evicted = self._models.popitem(last=False)
+            self._refresh_ids_locked()
         if evicted is not None and hasattr(evicted, "close"):
             try:
                 evicted.close()     # eager teardown hook, if offered

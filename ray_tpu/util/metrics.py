@@ -104,6 +104,17 @@ PREFIX_CACHE_HITS_METRIC = "ray_tpu_prefix_cache_hits_total"
 PREFIX_CACHE_QUERIES_METRIC = "ray_tpu_prefix_cache_queries_total"
 KV_EVICTIONS_METRIC = "ray_tpu_kv_evictions_total"
 
+# Concurrency sanitizer (devtools/locksan.py, enabled with
+# RAY_TPU_LOCKSAN=1).  wait_seconds observes how long acquire()
+# blocked on instrumented locks (untagged: one distribution per
+# process; per-site detail lives in the locksan report);
+# contention_total counts acquires that found the lock held, tagged
+# by the lock's creation site (file:line).
+LOCK_WAIT_SECONDS_METRIC = "ray_tpu_lock_wait_seconds"
+LOCK_CONTENTION_METRIC = "ray_tpu_lock_contention_total"
+LOCK_WAIT_BUCKETS = (0.00001, 0.0001, 0.001, 0.01, 0.05, 0.25, 1.0,
+                     5.0)
+
 # Inter-node object-transfer plane, auto-recorded node-side.
 # bytes_total tags: direction = in | out.  seconds tags: path =
 # stream (windowed binary plane) | multi (range-split, several
@@ -113,6 +124,15 @@ OBJECT_TRANSFER_SECONDS_METRIC = "ray_tpu_object_transfer_seconds"
 OBJECT_TRANSFER_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
                            5.0, 30.0)
 
+# THE registry lock: guards the metric registry, every metric's cell
+# map, cell values, and the retry queue.  One lock (instead of the
+# old per-metric locks) means cell creation, drain, and the pending
+# queue can never interleave inconsistently across threads — worker,
+# node, and scrape threads all mutate these maps (concurrency-
+# sanitizer self-application).  Cells are created exactly ONCE per
+# tagset and never replaced afterwards (drain resets them in place),
+# which is what makes the pre-resolved observer() fast path's
+# lock-free cell lookup sound.
 _lock = threading.RLock()
 _registry: List["_Metric"] = []
 _flusher_started = False
@@ -142,14 +162,17 @@ class _Metric:
         self.description = description
         self.tag_keys = tuple(tag_keys or ())
         self._default_tags: Dict[str, str] = {}
-        self._lock = threading.Lock()
-        # per-tagset state; subclasses define the value layout
+        # per-tagset state; subclasses define the value layout.
+        # Guarded by the module registry lock `_lock`; entries are
+        # create-once and reset in place at drain, never replaced.
         self._cells: Dict[Tuple[Tuple[str, str], ...], dict] = {}
         with _lock:
             _registry.append(self)
         _ensure_flusher()
 
     def set_default_tags(self, tags: Dict[str, str]) -> "_Metric":
+        # Rebind, don't mutate: _tagset readers see either the old or
+        # the new dict, never a half-updated one.
         self._default_tags = dict(tags)
         return self
 
@@ -166,6 +189,8 @@ class _Metric:
         return tuple(sorted(merged.items()))
 
     def _cell(self, tags) -> dict:
+        """Resolve (create-once) the cell for a tagset.  Caller holds
+        the registry lock `_lock`."""
         ts = self._tagset(tags)
         cell = self._cells.get(ts)
         if cell is None:
@@ -176,7 +201,8 @@ class _Metric:
     def _new_cell(self) -> dict:
         raise NotImplementedError
 
-    def _drain(self) -> List[dict]:
+    def _drain_locked(self) -> List[dict]:
+        """Caller holds the registry lock `_lock`."""
         raise NotImplementedError
 
 
@@ -192,19 +218,18 @@ class Counter(_Metric):
             tags: Optional[Dict[str, str]] = None) -> None:
         if value < 0:
             raise ValueError("Counter.inc() requires value >= 0")
-        with self._lock:
+        with _lock:
             self._cell(tags)["delta"] += value
 
-    def _drain(self) -> List[dict]:
+    def _drain_locked(self) -> List[dict]:
         out = []
-        with self._lock:
-            for ts, cell in self._cells.items():
-                if cell["delta"]:
-                    out.append({"name": self.name, "kind": "counter",
-                                "tags": dict(ts),
-                                "value": cell["delta"],
-                                "description": self.description})
-                    cell["delta"] = 0.0
+        for ts, cell in self._cells.items():
+            if cell["delta"]:
+                out.append({"name": self.name, "kind": "counter",
+                            "tags": dict(ts),
+                            "value": cell["delta"],
+                            "description": self.description})
+                cell["delta"] = 0.0
         return out
 
 
@@ -218,21 +243,20 @@ class Gauge(_Metric):
 
     def set(self, value: float,
             tags: Optional[Dict[str, str]] = None) -> None:
-        with self._lock:
+        with _lock:
             cell = self._cell(tags)
             cell["value"] = float(value)
             cell["dirty"] = True
 
-    def _drain(self) -> List[dict]:
+    def _drain_locked(self) -> List[dict]:
         out = []
-        with self._lock:
-            for ts, cell in self._cells.items():
-                if cell["dirty"]:
-                    out.append({"name": self.name, "kind": "gauge",
-                                "tags": dict(ts),
-                                "value": cell["value"],
-                                "description": self.description})
-                    cell["dirty"] = False
+        for ts, cell in self._cells.items():
+            if cell["dirty"]:
+                out.append({"name": self.name, "kind": "gauge",
+                            "tags": dict(ts),
+                            "value": cell["value"],
+                            "description": self.description})
+                cell["dirty"] = False
         return out
 
     def remove(self, tags: Optional[Dict[str, str]] = None) -> None:
@@ -242,12 +266,12 @@ class Gauge(_Metric):
         than the last live value.  For per-instance-tagged gauges
         (e.g. the paged-KV engine series) this keeps repeated
         construct/stop cycles from accumulating dead cells forever."""
-        global _pending
         ts = self._tagset(tags)
-        with self._lock:
-            existed = self._cells.pop(ts, None) is not None
-        if existed:
-            with _lock:
+        with _lock:
+            # One lock for pop + pending enqueue: the old split
+            # (per-metric lock, then registry lock) let a flush slip
+            # between them and push the zero before a straggler set().
+            if self._cells.pop(ts, None) is not None:
                 _pending.append({"name": self.name, "kind": "gauge",
                                  "tags": dict(ts), "value": 0.0,
                                  "description": self.description})
@@ -280,7 +304,7 @@ class Histogram(_Metric):
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
-        with self._lock:
+        with _lock:
             cell = self._cell(tags)
             for b in self.boundaries:
                 if value <= b:
@@ -292,22 +316,18 @@ class Histogram(_Metric):
     def observer(self, tags: Optional[Dict[str, str]] = None):
         """Pre-resolved observe callable for one tag set — hot paths
         (compiled-DAG hops at µs rates) skip the per-call tag
-        merge/sort.  The tagset key is pinned; _drain resets the cell
-        dict in place is NOT done (drain replaces the cell), so the
-        callable re-resolves through _cells each call by key."""
-        ts = self._tagset(tags)
-        lock = self._lock
+        merge/sort AND the cell-map lookup: the cell object is
+        resolved once here (create-once under the registry lock) and
+        pinned in the closure.  Sound because histogram cells are
+        never replaced — _drain_locked resets them in place — so the
+        pinned reference can't go stale (the old check-then-act
+        re-resolution re-created cells racing the drain)."""
         boundaries = self.boundaries
-        cells = self._cells
-        with lock:
-            if ts not in cells:
-                cells[ts] = self._new_cell()
+        with _lock:
+            cell = self._cell(tags)
 
         def obs(value: float) -> None:
-            with lock:
-                cell = cells.get(ts)
-                if cell is None:
-                    cell = cells[ts] = self._new_cell()
+            with _lock:
                 for b in boundaries:
                     if value <= b:
                         cell["buckets"][str(b)] += 1
@@ -317,19 +337,22 @@ class Histogram(_Metric):
 
         return obs
 
-    def _drain(self) -> List[dict]:
+    def _drain_locked(self) -> List[dict]:
         out = []
-        with self._lock:
-            for ts, cell in self._cells.items():
-                if cell["count"]:
-                    out.append({"name": self.name, "kind": "histogram",
-                                "tags": dict(ts),
-                                "value": 0.0,
-                                "buckets": dict(cell["buckets"]),
-                                "sum": cell["sum"],
-                                "count": cell["count"],
-                                "description": self.description})
-                    self._cells[ts] = self._new_cell()
+        for ts, cell in self._cells.items():
+            if cell["count"]:
+                out.append({"name": self.name, "kind": "histogram",
+                            "tags": dict(ts),
+                            "value": 0.0,
+                            "buckets": dict(cell["buckets"]),
+                            "sum": cell["sum"],
+                            "count": cell["count"],
+                            "description": self.description})
+                # Reset IN PLACE: observer() closures pin this dict.
+                for k in cell["buckets"]:
+                    cell["buckets"][k] = 0
+                cell["sum"] = 0.0
+                cell["count"] = 0
         return out
 
 
@@ -373,16 +396,20 @@ def shared_histogram(name: str, description: str = "",
 # ---------------------------------------------------------------------------
 def flush() -> None:
     """Push pending deltas to the node service now (also called by the
-    daemon flusher).  Failed pushes requeue the drained batch."""
+    daemon flusher).  Failed pushes requeue the drained batch.
+
+    Drain runs under the registry lock (consistent snapshot across
+    every metric); the network push runs OUTSIDE it — blocking on the
+    node service while holding the lock would convoy every writer
+    (the RT011 class)."""
     global _pending
     client = get_global_client()
     if client is None:
         return
     with _lock:
-        metrics = list(_registry)
         batch, _pending = list(_pending), []
-    for m in metrics:
-        batch.extend(m._drain())
+        for m in _registry:
+            batch.extend(m._drain_locked())
     if not batch:
         return
     try:

@@ -200,6 +200,24 @@ def summarize_objects() -> Dict[str, Any]:
     return {"count": len(objs), "total_bytes": total, "by_loc": by_loc}
 
 
+def locksan_report(directory: Optional[str] = None) -> Dict[str, Any]:
+    """Merged concurrency-sanitizer report (devtools/locksan.py).
+
+    Requires running the workload with ``RAY_TPU_LOCKSAN=1``: every
+    process (driver, node services, workers — the env var inherits)
+    instruments its locks and drops a ``<pid>.json`` report into the
+    locksan dir; this merges them with the calling process's live
+    state.  Keys: ``processes``, ``acquires``, ``edges`` (observed
+    acquisition orders ``"A || B"`` -> count), ``contention`` (by
+    lock creation site), ``inversions`` (lock-order cycles actually
+    witnessed at runtime — each a deadlock under the right timing),
+    and ``long_holds`` (locks held past ``lock_hold_warn_ms``, with
+    the holder's stack).  Unlike the other state APIs this does not
+    need an initialized runtime — reports outlive the cluster."""
+    from ray_tpu.devtools import locksan
+    return locksan.merged_report(directory)
+
+
 def memory_summary(leak_min_age_s: float = 60.0,
                    top_n: int = 200) -> Dict[str, Any]:
     """Cluster-wide object-store memory accounting (reference surface:

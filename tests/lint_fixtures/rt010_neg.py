@@ -1,0 +1,47 @@
+"""RT010 negative: every shared access guarded; construction-phase
+and held-lock-convention accesses exempt."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._items["seed"] = 1     # construction: not shared yet
+
+    def add(self, k, v):
+        with self._lock:
+            self._items[k] = v
+            self._prune_locked()
+
+    def drop(self, k):
+        with self._lock:
+            self._items.pop(k, None)
+
+    def _prune_locked(self):
+        # `_locked` suffix: runs with the lock held by convention.
+        while len(self._items) > 8:
+            self._items.popitem()
+
+    def size(self):
+        """Caller holds self._lock."""
+        return len(self._items)
+
+
+class ReadOnly:
+    """Never-mutated attributes don't fire even when mostly guarded."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._name = "fixed"
+
+    def a(self):
+        with self._lock:
+            return self._name
+
+    def b(self):
+        with self._lock:
+            return self._name + "!"
+
+    def c(self):
+        return self._name

@@ -1,0 +1,26 @@
+"""RT010 positive: attribute guarded everywhere else, accessed bare."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def drop(self, k):
+        with self._lock:
+            self._items.pop(k, None)
+
+    def drain(self):
+        with self._lock:
+            out = dict(self._items)
+            self._items.clear()
+        return out
+
+    def snapshot(self):
+        # BARE read of a lock-guarded map from another thread's method.
+        return list(self._items)

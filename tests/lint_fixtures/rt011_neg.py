@@ -1,0 +1,50 @@
+"""RT011 negative: blocking work outside critical sections; the
+patterns whose whole point is holding a lock stay silent."""
+import threading
+import time
+
+import ray_tpu
+
+_lock = threading.Lock()
+
+
+class Conn:
+    def __init__(self, sock):
+        self._send_lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._sock = sock
+        self._buf = []
+
+    def send(self, frame):
+        # A dedicated send lock EXISTS to cover sendall.
+        with self._send_lock:
+            self._sock.sendall(frame)
+
+    def send_with_stats(self, frame):
+        # The send lock exempts sendall wherever it sits in the held
+        # set — later with-item or an inner nested with.
+        with self._cond, self._send_lock:
+            self._sock.sendall(frame)
+
+    def send_nested(self, frame):
+        with self._cond:
+            with self._send_lock:
+                self._sock.sendall(frame)
+
+    def pop(self):
+        with self._cond:
+            while not self._buf:
+                self._cond.wait(1.0)     # Condition.wait releases it
+            return self._buf.pop()
+
+
+def fetch(ref):
+    blob = ray_tpu.get(ref)              # get OUTSIDE the lock
+    with _lock:
+        return blob
+
+
+def backoff():
+    time.sleep(0.1)
+    with _lock:
+        pass

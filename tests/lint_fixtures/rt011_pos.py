@@ -1,0 +1,37 @@
+"""RT011 positive: blocking calls inside `with <lock>` bodies."""
+import subprocess
+import threading
+import time
+
+import ray_tpu
+
+_lock = threading.Lock()
+
+
+class Conn:
+    def __init__(self, sock):
+        self._conn_lock = threading.Lock()
+        self._sock = sock
+
+    def dial(self, addr):
+        with self._conn_lock:
+            self._sock.connect(addr)      # socket dial under lock
+
+    def dial_multi_item(self, addr):
+        # Later with-items evaluate with earlier locks HELD.
+        with self._conn_lock, self._sock.connect(addr):
+            pass
+
+    def fetch(self, ref):
+        with self._conn_lock:
+            return ray_tpu.get(ref)       # blocking get under lock
+
+
+def backoff():
+    with _lock:
+        time.sleep(1.0)                   # sleep under lock
+
+
+def build():
+    with _lock:
+        subprocess.run(["make"], check=True)

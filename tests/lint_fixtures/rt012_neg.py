@@ -1,0 +1,22 @@
+"""RT012 negative: nested acquisition always follows one global
+order, so the lock-order graph is acyclic."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._outer_lock = threading.Lock()
+        self._inner_lock = threading.Lock()
+        self._balance = 0
+        self._log = []
+
+    def debit(self, n):
+        with self._outer_lock:
+            with self._inner_lock:       # order: outer -> inner
+                self._balance -= n
+                self._log.append(("debit", n))
+
+    def credit(self, n):
+        with self._outer_lock, self._inner_lock:   # same order
+            self._balance += n
+            self._log.append(("credit", n))

@@ -1,0 +1,21 @@
+"""RT012 positive: the same two locks acquired in opposite orders."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._acct_lock = threading.Lock()
+        self._audit_lock = threading.Lock()
+        self._balance = 0
+        self._log = []
+
+    def debit(self, n):
+        with self._acct_lock:
+            with self._audit_lock:       # order: acct -> audit
+                self._balance -= n
+                self._log.append(("debit", n))
+
+    def audit(self):
+        with self._audit_lock:
+            with self._acct_lock:        # order: audit -> acct (CYCLE)
+                self._log.append(("audit", self._balance))

@@ -66,6 +66,8 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.PREFIX_CACHE_HITS_METRIC)
     assert _NAME.match(metrics.PREFIX_CACHE_QUERIES_METRIC)
     assert _NAME.match(metrics.KV_EVICTIONS_METRIC)
+    assert _NAME.match(metrics.LOCK_WAIT_SECONDS_METRIC)
+    assert _NAME.match(metrics.LOCK_CONTENTION_METRIC)
     assert metrics.DAG_EXECUTIONS_METRIC.endswith("_total")
     # hop_seconds is a histogram — no _total.
     assert not metrics.DAG_HOP_SECONDS_METRIC.endswith("_total")
@@ -86,10 +88,14 @@ def test_declared_builtin_names_are_legal():
     assert metrics.PREFIX_CACHE_QUERIES_METRIC.endswith("_total")
     assert metrics.KV_EVICTIONS_METRIC.endswith("_total")
     assert not metrics.KV_BLOCKS_METRIC.endswith("_total")
+    # Locksan: contention is a counter, wait_seconds a histogram.
+    assert metrics.LOCK_CONTENTION_METRIC.endswith("_total")
+    assert not metrics.LOCK_WAIT_SECONDS_METRIC.endswith("_total")
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
                metrics.OBJECT_TRANSFER_BUCKETS,
                metrics.DRAIN_DURATION_BUCKETS,
-               metrics.GCS_RESYNC_BUCKETS, metrics.DAG_HOP_BUCKETS):
+               metrics.GCS_RESYNC_BUCKETS, metrics.DAG_HOP_BUCKETS,
+               metrics.LOCK_WAIT_BUCKETS):
         assert all(a < b for a, b in zip(bs, bs[1:]))
 
 
