@@ -422,6 +422,65 @@ def cmd_stack(args) -> int:
     return 0
 
 
+def _serve_shed_counters() -> dict:
+    """deployment -> {reason: count} from the merged metric plane."""
+    out: dict = {}
+    try:
+        from ray_tpu.util import metrics
+        for s in metrics.scrape():
+            if s.get("name") != metrics.SERVE_REQUESTS_SHED_METRIC:
+                continue
+            tags = s.get("tags") or {}
+            dep = tags.get("deployment", "?")
+            out.setdefault(dep, {})[tags.get("reason", "?")] = \
+                int(s.get("value") or 0)
+    except Exception:
+        pass
+    return out
+
+
+def _render_serve_status(data: dict, shed: dict) -> str:
+    """Text face of `ray_tpu serve status` (pure: unit-testable).
+    `data` is the controller's overload_status(); `shed` maps
+    deployment -> {reason: count} from the metric plane."""
+    lines = []
+    for name, s in sorted(data.items()):
+        lines.append(
+            f"{name}: {s.get('running', 0)} running"
+            f" / {s.get('draining', 0)} draining"
+            f" (target {s.get('target_replicas', '?')},"
+            f" v{s.get('version', '?')})")
+        qd = s.get("queue_depth")
+        ttft = s.get("ttft_p95_ms")
+        itl = s.get("itl_p95_ms")
+        lines.append(
+            "  queue_depth "
+            + (f"{qd:g}" if qd is not None else "n/a")
+            + "  ttft_p95 "
+            + (f"{ttft:.1f}ms" if ttft is not None else "n/a")
+            + "  itl_p95 "
+            + (f"{itl:.2f}ms" if itl is not None else "n/a"))
+        counts = shed.get(name) or {}
+        if counts:
+            lines.append("  shed: " + ", ".join(
+                f"{r}={n}" for r, n in sorted(counts.items())))
+        adm = s.get("admission")
+        if adm:
+            lines.append("  admission: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(adm.items())))
+        last = s.get("autoscale_last")
+        if last:
+            lines.append(
+                f"  autoscale: {last.get('action')} "
+                f"{last.get('current')} -> {last.get('desired')} "
+                f"({last.get('reason')})")
+        for ev in s.get("autoscale_events") or []:
+            lines.append(
+                f"    event: {ev.get('action')} {ev.get('current')}"
+                f" -> {ev.get('desired')} ({ev.get('reason')})")
+    return "\n".join(lines) if lines else "(no deployments)"
+
+
 def cmd_serve(args) -> int:
     """Declarative serve apply/status/shutdown (reference: `serve
     deploy` over the REST config, serve/schema.py)."""
@@ -437,7 +496,20 @@ def cmd_serve(args) -> int:
             names = serve_apply(args.config)
             print(json.dumps({"deployed": names}))
         elif args.serve_cmd == "status":
-            print(json.dumps(serve.status(), indent=1, default=str))
+            import ray_tpu
+            from ray_tpu.serve._controller import CONTROLLER_NAME
+            try:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                data = ray_tpu.get(
+                    controller.overload_status.remote(), timeout=60)
+            except ValueError:
+                data = {}       # serve never started on this cluster
+            shed = _serve_shed_counters()
+            if getattr(args, "json", False):
+                print(json.dumps({"deployments": data, "shed": shed},
+                                 indent=1, default=str))
+            else:
+                print(_render_serve_status(data, shed))
         elif args.serve_cmd == "shutdown":
             serve.shutdown()
             print("serve shut down")
@@ -757,8 +829,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("config")
     sp.add_argument("--address", default=None,
                     help="cluster client address host:port")
-    sp2 = ssub.add_parser("status")
+    sp2 = ssub.add_parser(
+        "status", help="deployments: replicas by state, queue depth, "
+                       "shed counters, autoscale decision")
     sp2.add_argument("--address", default=None)
+    sp2.add_argument("--json", action="store_true",
+                     help="machine-readable dump")
     sp3 = ssub.add_parser("shutdown")
     sp3.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_serve)

@@ -30,12 +30,13 @@ import cloudpickle
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
                                      multiplexed)
+from ray_tpu.serve._admission import RequestRejectedError
 from ray_tpu.serve._controller import CONTROLLER_NAME, ServeController
 
 __all__ = ["deployment", "run", "build", "delete", "shutdown", "status",
            "get_deployment_handle", "batch", "Deployment",
            "DeploymentHandle", "start_http_proxy", "start_grpc_proxy",
-           "multiplexed",
+           "multiplexed", "RequestRejectedError",
            "get_multiplexed_model_id"]
 
 
@@ -106,15 +107,24 @@ def deployment(_cls: Optional[type] = None, *,
                max_concurrent_queries: int = 8,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
+               admission_config: Optional[Dict[str, Any]] = None,
                health_check_period_s: float = 10.0,
                health_check_timeout_s: float = 30.0,
                user_config: Any = None):
     """@serve.deployment decorator (reference: serve/api.py).
 
     `autoscaling_config` (reference: serve/config.py AutoscalingConfig)
-    keys: min_replicas, max_replicas, target_ongoing_requests,
-    upscale_delay_s, downscale_delay_s — the controller then owns
-    num_replicas, scaling on replica-reported ongoing requests."""
+    keys: min_replicas, max_replicas, target_ongoing_requests /
+    target_queue_depth, target_ttft_ms, target_itl_ms,
+    upscale_delay_s, downscale_delay_s, interval_s — the controller
+    then owns num_replicas, scaling on replica-reported queue depth
+    and the TTFT / inter-token-latency SLO metrics.
+
+    `admission_config` (serve/_admission.py) keys: max_queue_depth,
+    rate_rps, burst, retry_after_s, priority_thresholds,
+    tenant_weights, tenant_pressure — requests beyond capacity are
+    shed with a typed RequestRejectedError instead of queueing to a
+    timeout."""
 
     def deco(cls: type) -> Deployment:
         return Deployment(cls, {
@@ -123,6 +133,8 @@ def deployment(_cls: Optional[type] = None, *,
             "ray_actor_options": dict(ray_actor_options or {}),
             "autoscaling_config": (dict(autoscaling_config)
                                    if autoscaling_config else None),
+            "admission_config": (dict(admission_config)
+                                 if admission_config else None),
             "health_check_period_s": health_check_period_s,
             "health_check_timeout_s": health_check_timeout_s,
             "user_config": user_config,
@@ -164,38 +176,51 @@ class DeploymentHandle:
 
 class _HandleMethod:
     def __init__(self, handle: DeploymentHandle, method: str,
-                 stream: bool = False, model_id: str = "") -> None:
+                 stream: bool = False, model_id: str = "",
+                 priority: str = "normal", tenant_id: str = "") -> None:
         self._handle = handle
         self._method = method
         self._stream = stream
         self._model_id = model_id
+        self._priority = priority
+        self._tenant_id = tenant_id
 
     def options(self, *, stream: bool = False,
-                multiplexed_model_id: str = "") -> "_HandleMethod":
+                multiplexed_model_id: str = "",
+                priority: str = "normal",
+                tenant_id: str = "") -> "_HandleMethod":
         """`handle.method.options(stream=True).remote(...)` returns an
         ObjectRefGenerator of per-item refs (reference:
         serve/handle.py DeploymentResponseGenerator);
         `multiplexed_model_id` routes to replicas holding the model
-        (reference: handle multiplexing)."""
+        (reference: handle multiplexing).  `priority` ("high" |
+        "normal" | "low") and `tenant_id` feed admission control:
+        under overload low-priority traffic sheds first and tenants
+        are held to weighted fair shares (serve/_admission.py)."""
         return _HandleMethod(self._handle, self._method, stream=stream,
-                             model_id=multiplexed_model_id)
+                             model_id=multiplexed_model_id,
+                             priority=priority, tenant_id=tenant_id)
 
     def remote(self, *args, **kwargs):
         router = self._handle._get_router()
         if self._stream:
-            gen, replica = router.assign_stream(self._method, args,
-                                                kwargs)
-            _attach_done_callback(router, gen.completed(), replica)
+            gen, replica, release = router.assign_stream(
+                self._method, args, kwargs, priority=self._priority,
+                tenant_id=self._tenant_id)
+            _attach_done_callback(router, gen.completed(), replica,
+                                  release)
             return gen
         # Unary requests: the router's per-request waiter owns the
         # done-callback AND failover (un-started requests retry once on
         # a different replica) — see _router.Router._watch.
         ref, _ = router.assign(self._method, args, kwargs,
-                               self._model_id)
+                               self._model_id,
+                               priority=self._priority,
+                               tenant_id=self._tenant_id)
         return ref
 
 
-def _attach_done_callback(router, ref, replica) -> None:
+def _attach_done_callback(router, ref, replica, release=None) -> None:
     """STREAM path only: decrement the outstanding count when the
     stream completes, and report dead replicas to the controller (drop
     from routing + backfill).  Unary requests ride the router's own
@@ -217,6 +242,8 @@ def _attach_done_callback(router, ref, replica) -> None:
             pass
         finally:
             router.done(replica)
+            if release is not None:
+                release()
 
     threading.Thread(target=waiter, daemon=True,
                      name="rtpu-serve-done").start()
@@ -319,7 +346,8 @@ def _deploy_one(controller, name: str, dep: Deployment,
         actor_opts, opts.get("autoscaling_config"),
         opts.get("health_check_period_s", 10.0),
         opts.get("health_check_timeout_s", 30.0),
-        opts.get("user_config")), timeout=120)
+        opts.get("user_config"),
+        opts.get("admission_config")), timeout=120)
 
 
 def run(target: Deployment, *, name: Optional[str] = None,

@@ -32,17 +32,31 @@ _SERVICE = "ray_tpu.serve.Serve"
 
 def _handle_unary(request: bytes) -> bytes:
     import ray_tpu
-    from ray_tpu import serve
+    from ray_tpu.serve._admission import RequestRejectedError
     from ray_tpu.serve._router import NoReplicasError
     try:
         req = json.loads(request)
-        handle = serve.get_deployment_handle(req["deployment"])
+        # Shared per-deployment handle (one router): see _proxy.py —
+        # a fresh router per call can neither shed nor scale cheaply.
+        from ray_tpu.serve._proxy import _get_handle
+        handle = _get_handle(req["deployment"])
         m = handle.method(req.get("method") or "__call__")
+        opts = {}
         if req.get("multiplexed_model_id"):
-            m = m.options(
-                multiplexed_model_id=req["multiplexed_model_id"])
+            opts["multiplexed_model_id"] = req["multiplexed_model_id"]
+        if req.get("priority"):
+            opts["priority"] = req["priority"]
+        if req.get("tenant_id"):
+            opts["tenant_id"] = req["tenant_id"]
+        if opts:
+            m = m.options(**opts)
         result = ray_tpu.get(m.remote(req.get("arg")), timeout=120)
         return json.dumps({"result": result}, default=str).encode()
+    except RequestRejectedError as e:
+        # Structured shed (RESOURCE_EXHAUSTED analog): the rejection
+        # schema rides the JSON envelope, code 429 like the HTTP face.
+        return json.dumps({"error": repr(e), "code": 429,
+                           **e.to_dict()}).encode()
     except (NoReplicasError, ValueError, KeyError) as e:
         return json.dumps({"error": repr(e), "code": 404}).encode()
     except Exception as e:  # noqa: BLE001
@@ -51,19 +65,26 @@ def _handle_unary(request: bytes) -> bytes:
 
 def _handle_stream(request: bytes):
     import ray_tpu
-    from ray_tpu import serve
+    from ray_tpu.serve._admission import RequestRejectedError
     try:
         req = json.loads(request)
-        handle = serve.get_deployment_handle(req["deployment"])
+        from ray_tpu.serve._proxy import _get_handle
+        handle = _get_handle(req["deployment"])
         m = handle.method(req.get("method") or "__call__")
         gen = m.options(
             stream=True,
             multiplexed_model_id=req.get("multiplexed_model_id") or "",
+            priority=req.get("priority") or "normal",
+            tenant_id=req.get("tenant_id") or "",
         ).remote(req.get("arg"))
         for ref in gen:
             item = ray_tpu.get(ref, timeout=120)
             yield json.dumps({"item": item}, default=str).encode()
         yield json.dumps({"end": True}).encode()
+    except RequestRejectedError as e:
+        # Same structured shed envelope as the unary face.
+        yield json.dumps({"error": repr(e), "code": 429,
+                          **e.to_dict()}).encode()
     except Exception as e:  # noqa: BLE001
         yield json.dumps({"error": repr(e)}).encode()
 

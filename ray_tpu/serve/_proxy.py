@@ -37,6 +37,29 @@ def _handles():
     return serve
 
 
+# One DeploymentHandle (= one router) per deployment, shared across
+# requests.  A handle per REQUEST would give every request a fresh
+# router: a controller get_replicas RPC + a parked 60 s long-poll per
+# hit (controller concurrency exhaustion under load), and an
+# admission gate that always reads queue depth 0 — shedding could
+# never trigger through the proxy.
+_HANDLES: Dict[str, Any] = {}
+_handles_lock = threading.Lock()
+
+
+def _get_handle(name: str):
+    with _handles_lock:
+        h = _HANDLES.get(name)
+        if h is None:
+            h = _HANDLES[name] = _handles().get_deployment_handle(name)
+        return h
+
+
+def _clear_handles() -> None:
+    with _handles_lock:
+        _HANDLES.clear()
+
+
 class _ProxyHandler(BaseHTTPRequestHandler):
     # HTTP/1.1 so chunked transfer-encoding (SSE streaming) is legal.
     protocol_version = "HTTP/1.1"
@@ -45,13 +68,30 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         pass
 
     # -- helpers -------------------------------------------------------
-    def _send(self, code: int, payload: Any) -> None:
+    def _send(self, code: int, payload: Any,
+              headers: Optional[Dict[str, str]] = None) -> None:
         body = json.dumps(payload, default=str).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_rejection(self, e) -> None:
+        """Structured shed response: HTTP 429 + Retry-After + the
+        rejection schema (reason / retry_after_s / priority /
+        tenant_id) — the explicit sub-10 ms answer an overloaded
+        deployment gives instead of a slow-burn timeout.  The header
+        is delay-seconds (RFC 9110: a non-negative INTEGER — a
+        fractional value is ignored by compliant clients); the exact
+        fractional hint rides the JSON body."""
+        import math
+        self._send(429, e.to_dict(),
+                   headers={"Retry-After":
+                            str(int(math.ceil(
+                                max(e.retry_after_s, 0.0))))})
 
     def _send_sse(self, gen) -> None:
         """Drain a streaming-generator handle as chunked SSE."""
@@ -148,20 +188,34 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         stream = (query.pop("stream", "") in ("1", "true")
                   or "text/event-stream"
                   in (self.headers.get("Accept") or ""))
+        # Admission-control tags: query params win, headers are the
+        # JSON-body-POST ergonomic fallback.  Routing flags, never
+        # user arguments.
+        priority = (query.pop("priority", "")
+                    or self.headers.get("X-Serve-Priority")
+                    or "normal")
+        tenant = (query.pop("tenant", "")
+                  or self.headers.get("X-Serve-Tenant") or "")
         # No per-request existence pre-check (that would add a full
         # controller status() round-trip to the hot path): route
         # directly; only the TYPED routing failures map to 404 — a user
         # method raising ValueError must surface as 500, not
         # "not found".
+        from ray_tpu.serve._admission import RequestRejectedError
         from ray_tpu.serve._router import NoReplicasError
-        handle = serve.get_deployment_handle(name)
+        handle = _get_handle(name)
         try:
             m = (getattr(handle, method) if method
                  else handle.method("__call__"))
+            m = m.options(stream=stream, priority=priority,
+                          tenant_id=tenant)
             if stream:
-                gen = m.options(stream=True).remote(arg)
+                gen = m.remote(arg)
             else:
                 ref = m.remote(arg)
+        except RequestRejectedError as e:
+            self._send_rejection(e)
+            return
         except NoReplicasError as e:
             self._send(404, {"error": repr(e)})
             return
@@ -177,13 +231,18 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             return
         try:
             self._send(200, {"result": ray_tpu.get(ref, timeout=120)})
+        except RequestRejectedError as e:
+            # Replica-side shed (the LLM engine's queue backstop)
+            # rides the error plane back — same structured 429.
+            self._send_rejection(e)
         except Exception as e:
             self._send(500, {"error": repr(e)})
 
     # -- verbs ---------------------------------------------------------
     def do_GET(self) -> None:
         q = dict(parse_qsl(urlparse(self.path).query))
-        q.pop("stream", None)      # routing flag, not a user argument
+        for flag in ("stream", "priority", "tenant"):
+            q.pop(flag, None)      # routing flags, not user arguments
         self._route(q or None)
 
     def do_POST(self) -> None:
@@ -221,6 +280,7 @@ def stop() -> None:
         if _server is not None:
             _server.shutdown()
             _server = None
+    _clear_handles()
 
 
 

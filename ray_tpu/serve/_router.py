@@ -30,7 +30,17 @@ replay could double side effects); their death error bridges through.
 Circuit breaker: consecutive request failures sideline a replica
 (excluded from pick) until its next successful queue-length probe —
 router-local protection for the window before the controller's
-replacement propagates.
+replacement propagates.  A sidelined replica receives no traffic, so
+request waiters can never discover it died — the PROBE classifies
+death errors itself (report + drop) so a replica that is sidelined
+and then scaled away or killed is removed instead of probed forever.
+
+Admission control (serve/_admission.py): deployments with an
+``admission_config`` get a per-router gate checked BEFORE the replica
+pick — token bucket, priority-classed queue-depth caps, per-tenant
+weighted fairness.  A shed raises the typed RequestRejectedError from
+``assign``/``assign_stream`` synchronously (pure local state, sub-10
+ms) instead of parking the request until a timeout.
 """
 
 from __future__ import annotations
@@ -60,7 +70,11 @@ class NoReplicasError(RuntimeError):
 
 class Router:
     def __init__(self, deployment_name: str) -> None:
+        from ray_tpu.serve._admission import AdmissionController
         self._name = deployment_name
+        # Admission gate (token bucket / priority / tenant fairness);
+        # configured from the controller's pushed admission_config.
+        self._gate = AdmissionController(deployment_name)
         self._replicas: List[Any] = []
         self._version = -1
         self._outstanding: Dict[bytes, int] = {}
@@ -81,6 +95,13 @@ class Router:
         # task path without paying probe+compile on every request.
         self._pipes: Dict[bytes, tuple] = {}
         self._pipe_failed: Dict[bytes, float] = {}
+        # Pipes of replicas REMOVED from the routing set while their
+        # requests are still in flight (graceful scale-down mask):
+        # torn down from done() once the replica's outstanding count
+        # drains — tearing down under in-flight requests surfaces
+        # "DAG was torn down" to users whose replica is alive and
+        # merely draining.
+        self._retired_pipes: Dict[bytes, tuple] = {}
         self._last_refresh = 0.0
         self._last_probe = 0.0
         self._probe_thread = None
@@ -110,19 +131,36 @@ class Router:
         self._ensure_poll_thread()
 
     def _apply(self, info: dict) -> None:
+        self._gate.configure(info.get("admission"))
         with self._lock:
             self._replicas = info["replicas"]
             self._version = info["version"]
             self._last_refresh = time.time()
             live = {r._actor_id for r in self._replicas}
-            dead_pipes = [self._pipes.pop(k) for k in
-                          list(self._pipes) if k not in live]
+            dead_pipes = []
+            for k in list(self._pipes):
+                if k in live:
+                    continue
+                ent = self._pipes.pop(k)
+                if self._outstanding.get(k, 0) > 0:
+                    # Replica masked (draining) with requests still in
+                    # flight through its pipe: park it; done() tears
+                    # it down when the last request completes.
+                    self._retired_pipes[k] = ent
+                else:
+                    dead_pipes.append(ent)
             self._pipe_failed = {k: v for k, v
                                  in self._pipe_failed.items()
                                  if k in live}
-            self._outstanding = {
-                r._actor_id: self._outstanding.get(r._actor_id, 0)
-                for r in self._replicas}
+            out = {r._actor_id: self._outstanding.get(r._actor_id, 0)
+                   for r in self._replicas}
+            for k, n in self._outstanding.items():
+                if k not in out and n > 0:
+                    # Draining replica's in-flight requests: keep the
+                    # count so _total_depth sees them and done() can
+                    # detect the drain completing.
+                    out[k] = n
+            self._outstanding = out
             self._probed = {
                 r._actor_id: self._probed.get(r._actor_id, 0)
                 for r in self._replicas}
@@ -182,6 +220,7 @@ class Router:
 
         def probe() -> None:
             import ray_tpu
+            from ray_tpu import exceptions as exc
             from ray_tpu._private.client import get_global_client
             for r in reps:
                 if get_global_client() is None:
@@ -189,6 +228,19 @@ class Router:
                 try:
                     info = ray_tpu.get(r.replica_info.remote(),
                                        timeout=5)
+                except (exc.ActorDiedError,
+                        exc.WorkerCrashedError) as e:
+                    # A sidelined replica gets no traffic, so no
+                    # request waiter will ever report its death — if
+                    # it was killed or scaled away in the meantime
+                    # the probe is the only path that can notice.
+                    # Without this classification the router probes
+                    # it every interval forever, waiting for a
+                    # successful probe that can never come.
+                    # (ActorUnavailableError = restarting: keep
+                    # probing, it will answer when it's back.)
+                    self._note_replica_failure(r, e)
+                    continue
                 except Exception:
                     continue
                 with self._lock:
@@ -241,6 +293,14 @@ class Router:
         k = replica._actor_id
         return self._outstanding.get(k, 0) + self._probed.get(k, 0)
 
+    def _total_depth(self) -> int:
+        """This router's view of the deployment's total outstanding
+        requests (its own in-flight + other routers' probed load) —
+        the queue-depth the admission gate judges against."""
+        with self._lock:
+            return (sum(self._outstanding.values())
+                    + sum(self._probed.values()))
+
     def pick(self, model_id: str = "", exclude=()):
         """Pow-2 choice over caller-side outstanding + probed counts;
         with a multiplexed model id, replicas already holding the
@@ -279,10 +339,20 @@ class Router:
             return choice
 
     def done(self, replica) -> None:
+        ent = None
         with self._lock:
             k = replica._actor_id
             if self._outstanding.get(k, 0) > 0:
                 self._outstanding[k] -= 1
+            if self._outstanding.get(k, 0) == 0 \
+                    and all(r._actor_id != k for r in self._replicas):
+                # A retired (masked/draining) replica just drained its
+                # last in-flight request: drop the bookkeeping and
+                # tear its parked pipe down now that nothing rides it.
+                self._outstanding.pop(k, None)
+                ent = self._retired_pipes.pop(k, None)
+        if ent is not None:
+            self._teardown_pipe_async(ent)
 
     # -- compiled serve pipeline (serve_compiled_pipeline) --------------
     @staticmethod
@@ -328,7 +398,8 @@ class Router:
 
     def _drop_pipe(self, actor_id: bytes) -> None:
         with self._lock:
-            ent = self._pipes.pop(actor_id, None)
+            ent = (self._pipes.pop(actor_id, None)
+                   or self._retired_pipes.pop(actor_id, None))
         if ent is not None:
             self._teardown_pipe_async(ent)
 
@@ -340,7 +411,8 @@ class Router:
                          name="rtpu-serve-pipe-td").start()
 
     def _watch_pipe(self, relay_ref, dag_ref, replica, method: str,
-                    args: tuple, kwargs: dict, model_id: str) -> None:
+                    args: tuple, kwargs: dict, model_id: str,
+                    release=None) -> None:
         """Compiled-path waiter: read the pipe's ("ok"|"err", value)
         envelope and bridge it onto the relay.  The graph itself is
         at-most-once; requests it LOSES on a replica death (envelope
@@ -348,106 +420,131 @@ class Router:
         through the ordinary task path on another replica — the same
         replay window actor max_task_retries accepts.  Either way the
         pipe is dropped, so later requests compile a fresh one on the
-        controller's replacement replica."""
+        controller's replacement replica.  `release` (the admission
+        slot) fires when the request reaches a terminal outcome here,
+        or is FORWARDED to the task-path waiter on failover."""
         relay = relay_ref.binary()
 
         def waiter() -> None:
             from ray_tpu import exceptions as exc
             _pin = relay_ref     # hold until the bridge lands
+            delegated = False
             try:
-                # No deadline: one slow request must not tear down the
-                # SHARED pipe (a TimeoutError here would close the
-                # channels under up-to-capacity unrelated in-flight
-                # requests).  Matches the task path's indefinite wait;
-                # a dead replica still surfaces via the loop-death
-                # check inside get().
-                status, value = dag_ref.get()
-            except BaseException as e:  # noqa: BLE001
-                self.done(replica)
-                self._drop_pipe(replica._actor_id)
-                death = isinstance(e, (exc.ActorDiedError,
-                                       exc.WorkerCrashedError,
-                                       exc.ActorUnavailableError))
-                if death:
-                    self._note_replica_failure(replica, e)
-                    failed = (set()
-                              if isinstance(e, exc.ActorUnavailableError)
-                              else {replica._actor_id})
-                    nxt = self._pick_for_failover(failed, model_id)
-                    if nxt is not None:
-                        self._count_failover()
-                        try:
-                            ref2 = nxt.handle_request.remote(
-                                method, args, kwargs, model_id)
-                        except Exception:
-                            self.done(nxt)
-                            self._bridge(relay, e, as_error=True)
+                try:
+                    # No deadline: one slow request must not tear down
+                    # the SHARED pipe (a TimeoutError here would close
+                    # the channels under up-to-capacity unrelated
+                    # in-flight requests).  Matches the task path's
+                    # indefinite wait; a dead replica still surfaces
+                    # via the loop-death check inside get().
+                    status, value = dag_ref.get()
+                except BaseException as e:  # noqa: BLE001
+                    self.done(replica)
+                    self._drop_pipe(replica._actor_id)
+                    death = isinstance(e, (exc.ActorDiedError,
+                                           exc.WorkerCrashedError,
+                                           exc.ActorUnavailableError))
+                    if death:
+                        self._note_replica_failure(replica, e)
+                        failed = (set()
+                                  if isinstance(
+                                      e, exc.ActorUnavailableError)
+                                  else {replica._actor_id})
+                        nxt = self._pick_for_failover(failed, model_id)
+                        if nxt is not None:
+                            self._count_failover()
+                            try:
+                                ref2 = nxt.handle_request.remote(
+                                    method, args, kwargs, model_id)
+                            except Exception:
+                                self.done(nxt)
+                                self._bridge(relay, e, as_error=True)
+                                return
+                            # Hand the second attempt to the ordinary
+                            # waiter (it owns bridge + one more
+                            # failover — and the admission slot).
+                            self._watch(relay_ref, ref2, nxt, method,
+                                        args, kwargs, model_id,
+                                        release)
+                            delegated = True
                             return
-                        # Hand the second attempt to the ordinary
-                        # waiter (it owns bridge + one more failover).
-                        self._watch(relay_ref, ref2, nxt, method,
-                                    args, kwargs, model_id)
-                        return
-                self._bridge(relay, e, as_error=True)
-                return
-            self.done(replica)
-            if status == "ok":
-                self._record_success(replica._actor_id)
-            self._bridge(relay, value, as_error=(status != "ok"))
+                    self._bridge(relay, e, as_error=True)
+                    return
+                self.done(replica)
+                if status == "ok":
+                    self._record_success(replica._actor_id)
+                self._bridge(relay, value, as_error=(status != "ok"))
+            finally:
+                if release is not None and not delegated:
+                    release()
 
         threading.Thread(target=waiter, daemon=True,
                          name="rtpu-serve-pipe").start()
 
     # -- request assignment + failover ----------------------------------
     def assign(self, method: str, args: tuple, kwargs: dict,
-               model_id: str = ""):
+               model_id: str = "", priority: str = "normal",
+               tenant_id: str = ""):
         """Submit one request; returns (ObjectRef, replica).  The ref
         is a RELAY object: the per-request waiter bridges the replica
         call's outcome onto it, retrying an un-started request once on
         a different replica when the first assignment dies.  The span
         covers replica choice + submission, and the actor-call spec
         inherits its trace context — the cross-process link between
-        the proxy's root span and the replica's execute span."""
+        the proxy's root span and the replica's execute span.
+
+        Admission runs FIRST, against purely local state: an
+        overloaded deployment sheds here with a typed
+        RequestRejectedError in microseconds instead of parking the
+        request behind a saturated queue."""
         from ray_tpu._private.chaos import chaos
         from ray_tpu.object_ref import ObjectRef
         from ray_tpu.util import profiling
-        with profiling.span("router.assign", deployment=self._name,
-                            method=method):
-            relay = os.urandom(16)
-            # ONE shared ObjectRef instance for the caller AND the
-            # waiter closure: its GC-time remove_ref must fire after
-            # BOTH are done with it.  A caller-only ref dropped before
-            # the bridge would decref a not-yet-existing entry (no-op)
-            # and the bridged response would then be pinned node-side
-            # forever.
-            relay_ref = ObjectRef(relay, owned=True)
-            replica = self.pick(model_id)
-            self._maybe_chaos_kill(chaos, replica)
-            if self._compiled_enabled():
-                ent = self._try_pipe(replica)
-                if ent is not None and method not in ent[2]:
-                    dag, plock, _ = ent
-                    dag_ref = None
-                    try:
-                        with plock:
-                            # Router handoff: the request goes straight
-                            # into the graph's input channel — no
-                            # scheduled task on the hot path.
-                            dag_ref = dag.execute(
-                                (method, args, kwargs, model_id))
-                    except BaseException:  # noqa: BLE001
-                        # Pipe broken before the request entered the
-                        # graph: safe to fall through to the task path.
-                        self._drop_pipe(replica._actor_id)
-                    if dag_ref is not None:
-                        self._watch_pipe(relay_ref, dag_ref, replica,
-                                         method, args, kwargs,
-                                         model_id)
-                        return relay_ref, replica
-            ref = replica.handle_request.remote(method, args, kwargs,
-                                                model_id)
+        release = self._gate.acquire(priority, tenant_id,
+                                     self._total_depth())
+        try:
+            with profiling.span("router.assign", deployment=self._name,
+                                method=method):
+                relay = os.urandom(16)
+                # ONE shared ObjectRef instance for the caller AND the
+                # waiter closure: its GC-time remove_ref must fire after
+                # BOTH are done with it.  A caller-only ref dropped
+                # before the bridge would decref a not-yet-existing
+                # entry (no-op) and the bridged response would then be
+                # pinned node-side forever.
+                relay_ref = ObjectRef(relay, owned=True)
+                replica = self.pick(model_id)
+                self._maybe_chaos_kill(chaos, replica)
+                if self._compiled_enabled():
+                    ent = self._try_pipe(replica)
+                    if ent is not None and method not in ent[2]:
+                        dag, plock, _ = ent
+                        dag_ref = None
+                        try:
+                            with plock:
+                                # Router handoff: the request goes
+                                # straight into the graph's input
+                                # channel — no scheduled task on the
+                                # hot path.
+                                dag_ref = dag.execute(
+                                    (method, args, kwargs, model_id))
+                        except BaseException:  # noqa: BLE001
+                            # Pipe broken before the request entered
+                            # the graph: safe to fall through to the
+                            # task path.
+                            self._drop_pipe(replica._actor_id)
+                        if dag_ref is not None:
+                            self._watch_pipe(relay_ref, dag_ref,
+                                             replica, method, args,
+                                             kwargs, model_id, release)
+                            return relay_ref, replica
+                ref = replica.handle_request.remote(method, args,
+                                                    kwargs, model_id)
+        except BaseException:
+            release()   # admitted but never submitted: free the slot
+            raise
         self._watch(relay_ref, ref, replica, method, args, kwargs,
-                    model_id)
+                    model_id, release)
         return relay_ref, replica
 
     @staticmethod
@@ -464,102 +561,117 @@ class Router:
             pass
 
     def _watch(self, relay_ref, ref, replica, method: str,
-               args: tuple, kwargs: dict, model_id: str) -> None:
+               args: tuple, kwargs: dict, model_id: str,
+               release=None) -> None:
         """Per-request waiter thread: awaits the attempt, retries an
         un-started request once on another replica, and bridges the
         final outcome (value or error) onto the relay object.  One
         short-lived thread per request — same cost shape as the old
         done-callback waiter, now also carrying the failover.  The
         closure's hold on `relay_ref` keeps the relay's GC decref
-        ordered after the bridge (see assign)."""
+        ordered after the bridge (see assign).  `release` frees the
+        request's admission slot once the outcome is terminal (every
+        path below bridges or returns a final result before the
+        waiter exits, so the finally covers them all)."""
         relay = relay_ref.binary()
 
         def waiter() -> None:
-            import ray_tpu
-            from ray_tpu import exceptions as exc
-            from ray_tpu._private.client import get_global_client
             _pin = relay_ref     # hold until the bridge lands
-            attempt_ref, attempt_replica = ref, replica
-            failed_ids: set = set()
-            for attempt in range(2):
-                try:
-                    ray_tpu.wait([attempt_ref], timeout=None)
-                    # Fast path: alias the completed inline outcome
-                    # onto the relay NODE-SIDE — the response payload
-                    # never re-enters this process (no deserialize +
-                    # reserialize on the serving hot path).  A failure
-                    # of this control rpc must NOT become the
-                    # request's outcome: the result is sitting READY
-                    # in the store — fall through and read it.
-                    rep = {}
-                    try:
-                        client = get_global_client()
-                        if client is not None:
-                            rep = client.conn.call(
-                                {"type": "relay_result",
-                                 "src": attempt_ref.binary(),
-                                 "dst": relay})
-                    except Exception:
-                        rep = {}
-                    if rep.get("done"):
-                        self.done(attempt_replica)
-                        self._record_success(attempt_replica._actor_id)
-                        return
-                    # Error outcome (classify below) or shm-sized
-                    # value (bridge by value — rare for serve).
-                    value = ray_tpu.get(attempt_ref)
-                except (exc.ActorDiedError, exc.WorkerCrashedError,
-                        exc.ActorUnavailableError) as e:
-                    self.done(attempt_replica)
-                    self._note_replica_failure(attempt_replica, e)
-                    if not isinstance(e, exc.ActorUnavailableError):
-                        # A restarting (unavailable) replica keeps its
-                        # actor id and is NOT excluded from the retry
-                        # pick: the resubmission queues on it and runs
-                        # once it's back.  Dead replicas are excluded.
-                        failed_ids.add(attempt_replica._actor_id)
-                    # Retry ONLY a provably un-started request
-                    # (task_started is False).  None means unknown —
-                    # e.g. a node-death ActorDiedError where the
-                    # request may have been mid-execution with side
-                    # effects already emitted; re-running it could
-                    # double them.
-                    started = getattr(e, "task_started", None)
-                    if attempt == 0 and started is False:
-                        nxt = self._pick_for_failover(failed_ids,
-                                                      model_id)
-                        if nxt is not None:
-                            self._count_failover()
-                            try:
-                                attempt_ref = \
-                                    nxt.handle_request.remote(
-                                        method, args, kwargs,
-                                        model_id)
-                            except Exception:
-                                # Resubmit itself failed (replica torn
-                                # down in the window): the relay MUST
-                                # still resolve.
-                                self.done(nxt)
-                                self._bridge(relay, e, as_error=True)
-                                return
-                            attempt_replica = nxt
-                            continue
-                    self._bridge(relay, e, as_error=True)
-                    return
-                except BaseException as e:  # noqa: BLE001
-                    # Application error (or shutdown): no failover —
-                    # surface it to the caller unchanged.
-                    self.done(attempt_replica)
-                    self._bridge(relay, e, as_error=True)
-                    return
-                else:
-                    self.done(attempt_replica)
-                    self._record_success(attempt_replica._actor_id)
-                    self._bridge(relay, value, as_error=False)
-                    return
+            try:
+                self._watch_attempts(relay, ref, replica, method, args,
+                                     kwargs, model_id)
+            finally:
+                if release is not None:
+                    release()
 
         threading.Thread(target=waiter, daemon=True,
                          name="rtpu-serve-request").start()
+
+    def _watch_attempts(self, relay: bytes, ref, replica, method: str,
+                        args: tuple, kwargs: dict,
+                        model_id: str) -> None:
+        """The waiter body: up to two attempts, then bridge."""
+        import ray_tpu
+        from ray_tpu import exceptions as exc
+        from ray_tpu._private.client import get_global_client
+        attempt_ref, attempt_replica = ref, replica
+        failed_ids: set = set()
+        for attempt in range(2):
+            try:
+                ray_tpu.wait([attempt_ref], timeout=None)
+                # Fast path: alias the completed inline outcome
+                # onto the relay NODE-SIDE — the response payload
+                # never re-enters this process (no deserialize +
+                # reserialize on the serving hot path).  A failure
+                # of this control rpc must NOT become the
+                # request's outcome: the result is sitting READY
+                # in the store — fall through and read it.
+                rep = {}
+                try:
+                    client = get_global_client()
+                    if client is not None:
+                        rep = client.conn.call(
+                            {"type": "relay_result",
+                             "src": attempt_ref.binary(),
+                             "dst": relay})
+                except Exception:
+                    rep = {}
+                if rep.get("done"):
+                    self.done(attempt_replica)
+                    self._record_success(attempt_replica._actor_id)
+                    return
+                # Error outcome (classify below) or shm-sized
+                # value (bridge by value — rare for serve).
+                value = ray_tpu.get(attempt_ref)
+            except (exc.ActorDiedError, exc.WorkerCrashedError,
+                    exc.ActorUnavailableError) as e:
+                self.done(attempt_replica)
+                self._note_replica_failure(attempt_replica, e)
+                if not isinstance(e, exc.ActorUnavailableError):
+                    # A restarting (unavailable) replica keeps its
+                    # actor id and is NOT excluded from the retry
+                    # pick: the resubmission queues on it and runs
+                    # once it's back.  Dead replicas are excluded.
+                    failed_ids.add(attempt_replica._actor_id)
+                # Retry ONLY a provably un-started request
+                # (task_started is False).  None means unknown —
+                # e.g. a node-death ActorDiedError where the
+                # request may have been mid-execution with side
+                # effects already emitted; re-running it could
+                # double them.
+                started = getattr(e, "task_started", None)
+                if attempt == 0 and started is False:
+                    nxt = self._pick_for_failover(failed_ids,
+                                                  model_id)
+                    if nxt is not None:
+                        self._count_failover()
+                        try:
+                            attempt_ref = \
+                                nxt.handle_request.remote(
+                                    method, args, kwargs,
+                                    model_id)
+                        except Exception:
+                            # Resubmit itself failed (replica torn
+                            # down in the window): the relay MUST
+                            # still resolve.
+                            self.done(nxt)
+                            self._bridge(relay, e, as_error=True)
+                            return
+                        attempt_replica = nxt
+                        continue
+                self._bridge(relay, e, as_error=True)
+                return
+            except BaseException as e:  # noqa: BLE001
+                # Application error (or shutdown): no failover —
+                # surface it to the caller unchanged.
+                self.done(attempt_replica)
+                self._bridge(relay, e, as_error=True)
+                return
+            else:
+                self.done(attempt_replica)
+                self._record_success(attempt_replica._actor_id)
+                self._bridge(relay, value, as_error=False)
+                return
 
     def _pick_for_failover(self, exclude: set, model_id: str):
         """Pick a retry replica, waiting briefly for the controller to
@@ -614,18 +726,28 @@ class Router:
         except Exception:
             pass
 
-    def assign_stream(self, method: str, args: tuple, kwargs: dict):
+    def assign_stream(self, method: str, args: tuple, kwargs: dict,
+                      priority: str = "normal", tenant_id: str = ""):
         """Submit one STREAMING request; returns (ObjectRefGenerator,
-        replica).  Items ride the core streaming-generator plane
-        (reference: streaming replica calls, proxy.py:779).  No
-        failover: a partially-consumed stream must not replay."""
+        replica, release).  Items ride the core streaming-generator
+        plane (reference: streaming replica calls, proxy.py:779).  No
+        failover: a partially-consumed stream must not replay.
+        `release` is the admission slot — the stream's done-callback
+        must call it when the drain completes."""
         from ray_tpu.util import profiling
-        with profiling.span("router.assign", deployment=self._name,
-                            method=method, stream=True):
-            replica = self.pick()
-            gen = replica.handle_request_stream.options(
-                num_returns="streaming").remote(method, args, kwargs)
-        return gen, replica
+        release = self._gate.acquire(priority, tenant_id,
+                                     self._total_depth())
+        try:
+            with profiling.span("router.assign", deployment=self._name,
+                                method=method, stream=True):
+                replica = self.pick()
+                gen = replica.handle_request_stream.options(
+                    num_returns="streaming").remote(method, args,
+                                                    kwargs)
+        except BaseException:
+            release()
+            raise
+        return gen, replica, release
 
     def report_failure(self, replica) -> None:
         """A request errored with a dead replica: tell the controller,
@@ -647,7 +769,9 @@ class Router:
     def close(self) -> None:
         self._closed.set()
         with self._lock:
-            pipes = list(self._pipes.values())
+            pipes = (list(self._pipes.values())
+                     + list(self._retired_pipes.values()))
             self._pipes.clear()
+            self._retired_pipes.clear()
         for ent in pipes:
             self._teardown_pipe_async(ent)

@@ -149,7 +149,8 @@ class ContinuousBatcher:
                  max_len: int = 512, prompt_pad: int = 64,
                  eos_id: Optional[int] = None,
                  decode_chunk: int = 8,
-                 pipeline_depth: int = 2) -> None:
+                 pipeline_depth: int = 2,
+                 max_queue: int = 0) -> None:
         from ray_tpu.models import decoding
         self._dec = decoding
         self.params = params
@@ -158,6 +159,20 @@ class ContinuousBatcher:
         self.max_len = max_len
         self.prompt_pad = prompt_pad
         self.eos_id = eos_id
+        # Admission backstop: submit() sheds (typed rejection) once
+        # this many requests are queued ahead of slot admission.
+        # 0 = unlimited.  The check runs BEFORE anything touches the
+        # KV path, so a shed request never allocates blocks or
+        # queries the prefix cache.
+        self.max_queue = max(int(max_queue), 0)
+        # SLO windows for the serve autoscaler (slo_snapshot): engine
+        # TTFT samples and inter-token latency derived from decode
+        # entry processing cadence.  Guarded by _slo_lock (processor
+        # thread appends, actor threads snapshot).
+        self._slo_lock = threading.Lock()
+        self._ttft_win: deque = deque(maxlen=128)
+        self._itl_win: deque = deque(maxlen=256)
+        self._last_entry_t: Optional[float] = None
         # Tokens decoded per device dispatch: >1 amortizes dispatch
         # overhead at the cost of admission/EOS granularity.
         self.decode_chunk = max(decode_chunk, 1)
@@ -235,11 +250,51 @@ class ContinuousBatcher:
         np.asarray(toks)
 
     # -- public ------------------------------------------------------------
+    def queue_depth(self) -> int:
+        """Requests queued ahead of slot admission (not yet decoding).
+        The paged engine adds its dispatcher-side waiting deque."""
+        return self._pending.qsize()
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The serve autoscaler's engine-side SLO view (consumed via
+        the replica's __rtpu_slo_stats__ hook): engine queue depth,
+        TTFT p95, and decode inter-token latency p95 over the rolling
+        time-decayed windows (one shared window constant + percentile
+        helper with the replica's request-latency signal)."""
+        from ray_tpu.serve._replica import _SLO_WINDOW_S, _p95_ms
+
+        def p95(xs):
+            v = _p95_ms(xs)
+            return round(v, 3) if v is not None else None
+
+        cutoff = time.time() - _SLO_WINDOW_S
+        with self._slo_lock:
+            ttfts = [v for t, v in self._ttft_win if t >= cutoff]
+            itls = [v for t, v in self._itl_win if t >= cutoff]
+        return {"queue_depth": self.queue_depth(),
+                "ttft_p95_ms": p95(ttfts),
+                "itl_p95_ms": p95(itls)}
+
     def submit(self, prompt: List[int], max_new: int = 32,
                streaming: bool = False, model_id: str = "") -> _Request:
         """Enqueue a request.  `model_id` selects a multiplexed
         adapter (paged engine only; the dense escape-hatch engine
-        serves the single base model)."""
+        serves the single base model).
+
+        With `max_queue` set, a submit that finds that many requests
+        already queued raises the typed RequestRejectedError HERE —
+        before the request touches the engine at all.  For the paged
+        engine that ordering is load-bearing: a shed request must
+        never query the prefix cache or hold KV blocks, so rejection
+        can never evict a live request's cache entries.  The
+        "llm-engine" label is a placeholder: the serving Replica
+        re-tags the rejection with its real deployment name (and
+        counts the shed there) on the way out."""
+        if self.max_queue and self.queue_depth() >= self.max_queue:
+            from ray_tpu.serve._admission import RequestRejectedError
+            raise RequestRejectedError(
+                deployment="llm-engine", reason="queue_full",
+                retry_after_s=0.5)
         if len(prompt) > self.prompt_pad:
             raise ValueError(f"prompt of {len(prompt)} tokens exceeds "
                              f"prompt budget {self.prompt_pad}")
@@ -557,6 +612,22 @@ class ContinuousBatcher:
             rows = np.asarray(devs[1])
         else:
             rows = np.asarray(devs[0])
+        # SLO windows (serve autoscaler): TTFT for this entry's
+        # admissions; an inter-token-latency sample from the entry
+        # cadence — each entry carries len(rows) decode steps, so
+        # wall time between consecutive processed entries / chunk is
+        # the per-token latency a streaming client observes.
+        t_proc = time.time()
+        with self._slo_lock:
+            for _, _, req in (admitted or ()):
+                self._ttft_win.append((t_proc, req.ttft_s))
+            if pairs:
+                if self._last_entry_t is not None:
+                    self._itl_win.append(
+                        (t_proc,
+                         max(t_proc - self._last_entry_t, 0.0)
+                         / max(len(rows), 1)))
+                self._last_entry_t = t_proc
         # Column-major with one C-level tolist() + bulk extends:
         # per-token Python in this loop contends the GIL with the
         # dispatcher thread at chunk x B = 256 tokens per entry.
@@ -621,6 +692,12 @@ class ContinuousBatcher:
             try:
                 entry = self._inflight.popleft()
             except IndexError:
+                # Idle: break the ITL cadence chain, or the first
+                # entry after an idle gap would record (gap / chunk)
+                # as an inter-token-latency sample and spuriously
+                # trip the autoscaler's ITL SLO at light load.
+                with self._slo_lock:
+                    self._last_entry_t = None
                 self._proc_wake.wait(timeout=0.05)
                 self._proc_wake.clear()
                 continue
@@ -893,7 +970,8 @@ class PagedBatcher(ContinuousBatcher):
                  prefix_cache: Optional[bool] = None,
                  adapters: Optional[Dict[str, Any]] = None,
                  max_resident_models: int = 3,
-                 attn_impl: str = "auto") -> None:
+                 attn_impl: str = "auto",
+                 max_queue: int = 0) -> None:
         from collections import OrderedDict
 
         from ray_tpu._private.config import config
@@ -954,7 +1032,15 @@ class PagedBatcher(ContinuousBatcher):
         super().__init__(params, cfg, num_slots=num_slots,
                          max_len=max_len, prompt_pad=prompt_pad,
                          eos_id=eos_id, decode_chunk=decode_chunk,
-                         pipeline_depth=pipeline_depth)
+                         pipeline_depth=pipeline_depth,
+                         max_queue=max_queue)
+
+    def queue_depth(self) -> int:
+        # The dispatcher-side waiting deque holds requests already
+        # popped from _pending but still blockless (backpressure);
+        # len() is a GIL-atomic read, good enough for a shed
+        # threshold.
+        return self._pending.qsize() + len(self._waiting)
 
     # -- hooks -------------------------------------------------------------
     def _init_caches(self, cfg, num_slots: int, max_len: int):
@@ -1376,7 +1462,8 @@ class LLMDeployment:
                  kv_num_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  adapters: Optional[Dict[str, Any]] = None,
-                 max_resident_models: int = 3) -> None:
+                 max_resident_models: int = 3,
+                 max_queue: int = 0) -> None:
         import jax
         from ray_tpu.models import transformer
         cfg = transformer.TransformerConfig(**cfg_kwargs)
@@ -1391,7 +1478,8 @@ class LLMDeployment:
                 kv_block_size=kv_block_size,
                 kv_num_blocks=kv_num_blocks,
                 prefix_cache=prefix_cache, adapters=adapters,
-                max_resident_models=max_resident_models)
+                max_resident_models=max_resident_models,
+                max_queue=max_queue)
         else:
             if adapters:
                 raise ValueError("adapters/multiplexing requires "
@@ -1399,14 +1487,29 @@ class LLMDeployment:
             self.batcher = ContinuousBatcher(
                 params, cfg, num_slots=num_slots, max_len=max_len,
                 prompt_pad=prompt_pad, decode_chunk=decode_chunk,
-                pipeline_depth=pipeline_depth)
+                pipeline_depth=pipeline_depth, max_queue=max_queue)
         # Router probe hook: multiplex-aware pow-2 prefers replicas
         # whose engine already holds the requested adapter merged.
         self.__rtpu_resident_models__ = self._resident_models
+        # Controller hooks: the autoscaler reads real engine SLO
+        # signals (queue depth / TTFT p95 / inter-token p95) instead
+        # of whole-request latency, and the health sweep caches the
+        # engine's per-instance gauge tags so an unclean replica
+        # death can zero its ray_tpu_kv_blocks series.
+        self.__rtpu_slo_stats__ = self._slo_stats
+        self.__rtpu_kv_engine_tags__ = self._kv_engine_tags
 
     def _resident_models(self) -> List[str]:
         if isinstance(self.batcher, PagedBatcher):
             return self.batcher.resident_models()
+        return []
+
+    def _slo_stats(self) -> Dict[str, Any]:
+        return self.batcher.slo_snapshot()
+
+    def _kv_engine_tags(self) -> List[str]:
+        if isinstance(self.batcher, PagedBatcher):
+            return [self.batcher._engine_tag]
         return []
 
     @staticmethod

@@ -23,6 +23,7 @@ YAML shape:
             init_kwargs: {scale: 3}      # optional
             ray_actor_options: {num_cpus: 1}
             autoscaling_config: {min_replicas: 1, max_replicas: 4}
+            admission_config: {max_queue_depth: 32, rate_rps: 100}
     http:
       port: 8000                         # optional ingress
     grpc:
@@ -90,7 +91,8 @@ def serve_apply(config) -> List[str]:
                 if ov:
                     opts = {k: ov[k] for k in
                             ("num_replicas", "max_concurrent_queries",
-                             "ray_actor_options", "autoscaling_config")
+                             "ray_actor_options", "autoscaling_config",
+                             "admission_config")
                             if k in ov}
                     dep = dep.options(**opts)
                 serve._validate_opts(dep)   # whole plan, before deploys
@@ -107,7 +109,8 @@ def serve_apply(config) -> List[str]:
                 target = serve.deployment(target)
             opts: Dict[str, Any] = {}
             for k in ("num_replicas", "max_concurrent_queries",
-                      "ray_actor_options", "autoscaling_config"):
+                      "ray_actor_options", "autoscaling_config",
+                      "admission_config"):
                 if k in d:
                     opts[k] = d[k]
             if opts:

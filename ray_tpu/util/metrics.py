@@ -104,6 +104,19 @@ PREFIX_CACHE_HITS_METRIC = "ray_tpu_prefix_cache_hits_total"
 PREFIX_CACHE_QUERIES_METRIC = "ray_tpu_prefix_cache_queries_total"
 KV_EVICTIONS_METRIC = "ray_tpu_kv_evictions_total"
 
+# Serve overload-robustness plane (serve/_controller.py autoscaler +
+# serve/_admission.py admission control).  requests_shed counts
+# requests rejected at admission instead of queued to timeout, tagged
+# by deployment and reason (overloaded = token bucket empty,
+# queue_full = queue-depth cap for the request's priority class,
+# tenant_quota = per-tenant fair-share exceeded under pressure).
+# replicas is the controller's per-deployment replica gauge by state
+# (running | draining | target); queue_depth is the autoscaler's last
+# polled total outstanding requests per deployment.
+SERVE_REQUESTS_SHED_METRIC = "ray_tpu_serve_requests_shed_total"
+SERVE_REPLICAS_METRIC = "ray_tpu_serve_replicas"
+SERVE_QUEUE_DEPTH_METRIC = "ray_tpu_serve_queue_depth"
+
 # Concurrency sanitizer (devtools/locksan.py, enabled with
 # RAY_TPU_LOCKSAN=1).  wait_seconds observes how long acquire()
 # blocked on instrumented locks (untagged: one distribution per
@@ -259,19 +272,26 @@ class Gauge(_Metric):
                 cell["dirty"] = False
         return out
 
-    def remove(self, tags: Optional[Dict[str, str]] = None) -> None:
+    def remove(self, tags: Optional[Dict[str, str]] = None,
+               force: bool = False) -> None:
         """Drop one series' cell from this process's registry,
         queueing a final zero sample so the node-side aggregate
         (push-model: series are never deleted there) reads 0 rather
         than the last live value.  For per-instance-tagged gauges
         (e.g. the paged-KV engine series) this keeps repeated
-        construct/stop cycles from accumulating dead cells forever."""
+        construct/stop cycles from accumulating dead cells forever.
+
+        ``force=True`` queues the zero sample even when THIS process
+        never wrote the series — cross-process cleanup of a dead
+        writer's samples (the Serve controller zeroing an uncleanly
+        killed replica's per-engine gauges, whose own registry died
+        with it)."""
         ts = self._tagset(tags)
         with _lock:
             # One lock for pop + pending enqueue: the old split
             # (per-metric lock, then registry lock) let a flush slip
             # between them and push the zero before a straggler set().
-            if self._cells.pop(ts, None) is not None:
+            if self._cells.pop(ts, None) is not None or force:
                 _pending.append({"name": self.name, "kind": "gauge",
                                  "tags": dict(ts), "value": 0.0,
                                  "description": self.description})
@@ -358,6 +378,7 @@ class Histogram(_Metric):
 
 _shared_counters: Dict[Tuple[str, Tuple[str, ...]], "Counter"] = {}
 _shared_histograms: Dict[Tuple[str, Tuple[str, ...]], "Histogram"] = {}
+_shared_gauges: Dict[Tuple[str, Tuple[str, ...]], "Gauge"] = {}
 
 
 def shared_counter(name: str, description: str = "",
@@ -373,6 +394,20 @@ def shared_counter(name: str, description: str = "",
                         tag_keys=tag_keys)
             _shared_counters[key] = c
         return c
+
+
+def shared_gauge(name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()) -> "Gauge":
+    """shared_counter's Gauge sibling (the Serve controller sets
+    replica/queue-depth gauges from several loops without each
+    reinventing a lazy global)."""
+    key = (name, tuple(tag_keys))
+    with _lock:
+        g = _shared_gauges.get(key)
+        if g is None:
+            g = Gauge(name, description=description, tag_keys=tag_keys)
+            _shared_gauges[key] = g
+        return g
 
 
 def shared_histogram(name: str, description: str = "",

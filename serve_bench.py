@@ -372,6 +372,172 @@ def _run_shared_prefix(cfg, params, label, dev, on_tpu) -> dict:
     }
 
 
+# ===========================================================================
+# Bursty diurnal replay: autoscaling + admission control + chaos
+# ===========================================================================
+def _run_bursty() -> dict:
+    """Diurnal-replay drill for the overload-robustness layer
+    (ROADMAP item 5 acceptance): a low -> burst -> low client pattern
+    against an autoscaled, admission-controlled deployment.
+
+    Asserts-by-measurement: TTFT p95 stays inside the configured SLO
+    while the replica count tracks load (scale_up AND scale_down
+    events in the capture); excess burst traffic is shed with
+    structured rejections whose p95 latency is < 10 ms; a seeded
+    chaos kill_replica during the downscale phase produces zero
+    user-visible errors.  Pure control-plane behavior — runs the same
+    on CPU and TPU (platform recorded in the JSON)."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private.config import config
+    from ray_tpu.serve._admission import RequestRejectedError
+    from ray_tpu.serve._controller import CONTROLLER_NAME
+    from ray_tpu.util import chaos as chaos_api
+    from ray_tpu.util import metrics
+    from ray_tpu.util.state import _percentile as pct
+
+    TTFT_SLO_MS = 400.0
+    ray_tpu.init(num_cpus=8)
+
+    @serve.deployment(
+        num_replicas=1, max_concurrent_queries=16,
+        autoscaling_config={"min_replicas": 1, "max_replicas": 4,
+                            "target_queue_depth": 2.0,
+                            "target_ttft_ms": TTFT_SLO_MS,
+                            "downscale_slo_fraction": 0.9,
+                            "upscale_delay_s": 0.3,
+                            "downscale_delay_s": 2.0,
+                            "interval_s": 0.25},
+        admission_config={"max_queue_depth": 12,
+                          "retry_after_s": 0.2})
+    class Diurnal:
+        async def __call__(self, x):
+            import asyncio
+            await asyncio.sleep(0.04)
+            return x
+
+    handle = serve.run(Diurnal.bind())
+
+    samples = []                  # (t, running, draining, target)
+    stop_sampler = threading.Event()
+
+    def sampler():
+        t0 = time.time()
+        while not stop_sampler.is_set():
+            try:
+                st = serve.status()["Diurnal"]
+                samples.append((round(time.time() - t0, 2),
+                                len(st["replica_states"]),
+                                st["draining_replicas"],
+                                st["target_replicas"]))
+            except Exception:
+                pass
+            stop_sampler.wait(0.25)
+
+    threading.Thread(target=sampler, daemon=True).start()
+
+    lock = threading.Lock()
+    phase_stats: dict = {}
+
+    def run_phase(name: str, seconds: float, clients: int) -> None:
+        oks, rejects, errors = [], [], []
+        deadline = time.time() + seconds
+
+        def client():
+            while time.time() < deadline:
+                t0 = time.perf_counter()
+                try:
+                    ray_tpu.get(handle.remote(1), timeout=30)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        oks.append(dt)
+                except RequestRejectedError as e:
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        rejects.append((dt, e.reason,
+                                        e.retry_after_s))
+                    time.sleep(min(e.retry_after_s, 0.3))
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(e))
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ok_sorted = sorted(oks)
+        rej_sorted = sorted(r[0] for r in rejects)
+        phase_stats[name] = {
+            "seconds": seconds, "clients": clients,
+            "completed": len(oks), "shed": len(rejects),
+            "errors": len(errors), "error_samples": errors[:3],
+            "ttft_p50_ms": (round(pct(ok_sorted, 0.5) * 1e3, 1)
+                            if oks else None),
+            "ttft_p95_ms": (round(pct(ok_sorted, 0.95) * 1e3, 1)
+                            if oks else None),
+            "reject_p95_ms": (round(pct(rej_sorted, 0.95) * 1e3, 3)
+                              if rejects else None),
+            "reject_reasons": sorted({r[1] for r in rejects}),
+        }
+
+    run_phase("low_warm", 6.0, 2)
+    run_phase("burst", 10.0, 16)
+    # Downscale phase: arm ONE seeded replica kill so the drill
+    # covers chaos-during-scale-down (zero user-visible errors —
+    # un-started requests fail over).
+    config.set("chaos_seed", 17)
+    config.set("chaos_spec", "serve.assign:kind=kill_replica:p=1:n=1")
+    chaos_api.refresh()
+    chaos_api.reset_trace()
+    run_phase("low_cooldown", 14.0, 2)
+    chaos_trace = [(s, site, kind)
+                   for s, site, kind in chaos_api.trace()]
+    config.set("chaos_spec", "")
+    config.set("chaos_seed", 0)
+    chaos_api.refresh()
+    stop_sampler.set()
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ostat = ray_tpu.get(controller.overload_status.remote(),
+                        timeout=30)["Diurnal"]
+    shed_counts: dict = {}
+    for s in metrics.scrape():
+        if s["name"] == metrics.SERVE_REQUESTS_SHED_METRIC:
+            shed_counts[(s["tags"] or {}).get("reason", "?")] = \
+                s["value"]
+    events = ostat.get("autoscale_events") or []
+    actions = [e.get("action") for e in events]
+    max_replicas = max((s[1] for s in samples), default=1)
+    out = {
+        "metric": "serve_bursty_diurnal",
+        "scenario": "bursty diurnal replay: low -> burst -> low "
+                    "against SLO autoscaling + admission control, "
+                    "seeded kill_replica during the downscale",
+        "ttft_slo_ms": TTFT_SLO_MS,
+        "phases": phase_stats,
+        "replica_timeline": samples,
+        "max_replicas_seen": max_replicas,
+        "scale_up_seen": "scale_up" in actions,
+        "scale_down_seen": "scale_down" in actions,
+        "autoscale_events": events,
+        "shed_total_by_reason": shed_counts,
+        "chaos_trace": chaos_trace,
+        "chaos_user_visible_errors": sum(
+            p["errors"] for p in phase_stats.values()),
+        "slo_met": all(
+            p["ttft_p95_ms"] is not None
+            and p["ttft_p95_ms"] <= TTFT_SLO_MS
+            for p in phase_stats.values()),
+    }
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return out
+
+
 def main() -> None:
     """Retry-once wrapper: a tunnel that probes healthy can still wedge
     between the probe and first device use (the round-3/4 evidence-loss
@@ -407,6 +573,27 @@ def _run() -> None:
 
     model = os.environ.get("SERVE_MODEL", "gpt2s")
     lg_name = hwprobe.lg_name("SERVE_BENCH", model, "gpt2s")
+
+    if os.environ.get("SERVE_SCENARIO") == "bursty":
+        # Control-plane drill: no model, no device — runs identically
+        # with or without a chip, so it records unconditionally under
+        # its OWN last-good key (never the default serve-bench record:
+        # the payload shapes differ — the PR-9 clobbering bug class).
+        try:
+            import jax
+            platform = jax.devices()[0].platform
+        except Exception:
+            platform = "unknown"
+        out = _run_bursty()
+        out["platform"] = platform
+        rnd = os.environ.get("SERVE_ROUND", "r08")
+        with open(f"SERVE_BENCH_{rnd}_bursty.json", "w") as f:
+            json.dump(out, f, indent=1)
+        hwprobe.record_last_good(
+            hwprobe.lg_name("SERVE_BENCH_BURSTY", model, "gpt2s"),
+            out)
+        print(json.dumps(out))
+        return
 
     # Probe in a subprocess before importing jax (see bench.py: two
     # rounds of driver captures died on a wedged tunnel at import).

@@ -68,6 +68,9 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.KV_EVICTIONS_METRIC)
     assert _NAME.match(metrics.LOCK_WAIT_SECONDS_METRIC)
     assert _NAME.match(metrics.LOCK_CONTENTION_METRIC)
+    assert _NAME.match(metrics.SERVE_REQUESTS_SHED_METRIC)
+    assert _NAME.match(metrics.SERVE_REPLICAS_METRIC)
+    assert _NAME.match(metrics.SERVE_QUEUE_DEPTH_METRIC)
     assert metrics.DAG_EXECUTIONS_METRIC.endswith("_total")
     # hop_seconds is a histogram — no _total.
     assert not metrics.DAG_HOP_SECONDS_METRIC.endswith("_total")
@@ -91,6 +94,11 @@ def test_declared_builtin_names_are_legal():
     # Locksan: contention is a counter, wait_seconds a histogram.
     assert metrics.LOCK_CONTENTION_METRIC.endswith("_total")
     assert not metrics.LOCK_WAIT_SECONDS_METRIC.endswith("_total")
+    # Serve overload plane: shed is a counter; replicas-by-state and
+    # queue-depth are gauges.
+    assert metrics.SERVE_REQUESTS_SHED_METRIC.endswith("_total")
+    assert not metrics.SERVE_REPLICAS_METRIC.endswith("_total")
+    assert not metrics.SERVE_QUEUE_DEPTH_METRIC.endswith("_total")
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
                metrics.OBJECT_TRANSFER_BUCKETS,
                metrics.DRAIN_DURATION_BUCKETS,

@@ -1,12 +1,17 @@
-"""Serve replica autoscaling (reference: serve/_private/
-autoscaling_state.py + serve/autoscaling_policy.py)."""
+"""Serve overload robustness: SLO-aware replica autoscaling, graceful
+scale-down under load, admission control / load shedding, and the
+dead-replica gauge sweep (reference: serve/_private/autoscaling_state.py
++ serve/autoscaling_policy.py + max_queued_requests admission)."""
 
+import threading
 import time
 
 import pytest
 
 import ray_tpu
 from ray_tpu import serve
+from ray_tpu.serve._admission import (AdmissionController,
+                                      RequestRejectedError)
 
 
 @pytest.fixture
@@ -63,3 +68,481 @@ def test_scales_up_under_load_and_back_down(rt):
             break
         time.sleep(0.3)
     assert _replica_count("Slow") == 1, "no scale-down when idle"
+
+
+# ===========================================================================
+# SLO-aware scaling: a violated TTFT target scales up even when queues
+# look shallow, and the decision + reason surface in status().
+# ===========================================================================
+@serve.deployment(max_concurrent_queries=16,
+                  autoscaling_config={"min_replicas": 1,
+                                      "max_replicas": 3,
+                                      # Queue signal effectively off:
+                                      "target_queue_depth": 50.0,
+                                      # ...but a 100 ms TTFT SLO a
+                                      # 400 ms handler must violate.
+                                      "target_ttft_ms": 100.0,
+                                      "upscale_delay_s": 0.2,
+                                      "downscale_delay_s": 30.0,
+                                      "interval_s": 0.2})
+class SlowSlo:
+    async def __call__(self, x):
+        import asyncio
+        await asyncio.sleep(0.4)
+        return x
+
+
+def test_ttft_slo_violation_scales_up(rt):
+    handle = serve.run(SlowSlo.bind())
+    assert _replica_count("SlowSlo") == 1
+    deadline = time.time() + 20
+    scaled = False
+    while time.time() < deadline and not scaled:
+        # Light load (2 concurrent << target_queue_depth 50): only the
+        # latency SLO can justify the scale-up.
+        ray_tpu.get([handle.remote(i) for i in range(2)], timeout=30)
+        scaled = _replica_count("SlowSlo") >= 2
+    assert scaled, "TTFT SLO violation did not scale up"
+    st = serve.status()["SlowSlo"]
+    dec = st.get("autoscale")
+    assert dec, "autoscale decision missing from status()"
+    assert "ttft_p95" in str(dec.get("reason", "")), dec
+
+
+# ===========================================================================
+# Graceful scale-down under load: zero failed requests, zero retry
+# lifecycle events (satellite 3).
+# ===========================================================================
+@serve.deployment(num_replicas=3, max_concurrent_queries=16)
+class Steady:
+    async def __call__(self, x):
+        import asyncio
+        await asyncio.sleep(0.05)
+        return x * 2
+
+
+def _retry_events():
+    events = ray_tpu._ensure_connected().timeline_events(cluster=True)
+    return [e for e in events if e.get("kind") == "retry"]
+
+
+def _serve_failover_count() -> float:
+    from ray_tpu.util import metrics
+    total = 0.0
+    for s in metrics.scrape():
+        if s.get("name") == metrics.TASK_RETRIES_METRIC and \
+                (s.get("tags") or {}).get("reason") == "serve_failover":
+            total += s.get("value", 0.0)
+    return total
+
+
+def test_scale_down_under_load_zero_failures(rt):
+    handle = serve.run(Steady.bind())
+    assert _replica_count("Steady") == 3
+
+    errors: list = []
+    done = threading.Event()
+
+    def client():
+        while not done.is_set():
+            try:
+                assert ray_tpu.get(handle.remote(21), timeout=30) == 42
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(1.0)                       # traffic at 3 replicas
+        serve.run(Steady.options(num_replicas=1))   # downscale NOW
+        # Keep the traffic running through the whole drain window.
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            st = serve.status()["Steady"]
+            if len(st["replica_states"]) == 1 \
+                    and st["draining_replicas"] == 0:
+                break
+            time.sleep(0.25)
+        time.sleep(1.0)                       # traffic at 1 replica
+    finally:
+        done.set()
+        for t in threads:
+            t.join(timeout=30)
+
+    st = serve.status()["Steady"]
+    assert len(st["replica_states"]) == 1, st
+    assert st["draining_replicas"] == 0, st
+    assert not errors, f"user-visible errors during scale-down: " \
+                       f"{errors[:3]}"
+    assert _serve_failover_count() == 0
+    assert _retry_events() == []
+
+
+def test_chaos_kill_replica_during_downscale_replays(rt):
+    """kill_replica injected mid-downscale stays zero-user-visible-
+    error, and the seeded fault trace replays identically (the PR-3
+    witness contract)."""
+    from ray_tpu._private.config import config
+    from ray_tpu.util import chaos as chaos_api
+
+    @serve.deployment(num_replicas=3, max_concurrent_queries=16)
+    class D:
+        async def __call__(self, x):
+            import asyncio
+            await asyncio.sleep(0.02)
+            return x + 1
+
+    def drill():
+        handle = serve.run(D.bind())
+        got = [ray_tpu.get(handle.remote(i), timeout=30)
+               for i in range(6)]
+        assert got == [i + 1 for i in range(6)]
+        serve.run(D.options(num_replicas=1))    # begin downscale
+        # Arm the seeded kill DURING the drain window: the next
+        # assign kills whichever replica the router picked.
+        config.set("chaos_seed", 31)
+        config.set("chaos_spec",
+                   "serve.assign:kind=kill_replica:p=1:n=1")
+        chaos_api.refresh()
+        chaos_api.reset_trace()
+        got = [ray_tpu.get(handle.remote(i), timeout=60)
+               for i in range(8)]
+        assert got == [i + 1 for i in range(8)]   # zero user errors
+        trace = [(s, site, kind)
+                 for s, site, kind in chaos_api.trace()]
+        config.set("chaos_spec", "")
+        config.set("chaos_seed", 0)
+        chaos_api.refresh()
+        serve.delete("D")
+        return trace
+
+    try:
+        t1 = drill()
+        t2 = drill()
+    finally:
+        config.set("chaos_spec", "")
+        config.set("chaos_seed", 0)
+        chaos_api.refresh()
+        chaos_api.reset_trace()
+    assert t1, "chaos kill_replica never fired"
+    assert [x[1:] for x in t1] == [("serve.assign", "kill_replica")]
+    assert t1 == t2, "seeded chaos trace did not replay"
+
+
+# ===========================================================================
+# Admission control: the gate logic (pure) + the serve-integrated shed
+# path with its sub-10 ms rejection budget.
+# ===========================================================================
+def test_gate_queue_full_priority_order():
+    g = AdmissionController("d")
+    g.configure({"max_queue_depth": 10})
+    # depth 5: low (thr 0.5 -> cap 5) sheds, normal/high admit.
+    with pytest.raises(RequestRejectedError) as ei:
+        g.acquire("low", "", depth=5)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_s > 0
+    g.acquire("normal", "", depth=5)()
+    g.acquire("high", "", depth=5)()
+    # depth 8: normal (thr 0.8 -> cap 8) sheds too, high still admits.
+    with pytest.raises(RequestRejectedError):
+        g.acquire("normal", "", depth=8)
+    g.acquire("high", "", depth=8)()
+    # depth 10: even high sheds.
+    with pytest.raises(RequestRejectedError):
+        g.acquire("high", "", depth=10)
+    assert g.snapshot()["shed"]["queue_full"] == 3
+
+
+def test_gate_token_bucket_overloaded():
+    g = AdmissionController("d")
+    g.configure({"rate_rps": 2.0, "burst": 2.0})
+    rels = [g.acquire("normal", "", 0), g.acquire("normal", "", 0)]
+    with pytest.raises(RequestRejectedError) as ei:
+        g.acquire("normal", "", 0)
+    assert ei.value.reason == "overloaded"
+    assert 0 < ei.value.retry_after_s <= 1.0
+    for r in rels:
+        r()
+    time.sleep(0.6)             # ~1.2 tokens refill at 2 rps
+    g.acquire("normal", "", 0)()
+
+
+def test_gate_tenant_quota_weighted_fairness():
+    g = AdmissionController("d")
+    g.configure({"max_queue_depth": 8, "tenant_pressure": 0.5,
+                 "tenant_weights": {"a": 1.0, "b": 1.0}})
+    rels = [g.acquire("high", "a", d) for d in range(4)]
+    rels += [g.acquire("high", "b", 4)]
+    # Pressure on (depth >= 4): a holds 4 = its share of 8/2 -> shed;
+    # b holds 1 < 4 -> admitted.  The hog is shed, the light tenant
+    # is not — weighted fairness, not global rejection.
+    with pytest.raises(RequestRejectedError) as ei:
+        g.acquire("high", "a", depth=5)
+    assert ei.value.reason == "tenant_quota"
+    assert ei.value.tenant_id == "a"
+    rels.append(g.acquire("high", "b", depth=5))
+    # Releases restore the hog's headroom.
+    for r in rels:
+        r()
+    g.acquire("high", "a", depth=5)()
+
+
+def test_gate_release_idempotent_and_unconfigured_admits():
+    g = AdmissionController("d")
+    rel = g.acquire("low", "t", depth=10 ** 6)   # no config: admit
+    rel()
+    rel()                                        # double release: no-op
+    assert g.snapshot()["tenants_outstanding"] == {}
+
+
+@serve.deployment(num_replicas=1, max_concurrent_queries=16,
+                  admission_config={"max_queue_depth": 6,
+                                    "retry_after_s": 0.25})
+class Gated:
+    async def __call__(self, x):
+        import asyncio
+        await asyncio.sleep(1.0)
+        return x
+
+
+def test_serve_shed_is_structured_and_fast(rt):
+    handle = serve.run(Gated.bind())
+    ray_tpu.get(handle.remote(0), timeout=30)    # router warm
+    # 4 in-flight: past the low-priority threshold (0.5 * 6 = 3) but
+    # inside normal's (0.8 * 6 = 4.8) — priority classes diverge.
+    refs = [handle.remote(i) for i in range(4)]
+    lat = []
+    rejections = []
+    for _ in range(40):
+        t0 = time.perf_counter()
+        try:
+            handle.method("__call__").options(priority="low").remote(1)
+        except RequestRejectedError as e:
+            lat.append(time.perf_counter() - t0)
+            rejections.append(e)
+    assert len(rejections) == 40, "saturated deployment did not shed"
+    e = rejections[0]
+    assert e.reason == "queue_full"
+    assert e.deployment == "Gated"
+    assert e.retry_after_s == 0.25
+    assert e.priority == "low"
+    assert e.to_dict()["rejected"] is True
+    # The shed path is local state only: p95 rejection latency must
+    # be far inside the 10 ms budget even on a loaded CI host.
+    lat.sort()
+    assert lat[int(0.95 * len(lat))] < 0.010, lat
+    # The SAME depth admits normal/high priority: shedding is classed,
+    # not a global off switch.
+    refs.append(handle.remote(5))
+    refs.append(
+        handle.method("__call__").options(priority="high").remote(6))
+    ray_tpu.get(refs, timeout=60)
+    ray_tpu.get(handle.remote(7), timeout=30)
+    from ray_tpu.util import metrics
+    shed = [s for s in metrics.scrape()
+            if s["name"] == metrics.SERVE_REQUESTS_SHED_METRIC
+            and (s.get("tags") or {}).get("deployment") == "Gated"]
+    assert shed and shed[0]["tags"]["reason"] == "queue_full"
+    assert shed[0]["value"] >= 40
+
+
+# ===========================================================================
+# LLM engine: shed happens BEFORE prefix-cache admission (satellite:
+# rejected requests never touch KV blocks).
+# ===========================================================================
+def _tiny_cfg():
+    import jax.numpy as jnp
+    from ray_tpu.models.transformer import TransformerConfig
+    return TransformerConfig(vocab_size=97, d_model=32, n_heads=4,
+                             n_kv_heads=2, n_layers=2, d_ff=64,
+                             max_seq=128, dtype=jnp.float32,
+                             remat=False)
+
+
+def test_llm_engine_sheds_before_prefix_cache():
+    import jax
+    from ray_tpu.models import transformer
+    from ray_tpu.serve.llm import PagedBatcher
+    cfg = _tiny_cfg()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    # Pool of 12 blocks; each request needs 7 -> the second QUEUES for
+    # blocks (depth stable at >= 1), the shed threshold is 2.
+    bat = PagedBatcher(params, cfg, num_slots=1, max_len=48,
+                       prompt_pad=16, decode_chunk=4,
+                       pipeline_depth=1, kv_block_size=4,
+                       max_queue=2)
+    try:
+        # Each request needs 7 of the 12 pool blocks, so at most one
+        # decodes while the rest QUEUE for blocks — the engine queue
+        # fills regardless of decode speed.
+        admitted = [bat.submit([1, 2, 3], max_new=24)
+                    for _ in range(2)]
+        # Flood: at most one more fits under max_queue=2; the rest
+        # must shed synchronously.
+        rejected = 0
+        for _ in range(6):
+            try:
+                admitted.append(bat.submit([4, 5, 6], max_new=24))
+            except RequestRejectedError as e:
+                assert e.reason == "queue_full"
+                assert e.deployment == "llm-engine"
+                rejected += 1
+        assert rejected >= 1, "full engine queue did not shed"
+        for r in admitted:
+            assert r.done.wait(120)
+            assert r.error is None
+        st = bat.kv_stats()
+        # Prefix-cache admissions count ADMITTED requests only: the
+        # shed requests never queried the radix tree or held blocks.
+        assert st["prefix_cache"]["queries"] == len(admitted)
+        assert st["blocks"]["used"] == 0
+        # The engine still serves after shedding.
+        out = bat.generate([9, 8, 7], max_new=4, timeout=60)
+        assert len(out["tokens"]) == 4
+    finally:
+        bat.stop()
+
+
+def test_replica_retags_engine_rejection():
+    """The engine's max_queue backstop doesn't know its deployment
+    name; the Replica wrapper must re-tag the rejection (metrics and
+    429 bodies key on the real deployment)."""
+    import cloudpickle
+
+    from ray_tpu.serve._replica import Replica
+
+    class U:
+        pass
+
+    r = Replica("MyDep", cloudpickle.dumps(U), (), {})
+    e = RequestRejectedError(deployment="llm-engine",
+                             reason="queue_full", retry_after_s=0.5,
+                             priority="low", tenant_id="t")
+    e2 = r._retag_rejection(e)
+    assert e2.deployment == "MyDep"
+    assert (e2.reason, e2.retry_after_s, e2.priority, e2.tenant_id) \
+        == ("queue_full", 0.5, "low", "t")
+    other = ValueError("x")
+    assert r._retag_rejection(other) is other
+
+
+# ===========================================================================
+# Satellite 1: an uncleanly-killed replica's per-engine kv_blocks
+# gauge series is zeroed by the controller's death sweep.
+# ===========================================================================
+def _kv_series_by_engine():
+    from ray_tpu.util import metrics
+    out = {}
+    for s in metrics.scrape():
+        if s["name"] != metrics.KV_BLOCKS_METRIC:
+            continue
+        tags = s.get("tags") or {}
+        out.setdefault(tags.get("engine", "?"), {})[
+            tags.get("state", "?")] = s.get("value", 0.0)
+    return out
+
+
+def test_dead_replica_kv_gauges_zeroed_by_health_sweep(rt):
+    from ray_tpu.serve._controller import CONTROLLER_NAME
+    from ray_tpu.serve.llm import LLMDeployment
+    dep = serve.deployment(
+        LLMDeployment, name="LlmGauge", num_replicas=1,
+        health_check_period_s=0.2, health_check_timeout_s=5.0,
+    ).bind(cfg_kwargs=dict(vocab_size=97, d_model=32, n_heads=4,
+                           n_kv_heads=2, n_layers=2, d_ff=64,
+                           max_seq=128),
+           num_slots=1, max_len=48, prompt_pad=16, decode_chunk=4,
+           pipeline_depth=1, kv_block_size=4)
+    handle = serve.run(dep)
+    out = ray_tpu.get(handle.generate.remote([1, 2, 3], max_new=4),
+                      timeout=180)
+    assert len(out["tokens"]) == 4
+    # The engine's gauges are flowing (free-pool line is nonzero).
+    deadline = time.time() + 20
+    tag = None
+    while time.time() < deadline and tag is None:
+        for eng, states in _kv_series_by_engine().items():
+            if states.get("free", 0) > 0:
+                tag = eng
+        time.sleep(0.25)
+    assert tag, "engine kv_blocks series never appeared"
+    # Let the health sweep cache the engine tag (period 0.2 s).
+    time.sleep(1.5)
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    reps = ray_tpu.get(controller.get_replicas.remote("LlmGauge"),
+                       timeout=30)["replicas"]
+    assert len(reps) == 1
+    ray_tpu.kill(reps[0])          # UNCLEAN: engine stop() never runs
+    # Health sweep notices the death, backfills, and zeroes the dead
+    # engine's series node-side.
+    deadline = time.time() + 30
+    zeroed = False
+    while time.time() < deadline and not zeroed:
+        states = _kv_series_by_engine().get(tag) or {}
+        zeroed = bool(states) and all(v == 0 for v in states.values())
+        time.sleep(0.25)
+    assert zeroed, ("dead replica's kv_blocks series persisted: "
+                    f"{_kv_series_by_engine().get(tag)}")
+
+
+# ===========================================================================
+# Satellite 2: a DEAD sidelined replica is dropped by the probe, not
+# probed forever (circuit-breaker vs scale-down/death race).
+# ===========================================================================
+@serve.deployment(num_replicas=2, max_concurrent_queries=8)
+class P2:
+    def __call__(self, x):
+        return x
+
+
+def test_dead_sidelined_replica_dropped_from_probe_list(rt):
+    handle = serve.run(P2.bind())
+    assert ray_tpu.get(handle.remote(1), timeout=30) == 1
+    router = handle._get_router()
+    with router._lock:
+        victim = router._replicas[0]
+    ray_tpu.kill(victim)
+    # Sideline it (as consecutive failures would): it now receives no
+    # traffic, so only the probe can ever learn it died.
+    for _ in range(3):
+        router._record_failure(victim._actor_id)
+    with router._lock:
+        assert victim._actor_id in router._sidelined
+    deadline = time.time() + 8
+    gone = False
+    while time.time() < deadline and not gone:
+        # Traffic keeps pick() -> _maybe_probe() firing.
+        ray_tpu.get(handle.remote(2), timeout=30)
+        with router._lock:
+            gone = (all(r._actor_id != victim._actor_id
+                        for r in router._replicas)
+                    and victim._actor_id not in router._sidelined)
+        time.sleep(0.3)
+    assert gone, "dead sidelined replica still in the probe list"
+
+
+# ===========================================================================
+# CLI face (pure rendering).
+# ===========================================================================
+def test_serve_status_rendering():
+    from ray_tpu.scripts.cli import _render_serve_status
+    data = {"M": {"running": 2, "draining": 1, "target_replicas": 2,
+                  "version": 7, "queue_depth": 5.0,
+                  "ttft_p95_ms": 88.2, "itl_p95_ms": None,
+                  "admission": {"max_queue_depth": 32},
+                  "autoscale_last": {"action": "scale_up",
+                                     "current": 1, "desired": 2,
+                                     "reason": "ttft_p95 180ms > "
+                                               "target 100ms"},
+                  "autoscale_events": [
+                      {"action": "scale_up", "current": 1,
+                       "desired": 2, "reason": "r"}]}}
+    text = _render_serve_status(data, {"M": {"queue_full": 4}})
+    assert "2 running / 1 draining" in text
+    assert "queue_depth 5" in text
+    assert "ttft_p95 88.2ms" in text
+    assert "shed: queue_full=4" in text
+    assert "scale_up 1 -> 2" in text
+    assert "max_queue_depth=32" in text
+    assert _render_serve_status({}, {}) == "(no deployments)"
