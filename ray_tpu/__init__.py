@@ -25,6 +25,16 @@ from ray_tpu.devtools import locksan as _locksan
 if _locksan.enabled():
     _locksan.install()
 
+# Resource-leak ledger (devtools/leaksan.py): same env-gated story as
+# locksan — arm the atexit dump here so every process (driver, node,
+# worker — the env inherits) leaves a per-pid ledger for `ray_tpu
+# leaksan` to merge.  The hooks themselves are compiled into the
+# instrumented subsystems and gate on the module flag.
+from ray_tpu.devtools import leaksan as _leaksan
+
+if _leaksan.enabled():
+    _leaksan.install()
+
 from ray_tpu._private.config import config
 from ray_tpu import exceptions
 from ray_tpu.object_ref import ObjectRef

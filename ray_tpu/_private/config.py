@@ -325,3 +325,11 @@ _D("locksan_dir", str, "",
    "Locksan: directory where each process drops its <pid>.json "
    "report for `ray_tpu locksan` / state.locksan_report() to merge "
    "(default /tmp/ray_tpu_locksan; RAY_TPU_LOCKSAN_DIR overrides).")
+# The leak ledger follows locksan's rules exactly: enabled ONLY by the
+# RAY_TPU_LEAKSAN env var (read at `import ray_tpu`, inherited by
+# spawned processes); only the report directory is a config knob.
+_D("leaksan_dir", str, "",
+   "Leaksan: directory where each process drops its <pid>.json "
+   "resource ledger for `ray_tpu leaksan` / state.leaksan_report() "
+   "to merge (default /tmp/ray_tpu_leaksan; RAY_TPU_LEAKSAN_DIR "
+   "overrides).")

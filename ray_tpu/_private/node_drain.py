@@ -51,6 +51,27 @@ from ray_tpu._private.node_state import (FAILED, READY, TaskRecord,
                                          _ConnCtx)
 
 
+def _read_notice_deadline(path: str) -> Optional[float]:
+    """Parse a preemption-notice file: a bare float deadline, or JSON
+    with a ``deadline_s`` key; None when empty/unreadable.  The old
+    inline ``open(path).read()`` leaked one fd per poll until GC
+    (RT013 self-finding) — the notice poller runs forever on every
+    node."""
+    deadline_s = None
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+        if raw:
+            try:
+                deadline_s = float(raw)
+            except ValueError:
+                deadline_s = float(
+                    json.loads(raw).get("deadline_s", 0) or 0)
+    except Exception:
+        pass
+    return deadline_s
+
+
 class DrainMixin:
     # Set by node_service.main(): called once the drain sequence ends
     # so the hosting process can exit.
@@ -135,17 +156,7 @@ class DrainMixin:
             self._notice_consumed = False   # notice withdrawn: re-arm
         if path and os.path.exists(path) and not self._notice_consumed:
             self._notice_consumed = True
-            deadline_s = None
-            try:
-                raw = open(path).read().strip()
-                if raw:
-                    try:
-                        deadline_s = float(raw)
-                    except ValueError:
-                        deadline_s = float(
-                            json.loads(raw).get("deadline_s", 0) or 0)
-            except Exception:
-                pass
+            deadline_s = _read_notice_deadline(path)
             self._begin_drain("preemption",
                               f"preemption notice at {path}",
                               grace_s=deadline_s)

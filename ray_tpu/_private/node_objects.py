@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.chaos import chaos
 from ray_tpu._private.config import config
+from ray_tpu.devtools import leaksan
 from ray_tpu._private.protocol import (
     CHAN_MAGIC, ConnectionLost, TRANSFER_ERR, TRANSFER_MAGIC,
     TRANSFER_REQ, TRANSFER_REQ_BODY, TRANSFER_RESP, _recv_exact,
@@ -908,6 +909,10 @@ class ObjectPlaneMixin:
                     store.delete(_OID(oid))
                     e.loc = "spilled"
                     e.spill_path = path
+                    # Fresh spill: lift the no-recache tombstone a
+                    # prior delete/reconstruct left for this oid.
+                    with self._spill_fd_lock:
+                        self._spill_dead.discard(oid)
                     # get_objects replies ship (loc, data, size): the
                     # client reads the spill file directly from `data`.
                     e.data = path.encode()
@@ -1051,6 +1056,19 @@ class ObjectPlaneMixin:
                         os.close(ent[0])
                     except OSError:
                         pass
+                    leaksan.discharge("spill_fd", ent[0], expect=False)
+                if oid in self._spill_dead:
+                    # The object was deleted while this chunk request
+                    # was in flight (mid-transfer abort/delete race):
+                    # serve the bytes if the file still exists, but do
+                    # NOT re-cache — _drop_spill_fd already ran and
+                    # nothing would ever close a re-cached entry.
+                    try:
+                        return os.pread(fd, ln, off)
+                    finally:
+                        os.close(fd)
+                leaksan.register("spill_fd", fd,
+                                 detail=f"oid={oid.hex()[:12]}")
                 self._spill_fds[oid] = (fd, path)
                 while len(self._spill_fds) > 128:
                     old = next(iter(self._spill_fds))
@@ -1061,6 +1079,7 @@ class ObjectPlaneMixin:
                         os.close(ofd)
                     except OSError:
                         pass
+                    leaksan.discharge("spill_fd", ofd, expect=False)
             else:
                 fd = ent[0]
             return os.pread(fd, ln, off)
@@ -1068,11 +1087,18 @@ class ObjectPlaneMixin:
     def _drop_spill_fd(self, oid: bytes) -> None:
         with self._spill_fd_lock:
             ent = self._spill_fds.pop(oid, None)
+            # Tombstone so a chunk request racing the delete can't
+            # re-cache an fd nobody will close.  Bounded: a wholesale
+            # clear only re-opens the (tiny) race for long-dead oids.
+            self._spill_dead.add(oid)
+            if len(self._spill_dead) > 4096:
+                self._spill_dead.clear()
         if ent is not None:
             try:
                 os.close(ent[0])
             except OSError:
                 pass
+            leaksan.discharge("spill_fd", ent[0], expect=False)
 
     def _complete_forwarded(self, task_id: bytes) -> None:
         """Release the owner-side embedded arg holds of a forwarded task

@@ -38,6 +38,7 @@ from ray_tpu._private.node_objects import ObjectPlaneMixin
 from ray_tpu._private.node_pg import PlacementGroupMixin
 from ray_tpu._private.node_streams import StreamChannelMixin
 from ray_tpu._private.protocol import ConnectionLost, recv_msg, send_msg
+from ray_tpu.devtools import leaksan
 from ray_tpu import exceptions as exc
 from ray_tpu._private.node_state import (  # noqa: F401
     ActorRecord, Bundle, FAILED, ObjectEntry, PENDING, READY,
@@ -135,6 +136,15 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         # (os.pread instead of open+seek per chunk).
         self._spill_fds: Dict[bytes, Tuple[int, str]] = {}
         self._spill_fd_lock = threading.Lock()
+        # Oids whose spill fd was dropped because the object left the
+        # directory (deleted / spill file destroyed): a late chunk
+        # request racing the delete — e.g. a fetch aborted by a
+        # partition whose last request lands after the owner's global
+        # delete — must serve its bytes WITHOUT re-caching the fd; the
+        # delete already ran, so nothing would ever close a re-cached
+        # entry (leak-ledger self-finding).  Cleared when the oid is
+        # re-spilled.  Guarded by _spill_fd_lock; bounded.
+        self._spill_dead: set = set()
         # (pg_id, bundle_index) -> Bundle reserved ON THIS NODE
         self.bundles: Dict[Tuple[bytes, int], Bundle] = {}
         # pg_id -> coordinator record for PGs created via this node:
@@ -331,7 +341,12 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         deadline = time.time() + 3.0
         for t in threads + pulls + [
                 getattr(self, "_monitor_thread", None),
-                getattr(self, "_gcs_event_thread", None)]:
+                getattr(self, "_gcs_event_thread", None),
+                # Log tailer reads worker-log files on a 0.25s tick; a
+                # straggler touching the log dir after teardown was an
+                # RT014 self-finding (it observes _shutdown, so this
+                # join is bounded by one tick).
+                getattr(self, "_log_tail_thread", None)]:
             if t is None or not t.is_alive():
                 continue
             t.join(timeout=max(0.05, deadline - time.time()))
@@ -350,6 +365,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 os.close(fd)
             except OSError:
                 pass
+            leaksan.discharge("spill_fd", fd, expect=False)
 
     # ------------------------------------------------------------------
     # connection handling
@@ -1124,6 +1140,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             # store was full of in-flight returns): track the file so
             # delete unlinks it and peers can fetch it.
             entry.spill_path = data.decode()
+            # Lift any stale no-recache tombstone (oid reborn via
+            # reconstruction): the fd cache may serve it again.
+            with self._spill_fd_lock:
+                self._spill_dead.discard(oid)
         if embedded:
             entry.embedded = list(embedded)
         if self.multinode:

@@ -266,6 +266,15 @@ class Connection:
         except OSError:
             pass
         self._sock.close()
+        # Join the recv thread (it wakes with ConnectionLost as soon
+        # as the socket dies) — UNLESS close() is running ON it (an
+        # on_disconnect callback closing its own connection), where a
+        # join would self-deadlock.  An unjoined recv thread is the
+        # RT014 class: it holds the fd's last reference and can fire
+        # callbacks after the owner thinks the connection is gone.
+        t = self._recv_thread
+        if t is not threading.current_thread() and t.is_alive():
+            t.join(timeout=2.0)
 
 
 class _Waiter:
@@ -297,10 +306,11 @@ def wake_and_join_acceptor(thread, family: int, addr,
     an EINTR retry can make the stale thread steal and instantly drop the
     new listener's first connection."""
     try:
-        s = socket.socket(family, socket.SOCK_STREAM)
-        s.settimeout(1.0)
-        s.connect(addr)
-        s.close()
+        # Context manager: a refused/raced connect must not leak the
+        # dummy socket until GC (RT013 self-finding).
+        with socket.socket(family, socket.SOCK_STREAM) as s:
+            s.settimeout(1.0)
+            s.connect(addr)
     except OSError:
         pass
     if thread is not None and thread.is_alive():

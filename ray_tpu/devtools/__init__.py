@@ -1,3 +1,9 @@
-"""Developer tooling that ships with the runtime (static analysis,
-introspection helpers).  Nothing here is imported on the task hot
-path; the decorators import `devtools.lint.decoration` lazily."""
+"""Developer tooling that ships with the runtime: static analysis
+(`devtools.lint`, rules RT001-RT016), the runtime lock-order sentinel
+(`devtools.locksan`, RAY_TPU_LOCKSAN=1), and the runtime resource-leak
+ledger (`devtools.leaksan`, RAY_TPU_LEAKSAN=1).  locksan/leaksan are
+the dynamic halves of the two-sided concurrency and resource-lifecycle
+sanitizers; the lint rules are the static halves.  Nothing here is
+imported on the task hot path; the decorators import
+`devtools.lint.decoration` lazily, and the leaksan hooks compiled into
+runtime subsystems gate on one module flag."""

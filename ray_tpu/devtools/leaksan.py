@@ -1,0 +1,385 @@
+"""Runtime resource-leak ledger (the dynamic half of the
+resource-lifecycle sanitizer; the static half is lint rules
+RT013-RT016).
+
+Enable with ``RAY_TPU_LEAKSAN=1`` in the environment BEFORE the first
+``import ray_tpu`` (the env var inherits into spawned node/worker
+processes, exactly like locksan).  Instrumented subsystems then call
+the cheap hooks below around every acquire/release of a tracked
+resource:
+
+* ``register(kind, key, detail=...)`` — a resource came alive.  The
+  ledger records its *creation site* (file:line of the registering
+  caller), birth time, and an optional detail string.
+* ``discharge(kind, key)`` — the resource was released.  A discharge
+  for a key that was never registered (or already discharged) is
+  recorded as a ``double_discharge`` anomaly rather than ignored —
+  the exactly-once contract cuts both ways.
+
+Tracked kinds (the runtime wiring):
+
+    kv_block        serve/llm.py BlockAllocator block leaving the free
+                    list (alloc / cached retention) and returning
+    admission_slot  serve/_admission.py AdmissionController.acquire
+                    release closures (the PR-11 exactly-once class)
+    spill_fd        node_objects.py cached spilled-object read fds
+    channel_mmap    experimental/channel.py mmap-backed channel files
+                    (creator side; unlinked at teardown)
+    thread          long-lived service threads that a stop()/
+                    shutdown() must join (LLM engine loops, serve
+                    controller loops)
+    metric_series   per-instance tagged Gauge cells (the per-engine
+                    ``ray_tpu_kv_blocks`` class) that need a
+                    ``.remove()`` on teardown
+
+Reports: each process appends its ledger to
+``<leaksan_dir>/<pid>.json`` (atexit, plus on demand); anything still
+live in the ledger at dump time is a *leak* — the process is exiting
+and nothing will ever discharge it.  ``merged_report()`` — surfaced
+as ``ray_tpu.util.state.leaksan_report()`` and the ``ray_tpu
+leaksan`` CLI — merges the directory with the in-process state.
+Short-lived *expected*-at-exit residents (the serve proxy's listening
+socket while serving, an engine's threads while running) are simply
+resources whose owners must be shut down before the verdict is read:
+the acceptance drill tears the cluster down cleanly first.
+
+Metrics: ``ray_tpu_resources_live{kind}`` gauges track the live count
+per kind; ``ray_tpu_resource_leaks_total{kind}`` counts leaks the
+ledger positively detected (a dump with live entries, a
+double-discharge).  Both feed the normal metric plane.
+
+Tests can use the module un-installed by calling
+``enable_for_testing()`` — hooks check a module flag, not the env —
+and ``reset()`` between cases.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+ENV_FLAG = "RAY_TPU_LEAKSAN"
+ENV_DIR = "RAY_TPU_LEAKSAN_DIR"
+DEFAULT_DIR = "/tmp/ray_tpu_leaksan"
+
+_MAX_ANOMALIES = 200
+_MAX_LIVE_DETAIL = 500      # per-kind cap on dumped live rows
+
+# Hot-path gate: hooks read this module attribute first and bail when
+# the sanitizer is off, so instrumented subsystems pay one attribute
+# load + branch per acquire in the common (disabled) case.
+_ENABLED = os.environ.get(ENV_FLAG, "").strip().lower() in (
+    "1", "true", "yes", "on")
+
+# Ledger state, guarded by a raw lock (leaksan must not depend on
+# locksan instrumentation and vice versa).
+_state_lock = threading.Lock()
+_live: Dict[tuple, dict] = {}           # (kind, key) -> record
+_live_by_kind: Dict[str, int] = {}      # kind -> live count (O(1))
+_registered: Dict[str, int] = {}        # kind -> total registers
+_discharged: Dict[str, int] = {}        # kind -> total discharges
+_anomalies: List[dict] = []             # double discharges etc.
+_dump_registered = False
+_leaks_counted = False                  # metric counted once per proc
+
+_metrics: Optional[tuple] = None        # (live_gauge, leaks_counter)
+_metrics_state = 0                      # 0 unbuilt / 1 building / 2 ready
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def install() -> bool:
+    """Arm the atexit dump (idempotent).  Called from ray_tpu/__init__
+    when RAY_TPU_LEAKSAN is set; the hooks themselves are compiled-in
+    call sites gated on the module flag."""
+    global _ENABLED, _dump_registered
+    _ENABLED = True
+    if not _dump_registered:
+        _dump_registered = True
+        atexit.register(dump)
+    return True
+
+
+def enable_for_testing() -> None:
+    """Flip the hook gate in-process (detector tests that don't want a
+    subprocess).  Does NOT arm the atexit dump."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable_for_testing() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def _creation_site(depth: int = 2) -> str:
+    """file:line of the instrumented caller — the first frame outside
+    this module."""
+    f = sys._getframe(depth)
+    here = __file__
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != here:
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _short_stack(limit: int = 8) -> List[str]:
+    return [ln.strip() for ln in
+            traceback.format_stack(sys._getframe(3), limit=limit)]
+
+
+def _metric_sinks() -> Optional[tuple]:
+    """(live_gauge, leaks_counter), built lazily with the same
+    single-builder gate locksan uses: exactly one thread may construct
+    (metric constructors start the flusher thread whose first tracked
+    operation could re-enter here)."""
+    global _metrics, _metrics_state
+    if _metrics_state == 2:
+        return _metrics
+    with _state_lock:
+        if _metrics_state != 0:
+            return None
+        _metrics_state = 1
+    try:
+        from ray_tpu.util import metrics as um
+        live = um.shared_gauge(
+            um.RESOURCES_LIVE_METRIC,
+            "live tracked resources in the leak ledger, by kind",
+            tag_keys=("kind",))
+        leaks = um.shared_counter(
+            um.RESOURCE_LEAKS_METRIC,
+            "resource leaks the ledger positively detected (live at "
+            "process exit, or released twice), by kind",
+            tag_keys=("kind",))
+        _metrics = (live, leaks)
+        _metrics_state = 2
+        return _metrics
+    except Exception:
+        _metrics_state = 0      # transient (mid-import): retry later
+        return None
+
+
+def _set_live_gauge(kind: str, n: int) -> None:
+    sinks = _metric_sinks()
+    if sinks is not None:
+        try:
+            sinks[0].set(n, tags={"kind": kind})
+        except Exception:
+            pass
+
+
+def _count_leak(kind: str, n: int = 1) -> None:
+    sinks = _metric_sinks()
+    if sinks is not None:
+        try:
+            sinks[1].inc(n, tags={"kind": kind})
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the hooks
+# ---------------------------------------------------------------------------
+def register(kind: str, key: Any, detail: str = "",
+             site: Optional[str] = None) -> None:
+    """A resource of `kind` identified by `key` came alive.  `key`
+    must be hashable and unique per live instance of the kind (block
+    id, fd number, channel path, admission token...)."""
+    if not _ENABLED:
+        return
+    k = (kind, key)
+    with _state_lock:
+        _registered[kind] = _registered.get(kind, 0) + 1
+        if k not in _live:
+            _live_by_kind[kind] = _live_by_kind.get(kind, 0) + 1
+        _live[k] = {
+            "site": site or _creation_site(),
+            "t": time.time(),
+            "detail": detail,
+        }
+        n = _live_by_kind[kind]
+    _set_live_gauge(kind, n)
+
+
+def discharge(kind: str, key: Any, expect: bool = True) -> None:
+    """The resource was released.  With ``expect=False`` an unknown
+    key is silently ignored (release paths that legitimately race
+    teardown, e.g. an fd cache cleared wholesale); the default records
+    a double_discharge anomaly."""
+    if not _ENABLED:
+        return
+    k = (kind, key)
+    with _state_lock:
+        rec = _live.pop(k, None)
+        if rec is not None:
+            _discharged[kind] = _discharged.get(kind, 0) + 1
+            _live_by_kind[kind] = _live_by_kind.get(kind, 1) - 1
+        elif expect and len(_anomalies) < _MAX_ANOMALIES:
+            _anomalies.append({
+                "kind": kind,
+                "key": repr(key),
+                "what": "double_discharge",
+                "thread": threading.current_thread().name,
+                "stack": _short_stack(),
+                "t": time.time(),
+            })
+        n = _live_by_kind.get(kind, 0)
+    _set_live_gauge(kind, n)
+    if rec is None and expect:
+        _count_leak(kind)
+
+
+def track_thread(t: "threading.Thread", detail: str = "") -> None:
+    """Register a long-lived service thread the owner promises to
+    join; pair with ``discharge_thread`` after the join."""
+    register("thread", t.ident or id(t),
+             detail=detail or t.name, site=_creation_site())
+
+
+def discharge_thread(t: "threading.Thread") -> None:
+    discharge("thread", t.ident or id(t), expect=False)
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+def live_counts() -> Dict[str, int]:
+    with _state_lock:
+        return {k: n for k, n in _live_by_kind.items() if n}
+
+
+def report() -> dict:
+    """This process's ledger as a plain dict.  `live` rows are the
+    would-be leaks if the process exited right now."""
+    with _state_lock:
+        by_kind: Dict[str, List[dict]] = {}
+        for (kind, key), rec in _live.items():
+            rows = by_kind.setdefault(kind, [])
+            if len(rows) < _MAX_LIVE_DETAIL:
+                rows.append({"key": repr(key), "site": rec["site"],
+                             "age_s": round(time.time() - rec["t"], 3),
+                             "detail": rec["detail"]})
+        return {
+            "pid": os.getpid(),
+            "argv": " ".join(sys.argv[:3]),
+            "enabled": _ENABLED,
+            "registered": dict(_registered),
+            "discharged": dict(_discharged),
+            "live": by_kind,
+            "live_counts": {k: n for k, n in _live_by_kind.items()
+                            if n},
+            "anomalies": [dict(a) for a in _anomalies],
+        }
+
+
+def report_dir() -> str:
+    d = os.environ.get(ENV_DIR, "").strip()
+    if not d:
+        try:
+            from ray_tpu._private.config import config
+            d = config.leaksan_dir
+        except Exception:
+            d = ""
+    return d or DEFAULT_DIR
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """Write this process's ledger (atomically) for the merger; no-op
+    when nothing was ever tracked.  Live entries at dump time are
+    leaks — count them into the metric plane best-effort (atexit may
+    be too late for a flush; the JSON report is the authority)."""
+    global _leaks_counted
+    rep = report()
+    if not rep["registered"] and not rep["anomalies"]:
+        return None
+    # Count still-live entries into the leak metric ONCE per process:
+    # an on-demand dump followed by the atexit dump must not double
+    # the counter for the same leaks.
+    if not _leaks_counted and rep["live_counts"]:
+        _leaks_counted = True
+        for kind, n in rep["live_counts"].items():
+            _count_leak(kind, n)
+    if path is None:
+        d = report_dir()
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            return None
+        path = os.path.join(d, f"{os.getpid()}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(rep, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def merged_report(directory: Optional[str] = None) -> dict:
+    """Merge every per-process ledger in `directory` (default: the
+    ambient leaksan dir) with the live in-process state.  `leaks` is
+    the union of every process's live-at-dump rows — with per-process
+    dumps written at exit, anything there was never discharged."""
+    directory = directory or report_dir()
+    reports: List[dict] = []
+    if os.path.isdir(directory):
+        for name in sorted(os.listdir(directory)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(directory, name),
+                          encoding="utf-8") as f:
+                    reports.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+    mine = report()
+    if mine["registered"] or mine["anomalies"]:
+        reports = [r for r in reports if r.get("pid") != mine["pid"]]
+        reports.append(mine)
+    merged: Dict[str, Any] = {
+        "processes": len(reports),
+        "registered": {},
+        "discharged": {},
+        "leaks": [],            # [{kind, key, site, pid, ...}]
+        "leak_counts": {},
+        "anomalies": [],
+    }
+    for r in reports:
+        for k, n in (r.get("registered") or {}).items():
+            merged["registered"][k] = merged["registered"].get(k, 0) + n
+        for k, n in (r.get("discharged") or {}).items():
+            merged["discharged"][k] = merged["discharged"].get(k, 0) + n
+        for kind, rows in (r.get("live") or {}).items():
+            for row in rows:
+                merged["leaks"].append(dict(row, kind=kind,
+                                            pid=r.get("pid")))
+            n = (r.get("live_counts") or {}).get(kind, len(rows))
+            merged["leak_counts"][kind] = \
+                merged["leak_counts"].get(kind, 0) + n
+        for a in r.get("anomalies") or []:
+            merged["anomalies"].append(dict(a, pid=r.get("pid")))
+    merged["registrations"] = sum(merged["registered"].values())
+    return merged
+
+
+def reset() -> None:
+    """Drop all in-process state (test isolation)."""
+    global _leaks_counted
+    with _state_lock:
+        _live.clear()
+        _live_by_kind.clear()
+        _registered.clear()
+        _discharged.clear()
+        _anomalies.clear()
+        _leaks_counted = False

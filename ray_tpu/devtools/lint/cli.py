@@ -85,6 +85,14 @@ def add_arguments(parser) -> None:
                              "acquisition-order graph (RT012's "
                              "input) instead of linting; exit 1 if "
                              "the graph has a cycle")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only files under the given paths "
+                             "that are git-modified vs HEAD (or "
+                             "untracked) — the fast incremental-CI "
+                             "run.  NOTE: project-scope rules (the "
+                             "RT012 lock graph) only see the changed "
+                             "subset; run the full paths before "
+                             "merging")
 
 
 def run(args) -> int:
@@ -93,8 +101,18 @@ def run(args) -> int:
               if args.select else None)
     if getattr(args, "lock_graph", False):
         return _run_lock_graph(args)
+    paths = list(args.paths)
+    if getattr(args, "changed", False):
+        try:
+            paths = engine.changed_files(paths, rel_root)
+        except (RuntimeError, FileNotFoundError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            print("0 findings (no changed files)")
+            return 0
     try:
-        res = engine.lint_paths(args.paths, select=select)
+        res = engine.lint_paths(paths, select=select)
     except (FileNotFoundError, KeyError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

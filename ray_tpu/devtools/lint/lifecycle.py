@@ -1,0 +1,851 @@
+"""RT013-RT016 — resource-lifecycle rules (the static half of the
+leak sanitizer; the runtime half is devtools/leaksan.py).
+
+The four rules share one *pairing registry* of acquire/release calls
+derived from this repo's own bug history (leaked KV blocks on a
+throwing dispatch, admission release closures that must fire exactly
+once, per-engine gauge series outliving their replica, threads
+without a join segfaulting interpreter teardown):
+
+    open/io.open/os.fdopen      -> .close()          (file)
+    os.open                     -> os.close(fd)      (fd)
+    mmap.mmap                   -> .close()          (mmap)
+    socket.socket / dial        -> .close()          (socket)
+    <pool>.alloc / <pool>.incref-> <pool>.decref/free (kv/block pool)
+    <gate>.acquire              -> closure() fired    (admission slot)
+    <x>.add_*/register_* paired -> <x>.remove_*/unregister_* in the
+                                   same function (exception-safe)
+    threading.Thread(...).start -> .join() on a teardown path (RT014)
+    Gauge .set(tags={...self...})-> .remove() on a teardown path (RT015)
+
+An acquire discharges its obligation by reaching the paired release on
+ALL control-flow paths — satisfied by a `with` block, a try/finally,
+a symmetric except-handler + normal-path release pair, by *ownership
+transfer* (storing the resource into an owner object or container,
+returning it, passing it to another call — a teardown rule then covers
+the owner), or by the explicit annotation ``# ray-tpu: transfer`` on
+the acquire line (deliberate hand-off the analysis can't see).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.lint.engine import (Finding, SourceModule,
+                                          _dotted_name, register)
+from ray_tpu.devtools.lint.rules import (_call_name, _enclosing_class,
+                                         _imports, _is_self_attr,
+                                         _mod_cached)
+
+# Explicit ownership-transfer annotation: the acquire line hands the
+# resource to an owner the analysis can't see (a C library, a peer
+# process, a registry keyed elsewhere).  Scoped like noqa but
+# rule-family-wide: it asserts a true fact about ownership, not a
+# suppression of one rule id.
+_TRANSFER_RE = re.compile(r"#\s*ray-tpu:\s*transfer\b", re.IGNORECASE)
+
+# ---------------------------------------------------------------------------
+# pairing registry
+# ---------------------------------------------------------------------------
+# Full-name acquires whose handle is the call result: kind, the method
+# names on the handle that release it, and (for fd-style handles) the
+# free function that takes the handle as its argument.
+_ACQ_FULL: Dict[str, Tuple[str, Set[str], Set[str]]] = {
+    "open": ("file", {"close"}, set()),
+    "io.open": ("file", {"close"}, set()),
+    "os.fdopen": ("file", {"close"}, set()),
+    "os.open": ("fd", set(), {"os.close"}),
+    "mmap.mmap": ("mmap", {"close"}, set()),
+    "socket.socket": ("socket", {"close"}, set()),
+    "socket.create_connection": ("socket", {"close"}, set()),
+}
+
+# Receiver-heuristic acquires: the receiver's trailing name marks it
+# as a pool/gate, so `.alloc()`/`.acquire()` on it is an acquire.
+_POOL_RECV_RE = re.compile(r"(?:^|_)(?:alloc(?:ator)?|pool)s?$",
+                           re.IGNORECASE)
+_GATE_RECV_RE = re.compile(r"(?:^|_)(?:gate|admission|admit)\w*$",
+                           re.IGNORECASE)
+_POOL_RELEASES = {"decref", "free", "release", "release_cached"}
+
+# Same-receiver add/remove pairs checked for exception-safety when
+# BOTH appear in one function (`register_x` without a visible remover
+# is the teardown-elsewhere pattern and stays silent).
+_ADD_PREFIXES = ("add_", "register_", "register")
+_REMOVE_FOR = {"add_": ("remove_", "discard_", "del_", "pop_"),
+               "register_": ("unregister_", "deregister_", "remove_"),
+               "register": ("unregister", "deregister")}
+
+
+def _transfer_annotated(mod: SourceModule, node: ast.AST) -> bool:
+    return bool(_TRANSFER_RE.search(
+        mod.line_text(getattr(node, "lineno", 0))))
+
+
+def _recv_name(call: ast.Call) -> Optional[str]:
+    """Dotted receiver of a method call `a.b.meth(...)` -> 'a.b'."""
+    if isinstance(call.func, ast.Attribute):
+        return _dotted_name(call.func.value)
+    return None
+
+
+def _recv_tail(call: ast.Call) -> str:
+    name = _recv_name(call) or ""
+    return name.rsplit(".", 1)[-1]
+
+
+def _functions(mod: SourceModule) -> List[ast.AST]:
+    return [n for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _fn_walk(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's subtree, pruning nested def/class bodies
+    (their bodies run later, in their own scope)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _try_regions(fn: ast.AST) -> Tuple[Set[int], Set[int]]:
+    """(ids of nodes inside any `finally` body, ids inside any
+    `except` handler) within this function."""
+    fin: Set[int] = set()
+    exc: Set[int] = set()
+    for node in _fn_walk(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        for s in node.finalbody:
+            for sub in ast.walk(s):
+                fin.add(id(sub))
+        for h in node.handlers:
+            for s in h.body:
+                for sub in ast.walk(s):
+                    exc.add(id(sub))
+    return fin, exc
+
+
+def _in_with_item(mod: SourceModule, call: ast.Call) -> bool:
+    """The call is (part of) a `with` item's context expression."""
+    cur: ast.AST = call
+    parent = mod.parent.get(cur)
+    while parent is not None:
+        if isinstance(parent, ast.withitem):
+            return True
+        if isinstance(parent, (ast.stmt,)):
+            return False
+        cur, parent = parent, mod.parent.get(parent)
+    return False
+
+
+def _assigned_name(mod: SourceModule, call: ast.Call) -> Optional[str]:
+    """Local name the call result is bound to (`x = acquire()`), or
+    None for any other binding shape."""
+    parent = mod.parent.get(call)
+    if isinstance(parent, ast.Assign) and parent.value is call \
+            and len(parent.targets) == 1 \
+            and isinstance(parent.targets[0], ast.Name):
+        return parent.targets[0].id
+    return None
+
+
+def _result_transferred(mod: SourceModule, call: ast.Call) -> bool:
+    """The call result immediately escapes this scope: returned,
+    yielded, passed to another call, or stored through an attribute/
+    subscript/container — ownership moves to the consumer/owner."""
+    cur: ast.AST = call
+    parent = mod.parent.get(cur)
+    # `open(p).read()`: the handle is consumed as a RECEIVER and only
+    # the method result flows onward — that is a drop, not a transfer.
+    via_result = False
+    while parent is not None and not isinstance(parent, ast.stmt):
+        if isinstance(parent, ast.Call):
+            if cur is parent.func:
+                via_result = True
+            elif not via_result:
+                return True          # argument to another call
+        cur, parent = parent, mod.parent.get(parent)
+    if via_result:
+        return False
+    if isinstance(parent, (ast.Return, ast.Expr)) \
+            and isinstance(getattr(parent, "value", None),
+                           (ast.Yield, ast.YieldFrom)):
+        return True
+    if isinstance(parent, ast.Return):
+        return True
+    if isinstance(parent, ast.Assign):
+        for t in parent.targets:
+            if not isinstance(t, ast.Name):
+                return True          # self.x = ..., d[k] = ..., a, b =
+    return False
+
+
+def _name_escapes(mod: SourceModule, fn: ast.AST, name: str,
+                  release_calls: List[ast.Call]) -> bool:
+    """Does local `name` escape the function (transfer of ownership)?
+    Escapes: returned/yielded, passed as an argument to a call,
+    stored into an attribute/subscript/other-name, captured by a
+    nested def, or placed in a container literal.  A plain method
+    call ON the name (`x.read()`) is a use, not an escape."""
+    release_ids = {id(c) for c in release_calls}
+    for node in ast.walk(fn):        # full walk: closures count
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            # Free-variable capture by a nested function.
+            bound = {a.arg for a in node.args.args}
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id == name \
+                        and isinstance(sub.ctx, ast.Load) \
+                        and name not in bound:
+                    return True
+        if not (isinstance(node, ast.Name) and node.id == name
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        cur: ast.AST = node
+        parent = mod.parent.get(cur)
+        # Once the walk passes through a call's RECEIVER position
+        # (`f.read()`), what flows onward is the call RESULT, not the
+        # handle — a returned/stored result is not an escape of x.
+        via_result = False
+        while parent is not None and not isinstance(parent, ast.stmt):
+            if isinstance(parent, ast.Call):
+                if id(parent) in release_ids:
+                    break            # part of the release itself
+                if cur is parent.func:
+                    via_result = True
+                    cur, parent = parent, mod.parent.get(parent)
+                    continue
+                if not via_result:
+                    return True      # x passed as an argument
+            elif isinstance(parent, (ast.Tuple, ast.List, ast.Set,
+                                     ast.Dict)) and not via_result:
+                return True          # container literal
+            cur, parent = parent, mod.parent.get(parent)
+        if via_result:
+            continue
+        if isinstance(parent, (ast.Return,)):
+            return True
+        if isinstance(parent, ast.Expr) \
+                and isinstance(parent.value, (ast.Yield, ast.YieldFrom)):
+            return True
+        if isinstance(parent, ast.Assign):
+            if any(not isinstance(t, ast.Name) for t in parent.targets):
+                return True          # stored into attr/subscript
+            if isinstance(parent.value, ast.Name) \
+                    and parent.value.id == name:
+                return True          # aliased: y = x
+    return False
+
+
+def _release_calls_for(fn: ast.AST, name: str, methods: Set[str],
+                       frees: Set[str],
+                       imports: Dict[str, str]) -> List[ast.Call]:
+    """Calls in `fn` that release local `name`: `name.close()` style,
+    `os.close(name)` style, or — for release closures — `name()`."""
+    out: List[ast.Call] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in methods \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id == name:
+            out.append(node)
+        elif frees:
+            from ray_tpu.devtools.lint.rules import _resolved
+            if _resolved(f, imports) in frees and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id == name:
+                out.append(node)
+        if not methods and not frees:       # closure: name() fires it
+            if isinstance(f, ast.Name) and f.id == name:
+                out.append(node)
+    return out
+
+
+def _risky_between(fn: ast.AST, after: ast.AST, before: ast.AST,
+                   skip: Set[int]) -> bool:
+    """Any call between `after` and `before` (by line) that could
+    raise and skip the release — calls in `skip` excluded."""
+    lo = getattr(after, "lineno", 0)
+    hi = getattr(before, "lineno", 1 << 30)
+    for node in _fn_walk(fn):
+        if isinstance(node, ast.Call) and id(node) not in skip \
+                and lo < getattr(node, "lineno", 0) <= hi \
+                and node is not before:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# RT013 — paired acquire/release on every path
+# ---------------------------------------------------------------------------
+@register(
+    "RT013", "acquired resource not released on all paths "
+    "(exception-safe pairing)",
+    "Recognized acquires (open/os.open/mmap/socket dial, block-pool "
+    "alloc/incref, admission acquire, same-function add_*/register_* "
+    "with its remover) must reach their paired release on EVERY "
+    "control-flow path, including exception edges.  Satisfied by a "
+    "`with` block, try/finally, a normal-path + except-handler "
+    "release pair, ownership transfer (stored into an owner object/"
+    "container, returned, passed on — a teardown rule covers the "
+    "owner), or the explicit `# ray-tpu: transfer` annotation.  The "
+    "repo's dominant hand-fixed bug class: resources leaked on the "
+    "error path nobody tested.")
+def check_rt013(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    for fn in _functions(mod):
+        fin_ids, exc_ids = _try_regions(fn)
+        yield from _rt013_handle_acquires(mod, fn, imports, fin_ids,
+                                          exc_ids)
+        yield from _rt013_pool_pairs(mod, fn, imports, fin_ids,
+                                     exc_ids)
+        yield from _rt013_add_remove(mod, fn, fin_ids, exc_ids)
+
+
+def _classify_release(call: ast.Call, fin_ids: Set[int],
+                      exc_ids: Set[int]) -> str:
+    if id(call) in fin_ids:
+        return "finally"
+    if id(call) in exc_ids:
+        return "except"
+    return "normal"
+
+
+def _release_covers(releases: List[ast.Call], fin_ids: Set[int],
+                    exc_ids: Set[int]) -> Optional[str]:
+    """None when the release set is exception-safe; otherwise a short
+    reason string."""
+    kinds = {_classify_release(r, fin_ids, exc_ids) for r in releases}
+    if "finally" in kinds:
+        return None
+    if "except" in kinds and "normal" in kinds:
+        return None            # symmetric pair covers both edges
+    if "normal" in kinds:
+        return ("released only on the normal path — an exception "
+                "between acquire and release leaks it (wrap in "
+                "try/finally or use a context manager)")
+    return ("released only inside an except handler — the normal "
+            "path leaks it")
+
+
+def _rt013_handle_acquires(mod, fn, imports, fin_ids, exc_ids):
+    for node in _fn_walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = _call_name(node, imports) or ""
+        spec = _ACQ_FULL.get(cname)
+        kind = methods = frees = None
+        if spec is not None:
+            kind, methods, frees = spec
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "acquire" \
+                and _GATE_RECV_RE.search(_recv_tail(node)):
+            kind, methods, frees = "release_closure", set(), set()
+        if kind is None:
+            continue
+        if _in_with_item(mod, node) or _transfer_annotated(mod, node):
+            continue
+        if _result_transferred(mod, node):
+            continue
+        name = _assigned_name(mod, node)
+        if name is None:
+            yield mod.finding(
+                "RT013", node,
+                f"{kind} acquired by {cname or 'acquire()'} is "
+                f"discarded — nothing can ever release it")
+            continue
+        releases = _release_calls_for(fn, name, methods, frees,
+                                      imports)
+        if not releases:
+            if _name_escapes(mod, fn, name, releases):
+                continue       # ownership transferred
+            yield mod.finding(
+                "RT013", node,
+                f"{kind} {name!r} acquired here is never released in "
+                f"this function and never handed off — use `with`, "
+                f"try/finally, or transfer ownership")
+            continue
+        reason = _release_covers(releases, fin_ids, exc_ids)
+        if reason is None:
+            continue
+        first = min(releases, key=lambda r: getattr(r, "lineno", 0))
+        skip = {id(r) for r in releases}
+        if not _risky_between(fn, node, first, skip):
+            continue
+        if _name_escapes(mod, fn, name, releases):
+            continue           # also handed off: owner releases too
+        yield mod.finding("RT013", node,
+                          f"{kind} {name!r}: {reason}")
+
+
+def _rt013_pool_pairs(mod, fn, imports, fin_ids, exc_ids):
+    """Block-pool discipline: a function that increfs/allocs on a
+    pool-like receiver and also decrefs it must pair them exception-
+    safely; an incref with NO release and no transfer leaks a ref."""
+    acquires: List[ast.Call] = []
+    releases: List[ast.Call] = []
+    for node in _fn_walk(fn):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        recv = _recv_tail(node)
+        if not _POOL_RECV_RE.search(recv):
+            continue
+        if node.func.attr in ("alloc", "incref"):
+            acquires.append(node)
+        elif node.func.attr in _POOL_RELEASES:
+            releases.append(node)
+    if not acquires:
+        return
+    for acq in acquires:
+        if _transfer_annotated(mod, acq):
+            continue
+        name = _assigned_name(mod, acq)
+        if name is not None and _name_escapes(mod, fn, name, releases):
+            continue           # e.g. req._blocks = pool.alloc(n)
+        if name is None and acq.func.attr == "alloc" \
+                and _result_transferred(mod, acq):
+            continue
+        if not releases:
+            yield mod.finding(
+                "RT013", acq,
+                f"pool {acq.func.attr}() without a matching decref/"
+                f"free in this function and no ownership transfer — "
+                f"leaked block refs on every call")
+            continue
+        reason = _release_covers(releases, fin_ids, exc_ids)
+        if reason is None:
+            continue
+        first = min(releases, key=lambda r: getattr(r, "lineno", 0))
+        if getattr(first, "lineno", 0) < getattr(acq, "lineno", 0):
+            continue           # release precedes (loop bodies): skip
+        skip = {id(r) for r in releases} | {id(a) for a in acquires}
+        if not _risky_between(fn, acq, first, skip):
+            continue
+        yield mod.finding(
+            "RT013", acq,
+            f"pool {acq.func.attr}() {reason}")
+
+
+def _rt013_add_remove(mod, fn, fin_ids, exc_ids):
+    """Same-receiver add_*/register_* + remove_* pair in one function
+    must be exception-safe (the registration epoch between them is an
+    exception edge that leaks the registration)."""
+    adds: Dict[Tuple[str, str], List[ast.Call]] = {}
+    removes: Dict[Tuple[str, str], List[ast.Call]] = {}
+    for node in _fn_walk(fn):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        meth = node.func.attr
+        recv = _recv_name(node) or ""
+        for pref in _ADD_PREFIXES:
+            if meth == pref or (pref.endswith("_")
+                                and meth.startswith(pref)):
+                suffix = meth[len(pref):]
+                adds.setdefault((recv, suffix), []).append(node)
+                break
+        else:
+            for pref, rems in _REMOVE_FOR.items():
+                for rpref in rems:
+                    if meth == rpref or (rpref.endswith("_")
+                                         and meth.startswith(rpref)):
+                        suffix = meth[len(rpref):]
+                        removes.setdefault((recv, suffix),
+                                           []).append(node)
+    for key, acqs in adds.items():
+        rels = removes.get(key)
+        if not rels:
+            continue           # removed elsewhere: teardown pattern
+        reason = _release_covers(rels, fin_ids, exc_ids)
+        if reason is None:
+            continue
+        for acq in acqs:
+            if _transfer_annotated(mod, acq):
+                continue
+            first = min(rels, key=lambda r: getattr(r, "lineno", 0))
+            if getattr(first, "lineno", 0) \
+                    < getattr(acq, "lineno", 0):
+                continue
+            skip = {id(r) for r in rels} | {id(a) for a in acqs}
+            if not _risky_between(fn, acq, first, skip):
+                continue
+            yield mod.finding(
+                "RT013", acq,
+                f"{acq.func.attr}() paired with "
+                f"{first.func.attr}() in this function but {reason}")
+
+
+# ---------------------------------------------------------------------------
+# RT014 — thread/loop lifecycle
+# ---------------------------------------------------------------------------
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+
+def _is_thread_ctor(node: ast.AST, imports: Dict[str, str]) -> bool:
+    return (isinstance(node, ast.Call)
+            and (_call_name(node, imports) in _THREAD_CTORS))
+
+
+def _ctor_kw(call: ast.Call, key: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == key:
+            return kw.value
+    return None
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    v = _ctor_kw(call, "daemon")
+    return isinstance(v, ast.Constant) and v.value is True
+
+
+_BLOCKING_WAKEABLE = ("recv", "accept", "readline")
+
+
+def _loop_has_stop(while_node: ast.While) -> bool:
+    """A `while True` loop body checks a stop signal: break/return, an
+    `.is_set()` probe, an Event-style `.wait(...)`, or a blocking
+    socket/queue read (recv*/accept/get) that teardown wakes by
+    closing the fd / poisoning the queue — the loop then exits via
+    the raised ConnectionLost/OSError."""
+    for node in ast.walk(while_node):
+        if node is while_node:
+            continue
+        if isinstance(node, (ast.Break, ast.Return)):
+            return True
+        if isinstance(node, ast.Call):
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else (node.func.id
+                          if isinstance(node.func, ast.Name) else ""))
+            if attr in ("is_set", "wait"):
+                return True
+            if any(attr.startswith(p) for p in _BLOCKING_WAKEABLE):
+                return True
+    return False
+
+
+@register(
+    "RT014", "started thread without a join on any teardown path / "
+    "unstoppable daemon loop",
+    "A thread stored on the instance and start()ed must be join()able "
+    "from some method (stop/shutdown/close — name-agnostic: any "
+    "method that loads the thread attr and calls .join counts): an "
+    "unjoined engine thread inside an XLA dispatch at interpreter "
+    "teardown is the PR-9 segfault class.  A LOCAL non-daemon thread "
+    "that is never joined and never escapes blocks process exit.  "
+    "And a thread target whose `while True:` body never checks a "
+    "stop Event (no break/return/is_set/wait) can never be shut "
+    "down cleanly at all.")
+def check_rt014(mod: SourceModule) -> Iterable[Finding]:
+    imports = _imports(mod)
+    yield from _rt014_attr_threads(mod, imports)
+    yield from _rt014_local_threads(mod, imports)
+    yield from _rt014_loops(mod, imports)
+
+
+def _rt014_attr_threads(mod, imports):
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        thread_attrs: Dict[str, ast.AST] = {}
+        started: Set[str] = set()
+        joined_attrs: Set[str] = set()
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        for fn in methods:
+            # A method "joins" if it calls .join() directly OR calls a
+            # helper whose name says join (wake_and_join_acceptor,
+            # _join_threads...) — the repo's teardown helpers.
+            has_join = any(
+                isinstance(n, ast.Call)
+                and (("join" in n.func.attr
+                      if isinstance(n.func, ast.Attribute)
+                      else "join" in (_dotted_name(n.func) or "")
+                      .rsplit(".", 1)[-1]))
+                for n in ast.walk(fn))
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and _is_self_attr(node.targets[0]) \
+                        and _is_thread_ctor(node.value, imports):
+                    thread_attrs[node.targets[0].attr] = node
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "start" \
+                        and _is_self_attr(node.func.value):
+                    started.add(node.func.value.attr)
+                if not has_join:
+                    continue
+                # Any self attr loaded in a join-bearing method is
+                # considered joined there (covers `for t in
+                # (self._a, self._b): t.join()`), including the
+                # `getattr(self, "_attr", None)` spelling.
+                if _is_self_attr(node) \
+                        and isinstance(node.ctx, ast.Load):
+                    joined_attrs.add(node.attr)
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == "getattr" \
+                        and len(node.args) >= 2 \
+                        and isinstance(node.args[1], ast.Constant) \
+                        and isinstance(node.args[1].value, str):
+                    joined_attrs.add(node.args[1].value)
+        for attr, assign in thread_attrs.items():
+            if attr not in started:
+                continue
+            if attr in joined_attrs:
+                continue
+            if _transfer_annotated(mod, assign):
+                continue
+            yield mod.finding(
+                "RT014", assign,
+                f"thread self.{attr} of {cls.name!r} is started but "
+                f"no method of the class ever joins it — teardown "
+                f"races the loop (add a stop()/shutdown() that "
+                f"signals and joins)")
+
+
+def _rt014_local_threads(mod, imports):
+    for fn in _functions(mod):
+        assigned: Dict[str, ast.AST] = {}
+        ctor_by_name: Dict[str, ast.Call] = {}
+        started: Set[str] = set()
+        joined: Set[str] = set()
+        for node in _fn_walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _is_thread_ctor(node.value, imports):
+                assigned[node.targets[0].id] = node
+                ctor_by_name[node.targets[0].id] = node.value
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name):
+                if node.func.attr == "start":
+                    started.add(node.func.value.id)
+                elif node.func.attr == "join":
+                    joined.add(node.func.value.id)
+            # Chained fire-and-forget: Thread(...).start()
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "start" \
+                    and _is_thread_ctor(node.func.value, imports) \
+                    and not _is_daemon(node.func.value) \
+                    and not _transfer_annotated(mod, node):
+                yield mod.finding(
+                    "RT014", node,
+                    "non-daemon Thread(...).start() with no handle — "
+                    "it can never be joined; keep a reference and "
+                    "join it, or mark daemon=True deliberately")
+        for name, assign in assigned.items():
+            if name not in started or name in joined:
+                continue
+            ctor = ctor_by_name[name]
+            if _is_daemon(ctor) or _transfer_annotated(mod, assign):
+                continue
+            if _name_escapes(mod, fn, name, []):
+                continue       # stored/returned: owner joins
+            yield mod.finding(
+                "RT014", assign,
+                f"non-daemon thread {name!r} is started but never "
+                f"joined in this function and never handed off — "
+                f"process exit will block on it")
+
+
+def _rt014_loops(mod, imports):
+    """`while True:` without a stop check, in functions used as thread
+    targets."""
+    # Thread targets: self.<meth> or a local function name.
+    target_methods: Set[str] = set()
+    target_names: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not _is_thread_ctor(node, imports):
+            continue
+        tgt = _ctor_kw(node, "target")
+        if isinstance(tgt, ast.Attribute):
+            target_methods.add(tgt.attr)
+        elif isinstance(tgt, ast.Name):
+            target_names.add(tgt.id)
+    if not target_methods and not target_names:
+        return
+    for fn in _functions(mod):
+        if fn.name not in target_methods \
+                and fn.name not in target_names:
+            continue
+        for node in _fn_walk(fn):
+            if not isinstance(node, ast.While):
+                continue
+            t = node.test
+            if not (isinstance(t, ast.Constant) and t.value in (True,
+                                                                1)):
+                continue
+            if _loop_has_stop(node):
+                continue
+            if _transfer_annotated(mod, node):
+                continue
+            yield mod.finding(
+                "RT014", node,
+                f"`while True` daemon loop in thread target "
+                f"{fn.name!r} never checks a stop Event (no break/"
+                f"return/is_set/wait) — the thread cannot be shut "
+                f"down cleanly")
+
+
+# ---------------------------------------------------------------------------
+# RT015 — per-instance tagged metric series need a remove()
+# ---------------------------------------------------------------------------
+@register(
+    "RT015", "per-instance tagged gauge series without a .remove() "
+    "teardown",
+    "A class that writes a Gauge series whose tag VALUE comes from "
+    "the instance (`.set(n, tags={'engine': self._tag})`) mints one "
+    "series per instance; without a matching `.remove()` on some "
+    "teardown path, every construct/stop cycle leaks dead cells in "
+    "the process registry and stale samples in the node aggregate — "
+    "the PR-9/PR-11 gauge-leak class, machine-checked.")
+def check_rt015(mod: SourceModule) -> Iterable[Finding]:
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        has_remove = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "remove"
+            for n in ast.walk(cls))
+        if has_remove:
+            continue
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "set"):
+                continue
+            tags = _ctor_kw(node, "tags")
+            if not isinstance(tags, ast.Dict):
+                continue
+            inst_vals = [v for v in tags.values if _is_self_attr(v)]
+            if not inst_vals:
+                continue
+            if _transfer_annotated(mod, node):
+                continue
+            yield mod.finding(
+                "RT015", node,
+                f"{cls.name!r} sets a gauge series tagged by "
+                f"instance state (self.{inst_vals[0].attr}) but the "
+                f"class never calls .remove() — each instance leaks "
+                f"its series on teardown")
+
+
+# ---------------------------------------------------------------------------
+# RT016 — exactly-once discharge of stored release closures
+# ---------------------------------------------------------------------------
+_RELEASE_PARAM_RE = re.compile(
+    r"(?:^|_)(?:release|release_cb|on_release|done_cb)$")
+
+
+def _closure_bindings(mod: SourceModule, fn: ast.AST
+                      ) -> List[Tuple[str, ast.AST]]:
+    """(name, site) pairs for release closures visible in `fn`:
+    params named release-ish, and locals bound from a gate-ish
+    .acquire()."""
+    out: List[Tuple[str, ast.AST]] = []
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        if _RELEASE_PARAM_RE.search(a.arg):
+            out.append((a.arg, fn))
+    for node in _fn_walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr == "acquire" \
+                and _GATE_RECV_RE.search(_recv_tail(node.value)):
+            out.append((node.targets[0].id, node))
+    return out
+
+
+def _mentions(nodes: List[ast.stmt], name: str) -> bool:
+    for s in nodes:
+        for sub in ast.walk(s):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+def _terminal(nodes: List[ast.stmt]) -> bool:
+    """Handler body ends the request's story here (return/raise) —
+    fall-through handlers may discharge later."""
+    for s in nodes:
+        if isinstance(s, (ast.Return, ast.Raise)):
+            return True
+    return False
+
+
+@register(
+    "RT016", "terminal branch neither fires nor forwards a release "
+    "closure (exactly-once discharge)",
+    "Admission release closures (and stored done-callbacks) must fire "
+    "exactly once per terminal outcome.  In a function holding one — "
+    "a parameter named release/on_release/done_cb, or a local bound "
+    "from a gate's .acquire() — every except handler that ends the "
+    "story (return/raise) must fire the closure, forward it, or be "
+    "covered by an enclosing finally; a terminal branch that does "
+    "none leaks the slot until the router is rebuilt (the PR-11 "
+    "trap, machine-checked).  Raising handlers whose exception "
+    "escapes into a covering try/finally also count as covered.")
+def check_rt016(mod: SourceModule) -> Iterable[Finding]:
+    for fn in _functions(mod):
+        bindings = _closure_bindings(mod, fn)
+        if not bindings:
+            continue
+        for name, site in bindings:
+            if site is not fn and _transfer_annotated(mod, site):
+                continue
+            # An enclosing finally that mentions the closure covers
+            # every branch of the function.
+            covered = False
+            for node in _fn_walk(fn):
+                if isinstance(node, ast.Try) and node.finalbody \
+                        and _mentions(node.finalbody, name):
+                    covered = True
+                    break
+            if covered:
+                continue
+            bind_line = getattr(site, "lineno", 0)
+            for node in _fn_walk(fn):
+                if not isinstance(node, ast.Try):
+                    continue
+                for h in node.handlers:
+                    if getattr(h, "lineno", 0) < bind_line:
+                        continue
+                    if _mentions(h.body, name):
+                        continue
+                    if not _terminal(h.body):
+                        continue
+                    # A handler that RAISES hands the exception to
+                    # callers — only a leak if nothing above catches
+                    # it with the closure... conservatively flag
+                    # `return`-terminated handlers, and `raise`
+                    # handlers only when the binding is local (the
+                    # caller can't fire a closure it never saw).
+                    raises_only = all(
+                        isinstance(s, ast.Raise) for s in h.body
+                        if isinstance(s, (ast.Return, ast.Raise)))
+                    if raises_only and site is fn:
+                        continue       # param: caller still owns it
+                    yield mod.finding(
+                        "RT016", h,
+                        f"except handler reaches a terminal outcome "
+                        f"without firing or forwarding release "
+                        f"closure {name!r} — the admission/"
+                        f"tenant slot leaks (exactly-once contract)")

@@ -1499,3 +1499,10 @@ def _rt012_finalize(mods: List[SourceModule]) -> Iterable[Finding]:
 def check_rt012(mod: SourceModule) -> Iterable[Finding]:
     _rt012_cached(mod)      # collect per-module facts; finalize reports
     return ()
+
+
+# RT013-RT016 (resource-lifecycle rules) live in their own module and
+# share this one's import-resolution helpers; importing registers
+# them.  Bottom of file: lifecycle imports back from rules, which is
+# complete by this line.
+from ray_tpu.devtools.lint import lifecycle  # noqa: E402,F401

@@ -43,6 +43,7 @@ from typing import Any, Dict, Optional
 
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import config
+from ray_tpu.devtools import leaksan
 
 _HEADER = 64
 _Q = struct.Struct("<Q")
@@ -63,14 +64,28 @@ class Channel:
                  slot_size: int = 1 << 20, create: bool = False,
                  spin_us: Optional[int] = None) -> None:
         self.path = path
+        self._created = create
         if create:
             size = _HEADER + capacity * (8 + slot_size)
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
             try:
                 os.ftruncate(fd, size)
                 self._mm = mmap.mmap(fd, size)
+            except BaseException:
+                # ftruncate/mmap failed (ENOSPC on /dev/shm): the
+                # just-created file would otherwise survive as an
+                # orphan no teardown knows about.
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                raise
             finally:
                 os.close(fd)
+            # Ledger: the creator owns the /dev/shm file until its
+            # close(unlink=True) — a killed executor's channel file
+            # shows up as a leaked channel_mmap.
+            leaksan.register("channel_mmap", path)
             self._mm[0:8] = _Q.pack(capacity)
             self._mm[8:16] = _Q.pack(slot_size)
             self._mm[16:24] = _Q.pack(0)
@@ -242,3 +257,5 @@ class Channel:
                 os.unlink(self.path)
             except OSError:
                 pass
+        if self._created and (unlink or not os.path.exists(self.path)):
+            leaksan.discharge("channel_mmap", self.path, expect=False)

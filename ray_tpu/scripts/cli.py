@@ -20,11 +20,15 @@ status`) + `ray list/summary` (util/state CLI) + `ray job` (job CLI).
     metrics                   Prometheus text from the head
     job {submit,status,logs,list,stop}
     microbench                core-runtime perf harness
-    lint <path>...            static analysis (RT001-RT012) for
-                              remote/actor/sharding/concurrency code
-                              (--lock-graph dumps the lock-order graph)
+    lint <path>...            static analysis (RT001-RT016) for
+                              remote/actor/sharding/concurrency/
+                              lifecycle code (--lock-graph dumps the
+                              lock-order graph; --changed lints only
+                              git-modified files)
     locksan                   merged runtime lock-sanitizer report
                               from a RAY_TPU_LOCKSAN=1 run
+    leaksan                   merged resource-leak ledger from a
+                              RAY_TPU_LEAKSAN=1 run (exit 1 on leaks)
 
 State (started pids, head address) persists in ~/.ray_tpu_cli.json so
 `stop`/`status` work from a fresh shell."""
@@ -609,6 +613,47 @@ def cmd_locksan(args) -> int:
     return 1 if inv else 0
 
 
+def cmd_leaksan(args) -> int:
+    """Merged resource-leak ledger (devtools/leaksan.py).  Run the
+    workload with RAY_TPU_LEAKSAN=1 first; every process drops a
+    <pid>.json ledger into the leaksan dir at exit.  Anything still
+    live in a ledger at dump time was never released — exit 1 on any
+    leak or exactly-once anomaly, 0 on a clean run."""
+    from ray_tpu.devtools import leaksan
+    rep = leaksan.merged_report(args.dir)
+    bad = bool(rep["leaks"] or rep["anomalies"])
+    if args.json:
+        print(json.dumps(rep, indent=1, default=str))
+        return 1 if bad else 0
+    print(f"leaksan report ({rep['processes']} process(es), "
+          f"{rep['registrations']} tracked registrations, dir "
+          f"{args.dir or leaksan.report_dir()})")
+    if not rep["processes"]:
+        print("no ledgers found — run the workload with "
+              "RAY_TPU_LEAKSAN=1")
+        return 0
+    print("\nper-kind registered/discharged:")
+    for kind in sorted(rep["registered"]):
+        reg = rep["registered"][kind]
+        dis = rep["discharged"].get(kind, 0)
+        leaked = rep["leak_counts"].get(kind, 0)
+        mark = f"  LEAKED {leaked}" if leaked else ""
+        print(f"  {kind:<16} {reg:>8} / {dis:<8}{mark}")
+    print(f"\nleaked resources: {len(rep['leaks'])}")
+    for row in rep["leaks"][:20]:
+        print(f"  [{row.get('kind')}] key={row.get('key')} "
+              f"age={row.get('age_s')}s pid={row.get('pid')}")
+        print(f"      born at {row.get('site')}")
+    if len(rep["leaks"]) > 20:
+        print(f"  ... and {len(rep['leaks']) - 20} more")
+    anoms = rep["anomalies"]
+    print(f"\nexactly-once anomalies (double discharge): {len(anoms)}")
+    for a in anoms[:10]:
+        print(f"  [{a.get('kind')}] key={a.get('key')} "
+              f"pid={a.get('pid')} thread={a.get('thread')}")
+    return 1 if bad else 0
+
+
 def cmd_drain(args) -> int:
     """Gracefully drain one node (reference: `ray drain-node`): the
     GCS flips it alive -> draining and the node hands back queued
@@ -874,6 +919,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "locksan dir)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_locksan)
+
+    p = sub.add_parser(
+        "leaksan",
+        help="merged resource-leak ledger (leaked blocks/slots/fds/"
+             "threads/series) from a RAY_TPU_LEAKSAN=1 run")
+    p.add_argument("--dir", default=None,
+                   help="ledger directory (default: the ambient "
+                        "leaksan dir)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_leaksan)
 
     # The rule-table epilog imports + registers the whole lint rule
     # set; only `ray_tpu lint -h` ever renders a subparser epilog, so

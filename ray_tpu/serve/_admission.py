@@ -41,6 +41,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ray_tpu.devtools import leaksan
+
 _DEFAULT_THRESHOLDS = {"low": 0.5, "normal": 0.8, "high": 1.0}
 _REASONS = ("overloaded", "queue_full", "tenant_quota")
 
@@ -116,6 +118,9 @@ class AdmissionController:
         self._token_t = time.monotonic()
         self._tenant_out: Dict[str, int] = {}
         self._shed = {r: 0 for r in _REASONS}
+        # Monotonic slot ids for the leak ledger (id() of the Event
+        # would recycle after GC and alias two slots).
+        self._slot_seq = 0
 
     def configure(self, cfg: Optional[dict]) -> None:
         """Apply the deployment's admission_config (None disables
@@ -175,18 +180,35 @@ class AdmissionController:
                     self._tokens -= 1.0
             self._tenant_out[tenant_id] = \
                 self._tenant_out.get(tenant_id, 0) + 1
+            self._slot_seq += 1
+            slot_id = self._slot_seq
         released = threading.Event()
+        # Ledger: the slot is live until its release fires — the
+        # PR-11 exactly-once class, machine-checked at runtime (a
+        # waiter path that bridges without releasing shows up as a
+        # leaked admission_slot after the storm).
+        leaksan.register("admission_slot", (id(self), slot_id),
+                         detail=f"{self._name}/{tenant_id or '-'}"
+                                f"/{priority}")
 
         def release() -> None:
-            if released.is_set():
-                return
-            released.set()
+            # Atomic test-and-set UNDER the lock: a normal-completion
+            # waiter and a failover waiter can race here, and a
+            # naked Event check would let both decrement the tenant
+            # slot (double-freeing fairness budget) and double-fire
+            # the ledger discharge.
             with self._lock:
+                if released.is_set():
+                    return
+                released.set()
                 n = self._tenant_out.get(tenant_id, 0)
                 if n <= 1:
                     self._tenant_out.pop(tenant_id, None)
                 else:
                     self._tenant_out[tenant_id] = n - 1
+            # Outside the lock: the ledger has its own lock and may
+            # lazily build metric sinks.
+            leaksan.discharge("admission_slot", (id(self), slot_id))
 
         return release
 

@@ -294,9 +294,15 @@ class ServeController:
                       "_autoscale_thread"):
                 setattr(self, a, None)
         stop.set()
+        from ray_tpu.devtools import leaksan
         for t in threads:
-            if t is not None and t.is_alive():
-                t.join(timeout=5.0)
+            if t is not None:
+                if t.is_alive():
+                    t.join(timeout=5.0)
+                # A timed-out join leaves the thread in the ledger on
+                # purpose: a wedged loop is exactly what it tracks.
+                if not t.is_alive():
+                    leaksan.discharge_thread(t)
 
     # -- data-plane queries ------------------------------------------------
     def get_replicas(self, name: str) -> dict:
@@ -660,6 +666,8 @@ class ServeController:
         deploy() after shutdown_all() therefore gets live loops again
         instead of stale dead threads."""
         import threading
+
+        from ray_tpu.devtools import leaksan
         with self._state_lock:
             t = getattr(self, attr, None)
             if t is not None and t.is_alive():
@@ -668,6 +676,7 @@ class ServeController:
                                  daemon=True, name=name)
             setattr(self, attr, t)
             t.start()
+            leaksan.track_thread(t)
 
     def _ensure_health_loop(self) -> None:
         """Active replica health probing (reference:

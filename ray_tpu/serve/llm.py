@@ -77,6 +77,8 @@ from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from ray_tpu.devtools import leaksan
+
 _STREAM_END = object()
 
 
@@ -214,6 +216,8 @@ class ContinuousBatcher:
         self._proc_thread = threading.Thread(
             target=self._process_loop, daemon=True, name="rtpu-llm-proc")
         self._proc_thread.start()
+        leaksan.track_thread(self._thread)
+        leaksan.track_thread(self._proc_thread)
 
     # -- engine-variant hooks (overridden by PagedBatcher) -----------------
     def _init_caches(self, cfg, num_slots: int, max_len: int):
@@ -344,6 +348,18 @@ class ContinuousBatcher:
         for t in (self._thread, self._proc_thread):
             if t is not threading.current_thread():
                 t.join(timeout=120.0)
+            # Only a thread that actually EXITED leaves the ledger: a
+            # join that timed out (wedged dispatch) must stay visible
+            # — that is the class the ledger exists to catch.
+            if not t.is_alive():
+                leaksan.discharge_thread(t)
+        # Terminal discharge: anything still owned/queued can never
+        # finish now that the loops are gone.  Leaving it parked
+        # strands its caller until the generate() timeout — and, on
+        # the paged engine, keeps its KV blocks refcounted forever
+        # (leak-ledger self-finding).  The paged _fail_all also drops
+        # the prefix cache, so a stopped engine holds zero blocks.
+        self._fail_all(RuntimeError("engine stopped"))
 
     # -- engine ------------------------------------------------------------
     def _push_token(self, req: _Request, tok: int) -> None:
@@ -784,6 +800,16 @@ class BlockAllocator:
     def available(self) -> int:
         return len(self._free)
 
+    # Leak-ledger hooks (RAY_TPU_LEAKSAN=1): a block is "live" from
+    # the moment it leaves the free list (held by a request and/or
+    # retained by the prefix tree) until it returns.  Keys include
+    # id(self) so two engines' pools in one process never collide.
+    def _ls_reg(self, bid: int) -> None:
+        leaksan.register("kv_block", (id(self), bid))
+
+    def _ls_dis(self, bid: int) -> None:
+        leaksan.discharge("kv_block", (id(self), bid))
+
     def alloc(self, n: int) -> Optional[List[int]]:
         """n fresh blocks at refcount 1, or None (caller evicts or
         queues — never a partial allocation)."""
@@ -792,6 +818,8 @@ class BlockAllocator:
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._ref[b] = 1
+            if leaksan._ENABLED:
+                self._ls_reg(b)
         return out
 
     def incref(self, bid: int) -> None:
@@ -806,6 +834,8 @@ class BlockAllocator:
         if r == 0 and bid not in self._cached:
             del self._ref[bid]
             self._free.append(bid)
+            if leaksan._ENABLED:
+                self._ls_dis(bid)
         else:
             self._ref[bid] = r
 
@@ -824,6 +854,8 @@ class BlockAllocator:
         if self._ref.get(bid, 0) == 0:
             self._ref.pop(bid, None)
             self._free.append(bid)
+            if leaksan._ENABLED:
+                self._ls_dis(bid)
 
     def counts(self) -> Dict[str, int]:
         used = sum(1 for r in self._ref.values() if r > 0)
@@ -1252,28 +1284,37 @@ class PagedBatcher(ContinuousBatcher):
                 # sweep skips refcount > 0).
                 for b in prefix_blocks:
                     self._alloc.incref(b)
-            need = total_blocks - len(prefix_blocks)
-            if need > self._alloc.available():
-                self._evict_locked(need - self._alloc.available())
-            if need > self._alloc.available():
-                for b in prefix_blocks:    # backpressure: undo the hold
-                    self._alloc.decref(b)
-                return None
-            # Count queries/hits per ADMITTED request, not per attempt:
-            # a backpressured request retries admission every tick and
-            # would otherwise inflate the hit ratio.
-            if self.prefix_cache_enabled:
-                self._cache_queries += 1
-                km = _get_kv_metrics()
-                if km is not None:
-                    km["queries"].inc()
-                if prefix_blocks:
-                    self._cache_hits += 1
-                    self._cache_hit_tokens += len(prefix_blocks) * bs
+            try:
+                need = total_blocks - len(prefix_blocks)
+                if need > self._alloc.available():
+                    self._evict_locked(need - self._alloc.available())
+                if need > self._alloc.available():
+                    for b in prefix_blocks:  # backpressure: undo hold
+                        self._alloc.decref(b)
+                    return None
+                # Count queries/hits per ADMITTED request, not per
+                # attempt: a backpressured request retries admission
+                # every tick and would otherwise inflate the hit ratio.
+                if self.prefix_cache_enabled:
+                    self._cache_queries += 1
+                    km = _get_kv_metrics()
                     if km is not None:
-                        km["hits"].inc()
-            new_blocks = self._alloc.alloc(need)
-            req._blocks = prefix_blocks + (new_blocks or [])
+                        km["queries"].inc()
+                    if prefix_blocks:
+                        self._cache_hits += 1
+                        self._cache_hit_tokens += len(prefix_blocks) * bs
+                        if km is not None:
+                            km["hits"].inc()
+                new_blocks = self._alloc.alloc(need)
+                req._blocks = prefix_blocks + (new_blocks or [])
+            except Exception:
+                # Exception edge between incref and handoff (a raising
+                # eviction sweep / metric sink): the prefix holds would
+                # leak forever — _retire only frees blocks that made it
+                # into req._blocks.  RT013 self-finding.
+                for b in prefix_blocks:
+                    self._alloc.decref(b)
+                raise
         req._prefix_len = len(prefix_blocks) * bs
         req.cache_hit = bool(prefix_blocks)
         req.cached_tokens = req._prefix_len
@@ -1351,7 +1392,10 @@ class PagedBatcher(ContinuousBatcher):
         # dispatcher thread itself draining now is safe (and keeps the
         # parked error from leaking onto requests submitted AFTER the
         # failure).
-        if threading.current_thread() is self._thread:
+        if threading.current_thread() is self._thread \
+                or (self._shutdown and not self._thread.is_alive()):
+            # Dispatcher thread itself, or stop() after the join —
+            # either way no dispatcher can race the deque.
             self._drain_waiting(e)
         else:
             self._waiting_fail = e

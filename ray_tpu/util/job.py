@@ -72,7 +72,8 @@ class _JobSupervisor:
         # Status flips to RUNNING only once the process exists — a
         # failed spawn must never leave a phantom RUNNING record.
         self._set_status("RUNNING")
-        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+        self._pump = threading.Thread(target=self._pump_loop,
+                                      daemon=True, name="rtpu-job-pump")
         self._pump.start()
 
     # -- state in GCS KV (survives this actor) -------------------------
@@ -114,6 +115,14 @@ class _JobSupervisor:
                 self.proc.wait(timeout=5)
             except Exception:
                 self.proc.kill()
+        # Join the log pump (it exits when the child's stdout closes):
+        # an unjoined pump racing actor teardown could flush its final
+        # log chunk against a closed client (RT014 self-finding).  The
+        # terminal status write is the pump's last act, so a joined
+        # stop() also guarantees status is final when we return.
+        pump = getattr(self, "_pump", None)
+        if pump is not None and pump.is_alive():
+            pump.join(timeout=10.0)
         return True
 
     def ping(self) -> bool:

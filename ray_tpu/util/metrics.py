@@ -20,6 +20,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu._private.client import get_global_client
+from ray_tpu.devtools import leaksan
 
 FLUSH_INTERVAL_S = 1.0
 
@@ -127,6 +128,16 @@ LOCK_WAIT_SECONDS_METRIC = "ray_tpu_lock_wait_seconds"
 LOCK_CONTENTION_METRIC = "ray_tpu_lock_contention_total"
 LOCK_WAIT_BUCKETS = (0.00001, 0.0001, 0.001, 0.01, 0.05, 0.25, 1.0,
                      5.0)
+
+# Resource-lifecycle sanitizer (devtools/leaksan.py, enabled with
+# RAY_TPU_LEAKSAN=1).  resources_live gauges the ledger's live count
+# per kind (kv_block | admission_slot | spill_fd | channel_mmap |
+# thread | metric_series); resource_leaks counts leaks the ledger
+# positively detected — a resource still live when its process dumped
+# at exit, or a release fired twice (the exactly-once contract cuts
+# both ways).
+RESOURCES_LIVE_METRIC = "ray_tpu_resources_live"
+RESOURCE_LEAKS_METRIC = "ray_tpu_resource_leaks_total"
 
 # Inter-node object-transfer plane, auto-recorded node-side.
 # bytes_total tags: direction = in | out.  seconds tags: path =
@@ -246,6 +257,14 @@ class Counter(_Metric):
         return out
 
 
+# Tag keys whose presence marks a gauge series as PER-INSTANCE (one
+# series per engine/replica instance, minted at runtime): the leak
+# ledger tracks their cells from first set() to remove() — the RT015
+# class, observed live.  Statically-tagged series (object_store_bytes
+# {kind}) live for the process by design and are not tracked.
+_INSTANCE_SERIES_TAGS = ("engine",)
+
+
 class Gauge(_Metric):
     """Last-write-wins value (reference: util/metrics.py:188)."""
 
@@ -256,10 +275,20 @@ class Gauge(_Metric):
 
     def set(self, value: float,
             tags: Optional[Dict[str, str]] = None) -> None:
+        ts = self._tagset(tags)
         with _lock:
-            cell = self._cell(tags)
+            cell = self._cells.get(ts)
+            fresh = cell is None
+            if fresh:
+                cell = self._new_cell()
+                self._cells[ts] = cell
             cell["value"] = float(value)
             cell["dirty"] = True
+        if fresh and leaksan._ENABLED and any(
+                k in _INSTANCE_SERIES_TAGS for k, _ in ts):
+            # Outside the registry lock: the ledger's metric sinks may
+            # construct metrics of their own.
+            leaksan.register("metric_series", (self.name, ts))
 
     def _drain_locked(self) -> List[dict]:
         out = []
@@ -291,10 +320,14 @@ class Gauge(_Metric):
             # One lock for pop + pending enqueue: the old split
             # (per-metric lock, then registry lock) let a flush slip
             # between them and push the zero before a straggler set().
-            if self._cells.pop(ts, None) is not None or force:
+            popped = self._cells.pop(ts, None) is not None
+            if popped or force:
                 _pending.append({"name": self.name, "kind": "gauge",
                                  "tags": dict(ts), "value": 0.0,
                                  "description": self.description})
+        if popped and leaksan._ENABLED:
+            leaksan.discharge("metric_series", (self.name, ts),
+                              expect=False)
 
 
 class Histogram(_Metric):
@@ -462,7 +495,12 @@ def _ensure_flusher() -> None:
         _flusher_started = True
 
     def loop():
-        while True:
+        # Process-lifetime singleton BY DESIGN: every process that
+        # touches a metric needs exactly one flusher until exit, and
+        # a stop knob would add a shutdown ordering hazard for zero
+        # benefit (the daemon dies with the process; pending deltas
+        # are pushed by the final flush() in scrape paths).
+        while True:      # ray-tpu: noqa[RT014]
             time.sleep(FLUSH_INTERVAL_S)
             flush()
 

@@ -218,6 +218,26 @@ def locksan_report(directory: Optional[str] = None) -> Dict[str, Any]:
     return locksan.merged_report(directory)
 
 
+def leaksan_report(directory: Optional[str] = None) -> Dict[str, Any]:
+    """Merged resource-leak ledger (devtools/leaksan.py).
+
+    Requires running the workload with ``RAY_TPU_LEAKSAN=1``: every
+    process (driver, node services, workers — the env var inherits)
+    tracks acquire/release of KV blocks, admission slots, spill fds,
+    channel mmap files, service threads, and per-instance metric
+    series, and drops a ``<pid>.json`` ledger into the leaksan dir at
+    exit; this merges them with the calling process's live state.
+    Keys: ``processes``, ``registrations``, ``registered`` /
+    ``discharged`` (per-kind totals), ``leaks`` (resources still live
+    when their process dumped — each with its creation site and age),
+    ``leak_counts`` (per kind), and ``anomalies`` (a release that
+    fired twice — the exactly-once contract cuts both ways).  Like
+    locksan_report, this needs no initialized runtime — ledgers
+    outlive the cluster."""
+    from ray_tpu.devtools import leaksan
+    return leaksan.merged_report(directory)
+
+
 def memory_summary(leak_min_age_s: float = 60.0,
                    top_n: int = 200) -> Dict[str, Any]:
     """Cluster-wide object-store memory accounting (reference surface:

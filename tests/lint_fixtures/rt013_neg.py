@@ -1,0 +1,83 @@
+"""RT013 negative: every acquire is with-scoped, try/finally'd,
+symmetric, transferred to an owner, or annotated."""
+import socket
+
+
+def with_scoped(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def try_finally(path):
+    f = open(path, "rb")
+    try:
+        return f.read()
+    finally:
+        f.close()
+
+
+def symmetric_pair(path):
+    f = open(path, "rb")
+    try:
+        data = f.read()
+    except OSError:
+        f.close()
+        raise
+    f.close()
+    return data
+
+
+def no_risk_between(addr):
+    s = socket.socket()
+    s.close()                  # nothing between acquire and release
+
+
+class Owner:
+    def adopt(self, path):
+        self._f = open(path, "rb")      # ownership -> teardown rule
+
+    def close(self):
+        self._f.close()
+
+
+def handed_to_caller(path):
+    return open(path, "rb")    # caller owns it now
+
+
+def handed_to_call(path, consume):
+    consume(open(path, "rb"))  # consumer owns it now
+
+
+def annotated(path, registry):
+    f = open(path, "rb")       # ray-tpu: transfer
+    registry["f"] = 1
+    return None
+
+
+def pool_transfer(req, pool):
+    req.blocks = pool.alloc(2)      # owner object frees at retire
+
+
+def pool_symmetric(pool, blocks, risky):
+    for b in blocks:
+        pool.incref(b)
+    try:
+        risky()
+    except ValueError:
+        for b in blocks:
+            pool.decref(b)
+        raise
+    for b in blocks:
+        pool.decref(b)
+
+
+def add_remove_finally(reg, item, risky):
+    reg.add_waiter(item)
+    try:
+        risky(item)
+    finally:
+        reg.remove_waiter(item)
+
+
+def add_only(reg, item):
+    reg.add_waiter(item)       # removed elsewhere: teardown pattern

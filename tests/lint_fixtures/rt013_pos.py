@@ -1,0 +1,36 @@
+"""RT013 positive: acquires that never reach their paired release on
+every path."""
+import socket
+
+
+def never_released(path):
+    f = open(path, "rb")
+    data = f.read()            # f is never closed and never handed off
+    return data
+
+
+def normal_path_only(path):
+    f = open(path, "rb")
+    data = f.read()            # read() raising skips the close below
+    f.close()
+    return data
+
+
+def discarded(path):
+    return open(path).read()   # handle dropped: nothing can close it
+
+
+def dial_unsafe(addr):
+    s = socket.create_connection(addr)
+    s.sendall(b"ping")         # sendall raising leaks the socket
+    s.close()
+
+
+def hold_forever(pool):
+    pool.incref(3)             # no decref anywhere, no transfer
+
+
+def registration_epoch(reg, item, risky):
+    reg.add_waiter(item)
+    risky(item)                # raising here leaks the registration
+    reg.remove_waiter(item)

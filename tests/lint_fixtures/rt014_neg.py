@@ -1,0 +1,51 @@
+"""RT014 negative: joined threads, stop-Event loops, wakeable
+blocking reads, sanctioned daemons."""
+import threading
+
+
+class Service:
+    def start(self, work):
+        self._work = work
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._work()
+            self._stop.wait(0.1)
+
+    def shutdown(self):
+        self._stop.set()
+        self._worker.join(timeout=5)
+
+
+class Recv:
+    def __init__(self, sock):
+        self.sock = sock
+        self._t = threading.Thread(target=self._recv_loop, daemon=True)
+        self._t.start()
+
+    def _recv_loop(self):
+        while True:
+            self.sock.recv(1)       # close() wakes it (ConnectionLost)
+
+    def close(self):
+        self.sock.close()
+        self._t.join(timeout=2)
+
+
+def local_joined(work):
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+
+
+def daemon_fire_and_forget(work):
+    threading.Thread(target=work, daemon=True).start()
+
+
+def handed_off(work, registry):
+    t = threading.Thread(target=work)
+    t.start()
+    registry.append(t)              # owner joins later

@@ -1,0 +1,25 @@
+"""RT014 positive: unjoinable threads and unstoppable daemon loops."""
+import threading
+
+
+class Service:
+    def start(self, work):
+        self._work = work
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _loop(self):
+        while True:                 # no stop Event, no break/return
+            self._work()
+
+    def stop(self):
+        pass                        # nothing ever joins self._worker
+
+
+def fire_and_forget(work):
+    t = threading.Thread(target=work)
+    t.start()                       # non-daemon, never joined
+
+
+def chained(work):
+    threading.Thread(target=work).start()   # no handle, non-daemon

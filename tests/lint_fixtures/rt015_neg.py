@@ -1,0 +1,26 @@
+"""RT015 negative: instance series removed on teardown; constant
+series exempt."""
+
+
+class Engine:
+    def __init__(self, gauge, tag):
+        self._gauge = gauge
+        self._tag = tag
+
+    def update(self, n):
+        self._gauge.set(n, tags={"state": "used",
+                                 "engine": self._tag})
+
+    def stop(self):
+        self._gauge.remove(tags={"state": "used",
+                                 "engine": self._tag})
+
+
+class StaticSeries:
+    """Constant tag values: one process-lifetime series, no leak."""
+
+    def __init__(self, gauge):
+        self._gauge = gauge
+
+    def update(self, n):
+        self._gauge.set(n, tags={"kind": "owned"})

@@ -1,0 +1,13 @@
+"""RT015 positive: per-instance tagged gauge series with no remove."""
+
+
+class Engine:
+    def __init__(self, gauge, tag):
+        self._gauge = gauge
+        self._tag = tag
+
+    def update(self, n):
+        # One series per Engine instance; the class never calls
+        # .remove(), so each construct/stop cycle leaks its series.
+        self._gauge.set(n, tags={"state": "used",
+                                 "engine": self._tag})

@@ -1,0 +1,41 @@
+"""RT016 negative: every terminal branch fires, forwards, or is
+covered by a finally."""
+
+
+def finally_covered(ref, release):
+    try:
+        try:
+            return ref.get()
+        except TimeoutError:
+            return None        # the outer finally still fires it
+    finally:
+        release()
+
+
+def symmetric(gate, work):
+    release = gate.acquire("normal", "", 0)
+    try:
+        out = work()
+    except RuntimeError:
+        release()
+        raise
+    release()
+    return out
+
+
+def forwarded(gate, next_fn, hand_off):
+    release = gate.acquire("normal", "", 0)
+    try:
+        return next_fn(release)      # delegated: next owner fires it
+    except ValueError:
+        hand_off(release)
+        return None
+
+
+def param_raise_is_callers_problem(ref, release):
+    try:
+        out = ref.get()
+    except OSError:
+        raise                  # param: the caller still owns the slot
+    release()
+    return out

@@ -1,0 +1,21 @@
+"""RT016 positive: terminal error branches that neither fire nor
+forward a release closure."""
+
+
+def waiter(ref, release):
+    try:
+        value = ref.get()
+    except TimeoutError:
+        return None            # terminal: the admission slot leaks
+    release()
+    return value
+
+
+def local_closure(gate, work):
+    release = gate.acquire("normal", "", 0)
+    try:
+        out = work()
+    except RuntimeError:
+        raise ValueError("failed")   # local binding: nobody can fire it
+    release()
+    return out

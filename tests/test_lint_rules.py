@@ -14,7 +14,8 @@ from ray_tpu.devtools.lint import engine
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 RULE_IDS = ["RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
-            "RT007", "RT008", "RT009", "RT010", "RT011", "RT012"]
+            "RT007", "RT008", "RT009", "RT010", "RT011", "RT012",
+            "RT013", "RT014", "RT015", "RT016"]
 
 
 def _fixture(rule_id: str, kind: str) -> str:
@@ -322,3 +323,134 @@ def test_shared_options_table_is_single_source():
     from ray_tpu._private.options import ACTOR_OPTIONS, TASK_OPTIONS
     assert remote_function._VALID_OPTIONS is TASK_OPTIONS
     assert actor._VALID_ACTOR_OPTIONS is ACTOR_OPTIONS
+
+# ---------------------------------------------------------------------------
+# RT013-RT016: lifecycle-rule specifics
+# ---------------------------------------------------------------------------
+def test_rt013_transfer_annotation_suppresses():
+    src = ("def f(path, sink):\n"
+           "    h = open(path, 'rb')  # ray-tpu: transfer\n"
+           "    sink.note(path)\n")
+    assert engine.lint_source(src, select=["RT013"]) == []
+    # Without the annotation the same source fires.
+    fired = engine.lint_source(src.replace("  # ray-tpu: transfer",
+                                           ""), select=["RT013"])
+    assert [f.rule_id for f in fired] == ["RT013"]
+
+
+def test_rt013_noqa_suppresses():
+    src = ("def f(path):\n"
+           "    h = open(path, 'rb')  # ray-tpu: noqa[RT013]\n"
+           "    return h.read()\n")
+    assert engine.lint_source(src, select=["RT013"]) == []
+
+
+def test_rt016_finally_in_nested_scope_not_credited():
+    """A finally inside a NESTED function must not cover the outer
+    function's closure (different scope, different execution)."""
+    src = ("def outer(gate, work):\n"
+           "    release = gate.acquire('n', '', 0)\n"
+           "    def inner():\n"
+           "        try:\n"
+           "            pass\n"
+           "        finally:\n"
+           "            release()\n"
+           "    try:\n"
+           "        out = work()\n"
+           "    except RuntimeError:\n"
+           "        raise ValueError('x')\n"
+           "    release()\n"
+           "    return out\n")
+    # _fn_walk prunes nested defs, so inner's finally is invisible and
+    # the bare terminal handler fires.
+    fired = engine.lint_source(src, select=["RT016"])
+    assert [f.rule_id for f in fired] == ["RT016"]
+
+
+def test_lifecycle_rules_listed_in_cli_help():
+    proc = _run_cli("--help")
+    for rid in ("RT013", "RT014", "RT015", "RT016"):
+        assert rid in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# --changed (git-diff-scoped selection) + parsed-module cache
+# ---------------------------------------------------------------------------
+def test_cli_changed_scopes_to_dirty_files(tmp_path):
+    repo = tmp_path / "r"
+    repo.mkdir()
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                    "user.name=t", "commit", "-q", "--allow-empty",
+                    "-m", "seed"], cwd=repo, check=True)
+    clean = repo / "clean.py"
+    clean.write_text("import time\n"
+                     "async def f():\n"
+                     "    time.sleep(1)\n")
+    subprocess.run(["git", "add", "clean.py"], cwd=repo, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                    "user.name=t", "commit", "-q", "-m", "c"],
+                   cwd=repo, check=True)
+    dirty = repo / "dirty.py"
+    dirty.write_text("import time\n"
+                     "async def g():\n"
+                     "    time.sleep(2)\n")
+    # --changed sees only the untracked dirty.py, not the committed
+    # (equally violating) clean.py.
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "lint", str(repo),
+         "--changed", "--select", "RT005", "--rel-root", str(repo),
+         "--format", "json"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(FIXTURES)))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert [f["path"] for f in payload["findings"]] == ["dirty.py"]
+    # With nothing dirty, --changed exits 0 without linting anything.
+    dirty.unlink()
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "lint", str(repo),
+         "--changed", "--rel-root", str(repo)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(FIXTURES)))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no changed files" in proc.stdout
+
+
+def test_module_cache_reuses_parse_and_invalidates_on_edit(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text("x = 1\n")
+    engine.lint_paths([str(f)])
+    with engine._module_cache_lock:
+        cached = engine._MODULE_CACHE[str(f)][1]
+    engine.lint_paths([str(f)])
+    with engine._module_cache_lock:
+        assert engine._MODULE_CACHE[str(f)][1] is cached
+    f.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    res = engine.lint_paths([str(f)], select=["RT005"])
+    assert len(res.findings) == 1          # edited content re-parsed
+    with engine._module_cache_lock:
+        assert engine._MODULE_CACHE[str(f)][1] is not cached
+
+
+def test_changed_files_from_repo_subdirectory(tmp_path):
+    """git diff prints repo-root-relative paths; resolving them
+    against a subdirectory cwd/rel_root used to match nothing and
+    pass dirty files green."""
+    repo = tmp_path / "r"
+    sub = repo / "pkg"
+    sub.mkdir(parents=True)
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+    tracked = sub / "mod.py"
+    tracked.write_text("x = 1\n")
+    subprocess.run(["git", "add", "-A"], cwd=repo, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                    "user.name=t", "commit", "-q", "-m", "c"],
+                   cwd=repo, check=True)
+    tracked.write_text("import time\n"
+                       "async def f():\n"
+                       "    time.sleep(1)\n")
+    # rel_root is the SUBDIRECTORY — the dirty tracked file must
+    # still be found (resolved via the git toplevel).
+    got = engine.changed_files([str(sub)], rel_root=str(sub))
+    assert got == [str(tracked)]

@@ -71,6 +71,8 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.SERVE_REQUESTS_SHED_METRIC)
     assert _NAME.match(metrics.SERVE_REPLICAS_METRIC)
     assert _NAME.match(metrics.SERVE_QUEUE_DEPTH_METRIC)
+    assert _NAME.match(metrics.RESOURCES_LIVE_METRIC)
+    assert _NAME.match(metrics.RESOURCE_LEAKS_METRIC)
     assert metrics.DAG_EXECUTIONS_METRIC.endswith("_total")
     # hop_seconds is a histogram — no _total.
     assert not metrics.DAG_HOP_SECONDS_METRIC.endswith("_total")
@@ -99,6 +101,10 @@ def test_declared_builtin_names_are_legal():
     assert metrics.SERVE_REQUESTS_SHED_METRIC.endswith("_total")
     assert not metrics.SERVE_REPLICAS_METRIC.endswith("_total")
     assert not metrics.SERVE_QUEUE_DEPTH_METRIC.endswith("_total")
+    # Leak ledger: leaks is a counter; the live-resource ledger
+    # occupancy is a gauge.
+    assert metrics.RESOURCE_LEAKS_METRIC.endswith("_total")
+    assert not metrics.RESOURCES_LIVE_METRIC.endswith("_total")
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
                metrics.OBJECT_TRANSFER_BUCKETS,
                metrics.DRAIN_DURATION_BUCKETS,

@@ -651,3 +651,45 @@ def test_dense_engine_rejects_model_id():
             bat.submit([1, 2, 3], model_id="m1")
     finally:
         bat.stop()
+
+
+def test_try_admit_undoes_prefix_holds_on_exception():
+    """A raising eviction sweep between the prefix incref and the
+    block handoff must undo the holds — they are not yet in
+    req._blocks, so _retire could never free them (RT013
+    self-finding; regression for the exception-edge leak)."""
+    import pytest as _pytest
+    from ray_tpu.serve.llm import _Request
+
+    cfg = _tiny_cfg()
+    params = _tiny_params()
+    bat = _paged(params, cfg, num_slots=2, max_len=32,
+                 kv_block_size=4, kv_num_blocks=8)
+    try:
+        # Populate the radix: one full shared block for this prompt.
+        prompt = [5, 6, 7, 8, 9, 10, 11, 12]
+        bat.generate(prompt, max_new=2, timeout=120)
+        with bat._kv_lock:
+            cached_before = bat._alloc.counts()["cached"]
+        assert cached_before >= 1
+        # Drain the free list so admission needs the eviction sweep,
+        # then make the sweep raise.
+        with bat._kv_lock:
+            hold = bat._alloc.alloc(bat._alloc.available())
+        orig = bat._evict_locked
+        bat._evict_locked = lambda n: (_ for _ in ()).throw(
+            RuntimeError("sweep boom"))
+        req = _Request(prompt=list(prompt), max_new=4)
+        with _pytest.raises(RuntimeError, match="sweep boom"):
+            bat._try_admit(req)
+        bat._evict_locked = orig
+        # The prefix holds were undone: cached blocks are back to
+        # refcount 0 (evictable), nothing leaked into "used".
+        with bat._kv_lock:
+            counts = bat._alloc.counts()
+            assert counts["cached"] == cached_before
+            assert counts["used"] == len(hold)
+            for b in hold:
+                bat._alloc.decref(b)
+    finally:
+        bat.stop()
