@@ -303,6 +303,44 @@ _D("stall_min_samples", int, 10,
    "only the stall_min_seconds floor applies).")
 _D("stall_check_interval_s", float, 2.0,
    "How often the node monitor sweeps executing tasks for stalls.")
+_D("train_telemetry_enabled", bool, True,
+   "Training telemetry plane (train/telemetry.py): per-step phase "
+   "decomposition, live MFU/goodput accounting, and cross-host "
+   "straggler detection for train sessions.")
+_D("train_telemetry_window", int, 128,
+   "Rolling window of per-step records kept (and published) by each "
+   "train worker's telemetry session — feeds step-time percentiles "
+   "and the straggler reducer.")
+_D("train_telemetry_publish_s", float, 1.0,
+   "How often a train worker's telemetry session publishes its "
+   "snapshot (phase totals, goodput ledger, step window) to the "
+   "control-plane KV for state.train_summary() / `ray_tpu train "
+   "status`.  A publisher thread keeps snapshots fresh even while a "
+   "step is wedged.")
+_D("train_span_min_interval_s", float, 0.25,
+   "Rate limit for train-step timeline spans: per-step driver events "
+   "are BATCHED into one span per interval (the PR-8 lesson — an "
+   "unbatched per-step notify re-introduces ms-scale stalls on fast "
+   "step loops).  0 emits one span per step.")
+_D("train_straggler_multiple", float, 1.5,
+   "A worker is flagged as the gang's straggler when its step-phase "
+   "p95 exceeds the gang median p95 by this multiple (>= 2 workers, "
+   "train_straggler_min_steps samples each).")
+_D("train_straggler_min_steps", int, 5,
+   "Minimum step samples in a worker's telemetry window before it "
+   "participates in straggler detection.")
+_D("train_straggler_check_s", float, 2.0,
+   "How often the trainer driver runs the straggler reducer over the "
+   "workers' published step windows (each newly flagged rank gets ONE "
+   "targeted stack capture via the stall-sentinel dump path).")
+_D("train_input_bound_fraction", float, 0.3,
+   "A run is classified input-bound when data_wait takes at least "
+   "this fraction of attributed step time (the ingest-vs-compute "
+   "verdict in state.train_summary() / `ray_tpu train status`).")
+_D("train_mfu_halflife_s", float, 30.0,
+   "Half-life of the exponentially decayed window behind the live "
+   "tokens/s and MFU readouts (recent steps dominate; a paused run "
+   "decays toward zero instead of averaging it away).")
 _D("workflow_storage_dir", str, "",
    "Durable workflow storage root (default: ~/.ray_tpu/workflows). "
    "Deliberately outside the session dir so resume survives shutdown.")

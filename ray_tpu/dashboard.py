@@ -21,6 +21,13 @@ exists here as JSON):
                         and node next to each node's real shm store
                         usage; ?min_age_s=N tunes the leak-suspect
                         age floor (backs `ray_tpu memory`)
+    GET /api/train      training telemetry rollup per run: step
+                        decomposition (data_wait/compile/step/
+                        checkpoint/sync), live MFU + tokens/s,
+                        goodput ledger, straggler verdicts, and the
+                        input-vs-compute bound verdict; ?run=<name>
+                        narrows to one run (backs
+                        `ray_tpu train status`)
     GET /api/stack      on-demand worker stack dumps, cluster-wide;
                         ?task_id=<hex prefix> targets just the
                         worker(s) executing that task
@@ -230,6 +237,13 @@ class _Handler(BaseHTTPRequestHandler):
                 min_age = float(q.get("min_age_s", ["60"])[0])
                 self._send(200, json.dumps(
                     state.memory_summary(leak_min_age_s=min_age),
+                    default=str).encode())
+            elif self.path.startswith("/api/train"):
+                from urllib.parse import parse_qs, urlparse
+                q = parse_qs(urlparse(self.path).query)
+                run = q.get("run", [None])[0]
+                self._send(200, json.dumps(
+                    state.train_summary(run=run),
                     default=str).encode())
             elif self.path.startswith("/api/stack"):
                 from urllib.parse import parse_qs, urlparse

@@ -42,6 +42,7 @@ import signal
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
@@ -485,6 +486,93 @@ def _render_serve_status(data: dict, shed: dict) -> str:
     return "\n".join(lines) if lines else "(no deployments)"
 
 
+def _render_train_status(data: dict) -> str:
+    """Text face of `ray_tpu train status` (pure: unit-testable).
+    `data` is state.train_summary()'s {"runs": {...}} payload."""
+    runs = data.get("runs") or {}
+    if not runs:
+        return "(no train runs recorded)"
+    lines = []
+    for name, r in sorted(runs.items()):
+        lines.append(
+            f"run {name} [{r.get('state', '?')}]: "
+            f"step {r.get('step_index', 0)}, "
+            f"{r.get('workers_reporting', 0)}"
+            f"/{r.get('world_size', '?')} workers, "
+            f"wall {float(r.get('wall_s') or 0):.1f}s, "
+            f"restarts {r.get('restarts', 0)}")
+        lines.append(f"  verdict: {r.get('verdict', 'n/a')}")
+        tok = float(r.get("tokens_per_s") or 0.0)
+        mfu = r.get("mfu")
+        line = f"  tokens/s {tok:,.0f}"
+        if mfu is not None:
+            line += f"  MFU {float(mfu):.3f}"
+        sm = r.get("step_ms") or {}
+        line += (f"  step p50 {float(sm.get('p50') or 0):.1f}ms"
+                 f" p95 {float(sm.get('p95') or 0):.1f}ms")
+        lines.append(line)
+        phases = r.get("phases") or {}
+        if phases:
+            lines.append("  phases: " + "  ".join(
+                f"{p}={c.get('seconds', 0):.2f}s"
+                f"({float(c.get('fraction') or 0) * 100:.0f}%)"
+                for p, c in phases.items()
+                if float(c.get("seconds") or 0) > 0))
+        ledger = r.get("ledger") or {}
+        lines.append(
+            "  goodput ledger: " + "  ".join(
+                f"{c}={v:.2f}s" for c, v in ledger.items()
+                if float(v or 0) > 0)
+            + f"  (coverage {float(r.get('coverage') or 0) * 100:.0f}%"
+              f", goodput "
+              f"{float(r.get('goodput_fraction') or 0) * 100:.0f}%)")
+        flagged = {rk: v for rk, v in
+                   (r.get("stragglers") or {}).items()
+                   if v.get("straggler")}
+        for rk, v in sorted(flagged.items(),
+                            key=lambda kv: int(kv[0])
+                            if str(kv[0]).isdigit() else 0):
+            p95 = float(v.get("p95_s") or 0.0)
+            med = float(v.get("median_s") or 0.0)
+            lines.append(
+                f"  STRAGGLER rank {rk}: step p95 "
+                f"{p95 * 1000:.1f}ms vs gang median "
+                f"{med * 1000:.1f}ms"
+                + (" (stack captured)"
+                   if rk in (r.get("straggler_captures") or {})
+                   else ""))
+    return "\n".join(lines)
+
+
+def cmd_train(args) -> int:
+    """Training telemetry status (train/telemetry.py): per-run step
+    decomposition, live MFU + tokens/s, goodput ledger, and
+    straggler verdicts, served by the head's dashboard."""
+    path = "/api/train"
+    if getattr(args, "run", None):
+        from urllib.parse import quote
+        path += f"?run={quote(args.run, safe='')}"
+    try:
+        data = _fetch_json(path, args)
+    except urllib.error.HTTPError as e:
+        # An unknown --run surfaces as the dashboard's 500 payload;
+        # show the server's error (it names the known runs) instead
+        # of a urllib traceback.
+        try:
+            detail = json.loads(e.read()).get("error", str(e))
+        except Exception:
+            detail = str(e)
+        print(f"error: {detail}", file=sys.stderr)
+        return 1
+    if getattr(args, "run", None):
+        data = {"runs": {args.run: data}}
+    if getattr(args, "json", False):
+        print(json.dumps(data, indent=1, default=str))
+    else:
+        print(_render_train_status(data))
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Declarative serve apply/status/shutdown (reference: `serve
     deploy` over the REST config, serve/schema.py)."""
@@ -867,6 +955,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--out", default=None,
                    help="write --flame output to this file")
     p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("train", help="training telemetry")
+    tsub = p.add_subparsers(dest="train_cmd", required=True)
+    tp = tsub.add_parser(
+        "status",
+        help="per-run step decomposition (data_wait/compile/step/"
+             "checkpoint/sync), live MFU, goodput ledger, and "
+             "straggler verdicts")
+    tp.add_argument("--dashboard-url", default=None)
+    tp.add_argument("--run", default=None,
+                    help="narrow to one run (default: all runs)")
+    tp.add_argument("--json", action="store_true",
+                    help="machine-readable dump")
+    p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("serve", help="declarative serve config")
     ssub = p.add_subparsers(dest="serve_cmd", required=True)

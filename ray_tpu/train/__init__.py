@@ -6,6 +6,21 @@ Checkpoint, session get_context()/report(); plus the TPU-native
 compile-once sharded step (CompiledTrainStep) replacing torch DDP
 backends.  The jax/optax-heavy train_step symbols are lazy (PEP 562) so
 CPU-only trainer workers don't pay the jax import.
+
+Telemetry (train/telemetry.py): every worker can open a
+``TrainTelemetry`` session — ``session.get_context().telemetry(...)``
+inside a train loop, or ``TrainTelemetry(run, client=None)`` offline —
+that decomposes each step's wall clock into data_wait / compile /
+step / checkpoint / sync (+ implicit idle), keeps a live
+decayed-window tokens/s + MFU readout, maintains a run-level goodput
+ledger (productive / compile / input_wait / checkpoint / sync /
+restart_recovery / idle) that survives worker restarts through the
+control-plane KV, and publishes a rolling step window the trainer's
+straggler reducer compares across the gang.  Every ``report()`` is
+stamped with a monotonic ``_step`` index + ``_ts`` that survives
+resume-from-checkpoint.  Read it back with
+``state.train_summary()``, the dashboard ``/api/train`` endpoint, or
+``ray_tpu train status [--json]``.
 """
 
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
@@ -22,6 +37,9 @@ def __getattr__(name):
     if name in _LAZY:
         from ray_tpu.train import train_step
         return getattr(train_step, name)
+    if name == "TrainTelemetry":
+        from ray_tpu.train.telemetry import TrainTelemetry
+        return TrainTelemetry
     raise AttributeError(name)
 
 
@@ -29,5 +47,5 @@ __all__ = [
     "Checkpoint", "CheckpointManager", "get_context", "get_dataset_shard", "report",
     "CheckpointConfig", "DataParallelTrainer", "FailureConfig", "Result",
     "RunConfig", "ScalingConfig", "TpuTrainer", "CompiledTrainStep",
-    "TrainState", "make_optimizer",
+    "TrainState", "TrainTelemetry", "make_optimizer",
 ]

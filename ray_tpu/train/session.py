@@ -4,10 +4,21 @@ Analog of the reference's train session (train/_internal/session.py:111
 _TrainSession + ray.train.get_context()): inside a training worker,
 user code calls `get_context()` for rank info and `report(metrics,
 checkpoint=...)` to stream results to the driver.
+
+The context also owns this worker's telemetry session
+(``ctx.telemetry(...)`` -> train/telemetry.py): per-step phase
+decomposition, live MFU/goodput, and the published step window the
+straggler reducer consumes.  Every ``report()`` is stamped with a
+monotonic ``_step`` index and ``_ts`` timestamp; the index is
+persisted through the control-plane KV so a resume-from-checkpoint
+restart CONTINUES the numbering — timeline spans and metrics agree on
+step identity across restarts.
 """
 
 from __future__ import annotations
 
+import os
+import time
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -45,6 +56,18 @@ class TrainContext:
         # is synchronized with the driver, session.py:111).
         self._report_ns = report_ns
         self._seq = 0
+        # Monotonic report index stamped onto every report's metrics;
+        # restored from the KV on restart so a resumed run keeps
+        # counting instead of resetting to 0 (None = not yet loaded).
+        # Its OWN lock (not self._lock) serializes hand-out AND the
+        # KV write-through as one unit: two racing report() threads
+        # must not land their persists out of order, or a restart
+        # would restore the stale lower index and mint a duplicate
+        # _step — and nothing else ever blocks on this lock, so the
+        # held kv_put cannot convoy the report path.
+        self._report_index: Optional[int] = None
+        self._seq_lock = threading.Lock()
+        self._telemetry = None
 
     # -- public API (mirrors ray.train context) -------------------------
     def get_world_size(self) -> int:
@@ -65,14 +88,73 @@ class TrainContext:
             return None
         return Checkpoint(self._restore)
 
+    def telemetry(self, **kwargs):
+        """This worker's TrainTelemetry session (created on first
+        call; see train/telemetry.py).  The run id is the trial-dir
+        basename, shared by every worker and every restart attempt —
+        which is what lets the goodput ledger accumulate across
+        restarts."""
+        if self._telemetry is None:
+            from ray_tpu.train import telemetry as telemetry_mod
+            run = os.path.basename(
+                self._trial_dir.rstrip("/")) or self._trial_dir
+            self._telemetry = telemetry_mod.TrainTelemetry(
+                run, rank=self._world_rank,
+                world_size=self._world_size, **kwargs)
+        return self._telemetry
+
+    def _stop_telemetry(self) -> None:
+        tel, self._telemetry = self._telemetry, None
+        if tel is not None:
+            tel.stop()
+
+    def _next_report_index(self, client) -> int:
+        """Monotonic per-rank report index, persisted through the KV
+        so a restarted worker CONTINUES the numbering (resume from
+        checkpoint must not reset step identity).  The KV ops run
+        under _seq_lock ON PURPOSE — persist order must match
+        hand-out order, and the lock guards nothing else."""
+        from ray_tpu.train.telemetry import KV_SEQ_NS
+        key = f"{self._trial_dir}:{self._world_rank}".encode()
+        with self._seq_lock:
+            if self._report_index is None:
+                restore = 0
+                if client is not None:
+                    try:
+                        blob = client.kv_get(   # ray-tpu: noqa[RT011]
+                            KV_SEQ_NS, key)
+                        restore = int(blob) + 1 if blob else 0
+                    except Exception:
+                        restore = 0
+                self._report_index = restore
+            idx = self._report_index
+            self._report_index += 1
+            if client is not None:
+                try:
+                    client.kv_put(          # ray-tpu: noqa[RT011]
+                        KV_SEQ_NS, key, str(idx).encode())
+                except Exception:
+                    pass
+        return idx
+
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
-        rep = _Report(dict(metrics),
+        client = None
+        if self._report_ns is not None:
+            from ray_tpu._private.client import get_global_client
+            client = get_global_client()
+        stamped = dict(metrics)
+        if "_step" not in stamped:
+            # Guarded, not setdefault: an eagerly-evaluated default
+            # would consume (and persist) an index even when the
+            # caller re-reports metrics that already carry the stamp.
+            stamped["_step"] = self._next_report_index(client)
+        if "_ts" not in stamped:
+            stamped["_ts"] = time.time()
+        rep = _Report(stamped,
                       checkpoint.path if checkpoint else None)
         if self._report_ns is not None:
             import pickle
-            from ray_tpu._private.client import get_global_client
-            client = get_global_client()
             with self._lock:
                 seq = self._seq
                 self._seq += 1

@@ -157,6 +157,29 @@ class CompiledTrainStep:
     def init_state(self, seed: int = 0) -> TrainState:
         return self._init(jax.random.PRNGKey(seed))
 
+    def _cache_size(self) -> int:
+        """Compiled-variant count of the jitted step — telemetry's
+        compile detector (train/telemetry.py device_step) watches
+        this grow to classify a step as `compile` rather than `step`.
+        Named like jax's own jit-cache accessor so CompiledTrainStep
+        itself can be passed as a telemetry `jit_fns` entry."""
+        try:
+            return int(self._step._cache_size())
+        except Exception:
+            return -1
+
+    def flops_per_token(self, seq: int,
+                        n_params: Optional[int] = None) -> float:
+        """Model FLOPs per trained token for this config (6N +
+        attention; shared formula in train/telemetry.py)."""
+        from ray_tpu.train.telemetry import transformer_flops_per_token
+        if n_params is None:
+            n_params = transformer.num_params(jax.eval_shape(
+                lambda: transformer.init_params(
+                    self.cfg, jax.random.PRNGKey(0))))
+        return transformer_flops_per_token(
+            n_params, self.cfg.n_layers, seq, self.cfg.d_model)
+
     def shard_batch(self, tokens) -> jax.Array:
         return jax.device_put(tokens, self.data_sharding)
 

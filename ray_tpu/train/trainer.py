@@ -101,6 +101,16 @@ class _TrainWorker:
             import traceback
             return "".join(traceback.format_exception(
                 type(e), e, e.__traceback__))
+        finally:
+            # Clean-exit telemetry teardown: final snapshot publish,
+            # publisher-thread join, per-run gauge removal.  A killed
+            # worker skips this — the restarted session restores from
+            # the last published snapshot and the driver force-zeroes
+            # the gauges at fit() end.
+            try:
+                self._ctx._stop_telemetry()
+            except Exception:
+                pass
 
 
 class TpuTrainer:
@@ -120,6 +130,7 @@ class TpuTrainer:
         # DataIterator (reference: DataParallelTrainer datasets= +
         # ray.train.get_dataset_shard).
         self._datasets = datasets or {}
+        self._stragglers_captured: set = set()
 
     # ------------------------------------------------------------------
     def fit(self) -> Result:
@@ -142,28 +153,98 @@ class TpuTrainer:
         history: List[Dict[str, Any]] = []
         last_metrics: Dict[str, Any] = {}
         error: Optional[Exception] = None
+        self._stragglers_captured = set()
+        # A fresh fit must not inherit a previous fit's telemetry
+        # state under a reused run name (within-fit restarts DO
+        # restore — workers only start publishing after this).
+        from ray_tpu.train import telemetry as telemetry_mod
+        try:
+            telemetry_mod.reset_run(ray_tpu._ensure_connected(),
+                                    run_name, trial_dir=trial_dir)
+        except Exception:
+            pass
 
         attempt = 0
-        while True:
-            try:
-                last_metrics = self._run_attempt(
-                    trial_dir, manager, restore, attempt, history)
-                error = None
-                break
-            except (exc.ActorDiedError, exc.WorkerCrashedError,
-                    exc.TaskError) as e:
-                error = e
-                if failures_left == 0:
+        terminal = None          # None = aborted (non-retryable raise)
+        try:
+            while True:
+                try:
+                    last_metrics = self._run_attempt(
+                        trial_dir, manager, restore, attempt, history)
+                    error = None
+                    terminal = "finished"
                     break
-                failures_left -= 1
-                attempt += 1
-                latest = manager.latest_checkpoint
-                restore = latest.path if latest else None
+                except (exc.ActorDiedError, exc.WorkerCrashedError,
+                        exc.TaskError) as e:
+                    error = e
+                    if failures_left == 0:
+                        terminal = "failed"
+                        break
+                    failures_left -= 1
+                    attempt += 1
+                    latest = manager.latest_checkpoint
+                    restore = latest.path if latest else None
+        finally:
+            # terminal stays None when the loop died on a
+            # NON-retryable exception (KeyboardInterrupt, a control-
+            # plane error out of _drain/wait): the run must not read
+            # "finished" in `ray_tpu train status`.
+            self._finalize_telemetry(run_name, terminal or "aborted")
 
         return Result(metrics=last_metrics,
                       checkpoint=manager.latest_checkpoint,
                       error=error, path=trial_dir,
                       metrics_dataframe=history)
+
+    def _finalize_telemetry(self, run_name: str,
+                            state: str) -> None:
+        """Stamp the run's terminal state in the runs registry and
+        force-zero its per-run gauges — workers that died uncleanly
+        (SIGKILL mid-run) never ran their own remove(), and the
+        node-side aggregate would hold their last samples forever
+        (the PR-11 dead-writer gauge class)."""
+        from ray_tpu.train import telemetry as telemetry_mod
+        try:
+            client = ray_tpu._ensure_connected()
+            if telemetry_mod.read_snapshots(client, run_name):
+                telemetry_mod.mark_run_state(client, run_name, state)
+                # Only for runs that actually published telemetry:
+                # force-zeroing unconditionally would MINT 9 node-side
+                # series per fit (the aggregate never deletes series —
+                # the very cardinality class RT015 exists to prevent).
+                telemetry_mod.remove_run_gauges(run_name, force=True)
+        except Exception:
+            pass
+
+    def _check_stragglers(self, run_name: str) -> None:
+        """Driver-side straggler sweep over the workers' published
+        step windows; each newly flagged rank gets ONE targeted stack
+        capture through the stall-sentinel dump path.  The capture
+        itself (a cluster stack_dump that can ride out a wedged
+        node's 5s window) runs on a one-shot daemon thread so the
+        drive loop keeps draining reports meanwhile."""
+        import threading
+
+        from ray_tpu.train import telemetry as telemetry_mod
+        try:
+            client = ray_tpu._ensure_connected()
+            snaps = telemetry_mod.read_snapshots(client, run_name)
+            if len(snaps) < 2:
+                return
+            for rank, verdict in telemetry_mod.straggler_verdicts(
+                    snaps).items():
+                if (verdict.get("straggler")
+                        and rank not in self._stragglers_captured
+                        and rank in snaps):
+                    self._stragglers_captured.add(rank)
+                    threading.Thread(
+                        target=telemetry_mod.capture_straggler,
+                        args=(client, run_name, rank, snaps[rank],
+                              verdict),
+                        daemon=True,
+                        name=f"rtpu-straggler-capture-{rank}").start()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     def _run_attempt(self, trial_dir: str, manager: CheckpointManager,
@@ -203,12 +284,21 @@ class TpuTrainer:
 
         run_refs = [w.run.remote((self._fn, self._config))
                     for w in workers]
+        from ray_tpu._private.config import config as _cfg
+        run_name = os.path.basename(trial_dir.rstrip("/"))
+        straggler_check_s = float(_cfg.train_straggler_check_s)
+        next_straggler_check = time.time() + straggler_check_s
         try:
             pending = list(run_refs)
             while pending:
                 ready, pending = ray_tpu.wait(
                     pending, num_returns=len(pending), timeout=0.25)
                 self._drain(report_ns, manager, history)
+                if (straggler_check_s > 0
+                        and time.time() >= next_straggler_check):
+                    next_straggler_check = (time.time()
+                                            + straggler_check_s)
+                    self._check_stragglers(run_name)
                 for r in ready:
                     tb = ray_tpu.get(r)
                     if tb is not None:

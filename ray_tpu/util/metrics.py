@@ -118,6 +118,26 @@ SERVE_REQUESTS_SHED_METRIC = "ray_tpu_serve_requests_shed_total"
 SERVE_REPLICAS_METRIC = "ray_tpu_serve_replicas"
 SERVE_QUEUE_DEPTH_METRIC = "ray_tpu_serve_queue_depth"
 
+# Training telemetry & goodput plane (train/telemetry.py), recorded
+# by train-session workers.  step_seconds tags: phase = data_wait
+# (blocked on the next batch — the ingest-vs-compute signal) |
+# compile (jit cache miss steps: tracing/lowering) | step (device
+# compute) | checkpoint | sync | idle (unattributed host time).
+# mfu / tokens_per_second are per-run gauges over a decayed window
+# (rank 0 reports; removed on telemetry stop — the RT015 contract).
+# goodput_fraction tags (run, class): the run-level wall-clock ledger
+# classes productive | compile | input_wait | checkpoint | sync |
+# restart_recovery | idle as fractions of wall.  stragglers_total
+# counts gang workers the reducer flagged (one targeted stack capture
+# each, via the stall-sentinel dump path).
+TRAIN_STEP_SECONDS_METRIC = "ray_tpu_train_step_seconds"
+TRAIN_STEP_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                      30.0, 120.0)
+TRAIN_MFU_METRIC = "ray_tpu_train_mfu"
+TRAIN_TOKENS_PER_S_METRIC = "ray_tpu_train_tokens_per_second"
+TRAIN_GOODPUT_FRACTION_METRIC = "ray_tpu_train_goodput_fraction"
+TRAIN_STRAGGLERS_METRIC = "ray_tpu_train_stragglers_total"
+
 # Concurrency sanitizer (devtools/locksan.py, enabled with
 # RAY_TPU_LOCKSAN=1).  wait_seconds observes how long acquire()
 # blocked on instrumented locks (untagged: one distribution per
@@ -258,11 +278,12 @@ class Counter(_Metric):
 
 
 # Tag keys whose presence marks a gauge series as PER-INSTANCE (one
-# series per engine/replica instance, minted at runtime): the leak
-# ledger tracks their cells from first set() to remove() — the RT015
-# class, observed live.  Statically-tagged series (object_store_bytes
-# {kind}) live for the process by design and are not tracked.
-_INSTANCE_SERIES_TAGS = ("engine",)
+# series per engine/replica/train-run instance, minted at runtime):
+# the leak ledger tracks their cells from first set() to remove() —
+# the RT015 class, observed live.  Statically-tagged series
+# (object_store_bytes {kind}) live for the process by design and are
+# not tracked.
+_INSTANCE_SERIES_TAGS = ("engine", "run")
 
 
 class Gauge(_Metric):

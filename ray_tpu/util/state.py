@@ -238,6 +238,58 @@ def leaksan_report(directory: Optional[str] = None) -> Dict[str, Any]:
     return leaksan.merged_report(directory)
 
 
+def train_summary(run: Optional[str] = None) -> Dict[str, Any]:
+    """Training telemetry rollup (train/telemetry.py): per-run step
+    decomposition, live MFU/goodput, and straggler verdicts.
+
+    Every train worker's telemetry session publishes a snapshot
+    (cumulative phase totals, goodput ledger, rolling step window,
+    decayed tokens/s + MFU) to the control-plane KV about once a
+    second; this merges them per run:
+
+    * phases: {data_wait, compile, step, checkpoint, sync} seconds +
+      fraction of attributed step time — the ingest-vs-compute
+      decomposition;
+    * verdict / bound: "input-bound: data_wait 41% of step time"
+      when data_wait crosses ``train_input_bound_fraction``, else
+      compile-bound / compute-bound — the measured target the
+      ingest-disaggregation and sharded-update work optimizes
+      against;
+    * ledger: run wall-clock classified productive / compile /
+      input_wait / checkpoint / sync / restart_recovery / idle —
+      chaos kills, drains, and GCS outages show up as quantified
+      lost goodput (restart_recovery persists across worker
+      restarts);
+    * coverage: ledger seconds over wall clock (≈1.0 when the loop
+      is instrumented end to end);
+    * tokens_per_s / mfu: decayed-window live rates (gang tokens/s
+      summed, MFU averaged over reporting workers);
+    * stragglers: per-rank step-phase p95 vs the gang median
+      (flagged above ``train_straggler_multiple``), plus
+      straggler_captures for ranks whose one-shot stack dump fired.
+
+    With `run`, returns that run's rollup alone; otherwise
+    ``{"runs": {name: rollup}}``.  The same data serves the
+    dashboard's ``/api/train`` and ``ray_tpu train status``."""
+    from ray_tpu.train import telemetry
+
+    client = _client()
+    metas = telemetry.read_run_metas(client)
+    if run is not None:
+        meta = metas.get(run)
+        if meta is None:
+            raise KeyError(f"unknown train run {run!r}; known: "
+                           f"{sorted(metas)}")
+        return telemetry.summarize_run(
+            meta, telemetry.read_snapshots(client, run),
+            telemetry.read_straggler_captures(client, run))
+    return {"runs": {
+        name: telemetry.summarize_run(
+            meta, telemetry.read_snapshots(client, name),
+            telemetry.read_straggler_captures(client, name))
+        for name, meta in sorted(metas.items())}}
+
+
 def memory_summary(leak_min_age_s: float = 60.0,
                    top_n: int = 200) -> Dict[str, Any]:
     """Cluster-wide object-store memory accounting (reference surface:

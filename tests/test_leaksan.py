@@ -457,6 +457,29 @@ for i in range(4):
     assert len(r["tokens"]) > 0
 bat.stop()
 
+# -- train telemetry session (publisher thread + per-run gauges) -------
+import tempfile
+from ray_tpu.train import RunConfig, ScalingConfig, TpuTrainer
+
+def _train_loop(config=None):
+    import time as _t
+    from ray_tpu.train import session
+    ctx = session.get_context()
+    tel = ctx.telemetry(tokens_per_step=64)
+    for i in range(4):
+        with tel.data_wait():
+            _t.sleep(0.01)
+        with tel.device_step():
+            _t.sleep(0.01)
+        tel.end_step()
+        session.report({"step": i})
+
+res = TpuTrainer(
+    _train_loop, scaling_config=ScalingConfig(num_workers=1),
+    run_config=RunConfig(name="drill_train",
+                         storage_path=tempfile.mkdtemp())).fit()
+assert res.error is None, res.error
+
 # -- serve plane: admission slots + chaos kill_replica -----------------
 from ray_tpu import serve
 

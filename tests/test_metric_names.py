@@ -73,6 +73,17 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.SERVE_QUEUE_DEPTH_METRIC)
     assert _NAME.match(metrics.RESOURCES_LIVE_METRIC)
     assert _NAME.match(metrics.RESOURCE_LEAKS_METRIC)
+    assert _NAME.match(metrics.TRAIN_STEP_SECONDS_METRIC)
+    assert _NAME.match(metrics.TRAIN_MFU_METRIC)
+    assert _NAME.match(metrics.TRAIN_TOKENS_PER_S_METRIC)
+    assert _NAME.match(metrics.TRAIN_GOODPUT_FRACTION_METRIC)
+    assert _NAME.match(metrics.TRAIN_STRAGGLERS_METRIC)
+    assert metrics.TRAIN_STRAGGLERS_METRIC.endswith("_total")
+    # step_seconds is a histogram, the rest are gauges — no _total.
+    assert not metrics.TRAIN_STEP_SECONDS_METRIC.endswith("_total")
+    assert not metrics.TRAIN_MFU_METRIC.endswith("_total")
+    assert not metrics.TRAIN_GOODPUT_FRACTION_METRIC.endswith(
+        "_total")
     assert metrics.DAG_EXECUTIONS_METRIC.endswith("_total")
     # hop_seconds is a histogram — no _total.
     assert not metrics.DAG_HOP_SECONDS_METRIC.endswith("_total")
@@ -109,7 +120,8 @@ def test_declared_builtin_names_are_legal():
                metrics.OBJECT_TRANSFER_BUCKETS,
                metrics.DRAIN_DURATION_BUCKETS,
                metrics.GCS_RESYNC_BUCKETS, metrics.DAG_HOP_BUCKETS,
-               metrics.LOCK_WAIT_BUCKETS):
+               metrics.LOCK_WAIT_BUCKETS,
+               metrics.TRAIN_STEP_BUCKETS):
         assert all(a < b for a, b in zip(bs, bs[1:]))
 
 
