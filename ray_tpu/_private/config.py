@@ -371,3 +371,42 @@ _D("leaksan_dir", str, "",
    "resource ledger for `ray_tpu leaksan` / state.leaksan_report() "
    "to merge (default /tmp/ray_tpu_leaksan; RAY_TPU_LEAKSAN_DIR "
    "overrides).")
+_D("metrics_history_resolution_s", float, 2.0,
+   "Metrics history ring: sampling interval of the node monitor's "
+   "per-series (ts, value) recorder behind state.metric_history() / "
+   "/api/metrics/history / `ray_tpu top`.  Counters sample their "
+   "running total, gauges their last value, histograms their "
+   "observation count.")
+_D("metrics_history_window_s", float, 600.0,
+   "Metrics history ring: how much trailing history each series "
+   "keeps (ring capacity = window / resolution samples; older "
+   "samples are evicted).")
+_D("metrics_history_max_series", int, 512,
+   "Metrics history ring: cap on distinct (name, tags) series "
+   "tracked per node — past it, new series are not recorded (bounds "
+   "memory under tag-cardinality explosions).")
+_D("slow_rpc_min_seconds", float, 1.0,
+   "Slow-RPC sentinel floor: an in-flight control-plane handler is "
+   "never flagged before running this long (the stall sentinel's "
+   "stall_min_seconds, at RPC scale).")
+_D("slow_rpc_p95_multiple", float, 5.0,
+   "Slow-RPC sentinel: with enough samples, a handler is flagged "
+   "when it exceeds this multiple of its method's server-side p95 — "
+   "the effective threshold is max(floor, multiple * p95).")
+_D("slow_rpc_min_samples", int, 20,
+   "Minimum completed-RPC samples in a method's server histogram "
+   "before its p95 participates in the slow-RPC threshold (below "
+   "this, only the slow_rpc_min_seconds floor applies).")
+_D("slow_rpc_capture_window_s", float, 30.0,
+   "Slow-RPC sentinel rate limit: at most ONE stack + args capture "
+   "per method per this window (the flag counter still increments "
+   "for every flagged handler).")
+_D("slow_rpc_check_interval_s", float, 2.0,
+   "How often the node monitor sweeps in-flight RPC handlers for "
+   "slow-RPC flags.")
+_D("sched_span_min_interval_s", float, 1.0,
+   "Rate limit for sampled `sched.decide` timeline spans: scheduler "
+   "decisions are BATCHED into at most one span per interval per "
+   "node (the PR-8 hot-path lesson — the per-decision counters and "
+   "the recent-decision ring are always on; only span emission is "
+   "sampled).  0 emits one span per scheduling pass.")

@@ -169,6 +169,11 @@ class GlobalControlState:
         self._wal = None
         self._wal_path: Optional[str] = None
         self._snap_path: Optional[str] = None
+        # Embedded op telemetry: how the control plane is being used
+        # (kv traffic vs object-directory traffic vs membership),
+        # surfaced as "op_counts" in status() — works in-process too,
+        # where the GcsServer dispatch wrapper never runs.
+        self._op_counts: Dict[str, int] = {}
         self._wal_ops = 0               # records since the last snapshot
         self._last_fsync = 0.0
         self._last_snapshot_ts: Optional[float] = None
@@ -297,6 +302,10 @@ class GlobalControlState:
         elif op == "lost_del":
             self._lost_objects.discard(args[0])
 
+    def _count_op(self, name: str) -> None:
+        """Bump one op-usage counter.  Caller holds the lock."""
+        self._op_counts[name] = self._op_counts.get(name, 0) + 1
+
     def _log(self, op: str, *args) -> None:
         """Append one durable op.  Caller holds the lock."""
         if self._wal is None:
@@ -413,12 +422,14 @@ class GlobalControlState:
                 "actor_directory": len(self._actor_nodes),
                 "objects_tracked": len(self._locations),
                 "small_objects": len(self._small_objects),
+                "op_counts": dict(self._op_counts),
             }
 
     # -- internal KV -------------------------------------------------------
     def kv_put(self, ns: str, key: bytes, value: bytes,
                overwrite: bool = True) -> bool:
         with self._lock:
+            self._count_op("kv_put")
             table = self._kv.setdefault(ns, {})
             if not overwrite and key in table:
                 return False
@@ -435,6 +446,7 @@ class GlobalControlState:
 
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
         with self._lock:
+            self._count_op("kv_get")
             return self._kv.get(ns, {}).get(key)
 
     def kv_del(self, ns: str, key: bytes) -> bool:
@@ -515,6 +527,7 @@ class GlobalControlState:
                       transfer_port: int,
                       resources_total: Dict[str, float]) -> None:
         with self._lock:
+            self._count_op("register_node")
             info = NodeInfo(
                 node_id, host, control_port, transfer_port, resources_total)
             self._nodes[node_id] = info
@@ -606,6 +619,7 @@ class GlobalControlState:
                   resources_avail: Dict[str, float],
                   load: Optional[dict] = None) -> None:
         with self._lock:
+            self._count_op("heartbeat")
             n = self._nodes.get(node_id)
             if n is None or n.state == "dead":
                 return
@@ -758,6 +772,7 @@ class GlobalControlState:
         GCS record itself (small by construction) so readers skip the
         node-to-node pull."""
         with self._lock:
+            self._count_op("add_location")
             holders, _ = self._locations.get(oid, (set(), 0))
             if node_id is not None:
                 holders.add(node_id)
@@ -779,6 +794,7 @@ class GlobalControlState:
 
     def get_locations(self, oid: bytes) -> dict:
         with self._lock:
+            self._count_op("get_locations")
             holders, size = self._locations.get(oid, (set(), 0))
             small = self._small_objects.get(oid)
             # Draining holders stay fetchable: their copies are valid

@@ -85,6 +85,15 @@ class GcsServer:
         self._conns: List[_GcsConn] = []
         self._lock = threading.Lock()
         self._shutdown = False
+        # Server-side per-op RPC telemetry, mirrored into every
+        # gcs_status reply so nodes re-publish it as
+        # ray_tpu_rpc_server_seconds{method="gcs.<op>"} without a
+        # second metrics channel.  Own lock: the dispatch path must
+        # not contend with the conn-list lock.
+        from ray_tpu.util.metrics import RPC_SERVER_BUCKETS
+        self._rpc_buckets = RPC_SERVER_BUCKETS
+        self._rpc_lock = threading.Lock()
+        self._rpc_stats: dict = {}
 
     def start(self) -> None:
         self._accept_thread = threading.Thread(
@@ -159,10 +168,36 @@ class GcsServer:
         if handler is None:
             conn.reply(m, {"__error__": f"unknown gcs rpc {m['type']}"})
             return
+        t0 = time.perf_counter()
         try:
             handler(conn, m)
         except Exception as e:
             conn.reply(m, {"__error__": e})
+        finally:
+            self._rpc_observe(m["type"], time.perf_counter() - t0)
+
+    def _rpc_observe(self, op: str, dur: float) -> None:
+        """Fold one handler duration into the per-op aggregate
+        (same cell layout as the node service's _rpc_stats)."""
+        with self._rpc_lock:
+            st = self._rpc_stats.get(op)
+            if st is None:
+                st = {"buckets": {str(b): 0
+                                  for b in self._rpc_buckets},
+                      "sum": 0.0, "count": 0}
+                self._rpc_stats[op] = st
+            for b in self._rpc_buckets:
+                if dur <= b:
+                    st["buckets"][str(b)] += 1
+                    break
+            st["sum"] += dur
+            st["count"] += 1
+
+    def _rpc_snapshot(self) -> dict:
+        with self._rpc_lock:
+            return {op: {"buckets": dict(st["buckets"]),
+                         "sum": st["sum"], "count": st["count"]}
+                    for op, st in self._rpc_stats.items()}
 
     def _health_loop(self) -> None:
         interval = config.heartbeat_interval_s
@@ -194,7 +229,9 @@ class GcsServer:
         conn.reply(m, out)
 
     def _h_gcs_status(self, conn, m):
-        conn.reply(m, self.state.status())
+        st = self.state.status()
+        st["rpc"] = self._rpc_snapshot()
+        conn.reply(m, st)
 
     def _h_heartbeat(self, conn, m):
         self.state.heartbeat(m["node_id"], m["resources_avail"],

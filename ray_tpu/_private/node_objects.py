@@ -624,7 +624,13 @@ class ObjectPlaneMixin:
                     break
                 oid, off, ln = TRANSFER_REQ_BODY.unpack(
                     _recv_exact(sock, TRANSFER_REQ_BODY.size))
+                # Transfer-listener server telemetry: one fold per
+                # chunk request into the rpc aggregates (own lock,
+                # not self.lock — cheap next to a 4 MiB socket write).
+                t0 = time.perf_counter()
                 served += self._serve_transfer_chunk(sock, oid, off, ln)
+                self._rpc_record("transfer_chunk",
+                                 time.perf_counter() - t0)
                 # Batched counter flush: the per-chunk hot path must
                 # not take the scheduler lock per 4 MiB.  Fetchers
                 # close the connection after each object, so the
@@ -1225,11 +1231,14 @@ class ObjectPlaneMixin:
         locality-aware spillback in cluster_task_manager)."""
         best = None
         best_key = None
+        peers = 0
+        cands = []
         for n in self._cluster_view:
             # != "alive" also excludes DRAINING peers: a departing node
             # must not receive new work it would only hand back.
             if n["node_id"] == self.node_id or n.get("state") != "alive":
                 continue
+            peers += 1
             pool = n["resources_avail"] if need_avail \
                 else n["resources_total"]
             if not all(pool.get(k, 0.0) >= v - 1e-9
@@ -1237,8 +1246,23 @@ class ObjectPlaneMixin:
                 continue
             key = (-(dep_bytes or {}).get(n["node_id"], 0),
                    -sum(n.get("resources_avail", {}).values()))
+            if len(cands) < 8:
+                cands.append({
+                    "node": n["node_id"].hex()[:12],
+                    "dep_bytes": int((dep_bytes or {})
+                                     .get(n["node_id"], 0)),
+                    "avail": round(sum(
+                        n.get("resources_avail", {}).values()), 3)})
             if best is None or key < best_key:
                 best, best_key = n, key
+        # Decision-trace detail (state.summarize_scheduling()): what
+        # the scorer saw, not just who won.  Caller holds self.lock.
+        self._sched_last_spill = {
+            "peers_considered": peers,
+            "feasible": len(cands),
+            "scores": cands,
+            "need_avail": need_avail,
+        }
         return best
 
     def _try_spill(self, rec: TaskRecord, res: Dict[str, float]) -> bool:
@@ -1273,8 +1297,16 @@ class ObjectPlaneMixin:
                     rec.locality_deadline + 0.01,
                     self._wake_scheduler)
             if now < rec.locality_deadline:
+                self._sched_note(rec, "queue", reason="locality_wait",
+                                 target=target["node_id"].hex()[:12])
                 return False
         self._forward_task(rec, target)
+        detail = dict(self._sched_last_spill or {})
+        detail.pop("need_avail", None)
+        self._sched_note(rec, "spill",
+                         target=target["node_id"].hex()[:12],
+                         dep_bytes=per_node.get(target["node_id"], 0),
+                         **detail)
         return True
 
     def _wake_scheduler(self) -> None:

@@ -45,6 +45,29 @@ from ray_tpu._private.node_state import (  # noqa: F401
     TaskRecord, WorkerHandle, _ConnCtx, _OID, _charge, _fits,
     _place_bundles, _reference_kind, _uncharge, _unregister_waiter)
 
+
+def _rpc_args_summary(msg: dict, max_len: int = 512) -> str:
+    """Bounded one-line summary of an RPC message's fields for the
+    slow-RPC capture: scalar values truncated, bulk payloads reduced
+    to type + size (a capture must never serialize object bytes)."""
+    parts = []
+    for k, v in list(msg.items())[:12]:
+        if k == "__req_id__":
+            continue
+        if isinstance(v, (bytes, bytearray)):
+            parts.append(f"{k}=<{len(v)}B>")
+        elif isinstance(v, (str, int, float, bool)) or v is None:
+            parts.append(f"{k}={str(v)[:48]}")
+        else:
+            try:
+                size = len(v)  # type: ignore[arg-type]
+            except TypeError:
+                size = -1
+            parts.append(f"{k}=<{type(v).__name__}"
+                         + (f" len={size}" if size >= 0 else "") + ">")
+    return " ".join(parts)[:max_len]
+
+
 class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                   StreamChannelMixin, NodeAgentMixin,
                   NativeWorkerMixin, DrainMixin):
@@ -189,6 +212,44 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         # (reference: _private/metrics_agent.py aggregation role).
         # key = (name, kind, frozenset(tag items)) -> series dict.
         self._metrics: Dict[tuple, dict] = {}
+        # Control-plane RPC server telemetry: per-method latency
+        # aggregates + the in-flight handler registry the slow-RPC
+        # sentinel sweeps.  Own lock — the dispatch wrapper must not
+        # contend with self.lock (most handlers take it themselves).
+        from ray_tpu.util import metrics as _metrics_mod
+        self._rpc_buckets = _metrics_mod.RPC_SERVER_BUCKETS
+        self._rpc_lock = threading.Lock()
+        # method -> {"buckets", "sum", "count", "inflight", "slow",
+        #            "last_capture"}
+        self._rpc_stats: Dict[str, dict] = {}
+        # token -> {"method", "t0" (perf_counter), "tid", "msg",
+        #           "flagged"} for handlers currently executing.
+        self._rpc_inflight: Dict[int, dict] = {}
+        self._rpc_token = 0
+        # Last successful GCS round-trip (heartbeat loop) — the
+        # doctor's GCS-outage signal: the heartbeat thread blocks on a
+        # dead GCS, so this age grows during an outage.
+        self._gcs_last_ok = time.time()
+        # Scheduler decision tracing: bounded recent-decision ring +
+        # cumulative outcome counts + the rate-limited `sched.decide`
+        # span accumulator.  All mutated under self.lock (the
+        # scheduler already holds it at every decision point).
+        self._sched_recent: deque = deque(maxlen=50)
+        self._sched_outcomes: Dict[str, int] = {}
+        # task_ids already counted for a non-terminal outcome
+        # (queue/drain_handback) — one count per queue episode, not
+        # one per scheduling pass.
+        self._sched_noted: set = set()
+        self._sched_span: Dict[str, int] = {}
+        self._sched_span_t0 = 0.0
+        self._next_sched_span = 0.0
+        # Spill-candidate detail stashed by _pick_spill_target for the
+        # decision ring (scores of the nodes considered).
+        self._sched_last_spill: Optional[dict] = None
+        # Metrics history ring: (name, kind, tags) -> deque of
+        # (ts, value) samples, recorded by the monitor loop at
+        # metrics_history_resolution_s cadence (state.metric_history).
+        self._metrics_history: Dict[tuple, deque] = {}
         # Worker stdout/stderr capture: per-file read offsets for the
         # log tailer that forwards new lines to the driver console
         # (reference: log_monitor.py `log_to_driver`).
@@ -435,16 +496,160 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             self._on_disconnect(ctx)
 
     def _dispatch(self, ctx: _ConnCtx, msg: dict) -> None:
-        handler = getattr(self, "_h_" + msg["type"], None)
+        mtype = msg["type"]
+        handler = getattr(self, "_h_" + mtype, None)
         if handler is None:
             if "__req_id__" in msg:
-                ctx.reply(msg, {"__error__": f"unknown rpc {msg['type']}"})
+                ctx.reply(msg, {"__error__": f"unknown rpc {mtype}"})
             return
+        token = self._rpc_begin(mtype, msg)
         try:
+            # Server-side chaos delay (site "rpc.<type>"): the
+            # protocol-layer injector fires SENDER-side, which a
+            # server-latency histogram never sees — this hook is what
+            # makes slow-handler drills (and the slow-RPC sentinel
+            # test) injectable.  fire_spec has a cheap disabled-path
+            # early-out, so the hot path pays one attribute read.
+            spec = chaos.fire_spec("rpc." + mtype, "delay")
+            if spec is not None:
+                lo = float(spec.get("lo_ms") or 0.0)
+                hi = float(spec.get("hi_ms") or lo)
+                time.sleep((lo + (hi - lo) * chaos.jitter()) / 1000.0)
             handler(ctx, msg)
         except Exception as e:  # handler bug — surface to caller
             if "__req_id__" in msg:
                 ctx.reply(msg, {"__error__": e})
+        finally:
+            self._rpc_end(mtype, token)
+
+    # ------------------------------------------------------------------
+    # control-plane RPC server telemetry (tentpole of PR 16): every
+    # dispatched handler lands in a per-method latency aggregate
+    # (ray_tpu_rpc_server_seconds{method}), an in-flight registry the
+    # slow-RPC sentinel sweeps, and — for listeners outside _dispatch
+    # (transfer chunks, stream delivery) — the _rpc_record fold-in.
+    # All under a dedicated _rpc_lock: ~two uncontended acquires per
+    # RPC, never self.lock (the PR-8 hot-path rule).
+    # ------------------------------------------------------------------
+    def _rpc_stat_locked(self, method: str) -> dict:
+        """Per-method aggregate cell (create-once).  Caller holds
+        self._rpc_lock."""
+        st = self._rpc_stats.get(method)
+        if st is None:
+            st = {"buckets": {str(b): 0 for b in self._rpc_buckets},
+                  "sum": 0.0, "count": 0, "inflight": 0,
+                  "slow": 0, "last_capture": 0.0}
+            self._rpc_stats[method] = st
+        return st
+
+    def _rpc_begin(self, method: str, msg: dict) -> int:
+        with self._rpc_lock:
+            self._rpc_token += 1
+            token = self._rpc_token
+            self._rpc_stat_locked(method)["inflight"] += 1
+            self._rpc_inflight[token] = {
+                "method": method, "t0": time.perf_counter(),
+                "tid": threading.get_ident(), "msg": msg,
+                "flagged": False}
+        return token
+
+    def _rpc_end(self, method: str, token: int) -> None:
+        end = time.perf_counter()
+        with self._rpc_lock:
+            entry = self._rpc_inflight.pop(token, None)
+            if entry is None:
+                return
+            st = self._rpc_stat_locked(method)
+            st["inflight"] = max(st["inflight"] - 1, 0)
+            dur = end - entry["t0"]
+            for b in self._rpc_buckets:
+                if dur <= b:
+                    st["buckets"][str(b)] += 1
+                    break
+            st["sum"] += dur
+            st["count"] += 1
+
+    def _rpc_record(self, method: str, dur: float) -> None:
+        """Fold one completed handler duration into the per-method
+        aggregates, for serving loops that don't route through
+        _dispatch (transfer-plane chunk serving, DAG stream
+        delivery)."""
+        with self._rpc_lock:
+            st = self._rpc_stat_locked(method)
+            for b in self._rpc_buckets:
+                if dur <= b:
+                    st["buckets"][str(b)] += 1
+                    break
+            st["sum"] += dur
+            st["count"] += 1
+
+    def _slow_rpc_tick(self) -> None:
+        """Monitor-loop sweep over in-flight handlers: flag anything
+        past max(slow_rpc_min_seconds, slow_rpc_p95_multiple * that
+        method's server-side p95) — the stall sentinel's contract at
+        RPC scale.  Flag under _rpc_lock, capture OUTSIDE it; at most
+        one stack+args capture per method per capture window."""
+        floor = config.slow_rpc_min_seconds
+        if floor <= 0:
+            return
+        from ray_tpu.util.metrics import hist_quantile
+        now = time.perf_counter()
+        wall = time.time()
+        flagged = []
+        with self._rpc_lock:
+            for entry in self._rpc_inflight.values():
+                if entry["flagged"]:
+                    continue
+                st = self._rpc_stats.get(entry["method"])
+                threshold = floor
+                if st is not None and \
+                        st["count"] >= config.slow_rpc_min_samples:
+                    threshold = max(
+                        floor, config.slow_rpc_p95_multiple
+                        * hist_quantile(st, 0.95))
+                elapsed = now - entry["t0"]
+                if elapsed < threshold:
+                    continue
+                entry["flagged"] = True
+                st = self._rpc_stat_locked(entry["method"])
+                st["slow"] += 1
+                capture = (wall - st["last_capture"]
+                           >= config.slow_rpc_capture_window_s)
+                if capture:
+                    st["last_capture"] = wall
+                flagged.append((entry, elapsed, threshold, capture))
+        for entry, elapsed, threshold, capture in flagged:
+            from ray_tpu.util.metrics import SLOW_RPC_METRIC
+            with self.lock:
+                self._inc_counter(
+                    SLOW_RPC_METRIC, {"method": entry["method"]},
+                    "control-plane handlers flagged by the slow-RPC "
+                    "sentinel")
+            if capture:
+                self._capture_slow_rpc(entry, elapsed, threshold)
+
+    def _capture_slow_rpc(self, entry: dict, elapsed: float,
+                          threshold: float) -> None:
+        """One stack + args-summary capture of a flagged handler's
+        thread, recorded as a `slow_rpc` timeline event (surfaced by
+        profiling.timeline() and `ray_tpu doctor`)."""
+        import traceback
+        frame = sys._current_frames().get(entry["tid"])
+        stack = ("".join(traceback.format_stack(frame))
+                 if frame is not None else "")
+        now = time.time()
+        self._emit_event({
+            "kind": "slow_rpc",
+            "name": "rpc." + entry["method"] + ":slow",
+            "method": entry["method"],
+            "elapsed_s": round(elapsed, 4),
+            "threshold_s": round(threshold, 4),
+            "stack": stack,
+            "rpc_args": _rpc_args_summary(entry.get("msg") or {}),
+            "pid": os.getpid(),
+            "start": now, "end": now,
+            "node_id": self.node_id.hex(),
+        })
 
     def _on_disconnect(self, ctx: _ConnCtx) -> None:
         self._native_on_disconnect(ctx)
@@ -568,6 +773,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                             "pg_demand": pg_demand,
                             "idle_since": self._idle_since}
                 self.gcs.heartbeat(self.node_id, avail, load)
+                # Doctor's GCS-outage signal: this thread blocks (or
+                # raises) on a dead GCS, so the age of the last
+                # successful round-trip grows during an outage.
+                self._gcs_last_ok = time.time()
                 # Autoscaler lease (StandardAutoscaler refreshes a
                 # timestamp in GCS KV every reconcile): gates infeasible
                 # fail-fast vs wait.  A stale lease (dead autoscaler)
@@ -2488,6 +2697,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     "node_id": self.node_id.hex(),
                 })
             pending = len(self.pending_queue)
+            sched = self._sched_summary_locked()
         store = self._store().stats()
         return {"tasks": tasks, "actors": actors, "workers": workers,
                 "objects": objects, "placement_groups": pgs,
@@ -2497,7 +2707,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 "store": store,
                 "stores": {self.node_id.hex(): store},
                 "dag_channel_items": {
-                    self.node_id.hex(): dict(self._dag_items)}}
+                    self.node_id.hex(): dict(self._dag_items)},
+                "scheduling": {
+                    self.node_id.hex(): sched}}
 
     def _fanout_peers(self, request: dict, timeout: float = 2.0
                       ) -> Tuple[List[Tuple[dict, dict]], List[str]]:
@@ -2543,6 +2755,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             clients = set(dump.get("clients") or [])
             stores = dict(dump.get("stores") or {})
             dag_items = dict(dump.get("dag_channel_items") or {})
+            scheduling = dict(dump.get("scheduling") or {})
             for _, peer in replies:
                 for k in merged:
                     merged[k].extend(peer["dump"].get(k, []))
@@ -2550,6 +2763,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 stores.update(peer["dump"].get("stores") or {})
                 dag_items.update(
                     peer["dump"].get("dag_channel_items") or {})
+                scheduling.update(
+                    peer["dump"].get("scheduling") or {})
             # Holder sets are a cluster-level fact: rebuild them from
             # every node's local copies so list_objects/memory_summary
             # show where each object's replicas actually live.
@@ -2570,9 +2785,136 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
             merged["stores"] = stores
             merged["clients"] = sorted(clients)
             merged["dag_channel_items"] = dag_items
+            merged["scheduling"] = scheduling
             ctx.reply(m, {"dump": merged})
             return
         ctx.reply(m, {"dump": dump})
+
+    # ------------------------------------------------------------------
+    # metrics history ring + doctor probe (control-plane observability)
+    # ------------------------------------------------------------------
+    def _history_sample_tick(self) -> None:
+        """Monitor-loop job: append one (ts, value) sample per tracked
+        series to the bounded history rings (counters sample their
+        running total, gauges their last value, histograms their
+        observation count) plus a few runtime built-ins — the data
+        behind state.metric_history() / /api/metrics/history /
+        `ray_tpu top`."""
+        now = time.time()
+        res_s = max(config.metrics_history_resolution_s, 0.05)
+        cap = max(int(config.metrics_history_window_s / res_s), 2)
+        max_series = config.metrics_history_max_series
+        try:
+            store_used = float(
+                self._store().stats().get("used_bytes", 0))
+        except Exception:
+            store_used = 0.0
+        with self._rpc_lock:
+            rpc_counts = [(m, float(st["count"]), float(st["inflight"]))
+                          for m, st in self._rpc_stats.items()]
+        with self.lock:
+            rows = []
+            for key, s in self._metrics.items():
+                if s["kind"] == "histogram":
+                    rows.append((key, float(s.get("count") or 0.0)))
+                else:
+                    rows.append((key, float(s.get("value") or 0.0)))
+            from ray_tpu.util.metrics import (RPC_INFLIGHT_METRIC,
+                                              RPC_SERVER_SECONDS_METRIC)
+            for method, count, inflight in rpc_counts:
+                mt = (("method", method),)
+                rows.append(((RPC_SERVER_SECONDS_METRIC, "histogram",
+                              mt), count))
+                rows.append(((RPC_INFLIGHT_METRIC, "gauge", mt),
+                             inflight))
+            rows.extend((
+                (("ray_tpu_tasks_pending", "gauge", ()),
+                 float(len(self.pending_queue))),
+                (("ray_tpu_tasks_total", "gauge", ()),
+                 float(len(self.tasks))),
+                (("ray_tpu_actors_alive", "gauge", ()),
+                 float(sum(1 for a in self.actors.values()
+                           if a.state == "alive"))),
+                (("ray_tpu_workers", "gauge", ()),
+                 float(len(self.workers))),
+                (("ray_tpu_objects_local", "gauge", ()),
+                 float(len(self.objects))),
+                (("ray_tpu_object_store_bytes_used", "gauge", ()),
+                 store_used),
+            ))
+            hist = self._metrics_history
+            for key, val in rows:
+                ring = hist.get(key)
+                if ring is None:
+                    if len(hist) >= max_series:
+                        continue   # cardinality cap: drop new series
+                    ring = deque(maxlen=cap)
+                    hist[key] = ring
+                elif ring.maxlen != cap:
+                    # Window/resolution knobs changed at runtime:
+                    # re-bound the ring, keeping the newest samples.
+                    ring = deque(ring, maxlen=cap)
+                    hist[key] = ring
+                ring.append((now, val))
+
+    def _h_metric_history(self, ctx: _ConnCtx, m: dict) -> None:
+        """Per-series history samples, optionally cluster-merged (each
+        row carries its node_id — the merge is a concat, not a sum)."""
+        name = m.get("name") or None
+        with self.lock:
+            series = []
+            for (n, kind, tags), ring in self._metrics_history.items():
+                if name and n != name:
+                    continue
+                series.append({
+                    "name": n, "kind": kind, "tags": dict(tags),
+                    "node_id": self.node_id.hex(),
+                    "samples": [[round(ts, 3), v] for ts, v in ring]})
+        if m.get("cluster") and self.multinode:
+            replies, unreachable = self._fanout_peers(
+                {"type": "metric_history", "name": name,
+                 "cluster": False})
+            for _, peer in replies:
+                series.extend(peer.get("series") or [])
+            ctx.reply(m, {"series": series,
+                          "unreachable_nodes": unreachable})
+            return
+        ctx.reply(m, {"series": series, "unreachable_nodes": []})
+
+    def _h_health_probe(self, ctx: _ConnCtx, m: dict) -> None:
+        """Doctor's per-node health card: GCS liveness age, GCS status
+        card, event-ring drops, slow-RPC tallies, scheduler outcome
+        counts — fanned out cluster-wide for state.doctor()."""
+        from ray_tpu.util.metrics import EVENTS_DROPPED_METRIC
+        now = time.time()
+        with self.lock:
+            cell = self._metrics.get(
+                (EVENTS_DROPPED_METRIC, "counter", ()))
+            info = {
+                "node_id": self.node_id.hex(),
+                "multinode": self.multinode,
+                "gcs_last_ok_age_s": round(now - self._gcs_last_ok, 3),
+                "gcs_status": dict(self._gcs_status or {}),
+                "events_dropped": float(cell["value"]) if cell else 0.0,
+                "pending_tasks": len(self.pending_queue),
+                "workers": len(self.workers),
+                "draining": bool(self.draining),
+                "sched_outcomes": dict(self._sched_outcomes),
+            }
+        with self._rpc_lock:
+            info["slow_rpcs"] = {meth: st["slow"]
+                                 for meth, st in self._rpc_stats.items()
+                                 if st["slow"]}
+        if m.get("cluster") and self.multinode:
+            replies, unreachable = self._fanout_peers(
+                {"type": "health_probe", "cluster": False})
+            nodes = [info] + [r.get("info") for _, r in replies
+                              if r.get("info")]
+            ctx.reply(m, {"info": info, "nodes": nodes,
+                          "unreachable_nodes": unreachable})
+            return
+        ctx.reply(m, {"info": info, "nodes": [info],
+                      "unreachable_nodes": []})
 
     # ------------------------------------------------------------------
     # task-lifecycle tracing (reference: task events + state-API task
@@ -2858,6 +3200,84 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 return n
         return None
 
+    def _sched_note(self, rec: TaskRecord, outcome: str,
+                    **detail) -> None:
+        """Record one scheduler placement decision: outcome counter,
+        placement-latency histogram (terminal outcomes), the bounded
+        recent-decision ring behind state.summarize_scheduling(), and
+        the rate-limited `sched.decide` span accumulator.  Caller
+        holds self.lock.  Non-terminal outcomes (queue /
+        drain_handback) count once per queue episode, not once per
+        scheduling pass — _schedule revisits the queue on every
+        resource change."""
+        from ray_tpu.util.metrics import (SCHED_DECISIONS_METRIC,
+                                          SCHED_PLACEMENT_BUCKETS,
+                                          SCHED_PLACEMENT_SECONDS_METRIC)
+        terminal = outcome in ("local", "forward", "spill",
+                               "infeasible")
+        if not terminal:
+            if rec.task_id in self._sched_noted:
+                return
+            if len(self._sched_noted) > 100_000:
+                # Cancelled-while-queued strays: intersect with live
+                # tasks instead of growing forever.
+                self._sched_noted &= set(self.tasks)
+            self._sched_noted.add(rec.task_id)
+        else:
+            self._sched_noted.discard(rec.task_id)
+        self._inc_counter(SCHED_DECISIONS_METRIC, {"outcome": outcome},
+                          "scheduler placement decisions by outcome")
+        self._sched_outcomes[outcome] = \
+            self._sched_outcomes.get(outcome, 0) + 1
+        if outcome in ("local", "forward", "spill"):
+            t0 = rec.stages.get("submitted")
+            if t0 is not None:
+                self._observe_hist(
+                    SCHED_PLACEMENT_SECONDS_METRIC,
+                    {"outcome": outcome}, time.time() - t0,
+                    SCHED_PLACEMENT_BUCKETS,
+                    "task submit->placement latency by outcome")
+        row = {"task": rec.spec.get("name") or "<task>",
+               "task_id": rec.task_id.hex()[:16],
+               "outcome": outcome, "ts": time.time()}
+        row.update(detail)
+        self._sched_recent.append(row)
+        if not self._sched_span:
+            self._sched_span_t0 = time.time()
+        self._sched_span[outcome] = \
+            self._sched_span.get(outcome, 0) + 1
+
+    def _flush_sched_span_locked(self) -> None:
+        """Emit the accumulated decision counts as ONE sampled
+        `sched.decide` timeline span, at most once per
+        sched_span_min_interval_s (per-decision spans would be the
+        PR-8 hot-path trap at 10k placements/s).  Caller holds
+        self.lock."""
+        if not self._sched_span:
+            return
+        now = time.time()
+        min_iv = config.sched_span_min_interval_s
+        if min_iv > 0 and now < self._next_sched_span:
+            return
+        self._next_sched_span = now + max(min_iv, 0.0)
+        counts, self._sched_span = self._sched_span, {}
+        self._emit_event({
+            "kind": "sched",
+            "name": "sched.decide",
+            "outcomes": counts,
+            "decisions": sum(counts.values()),
+            "pid": os.getpid(),
+            "start": self._sched_span_t0 or now, "end": now,
+            "node_id": self.node_id.hex(),
+        })
+
+    def _sched_summary_locked(self) -> dict:
+        """This node's scheduler-decision summary (cumulative outcome
+        counts + the recent-decision ring).  Caller holds self.lock."""
+        return {"outcomes": dict(self._sched_outcomes),
+                "pending": len(self.pending_queue),
+                "recent": list(self._sched_recent)}
+
     def _schedule(self) -> None:
         """Dispatch every runnable pending task. Caller holds self.lock."""
         if self._shutdown:
@@ -2877,6 +3297,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     # handback sweep (node_drain) forwards it to a
                     # healthy peer or marks it drain_keep when nothing
                     # can take it (then it runs here within the grace).
+                    self._sched_note(rec, "drain_handback")
                     continue
                 res = dict(rec.spec.get("resources") or {})
                 needs_tpu = res.get("TPU", 0) > 0
@@ -2895,6 +3316,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                             ninfo.get("state") == "alive"
                             or not aff.get("soft")):
                         self._forward_task(rec, ninfo)
+                        self._sched_note(
+                            rec, "forward", reason="affinity",
+                            target=ninfo["node_id"].hex()[:12])
                         progressed = True
                         continue
                     if aff.get("soft"):
@@ -2902,6 +3326,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     else:
                         self.pending_queue.remove(rec)
                         self.tasks.pop(rec.task_id, None)
+                        self._sched_note(
+                            rec, "infeasible", reason="affinity_dead",
+                            target=aff["node_id"].hex()[:12])
                         self._fail_task_returns(
                             rec, exc.NodeAffinityError(
                                 f"affinity node "
@@ -2924,10 +3351,16 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                             ninfo = self._cluster_node(target)
                             if ninfo is not None:
                                 self._forward_task(rec, ninfo)
+                                self._sched_note(
+                                    rec, "forward", reason="pg_home",
+                                    target=target.hex()[:12])
                                 progressed = True
                         continue
                     if not _fits(bundle.free, res):
-                        continue   # bundle busy: wait for a pg task end
+                        # bundle busy: wait for a pg task end
+                        self._sched_note(rec, "queue",
+                                         reason="pg_bundle_busy")
+                        continue
                     _charge(bundle.free, res)
                 elif not self._take(res):
                     # Affinity-pinned work must wait here, not spill.
@@ -2939,6 +3372,9 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                             and not rec.spec.get("streaming")
                             and self._try_spill(rec, res)):
                         progressed = True
+                    else:
+                        self._sched_note(rec, "queue",
+                                         reason="resources_busy")
                     continue
                 from ray_tpu._private.container import image_of
                 image = image_of(rec.spec.get("runtime_env"))
@@ -2949,6 +3385,8 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                     else:
                         self._give_back(res)
                     self._maybe_spawn(tpu=needs_tpu, image=image)
+                    self._sched_note(rec, "queue",
+                                     reason="no_idle_worker")
                     continue
                 self.pending_queue.remove(rec)
                 rec.state = "dispatched"
@@ -2967,8 +3405,10 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                 w.resources_held = res
                 w.bundle_key = key if bundle is not None else None
                 w.conn_send({"type": "execute_task", "spec": rec.spec})
+                self._sched_note(rec, "local", worker_pid=w.pid)
                 self._chaos_kill_dispatch(w)
                 progressed = True
+        self._flush_sched_span_locked()
 
     def _chaos_kill_dispatch(self, w: WorkerHandle) -> None:
         """Chaos kind=kill_worker at site 'dispatch': SIGKILL the worker
@@ -3435,21 +3875,11 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
     @staticmethod
     def _hist_quantile(cell: dict, q: float) -> float:
         """Upper-bound estimate of quantile `q` from an aggregated
-        histogram cell ({"buckets": {str(bound): n}, "count": n}).
-        Observations above every declared bucket count toward `count`
-        only, so a quantile past the top bucket returns that bound —
-        a conservative (low) estimate the multiple compensates for."""
-        count = cell.get("count") or 0
-        if count <= 0:
-            return 0.0
-        target = q * count
-        acc = 0.0
-        bounds = sorted(cell.get("buckets") or {}, key=float)
-        for b in bounds:
-            acc += cell["buckets"][b]
-            if acc >= target:
-                return float(b)
-        return float(bounds[-1]) if bounds else 0.0
+        histogram cell — delegates to the shared implementation in
+        util/metrics.py (one definition of "p95" for the stall
+        sentinel, the slow-RPC sentinel, and the state APIs)."""
+        from ray_tpu.util.metrics import hist_quantile
+        return hist_quantile(cell, q)
 
     def _stall_threshold_locked(self) -> float:
         """max(stall_min_seconds, stall_p95_multiple * executing-stage
@@ -3542,6 +3972,7 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
         # shutdown never pays a last stale sleep.
         next_spill = next_infeasible = next_mem = next_scan = 0.0
         next_drain = next_stall = 0.0
+        next_slow_rpc = next_hist = 0.0
         while not self._shutdown:
             with self.lock:
                 nearest = min(
@@ -3580,6 +4011,20 @@ class NodeService(ObjectPlaneMixin, PlacementGroupMixin,
                                        0.1)
                 try:
                     self._stall_sentinel_tick()
+                except Exception:
+                    pass
+            if now >= next_slow_rpc:   # slow-RPC sentinel sweep
+                next_slow_rpc = now + max(
+                    config.slow_rpc_check_interval_s, 0.1)
+                try:
+                    self._slow_rpc_tick()
+                except Exception:
+                    pass
+            if now >= next_hist:     # metrics history ring sampler
+                next_hist = now + max(
+                    config.metrics_history_resolution_s, 0.05)
+                try:
+                    self._history_sample_tick()
                 except Exception:
                     pass
             refresh_ms = config.memory_monitor_refresh_ms

@@ -380,7 +380,12 @@ class StreamChannelMixin:
         while not self._shutdown:
             (n,) = CHAN_ITEM.unpack(_recv_exact(sock, CHAN_ITEM.size))
             payload = _recv_exact(sock, n)
+            # Stream-listener server telemetry: deliver time includes
+            # any backpressure wait (the withheld ack) — exactly the
+            # server-side latency an operator needs to see.
+            t0 = time.perf_counter()
             ok = self._chan_stream_deliver(key, payload, max(cap, 1))
+            self._rpc_record("chan_stream", time.perf_counter() - t0)
             sock.sendall(CHAN_ACK.pack(CHAN_ACK_OK if ok
                                        else CHAN_ACK_CLOSED))
 
@@ -616,7 +621,78 @@ class StreamChannelMixin:
                            "value": val, "buckets": {}, "sum": 0.0,
                            "count": 0.0,
                            "description": "ray_tpu runtime built-in"})
+        series.extend(self._rpc_series())
         ctx.reply(m, {"series": series})
+
+    def _rpc_series(self) -> list:
+        """Control-plane RPC server telemetry as scrape series, built
+        from the dispatch wrapper's per-method aggregates at scrape
+        time — folding them into self._metrics would double-count
+        across scrapes.  Includes the relay-backlog gauges and the GCS
+        server's own per-op histograms (riding the periodic gcs_status
+        poll, tagged method="gcs.<op>")."""
+        from ray_tpu.util.metrics import (RPC_INFLIGHT_METRIC,
+                                          RPC_QUEUE_DEPTH_METRIC,
+                                          RPC_SERVER_SECONDS_METRIC,
+                                          SLOW_RPC_METRIC)
+        series: list = []
+        with self._rpc_lock:
+            for method, st in sorted(self._rpc_stats.items()):
+                series.append({
+                    "name": RPC_SERVER_SECONDS_METRIC,
+                    "kind": "histogram", "tags": {"method": method},
+                    "value": 0.0, "buckets": dict(st["buckets"]),
+                    "sum": st["sum"], "count": float(st["count"]),
+                    "description": "server-side control-plane RPC "
+                                   "handler latency"})
+                series.append({
+                    "name": RPC_INFLIGHT_METRIC, "kind": "gauge",
+                    "tags": {"method": method},
+                    "value": float(st["inflight"]), "buckets": {},
+                    "sum": 0.0, "count": 0.0,
+                    "description": "control-plane RPC handlers "
+                                   "currently executing"})
+                if st["slow"]:
+                    series.append({
+                        "name": SLOW_RPC_METRIC, "kind": "counter",
+                        "tags": {"method": method},
+                        "value": float(st["slow"]), "buckets": {},
+                        "sum": 0.0, "count": 0.0,
+                        "description": "handlers flagged by the "
+                                       "slow-RPC sentinel"})
+        # Relay-backlog depth: items queued toward the GCS (per-conn
+        # proxy queues), toward peers (task forwarders), and on
+        # compiled-DAG channel forwarders — a growing backlog is the
+        # control plane falling behind.
+        with self.lock:
+            gcs_depth = sum(
+                c.gcs_q.qsize() for c in self._conns
+                if getattr(c, "gcs_q", None) is not None)
+            fwd_depth = sum(q.qsize()
+                            for q in self._fwd_queues.values())
+        with self._peer_lock:
+            chan_depth = sum(q.qsize()
+                             for q in self._chan_fwd_queues.values())
+        for plane, depth in (("gcs_proxy", gcs_depth),
+                             ("forward", fwd_depth),
+                             ("chan_fwd", chan_depth)):
+            series.append({
+                "name": RPC_QUEUE_DEPTH_METRIC, "kind": "gauge",
+                "tags": {"plane": plane}, "value": float(depth),
+                "buckets": {}, "sum": 0.0, "count": 0.0,
+                "description": "control-plane relay queue backlog"})
+        # GCS server-side per-op latency (from the status poll).
+        gst = getattr(self, "_gcs_status", None) or {}
+        for op, st in sorted((gst.get("rpc") or {}).items()):
+            series.append({
+                "name": RPC_SERVER_SECONDS_METRIC, "kind": "histogram",
+                "tags": {"method": "gcs." + op}, "value": 0.0,
+                "buckets": dict(st.get("buckets") or {}),
+                "sum": float(st.get("sum") or 0.0),
+                "count": float(st.get("count") or 0.0),
+                "description": "server-side control-plane RPC "
+                               "handler latency"})
+        return series
 
     def _h_shutdown(self, ctx: _ConnCtx, m: dict) -> None:
         ctx.reply(m, {"ok": True})

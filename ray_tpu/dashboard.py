@@ -37,6 +37,25 @@ exists here as JSON):
                         worker, merged into flamegraph.pl folded
                         format (text/plain; backs
                         `ray_tpu stack --flame`)
+    GET /api/metrics/history   per-series (ts, value) samples from the
+                        bounded per-node history rings
+                        (metrics_history_resolution_s /
+                        metrics_history_window_s), cluster-merged;
+                        ?name=<metric> narrows to one metric (backs
+                        `ray_tpu top`)
+    GET /api/scheduler  cluster-merged scheduler decision rollup:
+                        outcome counts (local/forward/spill/queue/
+                        drain_handback/infeasible) + the recent
+                        decision ring with the detail each decision
+                        saw (spill candidate scores, queue reasons)
+    GET /api/doctor     health triage: prioritized findings with
+                        stable codes (GCS_UNREACHABLE, TASK_STALLED,
+                        LEAK_SUSPECT, NODE_UNREACHABLE errors;
+                        EVENT_RING_DROPS, SLOW_RPC, GCS_WAL_LARGE,
+                        GCS_SNAPSHOT_STALE, LOCK_CONTENTION,
+                        SERVE_SHEDDING, TRAIN_GOODPUT_LOW warnings);
+                        ?gcs_stale_s=N&leak_min_age_s=N tune
+                        thresholds (backs `ray_tpu doctor`)
     GET /metrics        Prometheus exposition (scrape endpoint)
     GET /graphs         self-contained metrics graphs (canvas
                         sparklines over /api/metrics.json samples —
@@ -311,6 +330,26 @@ class _Handler(BaseHTTPRequestHandler):
                                     "tags": m.get("tags") or {},
                                     "value": float(v)})
                 self._send(200, json.dumps(out).encode())
+            elif self.path.startswith("/api/metrics/history"):
+                from urllib.parse import parse_qs, urlparse
+                q = parse_qs(urlparse(self.path).query)
+                name = q.get("name", [None])[0]
+                self._send(200, json.dumps(
+                    state.metric_history(name=name),
+                    default=str).encode())
+            elif self.path.startswith("/api/scheduler"):
+                self._send(200, json.dumps(
+                    state.summarize_scheduling(),
+                    default=str).encode())
+            elif self.path.startswith("/api/doctor"):
+                from urllib.parse import parse_qs, urlparse
+                q = parse_qs(urlparse(self.path).query)
+                min_age = float(q.get("leak_min_age_s", ["60"])[0])
+                stale = float(q.get("gcs_stale_s", ["15"])[0])
+                self._send(200, json.dumps(
+                    state.doctor(leak_min_age_s=min_age,
+                                 gcs_stale_s=stale),
+                    default=str).encode())
             else:
                 self._send(404, b'{"error": "not found"}')
         except Exception as e:   # introspection must never crash serving

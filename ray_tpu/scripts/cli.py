@@ -29,6 +29,18 @@ status`) + `ray list/summary` (util/state CLI) + `ray job` (job CLI).
                               from a RAY_TPU_LOCKSAN=1 run
     leaksan                   merged resource-leak ledger from a
                               RAY_TPU_LEAKSAN=1 run (exit 1 on leaks)
+    doctor                    cluster health triage: GCS liveness/WAL,
+                              stalls, slow RPCs, leak suspects,
+                              event-ring drops, serve shedding, train
+                              goodput — prioritized findings with
+                              stable codes; exit 1 on errors
+    top [--interval S]        live terminal view over the metrics
+                              history rings (runtime gauges + busiest
+                              RPC handlers, sparklines)
+    bench-diff NEW BASE       direction-aware bench-capture regression
+                              gate (exit 1 when a throughput metric
+                              drops / latency metric rises beyond
+                              --tolerance)
 
 State (started pids, head address) persists in ~/.ray_tpu_cli.json so
 `stop`/`status` work from a fresh shell."""
@@ -858,6 +870,267 @@ def cmd_chaos(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# doctor / top / bench-diff (control-plane observability)
+# ---------------------------------------------------------------------------
+def _render_doctor(rep: dict) -> str:
+    """Text face of `ray_tpu doctor` (pure: unit-testable)."""
+    lines = []
+    findings = rep.get("findings") or []
+    errors = [f for f in findings if f.get("severity") == "error"]
+    warns = [f for f in findings if f.get("severity") != "error"]
+    if not findings:
+        lines.append("cluster is HEALTHY — no findings")
+    elif errors:
+        lines.append(f"cluster is UNHEALTHY — {len(errors)} error(s), "
+                     f"{len(warns)} warning(s)")
+    else:
+        lines.append(f"cluster is healthy with {len(warns)} warning(s)")
+    for f in findings:
+        sev = (f.get("severity") or "?").upper()
+        lines.append(f"  [{sev:<7}] {f.get('code')}: "
+                     f"{f.get('summary')}")
+        detail = f.get("detail") or {}
+        for k in sorted(detail):
+            v = detail[k]
+            text = json.dumps(v, default=str)
+            if len(text) > 160:
+                text = text[:160] + "..."
+            lines.append(f"             {k}: {text}")
+    for pe in rep.get("probe_errors") or []:
+        lines.append(f"  (probe {pe.get('probe')} failed: "
+                     f"{pe.get('error')})")
+    lines.append(f"probes run: {', '.join(rep.get('probes') or [])}")
+    return "\n".join(lines)
+
+
+def cmd_doctor(args) -> int:
+    """Cluster health triage (state.doctor() via /api/doctor): fuses
+    GCS liveness/WAL health, node reachability, stall + slow-RPC
+    sentinel captures, object leak suspects, event-ring drops, lock
+    inversions, serve shedding, and train goodput into prioritized
+    findings with stable codes.  Exit 1 when any error-severity
+    finding is present, 0 otherwise."""
+    rep = _fetch_json(
+        f"/api/doctor?gcs_stale_s={args.gcs_stale_s:g}"
+        f"&leak_min_age_s={args.leak_min_age_s:g}", args)
+    if args.json:
+        print(json.dumps(rep, indent=1, default=str))
+    else:
+        print(_render_doctor(rep))
+    return int(rep.get("exit_code") or 0)
+
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals: List[float], width: int = 32) -> str:
+    vals = list(vals)[-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK_CHARS[0] * len(vals)
+    span = hi - lo
+    return "".join(
+        _SPARK_CHARS[int((v - lo) / span * (len(_SPARK_CHARS) - 1))]
+        for v in vals)
+
+
+# Runtime gauges `ray_tpu top` always shows (one row per node each).
+_TOP_BUILTINS = (
+    "ray_tpu_tasks_pending",
+    "ray_tpu_tasks_total",
+    "ray_tpu_workers",
+    "ray_tpu_actors_alive",
+    "ray_tpu_objects_local",
+    "ray_tpu_object_store_bytes_used",
+)
+
+
+def _series_rate(samples: List[list]) -> float:
+    """Events/s over a monotone count series' sampled window."""
+    if len(samples) < 2:
+        return 0.0
+    (t0, v0), (t1, v1) = samples[0], samples[-1]
+    if t1 <= t0:
+        return 0.0
+    return max(v1 - v0, 0.0) / (t1 - t0)
+
+
+def _render_top(series: List[dict], width: int = 32) -> str:
+    """Text face of `ray_tpu top` (pure: unit-testable): sparkline
+    per builtin gauge per node, plus the busiest RPC methods by
+    handled rate with live in-flight counts."""
+    lines = []
+    by_name: Dict[str, List[dict]] = {}
+    for row in series:
+        by_name.setdefault(row.get("name", ""), []).append(row)
+    lines.append("runtime (per node):")
+    for name in _TOP_BUILTINS:
+        for row in sorted(by_name.get(name, ()),
+                          key=lambda r: r.get("node_id") or ""):
+            samples = row.get("samples") or []
+            vals = [s[1] for s in samples]
+            last = vals[-1] if vals else 0.0
+            nid = (row.get("node_id") or "?")[:8]
+            shown = (_fmt_bytes(last) if name.endswith("bytes_used")
+                     else f"{last:g}")
+            lines.append(f"  {name:<34} {nid:<8} {shown:>10}  "
+                         f"{_sparkline(vals, width)}")
+    rpc_rows = []
+    for row in by_name.get("ray_tpu_rpc_server_seconds", ()):
+        method = (row.get("tags") or {}).get("method", "?")
+        rate = _series_rate(row.get("samples") or [])
+        rpc_rows.append((rate, method, row))
+    inflight = {}
+    for row in by_name.get("ray_tpu_rpc_inflight", ()):
+        method = (row.get("tags") or {}).get("method", "?")
+        samples = row.get("samples") or []
+        if samples:
+            inflight[method] = inflight.get(method, 0.0) + \
+                samples[-1][1]
+    if rpc_rows:
+        lines.append("busiest RPC handlers (by handled/s):")
+        rpc_rows.sort(key=lambda r: -r[0])
+        for rate, method, row in rpc_rows[:10]:
+            vals = [s[1] for s in row.get("samples") or []]
+            lines.append(
+                f"  {method:<26} {rate:>8.1f}/s  inflight "
+                f"{inflight.get(method, 0):g}  "
+                f"{_sparkline(vals, width)}")
+    if not series:
+        lines.append("  (no history samples yet — the ring fills at "
+                     "metrics_history_resolution_s cadence)")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live terminal view over the metrics history rings
+    (/api/metrics/history): runtime gauges + busiest RPC handlers,
+    refreshed every --interval seconds.  --iterations N renders N
+    frames then exits (0 = until Ctrl-C)."""
+    frames = 0
+    try:
+        while True:
+            data = _fetch_json("/api/metrics/history", args)
+            frame = _render_top(data.get("series") or [],
+                                width=args.width)
+            if frames and not args.no_clear:
+                print("\x1b[2J\x1b[H", end="")
+            print(frame)
+            unreachable = data.get("unreachable_nodes") or []
+            if unreachable:
+                print("WARNING: partial view — unreachable nodes: "
+                      + ", ".join(n[:12] for n in unreachable))
+            frames += 1
+            if args.iterations and frames >= args.iterations:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+# Direction markers for bench-diff: a dotted metric path matching a
+# higher-better marker regresses when it DROPS; lower-better (latency-
+# shaped) paths regress when they RISE.  Higher-better wins ties
+# ("speedup_p50" is a speedup, not a latency).
+_BENCH_HIGHER = ("per_s", "_mb_s", "mbps", "throughput", "speedup",
+                 "goodput", "mfu", "tokens_s", "qps")
+_BENCH_LOWER = ("_us", "_ms", "_ns", "p50", "p95", "p99", "latency",
+                "seconds", "_s_", "overhead", "stall")
+
+
+def _bench_direction(path: str) -> Optional[str]:
+    low = path.lower()
+    if any(m in low for m in _BENCH_HIGHER):
+        return "higher"
+    if any(m in low for m in _BENCH_LOWER):
+        return "lower"
+    return None
+
+
+def _bench_flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_bench_flatten(v, f"{prefix}{k}."))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def _bench_diff(fresh: dict, baseline: dict,
+                tolerance: float = 0.10) -> List[dict]:
+    """Compare two bench-capture dicts metric by metric (pure:
+    unit-testable).  Returns one row per baseline metric: {path,
+    base, new, delta_pct, direction, regressed}; metrics with no
+    direction marker (counts, config echoes) are informational and
+    never regress, as are metrics absent from the fresh capture
+    (legs not re-run)."""
+    fflat = _bench_flatten(fresh)
+    bflat = _bench_flatten(baseline)
+    rows = []
+    for path in sorted(bflat):
+        base = bflat[path]
+        new = fflat.get(path)
+        direction = _bench_direction(path)
+        row = {"path": path, "base": base, "new": new,
+               "direction": direction, "delta_pct": None,
+               "regressed": False}
+        if new is not None and base:
+            row["delta_pct"] = round(100.0 * (new - base) / abs(base),
+                                     2)
+        if new is not None and direction == "higher":
+            row["regressed"] = new < base * (1.0 - tolerance)
+        elif new is not None and direction == "lower":
+            row["regressed"] = new > base * (1.0 + tolerance)
+        rows.append(row)
+    return rows
+
+
+def cmd_bench_diff(args) -> int:
+    """Regression gate over bench captures: compare a fresh
+    BENCH_*/MICROBENCH_*/SERVE_BENCH_* JSON against a last-good one,
+    direction-aware per metric (throughput-shaped metrics must not
+    drop, latency-shaped must not rise, beyond --tolerance).  Exit 1
+    on any regression, 0 otherwise."""
+    with open(args.fresh) as f:
+        fresh = json.load(f)
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    rows = _bench_diff(fresh, baseline, tolerance=args.tolerance)
+    regressions = [r for r in rows if r["regressed"]]
+    if args.json:
+        print(json.dumps({"rows": rows,
+                          "regressions": len(regressions),
+                          "tolerance": args.tolerance},
+                         indent=1))
+        return 1 if regressions else 0
+    shown = [r for r in rows
+             if r["regressed"] or (
+                 r["direction"] and r["delta_pct"] is not None
+                 and abs(r["delta_pct"]) >= 1.0)]
+    print(f"bench-diff {args.fresh} vs {args.baseline} "
+          f"(tolerance {args.tolerance:.0%}): "
+          f"{len(rows)} metrics, {len(regressions)} regression(s)")
+    table = [{
+        "metric": r["path"],
+        "base": f"{r['base']:g}",
+        "new": "missing" if r["new"] is None else f"{r['new']:g}",
+        "delta": ("" if r["delta_pct"] is None
+                  else f"{r['delta_pct']:+.1f}%"),
+        "want": r["direction"] or "-",
+        "verdict": "REGRESSED" if r["regressed"] else "ok",
+    } for r in shown]
+    if table:
+        _print_table(table, ["metric", "base", "new", "delta",
+                             "want", "verdict"])
+    else:
+        print("(no directional metric moved by 1% or more)")
+    return 1 if regressions else 0
+
+
+# ---------------------------------------------------------------------------
 def main(argv: Optional[List[str]] = None) -> int:
     raw = sys.argv[1:] if argv is None else list(argv)
     ap = argparse.ArgumentParser(prog="ray_tpu")
@@ -1011,6 +1284,50 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "config/env schedule)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "doctor",
+        help="cluster health triage: prioritized findings with "
+             "stable codes (exit 1 on error-severity findings)")
+    p.add_argument("--dashboard-url", default=None)
+    p.add_argument("--gcs-stale-s", type=float, default=15.0,
+                   dest="gcs_stale_s",
+                   help="flag GCS_UNREACHABLE when a node's last "
+                        "successful GCS heartbeat is older than this")
+    p.add_argument("--leak-min-age-s", type=float, default=60.0,
+                   dest="leak_min_age_s",
+                   help="minimum object age before it can be a "
+                        "LEAK_SUSPECT")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_doctor)
+
+    p = sub.add_parser(
+        "top",
+        help="live terminal view over the metrics history rings "
+             "(runtime gauges + busiest RPC handlers)")
+    p.add_argument("--dashboard-url", default=None)
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between frames")
+    p.add_argument("--iterations", type=int, default=0,
+                   help="render N frames then exit (0 = until Ctrl-C)")
+    p.add_argument("--width", type=int, default=32,
+                   help="sparkline width in samples")
+    p.add_argument("--no-clear", action="store_true", dest="no_clear",
+                   help="append frames instead of clearing the screen")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "bench-diff",
+        help="compare a fresh bench capture against a last-good one "
+             "(direction-aware; exit 1 on regression)")
+    p.add_argument("fresh", help="fresh capture JSON "
+                                 "(BENCH_*/MICROBENCH_*/SERVE_BENCH_*)")
+    p.add_argument("baseline", help="last-good capture JSON")
+    p.add_argument("--tolerance", type=float, default=0.10,
+                   help="allowed fractional change before a "
+                        "directional metric counts as regressed")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_bench_diff)
 
     p = sub.add_parser(
         "locksan",

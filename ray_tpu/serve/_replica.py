@@ -22,10 +22,10 @@ _SLO_WINDOW_S = 15.0
 def _p95_ms(samples: List[float]) -> Optional[float]:
     """p95 of a list of second-valued samples, in ms (None if empty).
     Shares the runtime's one percentile implementation."""
-    from ray_tpu.util.state import _percentile
+    from ray_tpu.util.metrics import percentile
     if not samples:
         return None
-    return _percentile(sorted(samples), 0.95) * 1000.0
+    return percentile(sorted(samples), 0.95) * 1000.0
 
 
 class Replica:

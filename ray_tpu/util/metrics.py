@@ -168,6 +168,42 @@ OBJECT_TRANSFER_SECONDS_METRIC = "ray_tpu_object_transfer_seconds"
 OBJECT_TRANSFER_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
                            5.0, 30.0)
 
+# Control-plane RPC server telemetry, recorded by the node service's
+# dispatch wrapper (and the GCS server's, surfaced through the
+# gcs_status poll).  server_seconds tags: method = the rpc type
+# (node handlers as-is, GCS handlers prefixed "gcs.", transfer-plane
+# chunk serving as "transfer_chunk", stream delivery as
+# "chan_stream").  inflight gauges handlers currently executing per
+# method; queue_depth gauges the control-plane relay backlogs per
+# plane = gcs_proxy (per-conn GCS relay queues) | forward (per-peer
+# task-forward queues) | chan_fwd (compiled-DAG channel forwarders).
+# slow_rpcs counts handlers the slow-RPC sentinel flagged (each also
+# gets ONE `slow_rpc` timeline event per method per capture window,
+# carrying the handler thread's stack + args summary).
+# Bucket floor is 50 µs: most control RPCs are sub-millisecond;
+# the tail (spill fanouts, WAL compaction holds) is what matters.
+RPC_SERVER_SECONDS_METRIC = "ray_tpu_rpc_server_seconds"
+RPC_SERVER_BUCKETS = (0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5,
+                      2.0, 10.0)
+RPC_INFLIGHT_METRIC = "ray_tpu_rpc_inflight"
+RPC_QUEUE_DEPTH_METRIC = "ray_tpu_rpc_queue_depth"
+SLOW_RPC_METRIC = "ray_tpu_slow_rpcs_total"
+
+# Scheduler decision tracing, recorded inside NodeService._schedule
+# (lock already held — counters go straight into the node aggregate).
+# decisions tags: outcome = local (dispatched to a local worker) |
+# forward (affinity/PG-forwarded to a peer) | spill (spilled to the
+# best-scored peer) | queue (stayed queued: no feasible slot yet) |
+# drain_handback (re-queued by a draining node) | infeasible (failed:
+# no node can ever satisfy it).  placement_seconds observes
+# submit->dispatch latency per placed task (outcome tag: local |
+# forward | spill).  The per-decision candidate/score detail rides in
+# sampled `sched.decide` timeline spans + state.summarize_scheduling().
+SCHED_DECISIONS_METRIC = "ray_tpu_sched_decisions_total"
+SCHED_PLACEMENT_SECONDS_METRIC = "ray_tpu_sched_placement_seconds"
+SCHED_PLACEMENT_BUCKETS = (0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0,
+                           10.0, 60.0)
+
 # THE registry lock: guards the metric registry, every metric's cell
 # map, cell values, and the retry queue.  One lock (instead of the
 # old per-metric locks) means cell creation, drain, and the pending
@@ -594,3 +630,42 @@ def prometheus_text() -> str:
         else:
             lines.append(f"{name}{label} {s['value']}")
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# shared percentile math
+# ---------------------------------------------------------------------------
+# THE percentile implementations: the stall sentinel's histogram-cell
+# quantile (node_service), the state-API sample percentile, the serve
+# replica/engine p95 helpers, and the slow-RPC threshold all call
+# these two — one definition of "p95" across the runtime instead of
+# three drifting copies.
+
+def percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of an ASCENDING-sorted sequence
+    (0 <= q <= 1).  Returns 0.0 on empty input."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(len(sorted_vals) * q), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def hist_quantile(cell: dict, q: float) -> float:
+    """Quantile estimate from an aggregated histogram cell
+    ``{"buckets": {str(bound): n}, "count": N}`` (the node-side merge
+    layout): the upper bound of the bucket where the cumulative count
+    crosses ``q * count``.  Observations above the largest declared
+    boundary land in the implicit +Inf bucket; for those the largest
+    finite boundary is returned (a conservative underestimate).
+    Returns 0.0 when the cell is empty."""
+    count = int(cell.get("count") or 0)
+    if count <= 0:
+        return 0.0
+    target = q * count
+    acc = 0
+    bounds = sorted(cell.get("buckets") or {}, key=float)
+    for b in bounds:
+        acc += cell["buckets"][b]
+        if acc >= target:
+            return float(b)
+    return float(bounds[-1]) if bounds else 0.0

@@ -71,11 +71,27 @@ def timeline(filename: Optional[str] = None) -> Any:
             args["threshold_s"] = ev.get("threshold_s")
             stack = ev.get("stack") or ""
             args["stack"] = stack[:4000]
+        if ev.get("kind") == "slow_rpc":
+            # Slow-RPC sentinel: same shape as a stall capture plus
+            # the handler method and a size-bounded args summary.
+            args["method"] = ev.get("method")
+            args["elapsed_s"] = ev.get("elapsed_s")
+            args["threshold_s"] = ev.get("threshold_s")
+            args["rpc_args"] = ev.get("rpc_args")
+            stack = ev.get("stack") or ""
+            args["stack"] = stack[:4000]
+        if ev.get("kind") == "sched":
+            # Batched scheduler-decision span: outcome counts for the
+            # scheduling episode the span covers.
+            args["outcomes"] = ev.get("outcomes")
+            args["decisions"] = ev.get("decisions")
         row = {
             "name": ev.get("name", "<span>"),
             "cat": ("lifecycle" if ev.get("kind") == "lifecycle" else
                     "drain" if ev.get("kind") == "drain" else
                     "stall" if ev.get("kind") == "stall" else
+                    "slow_rpc" if ev.get("kind") == "slow_rpc" else
+                    "sched" if ev.get("kind") == "sched" else
                     "gcs_restart" if ev.get("kind") == "gcs_restart"
                     else "actor" if ev.get("actor") else
                     "user" if ev.get("user") else "task"),

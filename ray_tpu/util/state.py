@@ -94,10 +94,10 @@ def list_nodes(filters=None, limit: int = 10_000) -> List[dict]:
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(int(len(sorted_vals) * q), len(sorted_vals) - 1)
-    return sorted_vals[idx]
+    # Kept as a name other modules import; the one implementation
+    # lives in util.metrics next to its histogram sibling.
+    from ray_tpu.util.metrics import percentile
+    return percentile(sorted_vals, q)
 
 
 def summarize_tasks() -> Dict[str, Dict[str, Any]]:
@@ -398,4 +398,310 @@ def memory_summary(leak_min_age_s: float = 60.0,
         "objects": top,
         "kv_blocks": kv_blocks,
         "unreachable_nodes": dump.get("unreachable_nodes") or [],
+    }
+
+
+def summarize_scheduling() -> Dict[str, Any]:
+    """Cluster-merged scheduler decision rollup.
+
+    Every placement decision the raylet scheduler makes is recorded
+    at the decision point (outcome = local / forward / spill / queue /
+    drain_handback / infeasible, with the detail the scorer saw —
+    spill candidates, locality targets, queue reasons); this merges
+    the per-node tallies plus each node's recent-decision ring:
+
+    * outcomes: cluster-wide {outcome: count};
+    * decisions: total decisions recorded;
+    * pending: tasks currently sitting in pending queues;
+    * recent: the newest decision rows across all nodes (each carries
+      node_id, task name, outcome, and outcome-specific detail like
+      spill candidate scores);
+    * by_node: the unmerged per-node view.
+
+    The same counts surface as ``ray_tpu_sched_decisions_total`` and
+    the placement-latency histogram
+    ``ray_tpu_sched_placement_seconds``."""
+    dump = _dump()
+    sched = dump.get("scheduling") or {}
+    outcomes: Dict[str, int] = {}
+    recent: List[dict] = []
+    pending = 0
+    for node, s in sched.items():
+        for k, v in (s.get("outcomes") or {}).items():
+            outcomes[k] = outcomes.get(k, 0) + int(v)
+        pending += int(s.get("pending") or 0)
+        for row in s.get("recent") or []:
+            recent.append(dict(row, node_id=node))
+    recent.sort(key=lambda r: r.get("ts") or 0.0)
+    return {
+        "outcomes": outcomes,
+        "decisions": sum(outcomes.values()),
+        "pending": pending,
+        "recent": recent[-100:],
+        "by_node": sched,
+        "unreachable_nodes": dump.get("unreachable_nodes") or [],
+    }
+
+
+def metric_history(name: Optional[str] = None,
+                   cluster: bool = True) -> Dict[str, Any]:
+    """Recent (ts, value) samples per metric series from the bounded
+    per-node history rings (``metrics_history_resolution_s`` sample
+    cadence, ``metrics_history_window_s`` retention).
+
+    Counters and histograms sample their running total/observation
+    count (rate = delta over the window); gauges sample the last set
+    value.  Each series row: {name, kind, tags, node_id, samples:
+    [[ts, value], ...]}.  With `name`, only that metric's series;
+    with cluster=True (default), merged across every alive node (a
+    concat — rows keep their node_id).  The same data serves
+    ``/api/metrics/history`` and the ``ray_tpu top`` live view."""
+    reply = _client().conn.call({"type": "metric_history",
+                                 "name": name, "cluster": cluster})
+    return {"series": reply.get("series") or [],
+            "unreachable_nodes": reply.get("unreachable_nodes") or []}
+
+
+def doctor(leak_min_age_s: float = 60.0,
+           gcs_stale_s: float = 15.0) -> Dict[str, Any]:
+    """Cluster health triage: one call that fuses the control-plane
+    signals (GCS liveness + WAL health, node reachability, stall
+    sentinel, slow-RPC captures, leak suspects, event-ring drops,
+    lock contention, serve shedding, train goodput) into a prioritized
+    findings list — the engine behind ``ray_tpu doctor`` and
+    ``/api/doctor``.
+
+    Returns {"healthy", "exit_code", "findings", "probes"}.  Each
+    finding: {"code", "severity" ("error" | "warning"), "summary",
+    "detail"}.  Stable codes:
+
+    * errors (exit_code 1): GCS_UNREACHABLE (a node's last successful
+      GCS heartbeat is older than `gcs_stale_s`; multinode only —
+      single-node mode has no heartbeat loop), NODE_UNREACHABLE
+      (registered-alive peer did not answer the health probe),
+      TASK_STALLED (stall-sentinel capture in the event ring),
+      LEAK_SUSPECT (READY object at least `leak_min_age_s` old whose
+      owner is dead or whose borrow count hit zero);
+    * warnings (exit_code stays 0): EVENT_RING_DROPS, SLOW_RPC,
+      GCS_WAL_LARGE (WAL > 4x gcs_wal_compact_bytes),
+      GCS_SNAPSHOT_STALE (ops since snapshot > 4x
+      gcs_wal_compact_ops), LOCK_CONTENTION (locksan witnessed a
+      lock-order inversion), SERVE_SHEDDING (admission control shed
+      requests), TRAIN_GOODPUT_LOW (productive fraction of an
+      instrumented run's wall clock below 50%).
+
+    Probes run independently — one failing (its subsystem not in use,
+    its sanitizer not enabled) records a probe error and the rest
+    still report."""
+    from ray_tpu._private.config import config
+
+    findings: List[dict] = []
+    probe_errors: List[dict] = []
+    probes: List[str] = []
+
+    def _probe(name):
+        probes.append(name)
+
+    # -- control-plane health cards (per node) -------------------------
+    _probe("health_probe")
+    gcs_down = False
+    try:
+        reply = _client().conn.call({"type": "health_probe",
+                                     "cluster": True})
+        nodes = reply.get("nodes") or []
+        unreachable = reply.get("unreachable_nodes") or []
+        if unreachable:
+            findings.append({
+                "code": "NODE_UNREACHABLE", "severity": "error",
+                "summary": (f"{len(unreachable)} registered-alive "
+                            "node(s) did not answer the health probe"),
+                "detail": {"nodes": unreachable}})
+        stale = [n for n in nodes
+                 if n.get("multinode")
+                 and (n.get("gcs_last_ok_age_s") or 0.0) > gcs_stale_s]
+        if stale:
+            gcs_down = True
+            worst = max(n["gcs_last_ok_age_s"] for n in stale)
+            findings.append({
+                "code": "GCS_UNREACHABLE", "severity": "error",
+                "summary": (f"{len(stale)} node(s) have not heard "
+                            f"from the GCS in over {gcs_stale_s:.0f}s "
+                            f"(worst {worst:.1f}s)"),
+                "detail": {"nodes": [
+                    {"node_id": n["node_id"],
+                     "age_s": n["gcs_last_ok_age_s"]} for n in stale]}})
+        dropped = sum(float(n.get("events_dropped") or 0.0)
+                      for n in nodes)
+        if dropped > 0:
+            findings.append({
+                "code": "EVENT_RING_DROPS", "severity": "warning",
+                "summary": (f"{int(dropped)} lifecycle/profile events "
+                            "evicted from bounded event rings — raise "
+                            "profile_events_max for full history"),
+                "detail": {"dropped_total": dropped}})
+        slow = {}
+        for n in nodes:
+            for meth, cnt in (n.get("slow_rpcs") or {}).items():
+                slow[meth] = slow.get(meth, 0) + int(cnt)
+        if slow:
+            findings.append({
+                "code": "SLOW_RPC", "severity": "warning",
+                "summary": ("slow-RPC sentinel fired for "
+                            + ", ".join(sorted(slow))
+                            + " — stacks in the timeline "
+                            "(kind=slow_rpc)"),
+                "detail": {"by_method": slow}})
+        gst = {}
+        for n in nodes:
+            gst = n.get("gcs_status") or {}
+            if gst:
+                break
+        if gst.get("persistent"):
+            wal_bytes = int(gst.get("wal_bytes") or 0)
+            if wal_bytes > 4 * config.gcs_wal_compact_bytes:
+                findings.append({
+                    "code": "GCS_WAL_LARGE", "severity": "warning",
+                    "summary": (f"GCS WAL is {wal_bytes} bytes, over "
+                                "4x the compaction threshold — "
+                                "compaction may not be firing"),
+                    "detail": {"wal_bytes": wal_bytes,
+                               "compact_bytes":
+                                   config.gcs_wal_compact_bytes}})
+            wal_ops = int(gst.get("wal_ops_since_snapshot") or 0)
+            if wal_ops > 4 * config.gcs_wal_compact_ops:
+                findings.append({
+                    "code": "GCS_SNAPSHOT_STALE", "severity": "warning",
+                    "summary": (f"{wal_ops} durable ops since the last "
+                                "GCS snapshot, over 4x the compaction "
+                                "threshold"),
+                    "detail": {
+                        "wal_ops_since_snapshot": wal_ops,
+                        "compact_ops": config.gcs_wal_compact_ops,
+                        "last_snapshot_age_s":
+                            gst.get("last_snapshot_age_s")}})
+    except Exception as exc:   # noqa: BLE001 - probe isolation
+        probe_errors.append({"probe": "health_probe",
+                             "error": repr(exc)})
+
+    # -- stall sentinel (event ring) -----------------------------------
+    _probe("stalls")
+    try:
+        stalls = [ev for ev in _client().timeline_events(cluster=True)
+                  if ev.get("kind") == "stall"]
+        if stalls:
+            findings.append({
+                "code": "TASK_STALLED", "severity": "error",
+                "summary": (f"stall sentinel captured {len(stalls)} "
+                            "long-running task(s) — stacks attached"),
+                "detail": {"stalls": [
+                    {k: ev.get(k) for k in
+                     ("task_name", "task_id", "elapsed_s",
+                      "threshold_s", "node_id", "pid")}
+                    for ev in stalls[-10:]]}})
+    except Exception as exc:   # noqa: BLE001
+        probe_errors.append({"probe": "stalls", "error": repr(exc)})
+
+    # -- object-store leak suspects ------------------------------------
+    _probe("memory")
+    try:
+        mem = memory_summary(leak_min_age_s=leak_min_age_s, top_n=10)
+        suspects = mem.get("leak_suspects") or []
+        if suspects:
+            findings.append({
+                "code": "LEAK_SUSPECT", "severity": "error",
+                "summary": (f"{len(suspects)} object(s) look leaked "
+                            "(dead owner or zero borrow count, age ≥ "
+                            f"{leak_min_age_s:.0f}s)"),
+                "detail": {"suspects": [
+                    {k: r.get(k) for k in
+                     ("object_id", "size_bytes", "owner",
+                      "reference_kind", "age_s", "leak_reason")}
+                    for r in suspects[:10]]}})
+    except Exception as exc:   # noqa: BLE001
+        probe_errors.append({"probe": "memory", "error": repr(exc)})
+
+    # -- lock-order inversions (needs RAY_TPU_LOCKSAN=1 runs) ----------
+    _probe("locksan")
+    try:
+        rep = locksan_report()
+        inv = rep.get("inversions") or []
+        if inv:
+            findings.append({
+                "code": "LOCK_CONTENTION", "severity": "warning",
+                "summary": (f"locksan witnessed {len(inv)} lock-order "
+                            "inversion(s) — each a deadlock under the "
+                            "right timing"),
+                "detail": {"inversions": inv[:5]}})
+    except Exception as exc:   # noqa: BLE001
+        probe_errors.append({"probe": "locksan", "error": repr(exc)})
+
+    # -- serve admission shedding --------------------------------------
+    _probe("serve")
+    try:
+        from ray_tpu.util.metrics import SERVE_REQUESTS_SHED_METRIC
+        shed = 0.0
+        for row in metric_history(
+                name=SERVE_REQUESTS_SHED_METRIC)["series"]:
+            samples = row.get("samples") or []
+            if samples:
+                shed += float(samples[-1][1])
+        if shed > 0:
+            findings.append({
+                "code": "SERVE_SHEDDING", "severity": "warning",
+                "summary": (f"serve admission control has shed "
+                            f"{int(shed)} request(s) — deployments "
+                            "are over capacity"),
+                "detail": {"requests_shed": shed}})
+    except Exception as exc:   # noqa: BLE001
+        probe_errors.append({"probe": "serve", "error": repr(exc)})
+
+    # -- train goodput --------------------------------------------------
+    # Telemetry snapshots live in the control-plane KV, whose node-side
+    # proxy BLOCKS while the GCS is down — with the GCS already flagged
+    # stale, skip rather than hang the whole triage behind it.
+    _probe("train")
+    try:
+        if gcs_down:
+            raise RuntimeError(
+                "skipped: control-plane KV unreachable (GCS stale)")
+        # Liveness ping with a short client-side deadline: right after
+        # a GCS death the health ages may not have crossed gcs_stale_s
+        # yet, and the first unguarded KV read would sit behind the
+        # proxy's full reconnect backoff (up to a minute).
+        try:
+            _client().conn.call(
+                {"type": "kv_keys", "ns": "__train_runs__",
+                 "prefix": b""}, timeout=2.0)
+        except TimeoutError:
+            raise RuntimeError(
+                "skipped: control-plane KV unreachable "
+                "(liveness ping timed out)") from None
+        runs = (train_summary() or {}).get("runs") or {}
+        for run, roll in runs.items():
+            ledger = roll.get("ledger") or {}
+            total = sum(float(v) for v in ledger.values())
+            productive = float(ledger.get("productive") or 0.0)
+            if total >= 10.0 and productive / total < 0.5:
+                findings.append({
+                    "code": "TRAIN_GOODPUT_LOW", "severity": "warning",
+                    "summary": (f"train run {run!r}: only "
+                                f"{100 * productive / total:.0f}% of "
+                                "instrumented wall clock was "
+                                "productive step time"),
+                    "detail": {"run": run,
+                               "verdict": roll.get("verdict"),
+                               "ledger": ledger}})
+    except Exception as exc:   # noqa: BLE001
+        probe_errors.append({"probe": "train", "error": repr(exc)})
+
+    sev_rank = {"error": 0, "warning": 1}
+    findings.sort(key=lambda f: (sev_rank.get(f["severity"], 2),
+                                 f["code"]))
+    errors = any(f["severity"] == "error" for f in findings)
+    return {
+        "healthy": not errors,
+        "exit_code": 1 if errors else 0,
+        "findings": findings,
+        "probes": probes,
+        "probe_errors": probe_errors,
     }

@@ -14,10 +14,16 @@ Measures, at 1/2/4/8 virtual nodes:
   * pg create/remove — sequential placement-group 2PC latency
 plus a 200-actor churn (create/kill loop) at the largest size.
 
-Writes SCALE_<round>.json (SCALE_ROUND env, default r05) and prints
+Writes SCALE_<round>.json (SCALE_ROUND env, default r07) and prints
 one JSON line.  tests/test_scale_envelope.py runs a shrunk version as
 the CI regression gate.  Reference baselines for orientation (64-node
 cluster, BASELINE.md): 334-589 tasks/s, 580 actors/s, PG 0.91/0.86 ms.
+
+Focused microbench legs (each writes into MICROBENCH_<round>.json):
+  SCALE_DAG=1              compiled-graph per-hop overhead
+  SCALE_OBJECT_TRANSFER=1  windowed binary object pull
+  SCALE_SCHED=1            scheduler placement throughput + decision
+                           latency p50/p95 on a 2-node cluster
 """
 
 from __future__ import annotations
@@ -317,6 +323,61 @@ def measure_dag(quick: bool = False) -> dict:
     return out
 
 
+def measure_sched(ray_tpu, quick: bool = False) -> dict:
+    """Scheduler decision microbench (SCALE_SCHED=1): placement
+    throughput draining no-op tasks over a 2-node cluster, plus the
+    decision-latency histogram (submit -> terminal placement) and the
+    outcome mix from the decision trace.  Latency percentiles come
+    from the head node's ray_tpu_sched_placement_seconds aggregate
+    (bucket-resolution); outcomes are cluster-merged."""
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util.metrics import (SCHED_PLACEMENT_SECONDS_METRIC,
+                                      hist_quantile)
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    def _hist_snapshot() -> dict:
+        agg = {"buckets": {}, "sum": 0.0, "count": 0}
+        for s in ray_tpu._ensure_connected().metrics_scrape():
+            if s.get("name") != SCHED_PLACEMENT_SECONDS_METRIC:
+                continue
+            for b, c in (s.get("buckets") or {}).items():
+                agg["buckets"][b] = agg["buckets"].get(b, 0) + c
+            agg["count"] += int(s.get("count") or 0)
+            agg["sum"] += float(s.get("sum") or 0.0)
+        return agg
+
+    n = 100 if quick else 400
+    ray_tpu.get([noop.remote(i) for i in range(8)])   # warm pools
+    base = _hist_snapshot()
+    t0 = time.perf_counter()
+    ray_tpu.get([noop.remote(i) for i in range(n)])
+    wall = time.perf_counter() - t0
+
+    summary = state_api.summarize_scheduling()
+    # Bench-window delta: warm-up placements wait on worker-pool
+    # spawn (seconds) and would drown the steady-state percentiles.
+    after = _hist_snapshot()
+    merged = {
+        "buckets": {b: c - base["buckets"].get(b, 0)
+                    for b, c in after["buckets"].items()},
+        "sum": after["sum"] - base["sum"],
+        "count": after["count"] - base["count"],
+    }
+    return {
+        "tasks": n,
+        "placements_per_s": round(n / wall, 1),
+        "decision_latency_ms_p50": round(
+            hist_quantile(merged, 0.50) * 1000.0, 3),
+        "decision_latency_ms_p95": round(
+            hist_quantile(merged, 0.95) * 1000.0, 3),
+        "decisions_recorded": summary["decisions"],
+        "outcomes": summary["outcomes"],
+    }
+
+
 def run_envelope(node_counts: List[int], n_tasks: int, n_actors: int,
                  n_pgs: int, churn: int) -> dict:
     import ray_tpu
@@ -370,8 +431,26 @@ def _merge_microbench(rnd: str, key: str, res: dict) -> None:
 
 
 def main() -> None:
-    rnd = os.environ.get("SCALE_ROUND", "r06")
+    rnd = os.environ.get("SCALE_ROUND", "r07")
     quick = os.environ.get("SCALE_QUICK", "") not in ("", "0", "false")
+    if os.environ.get("SCALE_SCHED", "") not in ("", "0", "false"):
+        # Scheduler decision microbench: placements/s + decision
+        # latency p50/p95 over a 2-node cluster, from the decision
+        # trace this round introduced.
+        import ray_tpu
+        from ray_tpu.cluster_utils import Cluster
+        cluster = Cluster()
+        cluster.add_node(resources={"CPU": 2.0})
+        ray_tpu.init(num_cpus=2, gcs_address=cluster.gcs_address)
+        try:
+            cluster.wait_for_nodes(2)
+            res = measure_sched(ray_tpu, quick=quick)
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+        _merge_microbench(rnd, "sched", res)
+        print(json.dumps({"metric": "sched", **res}))
+        return
     if os.environ.get("SCALE_DAG", "") not in ("", "0", "false"):
         # Compiled-graph microbench: 3-stage actor pipeline, per-hop
         # overhead on compiled channels vs the legacy per-call path.

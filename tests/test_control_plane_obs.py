@@ -1,0 +1,520 @@
+"""Control-plane observability (ISSUE 16): RPC server telemetry +
+slow-RPC sentinel, scheduler decision tracing, metrics history rings,
+and the `ray_tpu doctor` triage surface.
+
+Acceptance:
+  * server-side RPC latency histograms cover >= 10 distinct methods
+    after a two-node workload, next to in-flight and queue-depth
+    gauges;
+  * an injected server-side chaos delay makes the slow-RPC sentinel
+    capture exactly ONE stack+args event (per method per window);
+  * a forced spillback shows up in state.summarize_scheduling() with
+    the decision detail the scorer saw;
+  * history rings stay bounded at window/resolution samples and
+    cluster-merge with per-node attribution;
+  * doctor exits 0 on a healthy 2-node cluster and 1 (with the
+    matching finding code) under a seeded stall / GCS outage;
+  * bench-diff flags direction-aware regressions (exit 1).
+
+Reference analogs: ray's dashboard event/metrics plane, `ray status
+-v` scheduler debug output, and `ray health-check`.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import chaos as chaos_api
+from ray_tpu.util import state as state_api
+
+_FAST_HB = {"RAY_TPU_HEARTBEAT_INTERVAL_S": "0.2",
+            "RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD": "25",
+            "RAY_TPU_METRICS_HISTORY_RESOLUTION_S": "0.05",
+            "RAY_TPU_METRICS_HISTORY_WINDOW_S": "1.0"}
+
+
+def _wait_for(pred, timeout=10.0, interval=0.1, desc="condition"):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = pred()
+        if last:
+            return last
+        time.sleep(interval)
+    raise TimeoutError(f"{desc} not met within {timeout}s "
+                       f"(last={last!r})")
+
+
+@pytest.fixture(scope="module")
+def two_node():
+    """Head (in driver) + 1 worker node, fast history sampling.
+    Module-scoped: all assertions against it are presence/lower-bound
+    style, so the tests share one cluster (tier-1 wall-clock)."""
+    for k, v in _FAST_HB.items():
+        os.environ[k] = v
+    c = Cluster(env=_FAST_HB)
+    c.add_node(resources={"CPU": 2, "remote": 1})
+    ray_tpu.init(num_cpus=1, gcs_address=c.gcs_address,
+                 _system_config={"metrics_history_resolution_s": 0.05,
+                                 "metrics_history_window_s": 1.0})
+    c.wait_for_nodes(2)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    for k in _FAST_HB:
+        os.environ.pop(k, None)
+
+
+def _scrape():
+    return ray_tpu._ensure_connected().metrics_scrape()
+
+
+def _run_workload():
+    """Touch enough of the control plane that many distinct RPC
+    methods hit the head's dispatch path."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def work(i):
+        return i * 2
+
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return 1
+
+    assert ray_tpu.get([work.remote(i) for i in range(6)],
+                       timeout=60) == [0, 2, 4, 6, 8, 10]
+    ref = ray_tpu.put(np.zeros(10_000))
+    assert ray_tpu.get(ref, timeout=30).shape == (10_000,)
+    ray_tpu.wait([work.remote(1)], timeout=30)
+    h = Holder.remote()
+    assert ray_tpu.get(h.ping.remote(), timeout=60) == 1
+    ray_tpu.cluster_resources()
+    state_api.list_tasks()
+
+
+# ---------------------------------------------------------------------------
+# slow-RPC sentinel
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def rt_slow_rpc():
+    ray_tpu.init(num_cpus=2, _system_config={
+        "slow_rpc_min_seconds": 0.3,
+        "slow_rpc_check_interval_s": 0.05,
+        "slow_rpc_capture_window_s": 30.0,
+    })
+    yield ray_tpu
+    chaos_api.clear()
+    chaos_api.reset_trace()
+    ray_tpu.shutdown()
+
+
+def test_slow_rpc_capture_fires_exactly_once(rt_slow_rpc):
+    """A server-side chaos delay on one handler makes the sentinel
+    flag it (counter + one stack/args capture); the same in-flight
+    entry is never recaptured, and the per-method window gates any
+    second capture."""
+    chaos_api.inject("rpc.state_dump", kind="delay", n=1,
+                     lo_ms=800.0, hi_ms=800.0)
+    state_api.list_tasks()     # rides a state_dump RPC -> delayed
+
+    def _slow_events():
+        from ray_tpu.util import profiling
+        return [ev for ev in profiling.timeline_events()
+                if ev.get("kind") == "slow_rpc"]
+    events = _wait_for(_slow_events, timeout=10.0,
+                       desc="slow_rpc capture")
+    assert len(events) == 1, events
+    ev = events[0]
+    assert ev["method"] == "state_dump"
+    assert ev["elapsed_s"] >= ev["threshold_s"] >= 0.3
+    assert "state_dump" in (ev.get("rpc_args") or ""), ev["rpc_args"]
+    assert ev.get("stack"), "capture must carry the handler stack"
+    # Counter face.
+    from ray_tpu.util import metrics
+    slow = {tuple(sorted((s.get("tags") or {}).items())): s["value"]
+            for s in _scrape()
+            if s.get("name") == metrics.SLOW_RPC_METRIC}
+    assert slow.get((("method", "state_dump"),)) == 1.0, slow
+    # More state_dump RPCs (fast now, n=1 exhausted) + more sentinel
+    # sweeps: still exactly one capture and one flagged handler.
+    for _ in range(3):
+        state_api.list_tasks()
+    time.sleep(0.5)
+    assert len(_slow_events()) == 1
+    # The timeline export categorizes it for the trace viewer.
+    from ray_tpu.util import profiling
+    rows = [r for r in profiling.timeline()
+            if r["cat"] == "slow_rpc"]
+    assert rows and rows[0]["args"]["method"] == "state_dump"
+
+
+def test_fast_rpcs_never_flagged(rt_slow_rpc):
+    state_api.list_tasks()
+    time.sleep(0.4)            # several sentinel sweeps
+    from ray_tpu.util import metrics
+    assert not any(s.get("name") == metrics.SLOW_RPC_METRIC
+                   for s in _scrape())
+
+
+# ---------------------------------------------------------------------------
+# doctor
+# ---------------------------------------------------------------------------
+def test_doctor_flags_stalled_task():
+    ray_tpu.init(num_cpus=2, _system_config={
+        "stall_min_seconds": 0.3,
+        "stall_check_interval_s": 0.1,
+    })
+    try:
+        @ray_tpu.remote
+        def sleeper():
+            time.sleep(3.0)
+            return 1
+
+        ref = sleeper.remote()
+        rep = _wait_for(
+            lambda: (lambda r: r if r["exit_code"] else None)(
+                state_api.doctor()),
+            timeout=10.0, desc="doctor turns unhealthy")
+        codes = {f["code"]: f for f in rep["findings"]}
+        assert "TASK_STALLED" in codes, codes
+        assert codes["TASK_STALLED"]["severity"] == "error"
+        assert rep["exit_code"] == 1 and not rep["healthy"]
+        assert ray_tpu.get(ref, timeout=30) == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_doctor_flags_dead_owner_leak():
+    """An object whose owner died and that nothing will ever delete
+    is a LEAK_SUSPECT error: doctor exits 1 and names the object."""
+    import numpy as np
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Leaker:
+            def leak(self):
+                # Ref kept alive inside the actor: the object stays
+                # registered with this worker as owner.
+                self.ref = ray_tpu.put(
+                    np.zeros(200_000, dtype=np.float64))
+                return self.ref.binary().hex()
+
+        a = Leaker.remote()
+        leaked_hex = ray_tpu.get(a.leak.remote(), timeout=30)
+        rep = state_api.doctor(leak_min_age_s=0.0)
+        assert "LEAK_SUSPECT" not in [f["code"]
+                                      for f in rep["findings"]]
+        ray_tpu.kill(a)
+
+        def _leaked():
+            r = state_api.doctor(leak_min_age_s=0.0)
+            hits = [f for f in r["findings"]
+                    if f["code"] == "LEAK_SUSPECT"]
+            return (r, hits[0]) if hits else None
+        rep, finding = _wait_for(_leaked, timeout=15.0, interval=0.2,
+                                 desc="doctor flags the leaked object")
+        assert rep["exit_code"] == 1 and not rep["healthy"]
+        assert finding["severity"] == "error"
+        suspects = finding["detail"]["suspects"]
+        assert leaked_hex in [s["object_id"] for s in suspects], \
+            suspects
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_doctor_flags_gcs_outage(tmp_path):
+    for k, v in _FAST_HB.items():
+        os.environ[k] = v
+    c = Cluster(env=_FAST_HB, persist_dir=str(tmp_path / "gcs"))
+    try:
+        c.add_node(resources={"CPU": 2})
+        ray_tpu.init(num_cpus=2, gcs_address=c.gcs_address)
+        c.wait_for_nodes(2)
+        rep = state_api.doctor(gcs_stale_s=1.0)
+        assert "GCS_UNREACHABLE" not in [f["code"]
+                                         for f in rep["findings"]]
+        c.kill_gcs()
+        rep = _wait_for(
+            lambda: (lambda r: r if any(
+                f["code"] == "GCS_UNREACHABLE"
+                for f in r["findings"]) else None)(
+                    state_api.doctor(gcs_stale_s=1.0)),
+            timeout=15.0, interval=0.5,
+            desc="doctor flags the dead GCS")
+        assert rep["exit_code"] == 1
+        c.restart_gcs()
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        for k in _FAST_HB:
+            os.environ.pop(k, None)
+
+
+def test_doctor_surfaces_event_ring_drops():
+    """Satellite: events_dropped shows up as a doctor warning (but
+    keeps exit 0 — drops degrade history, not the cluster)."""
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"profile_events_max": 40})
+    try:
+        @ray_tpu.remote
+        def quick(i):
+            return i
+
+        ray_tpu.get([quick.remote(i) for i in range(80)], timeout=60)
+
+        def _drops():
+            rep = state_api.doctor()
+            hits = [f for f in rep["findings"]
+                    if f["code"] == "EVENT_RING_DROPS"]
+            return (rep, hits[0]) if hits else None
+        rep, finding = _wait_for(_drops, timeout=10.0,
+                                 desc="EVENT_RING_DROPS finding")
+        assert finding["severity"] == "warning"
+        assert finding["detail"]["dropped_total"] > 0
+        assert rep["exit_code"] == 0
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# shared percentile helpers (satellite: one implementation)
+# ---------------------------------------------------------------------------
+def test_percentile_helpers_are_shared():
+    from ray_tpu.serve._replica import _p95_ms
+    from ray_tpu.util.metrics import hist_quantile, percentile
+
+    vals = sorted([0.010, 0.020, 0.030, 0.100])
+    assert percentile(vals, 0.50) == 0.030
+    assert percentile(vals, 0.95) == 0.100
+    assert percentile([], 0.95) == 0.0
+    assert state_api._percentile(vals, 0.95) == percentile(vals, 0.95)
+    assert _p95_ms([0.010, 0.020, 0.030, 0.100]) == pytest.approx(
+        percentile(vals, 0.95) * 1000.0)
+    cell = {"buckets": {"0.001": 5, "0.01": 4, "0.1": 1}, "count": 10}
+    assert hist_quantile(cell, 0.50) == 0.001
+    assert hist_quantile(cell, 0.95) == 0.1
+    assert hist_quantile({"buckets": {}, "count": 0}, 0.95) == 0.0
+    # node-side delegation keeps the same answers
+    from ray_tpu._private.node_service import NodeService
+    assert NodeService._hist_quantile(cell, 0.95) == 0.1
+
+
+# ---------------------------------------------------------------------------
+# bench-diff
+# ---------------------------------------------------------------------------
+def test_bench_diff_direction_aware(tmp_path):
+    from ray_tpu.scripts.cli import _bench_diff, main
+
+    base = {"dag": {"per_hop_us_p50": 100.0,
+                    "pipelined_items_per_s": 1000.0,
+                    "iters": 2000}}
+    # Latency regressed 50%, throughput improved, config echo moved.
+    fresh = {"dag": {"per_hop_us_p50": 150.0,
+                     "pipelined_items_per_s": 1500.0,
+                     "iters": 500}}
+    rows = {r["path"]: r for r in _bench_diff(fresh, base, 0.10)}
+    assert rows["dag.per_hop_us_p50"]["regressed"]
+    assert rows["dag.per_hop_us_p50"]["direction"] == "lower"
+    assert not rows["dag.pipelined_items_per_s"]["regressed"]
+    assert rows["dag.iters"]["direction"] is None
+    assert not rows["dag.iters"]["regressed"]
+    # Throughput drop beyond tolerance regresses; within it passes.
+    drop = {"dag": {"pipelined_items_per_s": 950.0}}
+    assert not _bench_diff(drop, base, 0.10)[1]["regressed"]
+    drop = {"dag": {"pipelined_items_per_s": 800.0}}
+    by = {r["path"]: r for r in _bench_diff(drop, base, 0.10)}
+    assert by["dag.pipelined_items_per_s"]["regressed"]
+    # Metrics absent from the fresh capture are informational.
+    assert not any(r["regressed"]
+                   for r in _bench_diff({}, base, 0.10))
+    # CLI smoke: exit 1 on regression, 0 on a clean diff.
+    bpath, fpath = tmp_path / "base.json", tmp_path / "fresh.json"
+    bpath.write_text(json.dumps(base))
+    fpath.write_text(json.dumps(fresh))
+    assert main(["bench-diff", str(fpath), str(bpath)]) == 1
+    fpath.write_text(json.dumps(base))
+    assert main(["bench-diff", str(fpath), str(bpath)]) == 0
+    assert main(["bench-diff", str(fpath), str(bpath),
+                 "--json"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# RPC server telemetry
+# ---------------------------------------------------------------------------
+def test_rpc_server_histograms_cover_methods(two_node):
+    _run_workload()
+    _scrape()   # warm: a scrape only COUNTS once it finishes, so the
+    series = _scrape()  # second one sees the first in the histogram
+    hists = {}
+    for s in series:
+        if s.get("name") == "ray_tpu_rpc_server_seconds":
+            hists[(s.get("tags") or {}).get("method")] = s
+    assert len(hists) >= 10, sorted(hists)
+    for method, s in hists.items():
+        assert s["kind"] == "histogram"
+        assert s["count"] >= 1
+        assert sum(s["buckets"].values()) == s["count"], (method, s)
+        assert s["sum"] >= 0.0
+    # Handlers the driver itself exercised must be covered.
+    for expected in ("register_client", "submit_task", "get_objects",
+                     "put_object", "state_dump", "metrics_scrape"):
+        assert expected in hists, sorted(hists)
+    # In-flight gauges ride next to the histograms — the scrape that
+    # produced `series` was itself in flight while being counted.
+    inflight = [s for s in series
+                if s.get("name") == "ray_tpu_rpc_inflight"]
+    assert inflight
+    scrape_row = [s for s in inflight
+                  if (s.get("tags") or {}).get("method")
+                  == "metrics_scrape"]
+    assert scrape_row and scrape_row[0]["value"] >= 1.0
+    # Queue-depth gauges for all three backlog planes.
+    planes = {(s.get("tags") or {}).get("plane")
+              for s in series
+              if s.get("name") == "ray_tpu_rpc_queue_depth"}
+    assert planes == {"gcs_proxy", "forward", "chan_fwd"}, planes
+
+
+def test_gcs_server_latency_series_republished(two_node):
+    """The head polls the GCS status card (which now carries the GCS
+    server's own per-op latency aggregates) and republishes them as
+    method="gcs.<op>" series."""
+    def _gcs_methods():
+        return sorted(
+            (s.get("tags") or {}).get("method")
+            for s in _scrape()
+            if s.get("name") == "ray_tpu_rpc_server_seconds"
+            and (s.get("tags") or {}).get("method",
+                                          "").startswith("gcs."))
+    methods = _wait_for(_gcs_methods, timeout=15.0,
+                        desc="gcs.* latency series")
+    # register_node + heartbeat run on every cluster bring-up.
+    assert "gcs.heartbeat" in methods, methods
+    assert "gcs.register_node" in methods, methods
+
+
+# ---------------------------------------------------------------------------
+# scheduler decision tracing
+# ---------------------------------------------------------------------------
+def test_summarize_scheduling_records_spillback(two_node):
+    """Head has 1 CPU; a 2-CPU task is infeasible locally and must
+    spill to the worker node — the decision trace records the spill
+    with the candidates the scorer saw, and local placements record
+    their worker dispatch."""
+    @ray_tpu.remote(num_cpus=2)
+    def needs_two():
+        return os.getpid()
+
+    @ray_tpu.remote(num_cpus=1)
+    def local_one():
+        return 1
+
+    assert ray_tpu.get(local_one.remote(), timeout=60) == 1
+    spilled_pid = ray_tpu.get(needs_two.remote(), timeout=60)
+    assert spilled_pid != os.getpid()
+
+    summary = _wait_for(
+        lambda: (lambda s: s if s["outcomes"].get("spill") else None)(
+            state_api.summarize_scheduling()),
+        timeout=10.0, desc="spill outcome recorded")
+    assert summary["decisions"] >= 2
+    assert summary["outcomes"].get("local", 0) >= 1
+    spills = [r for r in summary["recent"]
+              if r["outcome"] == "spill"]
+    assert spills, summary["recent"]
+    row = spills[-1]
+    assert "needs_two" in row["task"]
+    assert row["target"], "spill row must name the chosen node"
+    assert row["peers_considered"] >= 1
+    assert row["feasible"] >= 1
+    locals_ = [r for r in summary["recent"]
+               if r["outcome"] == "local"]
+    assert locals_ and locals_[-1].get("worker_pid")
+    # Metric faces: the outcome counter and the placement-latency
+    # histogram.
+    series = _scrape()
+    outcomes = {(s.get("tags") or {}).get("outcome"): s["value"]
+                for s in series
+                if s.get("name") == "ray_tpu_sched_decisions_total"}
+    assert outcomes.get("spill", 0) >= 1, outcomes
+    assert outcomes.get("local", 0) >= 1, outcomes
+    hist = [s for s in series
+            if s.get("name") == "ray_tpu_sched_placement_seconds"]
+    assert hist and sum(s["count"] for s in hist) >= 1
+    # The batched sched.decide span landed in the timeline.
+    from ray_tpu.util import profiling
+    spans = [r for r in profiling.timeline() if r["cat"] == "sched"]
+    assert spans and spans[-1]["args"]["decisions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# metrics history rings
+# ---------------------------------------------------------------------------
+def test_metric_history_bounded_and_cluster_merged(two_node):
+    _run_workload()
+    cap = int(1.0 / 0.05)      # window_s / resolution_s = 20 samples
+
+    def _full_ring():
+        hist = state_api.metric_history(name="ray_tpu_workers")
+        rows = [r for r in hist["series"]
+                if len(r["samples"]) >= cap]
+        return rows if rows else None
+    _wait_for(_full_ring, timeout=15.0, desc="history ring filled")
+
+    hist = state_api.metric_history(name="ray_tpu_workers")
+    assert hist["series"], "named filter must match the builtin gauge"
+    nodes = set()
+    for row in hist["series"]:
+        assert row["name"] == "ray_tpu_workers"
+        assert row["kind"] == "gauge"
+        # Bounded: never more samples than window/resolution allows
+        # (worker nodes may sample at their own configured cadence,
+        # but no ring may exceed its cap).
+        assert len(row["samples"]) <= cap, len(row["samples"])
+        for ts, val in row["samples"]:
+            assert ts > 0 and val >= 0
+        nodes.add(row["node_id"])
+    assert len(nodes) == 2, f"expected both nodes' rings, got {nodes}"
+    # Timestamps advance monotonically within one ring.
+    row = hist["series"][0]
+    ts = [s[0] for s in row["samples"]]
+    assert ts == sorted(ts)
+    # Unfiltered history covers the RPC plane too.
+    full = state_api.metric_history()
+    names = {r["name"] for r in full["series"]}
+    assert "ray_tpu_rpc_server_seconds" in names
+    assert "ray_tpu_tasks_pending" in names
+
+
+def test_doctor_healthy_two_node_cluster(two_node):
+    _run_workload()
+    rep = state_api.doctor()
+    codes = [f["code"] for f in rep["findings"]]
+    assert rep["exit_code"] == 0, rep["findings"]
+    assert rep["healthy"], rep["findings"]
+    assert not any(f["severity"] == "error" for f in rep["findings"]), \
+        codes
+    assert "health_probe" in rep["probes"]
+    # CLI text face renders without a cluster.
+    from ray_tpu.scripts.cli import _render_doctor
+    text = _render_doctor(rep)
+    assert "HEALTHY" in text or "healthy" in text
+
+
+def test_top_renderer_pure(two_node):
+    _run_workload()
+    time.sleep(0.3)
+    from ray_tpu.scripts.cli import _render_top, _sparkline
+    assert _sparkline([]) == ""
+    assert _sparkline([5.0, 5.0, 5.0]) == "▁▁▁"
+    hist = state_api.metric_history()
+    text = _render_top(hist["series"])
+    assert "ray_tpu_workers" in text
+    assert "busiest RPC handlers" in text
+    assert _render_top([]).strip().startswith("runtime")

@@ -116,12 +116,30 @@ def test_declared_builtin_names_are_legal():
     # occupancy is a gauge.
     assert metrics.RESOURCE_LEAKS_METRIC.endswith("_total")
     assert not metrics.RESOURCES_LIVE_METRIC.endswith("_total")
+    # Control-plane observability: RPC server latency + scheduler
+    # placement latency are histograms, in-flight / queue depth are
+    # gauges, slow-RPC captures + decision outcomes are counters.
+    assert _NAME.match(metrics.RPC_SERVER_SECONDS_METRIC)
+    assert _NAME.match(metrics.RPC_INFLIGHT_METRIC)
+    assert _NAME.match(metrics.RPC_QUEUE_DEPTH_METRIC)
+    assert _NAME.match(metrics.SLOW_RPC_METRIC)
+    assert _NAME.match(metrics.SCHED_DECISIONS_METRIC)
+    assert _NAME.match(metrics.SCHED_PLACEMENT_SECONDS_METRIC)
+    assert metrics.SLOW_RPC_METRIC.endswith("_total")
+    assert metrics.SCHED_DECISIONS_METRIC.endswith("_total")
+    assert not metrics.RPC_SERVER_SECONDS_METRIC.endswith("_total")
+    assert not metrics.RPC_INFLIGHT_METRIC.endswith("_total")
+    assert not metrics.RPC_QUEUE_DEPTH_METRIC.endswith("_total")
+    assert not metrics.SCHED_PLACEMENT_SECONDS_METRIC.endswith(
+        "_total")
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
                metrics.OBJECT_TRANSFER_BUCKETS,
                metrics.DRAIN_DURATION_BUCKETS,
                metrics.GCS_RESYNC_BUCKETS, metrics.DAG_HOP_BUCKETS,
                metrics.LOCK_WAIT_BUCKETS,
-               metrics.TRAIN_STEP_BUCKETS):
+               metrics.TRAIN_STEP_BUCKETS,
+               metrics.RPC_SERVER_BUCKETS,
+               metrics.SCHED_PLACEMENT_BUCKETS):
         assert all(a < b for a, b in zip(bs, bs[1:]))
 
 
