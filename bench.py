@@ -139,6 +139,7 @@ def main() -> None:
                          peak_flops=_peak_for(dev), jit_fns=[step])
     step_times = []
     steady_tokens = steady_time = 0.0
+    recompiles_steady = 0
     for _ in range(steps):
         with tel.device_step():
             state, metrics = step(state, batch_dev)
@@ -148,6 +149,11 @@ def main() -> None:
         if "compile" not in rec["phases"]:
             steady_tokens += rec["tokens"]
             steady_time += rec["wall"]
+        else:
+            # A cache miss after warmup means something retraced —
+            # shapes are frozen, so any nonzero count here is a
+            # regression (the xlasan ledger names the site).
+            recompiles_steady += 1
     tel.stop()
     step_times.sort()
     steady_tok_s = steady_tokens / steady_time if steady_time else 0.0
@@ -168,6 +174,7 @@ def main() -> None:
         "step_ms_p50": round(_percentile(step_times, 0.50) * 1000, 1),
         "step_ms_p95": round(_percentile(step_times, 0.95) * 1000, 1),
         "mfu_steady": round(mfu_steady, 4),
+        "recompiles_steady": recompiles_steady,
         "loss": round(loss, 4),
     }
     if on_tpu:

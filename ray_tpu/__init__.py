@@ -35,6 +35,16 @@ from ray_tpu.devtools import leaksan as _leaksan
 if _leaksan.enabled():
     _leaksan.install()
 
+# XLA sanitizer (devtools/xlasan.py): env-gated like the two above.
+# install() patches jax.jit at import so every later jit construction
+# — in ray_tpu's own train/models/rllib layers AND user code — is
+# tracked in the recompile ledger.  Deferred until jax imports
+# cleanly; a missing jax just leaves the sanitizer dormant.
+from ray_tpu.devtools import xlasan as _xlasan
+
+if _xlasan.enabled():
+    _xlasan.install()
+
 from ray_tpu._private.config import config
 from ray_tpu import exceptions
 from ray_tpu.object_ref import ObjectRef

@@ -371,6 +371,15 @@ _D("leaksan_dir", str, "",
    "resource ledger for `ray_tpu leaksan` / state.leaksan_report() "
    "to merge (default /tmp/ray_tpu_leaksan; RAY_TPU_LEAKSAN_DIR "
    "overrides).")
+# The XLA sanitizer follows the same rules: enabled ONLY by the
+# RAY_TPU_XLASAN env var (read at `import ray_tpu`, inherited by
+# spawned processes — jax.jit must be patched before user code grabs
+# a reference); only the report directory is a config knob.
+_D("xlasan_dir", str, "",
+   "Xlasan: directory where each process drops its <pid>.json "
+   "recompile/host-sync ledger for `ray_tpu xlasan` / "
+   "state.xlasan_report() to merge (default /tmp/ray_tpu_xlasan; "
+   "RAY_TPU_XLASAN_DIR overrides).")
 _D("metrics_history_resolution_s", float, 2.0,
    "Metrics history ring: sampling interval of the node monitor's "
    "per-series (ts, value) recorder behind state.metric_history() / "

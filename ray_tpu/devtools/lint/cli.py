@@ -20,6 +20,8 @@ def rule_table_text() -> str:
     lines = ["rules:"]
     for rid in sorted(rules):
         lines.append(f"  {rid}  {rules[rid].summary}")
+    for old, new in sorted(engine.rule_aliases().items()):
+        lines.append(f"  {old}  deprecated alias of {new}")
     lines.append("")
     lines.append("suppress per line with `# ray-tpu: noqa[RT001]` "
                  "(or bare `# ray-tpu: noqa`);")

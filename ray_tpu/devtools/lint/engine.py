@@ -78,6 +78,11 @@ class Rule:
 
 _REGISTRY: Dict[str, Rule] = {}
 
+# Deprecated rule ids that resolve to a successor at selection time
+# (`--select RT004` keeps working after RT019 subsumed it); findings
+# are reported under the successor's id.
+_ALIASES: Dict[str, str] = {}
+
 
 def register(rule_id: str, summary: str, doc: str = "",
              project_finalize=None):
@@ -87,6 +92,16 @@ def register(rule_id: str, summary: str, doc: str = "",
                                   project_finalize)
         return fn
     return deco
+
+
+def register_alias(old_id: str, new_id: str) -> None:
+    """Map a retired rule id onto its successor for `--select`."""
+    _ALIASES[old_id.upper()] = new_id.upper()
+
+
+def rule_aliases() -> Dict[str, str]:
+    _load_builtin_rules()
+    return dict(_ALIASES)
 
 
 def all_rules() -> Dict[str, Rule]:
@@ -261,7 +276,7 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 def _select_rules(select: Optional[Sequence[str]]) -> Dict[str, Rule]:
     rules = all_rules()
     if select:
-        sel = {s.upper() for s in select}
+        sel = {_ALIASES.get(s.upper(), s.upper()) for s in select}
         unknown = sel - set(rules)
         if unknown:
             raise KeyError(f"unknown rule id(s): {sorted(unknown)}")

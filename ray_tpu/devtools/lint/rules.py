@@ -357,87 +357,12 @@ def check_rt003(mod: SourceModule) -> Iterable[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# RT004 — PartitionSpec axis not on the mesh
+# RT004 — PartitionSpec axis not on the mesh: superseded by RT019 in
+# lint/xla.py, which extends the same mesh-vs-spec check to collective
+# axis names and spec-rank-vs-array-rank.  `--select RT004` still
+# works via the alias xla.py registers; only the helper below remains
+# because RT019 (and RT010's spec parsing) reuse it.
 # ---------------------------------------------------------------------------
-_PSPEC_NAMES = {"jax.sharding.PartitionSpec",
-                "jax.experimental.PartitionSpec"}
-
-
-@register(
-    "RT004", "PartitionSpec names a mesh axis the mesh doesn't declare",
-    "A P('axis') referencing an axis absent from every mesh declared "
-    "in the file fails at trace/compile time with an opaque XLA "
-    "error (or silently replicates).  Checked only when the file "
-    "declares mesh axes statically (Mesh(...), MeshSpec(...), "
-    "make_mesh(axis_sizes={...})).")
-def check_rt004(mod: SourceModule) -> Iterable[Finding]:
-    imports = _imports(mod)
-    declared: Set[str] = set()
-    saw_mesh = False
-
-    def str_elts(node: ast.AST) -> List[str]:
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            return [node.value]
-        if isinstance(node, (ast.Tuple, ast.List)):
-            out: List[str] = []
-            for e in node.elts:
-                if isinstance(e, ast.Constant) \
-                        and isinstance(e.value, str):
-                    out.append(e.value)
-            return out
-        return []
-
-    for node in ast.walk(mod.tree):
-        if not isinstance(node, ast.Call):
-            continue
-        cname = _call_name(node, imports) or ""
-        tail = cname.rsplit(".", 1)[-1]
-        if tail == "Mesh" or cname in ("jax.make_mesh",):
-            axes: List[str] = []
-            if len(node.args) >= 2:
-                axes = str_elts(node.args[1])
-            for kw in node.keywords:
-                if kw.arg == "axis_names":
-                    axes = str_elts(kw.value)
-            if axes:
-                saw_mesh = True
-                declared.update(axes)
-        elif tail == "MeshSpec":
-            kws = [kw.arg for kw in node.keywords if kw.arg]
-            if kws:
-                saw_mesh = True
-                declared.update(kws)
-        elif tail == "make_mesh":
-            for kw in node.keywords:
-                if kw.arg == "axis_sizes" and isinstance(
-                        kw.value, ast.Dict):
-                    keys = [k.value for k in kw.value.keys
-                            if isinstance(k, ast.Constant)
-                            and isinstance(k.value, str)]
-                    if keys:
-                        saw_mesh = True
-                        declared.update(keys)
-
-    if not saw_mesh or not declared:
-        return
-
-    for node in ast.walk(mod.tree):
-        if not isinstance(node, ast.Call):
-            continue
-        cname = _call_name(node, imports) or ""
-        if cname not in _PSPEC_NAMES \
-                and cname.rsplit(".", 1)[-1] != "PartitionSpec":
-            continue
-        for arg in node.args:
-            for ax in _spec_axis_names(arg):
-                if ax not in declared:
-                    yield mod.finding(
-                        "RT004", arg,
-                        f"PartitionSpec axis {ax!r} is not declared "
-                        f"by any mesh in this file (axes: "
-                        f"{sorted(declared)})")
-
-
 def _spec_axis_names(arg: ast.AST) -> List[str]:
     if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
         return [arg.value]
@@ -1506,3 +1431,7 @@ def check_rt012(mod: SourceModule) -> Iterable[Finding]:
 # them.  Bottom of file: lifecycle imports back from rules, which is
 # complete by this line.
 from ray_tpu.devtools.lint import lifecycle  # noqa: E402,F401
+# RT017-RT020 (XLA compilation/sharding rules, the static half of
+# xlasan) — same arrangement; also registers the RT004 -> RT019
+# deprecation alias.
+from ray_tpu.devtools.lint import xla  # noqa: E402,F401

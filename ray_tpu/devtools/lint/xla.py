@@ -1,0 +1,808 @@
+"""XLA compilation & sharding rules (RT017-RT020) — the static half
+of the xlasan pass (runtime half: devtools/xlasan.py).
+
+The four rules target the JAX/XLA efficiency hazards that dominate
+badly-tuned TPU deployments: silent per-step recompiles (RT017), host
+syncs that stall the step thread mid-loop (RT018), PartitionSpec /
+collective axis names that drift from the declared mesh and only fail
+on real hardware (RT019, subsuming RT004), and weight-update jits
+that double-buffer params/opt_state because nothing was donated
+(RT020).
+
+Like the lifecycle rules, everything here is conservative: a rule
+fires only on patterns it can resolve statically through this file's
+imports.  Deliberate device fences — the one host sync a train loop
+MUST contain (train/telemetry.py device_step) — are annotated
+`# ray-tpu: fence` on the witness line and are never reported.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.lint.engine import (Finding, SourceModule,
+                                          _dotted_name, register,
+                                          register_alias)
+from ray_tpu.devtools.lint.rules import (_call_name, _imports,
+                                         _mod_cached, _resolved,
+                                         _spec_axis_names)
+
+# `# ray-tpu: fence` marks a DELIBERATE device fence (the step-timing
+# sync train/telemetry.py's device_step requires); RT018 distinguishes
+# it from an accidental sync and stays silent.  Same mechanism as
+# lifecycle.py's `# ray-tpu: transfer`.
+_FENCE_RE = re.compile(r"#\s*ray-tpu:\s*fence\b", re.IGNORECASE)
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "pjit",
+              "jax.experimental.pjit.pjit"}
+
+# Packages whose loops are the TPU hot path — RT018 widens from
+# "provably device-derived" to "not provably host" inside these.
+_HOT_SEGMENTS = ("/train/", "/models/", "/ops/", "/rllib/",
+                 "/serve/llm")
+
+# Parameter names that smell like train-state pytrees (RT020's
+# "takes AND returns params/opt_state-shaped" witness).
+_PARAMISH = {"params", "opt_state", "state", "train_state",
+             "opt_states", "weights", "variables"}
+
+_COLLECTIVES = {
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.all_gather", "jax.lax.ppermute", "jax.lax.all_to_all",
+    "jax.lax.axis_index", "jax.lax.psum_scatter", "lax.psum",
+    "lax.pmean", "lax.pmax", "lax.pmin", "lax.all_gather",
+    "lax.ppermute", "lax.all_to_all", "lax.axis_index",
+    "lax.psum_scatter",
+}
+
+
+def _fence_annotated(mod: SourceModule, node: ast.AST) -> bool:
+    return bool(_FENCE_RE.search(mod.line_text(
+        getattr(node, "lineno", 0))))
+
+
+def _hot_path(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(seg in p for seg in _HOT_SEGMENTS)
+
+
+def _uses_jax(mod: SourceModule) -> bool:
+    imports = _imports(mod)
+    return any(v == "jax" or v.startswith("jax.")
+               for v in imports.values())
+
+
+def _is_jit_call(call: ast.Call, imports: Dict[str, str]) -> bool:
+    """`jax.jit(...)` / `pjit(...)`, or the decorator idiom
+    `functools.partial(jax.jit, ...)`."""
+    name = _call_name(call, imports)
+    if name in _JIT_NAMES:
+        return True
+    if name in ("functools.partial", "partial") and call.args:
+        inner = _resolved(call.args[0], imports)
+        return inner in _JIT_NAMES
+    return False
+
+
+def _jit_kwargs(call: ast.Call, imports: Dict[str, str]
+                ) -> Dict[str, ast.expr]:
+    """Keyword args of the jit construction (partial form included)."""
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _static_fields(kwargs: Dict[str, ast.expr]
+                   ) -> Tuple[Set[int], Set[str]]:
+    """(static_argnums, static_argnames) as literal sets, where
+    statically readable."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    v = kwargs.get("static_argnums")
+    for c in ast.walk(v) if v is not None else ():
+        if isinstance(c, ast.Constant) and isinstance(c.value, int):
+            nums.add(c.value)
+    v = kwargs.get("static_argnames")
+    for c in ast.walk(v) if v is not None else ():
+        if isinstance(c, ast.Constant) and isinstance(c.value, str):
+            names.add(c.value)
+    return nums, names
+
+
+def _donate_fields(kwargs: Dict[str, ast.expr]) -> Set[int]:
+    nums: Set[int] = set()
+    v = kwargs.get("donate_argnums")
+    for c in ast.walk(v) if v is not None else ():
+        if isinstance(c, ast.Constant) and isinstance(c.value, int):
+            nums.add(c.value)
+    return nums
+
+
+class _JitInfo:
+    __slots__ = ("node", "static_argnums", "static_argnames",
+                 "donates", "donate_argnums", "params", "fn_def")
+
+    def __init__(self, node, nums, names, donates, donate_argnums,
+                 params=None, fn_def=None):
+        self.node = node
+        self.static_argnums = nums
+        self.static_argnames = names
+        self.donates = donates
+        self.donate_argnums = donate_argnums
+        self.params = params or []
+        self.fn_def = fn_def
+
+
+def _jit_constructions(mod: SourceModule
+                       ) -> Tuple[List[_JitInfo], Dict[str, _JitInfo]]:
+    """(every jit construction in the file, local name -> facts).
+
+    Covers `@jax.jit` / `@functools.partial(jax.jit, ...)` decorated
+    defs, `x = jax.jit(fn, ...)` assignments, and
+    `self.x = jax.jit(fn, ...)` (keyed `self.x`).  The list keeps
+    same-named defs from different factory scopes that the name map
+    collapses."""
+    def build() -> Tuple[List[_JitInfo], Dict[str, _JitInfo]]:
+        imports = _imports(mod)
+        infos: List[_JitInfo] = []
+        out: Dict[str, _JitInfo] = {}
+
+        def info_from(call: ast.Call, fn_def=None) -> _JitInfo:
+            kw = _jit_kwargs(call, imports)
+            nums, names = _static_fields(kw)
+            donates = ("donate_argnums" in kw
+                       or "donate_argnames" in kw)
+            params = ([a.arg for a in fn_def.args.args]
+                      if fn_def is not None else [])
+            return _JitInfo(call, nums, names, donates,
+                            _donate_fields(kw), params, fn_def)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    info = None
+                    if isinstance(dec, ast.Call) \
+                            and _is_jit_call(dec, imports):
+                        info = info_from(dec, node)
+                    elif _resolved(dec, imports) in _JIT_NAMES:
+                        info = _JitInfo(
+                            dec, set(), set(), False, set(),
+                            [a.arg for a in node.args.args], node)
+                    if info is not None:
+                        infos.append(info)
+                        out[node.name] = info
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_jit_call(node.value, imports):
+                fn_def = None
+                if node.value.args and \
+                        isinstance(node.value.args[0], ast.Name):
+                    fn_def = _local_def(mod, node,
+                                        node.value.args[0].id)
+                info = info_from(node.value, fn_def)
+                infos.append(info)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = info
+                    elif isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        out[f"self.{tgt.attr}"] = info
+        return infos, out
+
+    return _mod_cached(mod, "xla_jit_table", build)
+
+
+def _jit_table(mod: SourceModule) -> Dict[str, _JitInfo]:
+    return _jit_constructions(mod)[1]
+
+
+def _local_def(mod: SourceModule, near: ast.AST, name: str):
+    """The def bound to `name` in the scope enclosing `near` (or the
+    module), for resolving `jax.jit(step_fn, ...)` back to its
+    signature."""
+    scope = mod.enclosing_function(near) or mod.tree
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _loops_between(mod: SourceModule, node: ast.AST) -> List[ast.AST]:
+    """Loop statements (for/while/comprehensions) between `node` and
+    its nearest enclosing function/module — i.e. loops whose every
+    iteration re-executes `node`.  A def's decorators belong to the
+    scope OUTSIDE the def, so the walk skips a FunctionDef whose
+    decorator_list contains the previous hop."""
+    out: List[ast.AST] = []
+    prev: ast.AST = node
+    cur = mod.parent.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            # The FIRST generator's iterable is evaluated once, not
+            # per element — `f(x) for v in device_get(x).items()` is
+            # a single sync, not a loop of them.
+            src = cur.generators[0].iter
+            if not any(node is sub for sub in ast.walk(src)):
+                out.append(cur)
+        elif isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            out.append(cur)
+        elif isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            in_decorators = any(
+                prev is d or prev in ast.walk(d)
+                for d in getattr(cur, "decorator_list", []))
+            if not in_decorators:
+                break
+        prev, cur = cur, mod.parent.get(cur)
+    return out
+
+
+def _unhashable_literal(node: ast.expr,
+                        imports: Dict[str, str]) -> Optional[str]:
+    """'dict literal' / 'f-string' / ... when `node` can never be a
+    hashable static argument, else None."""
+    if isinstance(node, ast.Dict):
+        return "dict literal"
+    if isinstance(node, ast.List):
+        return "list literal"
+    if isinstance(node, ast.Set):
+        return "set literal"
+    if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                         ast.GeneratorExp)):
+        return "comprehension"
+    if isinstance(node, ast.JoinedStr):
+        return "f-string built per call"
+    if isinstance(node, ast.Lambda):
+        return "fresh lambda"
+    if isinstance(node, ast.Call):
+        name = _call_name(node, imports)
+        if name in ("dict", "list", "set"):
+            return f"fresh {name}()"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# RT017 — recompile hazard
+# ---------------------------------------------------------------------------
+@register(
+    "RT017", "jit/pjit recompile hazard (jit in loop, unhashable or "
+             "per-iteration static arg)",
+    "A `jax.jit`/`pjit` constructed inside a loop body builds a fresh "
+    "cache every iteration — every call retraces and recompiles.  "
+    "The same storm hides in static arguments: an unhashable or "
+    "per-iteration object (dict/list literal, f-string, fresh "
+    "closure) in a `static_argnums`/`static_argnames` position "
+    "misses the jit cache on every call, and a closed-over Python "
+    "scalar mutated between calls retraces on every new value.  "
+    "Hoist the jit to module/constructor scope and make statics "
+    "hashable constants; the runtime twin (`RAY_TPU_XLASAN=1`, "
+    "`ray_tpu xlasan`) attributes the recompiles this rule's "
+    "blind spots cause.")
+def check_rt017(mod: SourceModule) -> Iterable[Finding]:
+    if not _uses_jax(mod):
+        return
+    imports = _imports(mod)
+    table = _jit_table(mod)
+
+    for node in ast.walk(mod.tree):
+        # (a) jit constructed (or constructed-and-invoked) in a loop.
+        if isinstance(node, ast.Call) and _is_jit_call(node, imports):
+            if _loops_between(mod, node):
+                yield mod.finding(
+                    "RT017", node,
+                    "jax.jit constructed inside a loop body — each "
+                    "iteration builds a fresh jit (full retrace + "
+                    "compile); hoist the jit out of the loop")
+            continue
+        # (a') a jit-decorated def whose body re-executes per
+        # iteration (def inside a loop).
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            jitted = any(
+                (isinstance(d, ast.Call)
+                 and _is_jit_call(d, imports))
+                or _resolved(d, imports) in _JIT_NAMES
+                for d in node.decorator_list)
+            if jitted and _loops_between(mod, node):
+                yield mod.finding(
+                    "RT017", node,
+                    f"jitted function {node.name!r} defined inside a "
+                    f"loop — a fresh function object per iteration "
+                    f"never hits the jit cache; define it once "
+                    f"outside the loop")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        # (b) unhashable / per-iteration value in a static position
+        # of a known-jitted callable.
+        callee = _dotted_name(node.func)
+        info = table.get(callee) if callee else None
+        if info is None or not (info.static_argnums
+                                or info.static_argnames):
+            continue
+        for i, arg in enumerate(node.args):
+            if i in info.static_argnums:
+                why = _unhashable_literal(arg, imports)
+                if why:
+                    yield mod.finding(
+                        "RT017", arg,
+                        f"static argument {i} of jitted "
+                        f"{callee!r} is a {why} — unhashable/fresh "
+                        f"per call, so every call recompiles")
+        for kw in node.keywords:
+            if kw.arg in info.static_argnames:
+                why = _unhashable_literal(kw.value, imports)
+                if why:
+                    yield mod.finding(
+                        "RT017", kw.value,
+                        f"static argument {kw.arg!r} of jitted "
+                        f"{callee!r} is a {why} — unhashable/fresh "
+                        f"per call, so every call recompiles")
+
+
+# ---------------------------------------------------------------------------
+# RT018 — host sync in hot loop
+# ---------------------------------------------------------------------------
+_SYNC_BUILTINS = {"float", "int", "bool"}
+
+
+def _value_kinds(mod: SourceModule, fn) -> Dict[str, str]:
+    """Name -> 'device' | 'host' for names assigned in `fn` (or the
+    module), by the producer of the assigned value: calls into
+    jax/jnp or a known-jitted callable are device; numpy/math/len/
+    device_get results and literals are host.  Last writer wins in
+    source order — good enough for straight-line loop bodies."""
+    imports = _imports(mod)
+    table = _jit_table(mod)
+    scope = fn or mod.tree
+    kinds: Dict[str, str] = {}
+
+    def classify(value: ast.expr) -> Optional[str]:
+        if isinstance(value, ast.Constant):
+            return "host"
+        if not isinstance(value, ast.Call):
+            return None
+        name = _call_name(value, imports) or ""
+        dotted = _dotted_name(value.func) or ""
+        if dotted in table or name in table:
+            return "device"
+        if name == "jax.device_get" or dotted == "jax.device_get":
+            return "host"
+        if name == "jax" or name.startswith("jax."):
+            return "device"
+        head = name.split(".")[0]
+        if name in ("len", "range") or head in ("numpy", "math",
+                                                "time", "os"):
+            return "host"
+        if dotted.startswith("np.") or dotted.startswith("math."):
+            return "host"
+        return None
+
+    def classify_iter(it: ast.expr) -> Optional[str]:
+        # `for k, v in X.items()` inherits X's kind, so a single
+        # `jax.device_get(metrics)` before (or inside) the
+        # comprehension makes its targets host-side.
+        if isinstance(it, ast.Call) and \
+                isinstance(it.func, ast.Attribute) and \
+                it.func.attr in ("items", "values", "keys"):
+            base = it.func.value
+            if isinstance(base, ast.Name):
+                return kinds.get(base.id)
+            if isinstance(base, ast.Call):
+                return classify(base)
+            return None
+        return classify(it)
+
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not scope:
+            continue
+        if isinstance(node, ast.Assign):
+            kind = classify(node.value)
+            if kind is None:
+                continue
+            targets: List[ast.expr] = []
+            for t in node.targets:
+                targets.extend(t.elts if isinstance(
+                    t, (ast.Tuple, ast.List)) else [t])
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    kinds[t.id] = kind
+        elif isinstance(node, (ast.ListComp, ast.SetComp,
+                               ast.DictComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                kind = classify_iter(gen.iter)
+                if kind is None:
+                    continue
+                tgts = (gen.target.elts if isinstance(
+                    gen.target, (ast.Tuple, ast.List))
+                    else [gen.target])
+                for t in tgts:
+                    if isinstance(t, ast.Name):
+                        kinds[t.id] = kind
+    return kinds
+
+
+def _suspect(mod: SourceModule, fn, arg: ast.expr, hot: bool,
+             imports: Dict[str, str]) -> Optional[str]:
+    """Why `arg` is (probably) a traced/device value, or None."""
+    kinds = _mod_cached(mod, f"xla_kinds_{id(fn)}",
+                        lambda: _value_kinds(mod, fn))
+    if isinstance(arg, ast.Name):
+        kind = kinds.get(arg.id)
+        if kind == "device":
+            return f"{arg.id!r} comes from a jitted/jax call"
+        if kind is None and hot:
+            return (f"{arg.id!r} is not provably host-side in a "
+                    f"hot-path package")
+        return None
+    if isinstance(arg, ast.Call):
+        name = _call_name(arg, imports) or ""
+        dotted = _dotted_name(arg.func) or ""
+        if name == "jax" or name.startswith("jax.") \
+                or dotted.startswith("jnp."):
+            return f"result of device op {dotted or name!r}"
+    return None
+
+
+@register(
+    "RT018", "host sync on a device value inside a hot loop "
+             "(annotate deliberate fences `# ray-tpu: fence`)",
+    "`float()/int()/bool()/.item()/np.array()/print()/"
+    "block_until_ready()` on a traced/device value inside a loop "
+    "blocks the host thread on the device every iteration — the "
+    "async dispatch pipeline drains and the accelerator idles "
+    "between steps (the dominant goodput sink PR 13's ledger "
+    "surfaces as inflated `step` wall).  Inside the hot-path "
+    "packages (train/, models/, ops/, serve/llm, rllib/) any "
+    "not-provably-host value counts.  Accumulate device-side and "
+    "convert ONCE after the loop, or — for the one deliberate "
+    "per-step fence a train loop needs (telemetry's device_step "
+    "contract) — annotate the line `# ray-tpu: fence`.")
+def check_rt018(mod: SourceModule) -> Iterable[Finding]:
+    if not _uses_jax(mod):
+        return
+    imports = _imports(mod)
+    hot = _hot_path(mod.path)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _loops_between(mod, node):
+            continue
+        if _fence_annotated(mod, node):
+            continue
+        fn = mod.enclosing_function(node)
+
+        # x.block_until_ready() / x.item() attribute calls.
+        if isinstance(node.func, ast.Attribute) and not node.args \
+                and node.func.attr in ("block_until_ready", "item"):
+            base = node.func.value
+            why = _suspect(mod, fn, base, hot, imports)
+            if node.func.attr == "block_until_ready" or why:
+                yield mod.finding(
+                    "RT018", node,
+                    f".{node.func.attr}() inside a loop is a host "
+                    f"sync every iteration; hoist it out or annotate "
+                    f"a deliberate fence with `# ray-tpu: fence`")
+            continue
+
+        name = _call_name(node, imports) or ""
+        if name in ("jax.block_until_ready", "jax.device_get"):
+            yield mod.finding(
+                "RT018", node,
+                f"{name}() inside a loop is a host sync every "
+                f"iteration; hoist it out or annotate a deliberate "
+                f"fence with `# ray-tpu: fence`")
+            continue
+        if name in _SYNC_BUILTINS and len(node.args) == 1:
+            why = _suspect(mod, fn, node.args[0], hot, imports)
+            if why:
+                yield mod.finding(
+                    "RT018", node,
+                    f"{name}() on a device value inside a loop "
+                    f"({why}) stalls the step thread; accumulate "
+                    f"device-side and convert once after the loop")
+            continue
+        if name in ("numpy.array", "numpy.asarray"):
+            if node.args:
+                why = _suspect(mod, fn, node.args[0], hot, imports)
+                if why:
+                    yield mod.finding(
+                        "RT018", node,
+                        f"np.{name.split('.')[-1]}() on a device "
+                        f"value inside a loop ({why}) copies to host "
+                        f"every iteration")
+            continue
+        if name == "print":
+            for arg in node.args:
+                why = _suspect(mod, fn, arg, hot, imports)
+                if why and not (isinstance(arg, ast.Name)
+                                and hot and "jitted" not in why):
+                    yield mod.finding(
+                        "RT018", node,
+                        f"print() of a device value inside a loop "
+                        f"({why}) syncs every iteration; log a "
+                        f"host copy outside the loop")
+                    break
+
+
+# ---------------------------------------------------------------------------
+# RT019 — mesh / PartitionSpec / collective-axis consistency
+# ---------------------------------------------------------------------------
+_PSPEC_NAMES = {"jax.sharding.PartitionSpec",
+                "jax.experimental.PartitionSpec",
+                "PartitionSpec"}
+_SHAPED_CTORS = {"jax.numpy.zeros", "jax.numpy.ones",
+                 "jax.numpy.full", "jnp.zeros", "jnp.ones",
+                 "jnp.full", "numpy.zeros", "numpy.ones"}
+
+
+def _declared_axes(mod: SourceModule) -> Tuple[bool, Set[str]]:
+    """(saw a mesh declaration, union of declared axis names) across
+    the file: `Mesh(devs, axes)`, `jax.make_mesh(..., axis_names)`,
+    `MeshSpec(dp=..., tp=...)`, `make_mesh(axis_sizes={...})`."""
+    imports = _imports(mod)
+    declared: Set[str] = set()
+    saw_mesh = False
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = _call_name(node, imports) or ""
+        tail = cname.rsplit(".", 1)[-1]
+        if tail == "Mesh" or cname == "jax.make_mesh":
+            saw_mesh = True
+            axes_arg = None
+            if len(node.args) >= 2:
+                axes_arg = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    axes_arg = kw.value
+            if axes_arg is not None:
+                declared |= set(_spec_axis_names(axes_arg))
+        elif tail == "MeshSpec":
+            saw_mesh = True
+            declared |= {kw.arg for kw in node.keywords if kw.arg}
+        elif tail == "make_mesh":
+            for kw in node.keywords:
+                if kw.arg == "axis_sizes" and isinstance(
+                        kw.value, ast.Dict):
+                    saw_mesh = True
+                    for k in kw.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            declared.add(k.value)
+    return saw_mesh, declared
+
+
+def _collective_axes(call: ast.Call) -> Set[str]:
+    """String axis names named by a collective call: 2nd positional
+    arg or `axis_name=` keyword."""
+    out: Set[str] = set()
+    cands: List[ast.expr] = []
+    if len(call.args) >= 2:
+        cands.append(call.args[1])
+    elif len(call.args) == 1 and not any(
+            kw.arg == "axis_name" for kw in call.keywords):
+        # axis_index("dp") takes the axis as its only argument.
+        cands.append(call.args[0])
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            cands.append(kw.value)
+    for c in cands:
+        out |= set(_spec_axis_names(c))
+    return out
+
+
+def _mesh_axis_findings(mod: SourceModule) -> Iterable[Finding]:
+    """The shared RT019/RT004 mesh-axis consistency walk."""
+    imports = _imports(mod)
+    saw_mesh, declared = _declared_axes(mod)
+    if not saw_mesh or not declared:
+        # No statically-visible mesh (e.g. mesh passed as a
+        # parameter, parallel/pipeline.py) — nothing to check
+        # against; the runtime fails loudly enough there.
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = _call_name(node, imports) or ""
+        if cname in _PSPEC_NAMES or cname.endswith("PartitionSpec"):
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                for ax in sorted(_spec_axis_names(arg)):
+                    if ax not in declared:
+                        yield mod.finding(
+                            "RT019", arg,
+                            f"PartitionSpec axis {ax!r} is not "
+                            f"declared by any mesh in this file "
+                            f"(axes: {sorted(declared)})")
+        elif cname in _COLLECTIVES:
+            for ax in sorted(_collective_axes(node)):
+                if ax not in declared:
+                    yield mod.finding(
+                        "RT019", node,
+                        f"collective axis {ax!r} is not declared by "
+                        f"any mesh in this file "
+                        f"(axes: {sorted(declared)})")
+
+
+def _rank_findings(mod: SourceModule) -> Iterable[Finding]:
+    """Spec-rank vs argument-rank, in the one statically-inferable
+    shape: `device_put(jnp.zeros((literal,...)),
+    NamedSharding(mesh, P(...)))` with more spec entries than array
+    dims."""
+    imports = _imports(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = _call_name(node, imports) or ""
+        if cname not in ("jax.device_put", "device_put"):
+            continue
+        if len(node.args) < 2:
+            continue
+        arr, sh = node.args[0], node.args[1]
+        rank = None
+        if isinstance(arr, ast.Call):
+            actor = _call_name(arr, imports) or ""
+            if actor in _SHAPED_CTORS and arr.args and isinstance(
+                    arr.args[0], (ast.Tuple, ast.List)):
+                rank = len(arr.args[0].elts)
+        if rank is None:
+            continue
+        spec = None
+        if isinstance(sh, ast.Call):
+            shname = _call_name(sh, imports) or ""
+            if shname.endswith("NamedSharding") and len(sh.args) >= 2 \
+                    and isinstance(sh.args[1], ast.Call):
+                spec = sh.args[1]
+            elif shname in _PSPEC_NAMES or \
+                    shname.endswith("PartitionSpec"):
+                spec = sh
+        if spec is None:
+            continue
+        nspec = len(spec.args)
+        if nspec > rank:
+            yield mod.finding(
+                "RT019", spec,
+                f"PartitionSpec has {nspec} entries but the array "
+                f"being placed has rank {rank} — the spec cannot "
+                f"apply (rank mismatch fails at runtime)")
+
+
+@register(
+    "RT019", "PartitionSpec / collective axis not declared by any "
+             "mesh in the file (subsumes RT004)",
+    "Every `PartitionSpec` axis — including specs inside `shard_map` "
+    "in_specs/out_specs and match_partition_rules-style rule tables "
+    "— and every collective axis name (`psum`/`pmean`/`all_gather`/"
+    "`ppermute`/`axis_index` axis_name) must be declared by a mesh "
+    "visible in the file; a drifted axis name passes every CPU test "
+    "and fails only on the real TPU mesh.  Where the array rank is "
+    "statically inferable, a spec with more entries than dims is "
+    "flagged too.  Files that receive their mesh as a parameter are "
+    "skipped.  (RT004 is this rule's deprecated alias: `--select "
+    "RT004` maps here.)")
+def check_rt019(mod: SourceModule) -> Iterable[Finding]:
+    yield from _mesh_axis_findings(mod)
+    yield from _rank_findings(mod)
+
+
+# `--select RT004` keeps working (PR 3's mesh-axis rule), resolved to
+# the RT019 check at selection time.
+register_alias("RT004", "RT019")
+
+
+# ---------------------------------------------------------------------------
+# RT020 — missing donation / use-after-donation
+# ---------------------------------------------------------------------------
+def _paramish_positions(info: _JitInfo) -> List[int]:
+    return [i for i, p in enumerate(info.params)
+            if p.lstrip("_") in _PARAMISH]
+
+
+def _returns_paramish(fn_def) -> bool:
+    for node in ast.walk(fn_def):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn_def:
+            continue
+        if isinstance(node, ast.Return) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    base = sub.id.lstrip("_")
+                    if base.startswith("new_"):
+                        base = base[4:]
+                    if base in _PARAMISH:
+                        return True
+    return False
+
+
+@register(
+    "RT020", "jitted train-step takes AND returns params/opt_state "
+             "without donate_argnums (or donated arg reused)",
+    "A jitted function that takes a params/opt_state-shaped pytree "
+    "and returns its successor without `donate_argnums` keeps BOTH "
+    "generations live across the update — doubling optimizer memory, "
+    "exactly the waste cross-replica sharded weight updates exist to "
+    "remove (PAPERS.md).  Donate the state the caller immediately "
+    "rebinds.  The inverse hazard is flagged too: reading an "
+    "argument after passing it in a donated position (its buffer is "
+    "gone), including passing the same un-rebound name again on the "
+    "next loop iteration.")
+def check_rt020(mod: SourceModule) -> Iterable[Finding]:
+    if not _uses_jax(mod):
+        return
+    infos, table = _jit_constructions(mod)
+
+    # Missing donation at the jit construction.
+    seen: Set[int] = set()
+    for info in infos:
+        if info.fn_def is None or id(info.node) in seen:
+            continue
+        seen.add(id(info.node))
+        if info.donates:
+            continue
+        pos = _paramish_positions(info)
+        if not pos or not _returns_paramish(info.fn_def):
+            continue
+        which = ", ".join(info.params[i] for i in pos)
+        yield mod.finding(
+            "RT020", info.node,
+            f"jitted {info.fn_def.name!r} takes and returns "
+            f"state-shaped pytrees ({which}) without donate_argnums "
+            f"— both generations stay live, doubling state memory; "
+            f"donate the inputs the caller rebinds "
+            f"(donate_argnums={tuple(pos)})")
+
+    # Use-after-donation at call sites of donating jits.
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted_name(node.func)
+        info = table.get(callee) if callee else None
+        if info is None or not info.donate_argnums:
+            continue
+        fn = mod.enclosing_function(node)
+        scope = fn or mod.tree
+        for i in sorted(info.donate_argnums):
+            if i >= len(node.args) or not isinstance(
+                    node.args[i], ast.Name):
+                continue
+            donated = node.args[i].id
+            call_line = node.lineno
+            # Stores count from the call line itself: the rebinding
+            # idiom `params, _ = update(params, ...)` re-stores the
+            # donated name in the same statement.
+            first_load: Optional[int] = None
+            first_store: Optional[int] = None
+            for sub in ast.walk(scope):
+                if not (isinstance(sub, ast.Name)
+                        and sub.id == donated):
+                    continue
+                if isinstance(sub.ctx, ast.Store) \
+                        and sub.lineno >= call_line:
+                    if first_store is None or \
+                            sub.lineno < first_store:
+                        first_store = sub.lineno
+                elif isinstance(sub.ctx, ast.Load) \
+                        and sub.lineno > call_line:
+                    if first_load is None or sub.lineno < first_load:
+                        first_load = sub.lineno
+            in_loop = bool(_loops_between(mod, node))
+            if first_load is not None and (
+                    first_store is None or first_load < first_store):
+                yield mod.finding(
+                    "RT020", node,
+                    f"{donated!r} is donated to {callee!r} "
+                    f"(donate_argnums includes {i}) but read again "
+                    f"at line {first_load} — its buffer no longer "
+                    f"exists after the call")
+            elif in_loop and first_store is None:
+                yield mod.finding(
+                    "RT020", node,
+                    f"{donated!r} is donated to {callee!r} inside a "
+                    f"loop without being rebound — the next "
+                    f"iteration passes a deleted buffer")

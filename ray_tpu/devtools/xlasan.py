@@ -1,0 +1,501 @@
+"""Runtime recompile/host-sync attribution (the dynamic half of the
+XLA sanitizer; the static half is lint rules RT017-RT020).
+
+Enable with ``RAY_TPU_XLASAN=1`` in the environment BEFORE the first
+``import ray_tpu`` (the env var inherits into spawned node/worker
+processes, exactly like locksan/leaksan).  ``install()`` then wraps
+``jax.jit`` so every jitted callable is tracked:
+
+* each ``jax.jit(...)`` call records its *construction site*
+  (file:line of the caller) — the key the whole ledger hangs off;
+* each call of the jitted function snapshots the pjit cache size
+  before and after.  Cache growth means XLA traced+compiled during
+  that call; the ledger charges the call's wall time to the site as
+  compile time and records the argument shape/dtype signature.  A
+  compile whose signature EQUALS the previous compile's at the same
+  site is the classic unhashable-static / weak-type storm: nothing
+  about the arguments changed, yet XLA compiled again;
+* ``jax.block_until_ready`` and ``jax.device_get`` are wrapped the
+  same way into a per-call-site host-sync ledger (the runtime shadow
+  of lint rule RT018).
+
+Everything past the first compile per site counts as a *recompile*;
+a site whose recompiles exceed the budget (``RAY_TPU_XLASAN_BUDGET``,
+default 2) is a *storm*.  Reports: each process dumps its ledger to
+``<xlasan_dir>/<pid>.json`` (atexit, plus on demand);
+``merged_report()`` — surfaced as ``ray_tpu.util.state.
+xlasan_report()`` and the ``ray_tpu xlasan`` CLI (exit 1 on storms) —
+merges the directory with in-process state.  The doctor turns the
+same data plus the metrics-history ring into RECOMPILE_STORM /
+HOST_SYNC_HOT_LOOP findings.
+
+Metrics: ``ray_tpu_xla_recompiles_total{site}`` counts recompiles
+(everything beyond a site's first compile);
+``ray_tpu_xla_compile_seconds`` observes every compile's wall time.
+PR-13 telemetry drains ``take_recent_compiles()`` to attribute its
+``compile`` goodput class to construction sites.
+
+Tests can use the module un-installed via ``enable_for_testing()``
+(which DOES patch jax.jit, reversibly) and ``reset()`` between cases.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+ENV_FLAG = "RAY_TPU_XLASAN"
+ENV_DIR = "RAY_TPU_XLASAN_DIR"
+ENV_BUDGET = "RAY_TPU_XLASAN_BUDGET"
+DEFAULT_DIR = "/tmp/ray_tpu_xlasan"
+DEFAULT_BUDGET = 2
+
+_MAX_DELTAS = 8          # per-site ring of recent signature changes
+_MAX_RECENT = 256        # un-drained compile events for telemetry
+_MAX_SYNC_SITES = 500
+
+_ENABLED = os.environ.get(ENV_FLAG, "").strip().lower() in (
+    "1", "true", "yes", "on")
+
+# Ledger state, guarded by a raw lock (sanitizers must not depend on
+# each other's instrumentation).
+_state_lock = threading.Lock()
+_sites: Dict[str, dict] = {}         # site -> record (see _site_rec)
+_sync_sites: Dict[str, dict] = {}    # site -> {kind, count, seconds}
+_recent: List[Tuple[str, float]] = []   # (site, seconds) for telemetry
+_dump_registered = False
+_installed = False
+_orig_jit = None
+_orig_block = None
+_orig_device_get = None
+
+_metrics: Optional[tuple] = None     # (recompiles_counter, compile_hist)
+_metrics_state = 0                   # 0 unbuilt / 1 building / 2 ready
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def budget() -> int:
+    raw = os.environ.get(ENV_BUDGET, "").strip()
+    try:
+        return int(raw) if raw else DEFAULT_BUDGET
+    except ValueError:
+        return DEFAULT_BUDGET
+
+
+def _creation_site(depth: int = 2) -> str:
+    """file:line of the instrumented caller — the first frame outside
+    this module."""
+    f = sys._getframe(depth)
+    here = __file__
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != here:
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _metric_sinks() -> Optional[tuple]:
+    """(recompiles_counter, compile_histogram), built lazily with the
+    single-builder gate locksan/leaksan use (metric constructors start
+    the flusher thread whose first op could re-enter here)."""
+    global _metrics, _metrics_state
+    if _metrics_state == 2:
+        return _metrics
+    with _state_lock:
+        if _metrics_state != 0:
+            return None
+        _metrics_state = 1
+    try:
+        from ray_tpu.util import metrics as um
+        rec = um.shared_counter(
+            um.XLA_RECOMPILES_METRIC,
+            "XLA recompiles beyond each jit site's first compile, by "
+            "construction site (file:line)",
+            tag_keys=("site",))
+        hist = um.shared_histogram(
+            um.XLA_COMPILE_SECONDS_METRIC,
+            "wall time of XLA trace+compile events the xlasan wrapper "
+            "observed",
+            boundaries=um.XLA_COMPILE_BUCKETS)
+        _metrics = (rec, hist)
+        _metrics_state = 2
+        return _metrics
+    except Exception:
+        _metrics_state = 0      # transient (mid-import): retry later
+        return None
+
+
+def _count_recompile(site: str, seconds: float) -> None:
+    sinks = _metric_sinks()
+    if sinks is not None:
+        try:
+            sinks[0].inc(1, tags={"site": site})
+            sinks[1].observe(seconds)
+        except Exception:
+            pass
+
+
+def _observe_compile(seconds: float) -> None:
+    sinks = _metric_sinks()
+    if sinks is not None:
+        try:
+            sinks[1].observe(seconds)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# argument signatures
+# ---------------------------------------------------------------------------
+def _leaf_sig(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}{tuple(shape)}"
+    if isinstance(x, (bool, int, float, str, bytes, type(None))):
+        r = repr(x)
+        return r if len(r) <= 32 else f"{type(x).__name__}<{len(r)}>"
+    return type(x).__name__
+
+
+def _arg_signature(args: tuple, kwargs: dict) -> str:
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+    except Exception:
+        leaves = list(args) + list(kwargs.values())
+    parts = [_leaf_sig(v) for v in leaves[:64]]
+    if len(leaves) > 64:
+        parts.append(f"...+{len(leaves) - 64}")
+    # "|" separator: shape tuples like float32(1,) contain commas.
+    return "|".join(parts)
+
+
+def _sig_delta(prev: Optional[str], cur: str) -> str:
+    if prev is None:
+        return "first compile"
+    if prev == cur:
+        return ("same arg shapes/dtypes as previous compile — "
+                "unhashable static arg or weak-type churn")
+    a, b = prev.split("|"), cur.split("|")
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x != y:
+            return f"leaf {i}: {x} -> {y}"
+    return f"arity {len(a)} -> {len(b)}"
+
+
+# ---------------------------------------------------------------------------
+# the jit wrapper
+# ---------------------------------------------------------------------------
+def _site_rec(site: str, label: str) -> dict:
+    rec = _sites.get(site)
+    if rec is None:
+        rec = _sites[site] = {
+            "label": label, "calls": 0, "compiles": 0,
+            "seconds": 0.0, "last_sig": None, "deltas": [],
+        }
+    return rec
+
+
+def _record_call(site: str, label: str, compiled: bool,
+                 seconds: float, sig: str) -> None:
+    with _state_lock:
+        rec = _site_rec(site, label)
+        rec["calls"] += 1
+        if not compiled:
+            return
+        rec["compiles"] += 1
+        rec["seconds"] += seconds
+        delta = _sig_delta(rec["last_sig"], sig)
+        rec["last_sig"] = sig
+        if len(rec["deltas"]) >= _MAX_DELTAS:
+            rec["deltas"].pop(0)
+        rec["deltas"].append(delta)
+        recompile = rec["compiles"] > 1
+        if len(_recent) < _MAX_RECENT:
+            _recent.append((site, seconds))
+    if recompile:
+        _count_recompile(site, seconds)
+    else:
+        _observe_compile(seconds)
+
+
+class _TrackedFunction:
+    """Callable proxy around a pjit function: detects compiles by
+    cache growth, charges their wall time to the construction site.
+    Attribute access (lower/ trace/ clear_cache/ _cache_size...)
+    forwards to the real pjit function, so CompiledTrainStep and
+    telemetry's register_jit keep working on a tracked fn."""
+
+    __slots__ = ("_fn", "_site", "_label")
+
+    def __init__(self, fn, site: str, label: str):
+        self._fn = fn
+        self._site = site
+        self._label = label
+
+    def __call__(self, *args, **kwargs):
+        try:
+            before = self._fn._cache_size()
+        except Exception:
+            before = -1
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        try:
+            after = self._fn._cache_size()
+        except Exception:
+            after = -1
+        compiled = before >= 0 and after > before
+        _record_call(self._site, self._label, compiled, dt,
+                     _arg_signature(args, kwargs) if compiled else "")
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def __repr__(self):
+        return f"<xlasan-tracked {self._label} @ {self._site}>"
+
+
+def _tracking_jit(fun=None, **kwargs):
+    site = _creation_site()
+    if fun is None:
+        # jax.jit(static_argnames=...) partial form.
+        def partial_jit(f):
+            return _TrackedFunction(
+                _orig_jit(f, **kwargs), site,
+                getattr(f, "__name__", repr(f)))
+        return partial_jit
+    return _TrackedFunction(_orig_jit(fun, **kwargs), site,
+                            getattr(fun, "__name__", repr(fun)))
+
+
+def _note_sync(kind: str, seconds: float, site: str) -> None:
+    with _state_lock:
+        rec = _sync_sites.get(site)
+        if rec is None:
+            if len(_sync_sites) >= _MAX_SYNC_SITES:
+                return
+            rec = _sync_sites[site] = {"kind": kind, "count": 0,
+                                       "seconds": 0.0}
+        rec["count"] += 1
+        rec["seconds"] += seconds
+
+
+def _tracking_block_until_ready(x):
+    site = _creation_site()
+    t0 = time.perf_counter()
+    out = _orig_block(x)
+    _note_sync("block_until_ready", time.perf_counter() - t0, site)
+    return out
+
+
+def _tracking_device_get(x):
+    site = _creation_site()
+    t0 = time.perf_counter()
+    out = _orig_device_get(x)
+    _note_sync("device_get", time.perf_counter() - t0, site)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# install / uninstall
+# ---------------------------------------------------------------------------
+def install() -> bool:
+    """Patch jax.jit / block_until_ready / device_get and arm the
+    atexit dump (idempotent).  Called from ray_tpu/__init__ when
+    RAY_TPU_XLASAN is set.  Returns False when jax is unavailable."""
+    global _ENABLED, _installed, _dump_registered
+    global _orig_jit, _orig_block, _orig_device_get
+    _ENABLED = True
+    if _installed:
+        return True
+    try:
+        import jax
+    except Exception:
+        return False
+    _orig_jit = jax.jit
+    _orig_block = jax.block_until_ready
+    _orig_device_get = jax.device_get
+    jax.jit = _tracking_jit
+    jax.block_until_ready = _tracking_block_until_ready
+    jax.device_get = _tracking_device_get
+    _installed = True
+    if not _dump_registered:
+        _dump_registered = True
+        atexit.register(dump)
+    return True
+
+
+def uninstall() -> None:
+    """Restore the real jax entry points (test isolation)."""
+    global _ENABLED, _installed
+    if _installed:
+        import jax
+        jax.jit = _orig_jit
+        jax.block_until_ready = _orig_block
+        jax.device_get = _orig_device_get
+        _installed = False
+    _ENABLED = False
+
+
+def enable_for_testing() -> None:
+    """install() without the atexit dump — patches are applied so the
+    drill actually observes compiles; pair with disable_for_testing()
+    (which unpatches) in a finally."""
+    global _dump_registered
+    before = _dump_registered
+    _dump_registered = True      # suppress atexit arming
+    try:
+        install()
+    finally:
+        _dump_registered = before
+
+
+def disable_for_testing() -> None:
+    uninstall()
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+def take_recent_compiles() -> List[Tuple[str, float]]:
+    """Drain (site, seconds) compile events since the last drain —
+    telemetry's per-step `compile` goodput attribution."""
+    with _state_lock:
+        out = list(_recent)
+        _recent.clear()
+    return out
+
+
+def report() -> dict:
+    """This process's ledger as a plain dict."""
+    b = budget()
+    with _state_lock:
+        sites = {
+            s: {"label": r["label"], "calls": r["calls"],
+                "compiles": r["compiles"],
+                "recompiles": max(0, r["compiles"] - 1),
+                "seconds": round(r["seconds"], 6),
+                "deltas": list(r["deltas"])}
+            for s, r in _sites.items()}
+        syncs = {s: dict(r) for s, r in _sync_sites.items()}
+    return {
+        "pid": os.getpid(),
+        "argv": " ".join(sys.argv[:3]),
+        "enabled": _ENABLED,
+        "budget": b,
+        "sites": sites,
+        "syncs": syncs,
+        "storms": sorted(s for s, r in sites.items()
+                         if r["recompiles"] > b),
+    }
+
+
+def report_dir() -> str:
+    d = os.environ.get(ENV_DIR, "").strip()
+    if not d:
+        try:
+            from ray_tpu._private.config import config
+            d = config.xlasan_dir
+        except Exception:
+            d = ""
+    return d or DEFAULT_DIR
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """Write this process's ledger (atomically) for the merger; no-op
+    when nothing was ever tracked."""
+    rep = report()
+    if not rep["sites"] and not rep["syncs"]:
+        return None
+    if path is None:
+        d = report_dir()
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            return None
+        path = os.path.join(d, f"{os.getpid()}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(rep, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def merged_report(directory: Optional[str] = None) -> dict:
+    """Merge every per-process ledger in `directory` (default: the
+    ambient xlasan dir) with the live in-process state.  `storms` are
+    sites whose merged recompile count exceeds the budget."""
+    directory = directory or report_dir()
+    reports: List[dict] = []
+    if os.path.isdir(directory):
+        for name in sorted(os.listdir(directory)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(directory, name),
+                          encoding="utf-8") as f:
+                    reports.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+    mine = report()
+    if mine["sites"] or mine["syncs"]:
+        reports = [r for r in reports if r.get("pid") != mine["pid"]]
+        reports.append(mine)
+    b = budget()
+    merged: Dict[str, Any] = {
+        "processes": len(reports),
+        "budget": b,
+        "sites": {},
+        "syncs": {},
+        "storms": [],
+    }
+    for r in reports:
+        for site, rec in (r.get("sites") or {}).items():
+            m = merged["sites"].setdefault(
+                site, {"label": rec.get("label", "?"), "calls": 0,
+                       "compiles": 0, "recompiles": 0, "seconds": 0.0,
+                       "deltas": []})
+            m["calls"] += rec.get("calls", 0)
+            m["compiles"] += rec.get("compiles", 0)
+            m["recompiles"] += rec.get("recompiles", 0)
+            m["seconds"] = round(m["seconds"]
+                                 + rec.get("seconds", 0.0), 6)
+            m["deltas"] = (m["deltas"]
+                           + list(rec.get("deltas", [])))[-_MAX_DELTAS:]
+        for site, rec in (r.get("syncs") or {}).items():
+            m = merged["syncs"].setdefault(
+                site, {"kind": rec.get("kind", "?"), "count": 0,
+                       "seconds": 0.0})
+            m["count"] += rec.get("count", 0)
+            m["seconds"] = round(m["seconds"]
+                                 + rec.get("seconds", 0.0), 6)
+    merged["storms"] = sorted(
+        s for s, m in merged["sites"].items() if m["recompiles"] > b)
+    merged["compiles"] = sum(m["compiles"]
+                             for m in merged["sites"].values())
+    merged["recompiles"] = sum(m["recompiles"]
+                               for m in merged["sites"].values())
+    return merged
+
+
+def reset() -> None:
+    """Drop all in-process state (test isolation)."""
+    with _state_lock:
+        _sites.clear()
+        _sync_sites.clear()
+        _recent.clear()

@@ -22,6 +22,7 @@ parquet through ray_tpu.data (rllib/offline/dataset_reader.py role).
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -119,7 +120,8 @@ def make_cql_update_fn(actor_opt, critic_opt, alpha_opt, gamma: float,
                 * (jax.lax.stop_gradient(logp)
                    + target_entropy)).mean()
 
-    @jax.jit
+    # Donate the carried learner state the caller rebinds (RT020).
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def update(state, data, rng):
         n = data["obs"].shape[0]
 

@@ -11,6 +11,7 @@ hard target-net sync every `target_update_interval` train calls.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -249,7 +250,9 @@ def make_update_fn(optimizer, gamma: float, num_grad_steps: int,
             per = per * w        # prioritized-replay IS correction
         return per.mean()
 
-    @jax.jit
+    # Donate the rebound (params, opt_state); target_params is
+    # reused across updates and must NOT be donated (RT020).
+    @functools.partial(jax.jit, donate_argnums=(0, 2))
     def update(params, target_params, opt_state, data, rng):
         n = data["obs"].shape[0]
 
@@ -327,7 +330,10 @@ class DQN(RLCheckpointMixin):
                                   CartPoleEnv.observation_size,
                                   CartPoleEnv.num_actions,
                                   hidden=config.hidden)
-        self.target_params = self.params   # arrays are immutable
+        # Distinct buffers, not an alias: update() donates params, and
+        # a donated buffer must not also arrive as target_params.
+        self.target_params = jax.tree.map(lambda x: x.copy(),
+                                          self.params)
         self.optimizer = optax.adam(config.lr)
         self.opt_state = self.optimizer.init(self.params)
         self._update, self._td_fn = make_update_fn(
@@ -407,7 +413,9 @@ class DQN(RLCheckpointMixin):
                 self.buffer.update_priorities(slab_ix, td)
         self.iteration += 1
         if self.iteration % self.config.target_update_interval == 0:
-            self.target_params = self.params   # arrays are immutable
+            # Copy, don't alias: params is donated on the next update.
+            self.target_params = jax.tree.map(lambda x: x.copy(),
+                                              self.params)
         steps = sum(len(s["actions"]) for s in samples)
         return {
             "training_iteration": self.iteration,

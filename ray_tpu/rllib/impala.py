@@ -215,10 +215,12 @@ class VTraceRolloutWorker:
             logp = jax.nn.log_softmax(logits)[
                 jnp.arange(N), action]
             obs_buf[t] = self.obs
-            act_buf[t] = np.asarray(action)
-            logp_buf[t] = np.asarray(logp)
+            # The env boundary is a deliberate per-step device fence:
+            # env.step needs host arrays.
+            act_buf[t] = np.asarray(action)    # ray-tpu: fence
+            logp_buf[t] = np.asarray(logp)     # ray-tpu: fence
             self.obs, rew_buf[t], done_buf[t] = self.vec.step(
-                np.asarray(action))
+                np.asarray(action))            # ray-tpu: fence
         return {"obs": obs_buf, "actions": act_buf, "logp": logp_buf,
                 "rewards": rew_buf, "dones": done_buf,
                 "last_obs": self.obs.astype(np.float32),
@@ -362,7 +364,9 @@ class IMPALA(RLCheckpointMixin):
                 self.params, self.opt_state, jb)
             self.updates += 1
             if self.updates % self.config.broadcast_every == 0:
-                host = jax.device_get(self.params)
+                # Deliberate fence: the broadcast ships host
+                # arrays to the rollout workers.
+                host = jax.device_get(self.params)  # ray-tpu: fence
                 pref = ray_tpu.put(host)
                 for w in self.workers:
                     # fire-and-forget param broadcast
@@ -386,7 +390,8 @@ class IMPALA(RLCheckpointMixin):
             "learner_steps_per_s": round(steps / max(wall, 1e-9), 1),
             "updates_per_s": round(needed / max(wall, 1e-9), 2),
             "wall_s": round(wall, 2),
-            **{k: float(v) for k, v in metrics.items()},
+            **{k: float(v)
+               for k, v in jax.device_get(metrics).items()},
         }
 
     def stop(self) -> None:

@@ -155,7 +155,8 @@ class MultiAgentWorker:
                                      for e, aid in lanes])
                 acts = act(pid, lane_obs, t)
                 for (e, aid), a in zip(lanes, acts):
-                    actions_by_env[e][aid] = int(a)
+                    # env.step takes host ints — deliberate fence.
+                    actions_by_env[e][aid] = int(a)  # ray-tpu: fence
             for e, env in enumerate(self.envs):
                 obs, rews, dones, _ = env.step(actions_by_env[e])
                 self.obs[e] = obs
@@ -167,7 +168,7 @@ class MultiAgentWorker:
             lane_obs = np.stack([self.obs[e][aid] for e, aid in lanes])
             _, value = self._infer(policy_params[pid],
                                    jnp.asarray(lane_obs))
-            out[pid]["values"][T] = np.asarray(value)
+            out[pid]["values"][T] = np.asarray(value)  # ray-tpu: fence
         returns = []
         for env in self.envs:
             returns.extend(env.drain_episode_returns())
@@ -266,8 +267,7 @@ class MultiAgentPPO:
         import jax.numpy as jnp
 
         t0 = time.time()
-        params_ref = ray_tpu.put(
-            {pid: jax.device_get(p) for pid, p in self.params.items()})
+        params_ref = ray_tpu.put(jax.device_get(self.params))
         samples = ray_tpu.get([w.sample.remote(params_ref)
                                for w in self.workers])
         episode_returns = []
@@ -291,7 +291,11 @@ class MultiAgentPPO:
             self.params[pid], self.opt_states[pid], m = \
                 self._updates[pid](self.params[pid],
                                    self.opt_states[pid], rollout, key)
-            metrics[pid] = {k: float(v) for k, v in m.items()}
+            metrics[pid] = m
+        # One device_get for every policy's metrics after the update
+        # loop, instead of a sync per policy inside it (RT018).
+        metrics = {pid: {k: float(v) for k, v in md.items()}
+                   for pid, md in jax.device_get(metrics).items()}
         self.iteration += 1
         steps = sum(p["actions"].size for s in samples
                     for p in s["per_policy"].values())

@@ -16,6 +16,7 @@ offline path).
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -79,7 +80,9 @@ def make_bc_update_fn(optimizer, batch_size: int, num_grad_steps: int):
             axis=1)[:, 0]
         return nll.mean()
 
-    @jax.jit
+    # Donate the rebound state: without donation both parameter
+    # generations stay live across the update (RT020).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def update(params, opt_state, data, rng):
         n = data["obs"].shape[0]
 
@@ -128,7 +131,7 @@ def make_marwil_update_fn(optimizer, batch_size: int,
         critic = (adv ** 2).mean()
         return actor + vf_coef * critic, (actor, critic)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def update(params, opt_state, data, rng):
         n = data["obs"].shape[0]
 
@@ -345,11 +348,11 @@ class BC(RLCheckpointMixin):
             self._rng, key = jax.random.split(self._rng)
             self.params, self.opt_state, loss = self._update(
                 self.params, self.opt_state, data, key)
-            losses.append(float(loss))
+            losses.append(loss)
         self.iteration += 1
         return {"training_iteration": self.iteration,
-                "loss": float(np.mean(losses)) if losses else
-                float("nan"),
+                "loss": (float(jnp.mean(jnp.stack(losses)))
+                         if losses else float("nan")),
                 "rows_this_iter": rows,
                 "time_this_iter_s": time.time() - t0}
 

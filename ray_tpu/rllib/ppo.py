@@ -100,11 +100,13 @@ class RolloutWorker:
             logp = jax.nn.log_softmax(logits)[
                 jnp.arange(N), action]
             obs_buf[t] = self.obs
-            act_buf[t] = np.asarray(action)
-            logp_buf[t] = np.asarray(logp)
-            val_buf[t] = np.asarray(value)
+            # The env boundary is a deliberate per-step device fence:
+            # env.step needs host arrays.
+            act_buf[t] = np.asarray(action)    # ray-tpu: fence
+            logp_buf[t] = np.asarray(logp)     # ray-tpu: fence
+            val_buf[t] = np.asarray(value)     # ray-tpu: fence
             self.obs, rew_buf[t], done_buf[t] = self.vec.step(
-                np.asarray(action))
+                np.asarray(action))            # ray-tpu: fence
         _, last_val = self._infer(params, jnp.asarray(self.obs))
         val_buf[T] = np.asarray(last_val)
         return {"obs": obs_buf, "actions": act_buf, "logp": logp_buf,
@@ -155,7 +157,8 @@ def make_update_fn(optimizer, clip: float, vf_coef: float,
         total = pg + vf_coef * vf - ent_coef * ent
         return total, {"pg_loss": pg, "vf_loss": vf, "entropy": ent}
 
-    @jax.jit
+    # Donate the rebound (params, opt_state) (RT020).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def update(params, opt_state, rollout, rng):
         rewards = rollout["rewards"]
         advs = gae(rewards, rollout["values"], rollout["dones"])
@@ -318,7 +321,8 @@ class PPO(RLCheckpointMixin):
             "episodes_this_iter": len(episode_returns),
             "timesteps_this_iter": steps,
             "time_this_iter_s": time.time() - t0,
-            **{k: float(v) for k, v in metrics.items()},
+            **{k: float(v)
+               for k, v in jax.device_get(metrics).items()},
         }
 
     def compute_action(self, obs: np.ndarray) -> int:
@@ -343,7 +347,8 @@ class PPO(RLCheckpointMixin):
             obs, total, done = env.reset(), 0.0, False
             while not done:
                 logits, _ = infer(self.params, jnp.asarray(obs[None]))
-                obs, r, done, _ = env.step(int(jnp.argmax(logits[0])))
+                obs, r, done, _ = env.step(
+                    int(jnp.argmax(logits[0])))  # ray-tpu: fence
                 total += r
             returns.append(total)
         return {"evaluation_reward_mean": float(np.mean(returns))}

@@ -17,6 +17,7 @@ critics — so the whole learner phase is a single XLA program.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -133,7 +134,7 @@ class SACWorker:
         for _ in range(T):
             if uniform_random:       # warmup: cover the action space
                 self.rng, key = jax.random.split(self.rng)
-                action = np.asarray(jax.random.uniform(
+                action = np.asarray(jax.random.uniform(  # ray-tpu: fence
                     key, (N, self.vec.envs[0].action_size),
                     minval=-self._action_scale,
                     maxval=self._action_scale))
@@ -198,7 +199,8 @@ def make_update_fn(actor_opt, critic_opt, alpha_opt, gamma: float,
                 * (jax.lax.stop_gradient(logp)
                    + target_entropy)).mean()
 
-    @jax.jit
+    # Donate the carried learner state the caller rebinds (RT020).
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def update(state, data, rng):
         n = data["obs"].shape[0]
 
@@ -296,7 +298,10 @@ class SAC(RLCheckpointMixin):
                           hidden=c.hidden)
         self.actor = params["actor"]
         self.qs = {"q1": params["q1"], "q2": params["q2"]}
-        self.target_qs = self.qs        # arrays are immutable
+        # Distinct buffers, not an alias: the jitted update donates the
+        # whole learner-state tuple, and a donated qs leaf must not
+        # also arrive as a target_qs leaf in the same call.
+        self.target_qs = jax.tree.map(lambda x: x.copy(), self.qs)
         self.log_alpha = params["log_alpha"]
         self.actor_opt = optax.adam(c.actor_lr)
         self.critic_opt = optax.adam(c.critic_lr)

@@ -20,15 +20,18 @@ status`) + `ray list/summary` (util/state CLI) + `ray job` (job CLI).
     metrics                   Prometheus text from the head
     job {submit,status,logs,list,stop}
     microbench                core-runtime perf harness
-    lint <path>...            static analysis (RT001-RT016) for
+    lint <path>...            static analysis (RT001-RT020) for
                               remote/actor/sharding/concurrency/
-                              lifecycle code (--lock-graph dumps the
-                              lock-order graph; --changed lints only
-                              git-modified files)
+                              lifecycle/XLA code (--lock-graph dumps
+                              the lock-order graph; --changed lints
+                              only git-modified files)
     locksan                   merged runtime lock-sanitizer report
                               from a RAY_TPU_LOCKSAN=1 run
     leaksan                   merged resource-leak ledger from a
                               RAY_TPU_LEAKSAN=1 run (exit 1 on leaks)
+    xlasan                    merged XLA recompile/host-sync ledger
+                              from a RAY_TPU_XLASAN=1 run (exit 1 on
+                              recompile storms over budget)
     doctor                    cluster health triage: GCS liveness/WAL,
                               stalls, slow RPCs, leak suspects,
                               event-ring drops, serve shedding, train
@@ -754,6 +757,54 @@ def cmd_leaksan(args) -> int:
     return 1 if bad else 0
 
 
+def cmd_xlasan(args) -> int:
+    """Merged XLA recompile/host-sync ledger (devtools/xlasan.py).
+    Run the workload with RAY_TPU_XLASAN=1 first; every process drops
+    a <pid>.json ledger into the xlasan dir at exit.  Exit 1 when any
+    jit site recompiled past the budget (--budget overrides
+    RAY_TPU_XLASAN_BUDGET), 0 on a clean run."""
+    from ray_tpu.devtools import xlasan
+    rep = xlasan.merged_report(args.dir)
+    budget = args.budget if args.budget is not None \
+        else rep.get("budget", xlasan.DEFAULT_BUDGET)
+    storms = sorted(s for s, m in rep["sites"].items()
+                    if m["recompiles"] > budget)
+    rep["budget"], rep["storms"] = budget, storms
+    if args.json:
+        print(json.dumps(rep, indent=1, default=str))
+        return 1 if storms else 0
+    print(f"xlasan report ({rep['processes']} process(es), "
+          f"{rep['compiles']} compile(s) / {rep['recompiles']} "
+          f"recompile(s), budget {budget}, dir "
+          f"{args.dir or xlasan.report_dir()})")
+    if not rep["processes"]:
+        print("no ledgers found — run the workload with "
+              "RAY_TPU_XLASAN=1")
+        return 0
+    ordered = sorted(rep["sites"].items(),
+                     key=lambda kv: (-kv[1]["recompiles"],
+                                     -kv[1]["seconds"]))
+    print("\njit sites (calls / compiles / recompiles / compile-s):")
+    for site, m in ordered[:20]:
+        mark = "  STORM" if site in storms else ""
+        print(f"  {m['calls']:>7} {m['compiles']:>5} "
+              f"{m['recompiles']:>5} {m['seconds']:>9.3f}  "
+              f"{m['label']} @ {site}{mark}")
+        if site in storms:
+            for d in m["deltas"][-3:]:
+                print(f"      {d}")
+    syncs = sorted(rep["syncs"].items(),
+                   key=lambda kv: -kv[1]["count"])
+    print(f"\nhost-sync sites: {len(syncs)}")
+    for site, m in syncs[:10]:
+        print(f"  x{m['count']:<7} {m['seconds']:>9.3f}s  "
+              f"{m['kind']} @ {site}")
+    if storms:
+        print(f"\nRECOMPILE STORMS ({len(storms)} site(s) over "
+              f"budget {budget}) — fix the static/arg churn above")
+    return 1 if storms else 0
+
+
 def cmd_drain(args) -> int:
     """Gracefully drain one node (reference: `ray drain-node`): the
     GCS flips it alive -> draining and the node hands back queued
@@ -1348,6 +1399,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "leaksan dir)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_leaksan)
+
+    p = sub.add_parser(
+        "xlasan",
+        help="merged XLA recompile/host-sync ledger (per-jit-site "
+             "compile counts, arg-shape deltas, storm verdicts) from "
+             "a RAY_TPU_XLASAN=1 run")
+    p.add_argument("--dir", default=None,
+                   help="ledger directory (default: the ambient "
+                        "xlasan dir)")
+    p.add_argument("--budget", type=int, default=None,
+                   help="recompiles allowed per jit site before it "
+                        "counts as a storm (default: "
+                        "RAY_TPU_XLASAN_BUDGET or 2)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_xlasan)
 
     # The rule-table epilog imports + registers the whole lint rule
     # set; only `ray_tpu lint -h` ever renders a subparser epilog, so

@@ -185,6 +185,7 @@ class _DeviceStepTimer:
         jit1 = self._tel._jit_cache_size()
         compiled = self._jit0 >= 0 and jit1 > self._jit0
         self._tel._add_phase("compile" if compiled else "step", dt)
+        self._tel._note_compile_sites()
         if self._tokens is not None:
             self._tel._note_tokens(self._tokens)
 
@@ -254,6 +255,9 @@ class TrainTelemetry:
         self._phase_totals: Dict[str, float] = {p: 0.0 for p in PHASES}
         self._ledger: Dict[str, float] = {c: 0.0
                                           for c in LEDGER_CLASSES}
+        # Per-jit-site compile seconds (xlasan attribution): which
+        # construction site the run's `compile` ledger class went to.
+        self._compile_sites: Dict[str, float] = {}
         self._window: deque = deque(
             maxlen=max(int(config.train_telemetry_window), 8))
         self._step_index = 0
@@ -347,6 +351,8 @@ class TrainTelemetry:
         for c, v in (snap.get("ledger") or {}).items():
             if c in self._ledger:
                 self._ledger[c] = float(v)
+        for s, v in (snap.get("compile_sites") or {}).items():
+            self._compile_sites[s] = float(v)
         self._step_index = int(snap.get("step_index") or 0)
         self._restarts = int(snap.get("restarts") or 0) + 1
         self._t0 = float(snap.get("t0") or self._t0)
@@ -485,6 +491,25 @@ class TrainTelemetry:
         with self._lock:
             self._cur[phase] = self._cur.get(phase, 0.0) + dt
 
+    def _note_compile_sites(self) -> None:
+        """With the xlasan wrapper installed, drain its (site,
+        seconds) compile events into this run's attribution map — the
+        `compile` goodput class broken down by jit construction
+        site."""
+        try:
+            from ray_tpu.devtools import xlasan
+            if not xlasan.enabled():
+                return
+            events = xlasan.take_recent_compiles()
+        except Exception:
+            return
+        if not events:
+            return
+        with self._lock:
+            for site, secs in events:
+                self._compile_sites[site] = (
+                    self._compile_sites.get(site, 0.0) + secs)
+
     def _note_tokens(self, tokens: int) -> None:
         with self._lock:
             self._cur_tokens = (self._cur_tokens or 0) + int(tokens)
@@ -554,6 +579,8 @@ class TrainTelemetry:
                        for p, v in self._phase_totals.items()},
             "ledger": {c: round(v, 6)
                        for c, v in self._ledger.items()},
+            "compile_sites": {s: round(v, 6)
+                              for s, v in self._compile_sites.items()},
             "tokens_per_s": tokens_rate,
             "mfu": self._mfu_locked(tokens_rate),
             "flops_per_token": self._flops_per_token,
@@ -913,6 +940,7 @@ def summarize_run(meta: Dict[str, Any],
     mfus: List[float] = []
     restarts = 0
     step_samples: List[float] = []
+    compile_sites: Dict[str, float] = {}
     for snap in snaps.values():
         for p, v in (snap.get("phases") or {}).items():
             if p in phases:
@@ -920,6 +948,8 @@ def summarize_run(meta: Dict[str, Any],
         for c, v in (snap.get("ledger") or {}).items():
             if c in ledger:
                 ledger[c] += float(v)
+        for s, v in (snap.get("compile_sites") or {}).items():
+            compile_sites[s] = compile_sites.get(s, 0.0) + float(v)
         wall = max(wall, float(snap.get("wall_s") or 0.0))
         step_index = max(step_index,
                          int(snap.get("step_index") or 0))
@@ -965,6 +995,12 @@ def summarize_run(meta: Dict[str, Any],
             str(r): v
             for r, v in straggler_verdicts(snaps).items()},
     }
+    if compile_sites:
+        # xlasan attribution: the `compile` ledger class broken down
+        # by jit construction site, gang-summed, costliest first.
+        out["compile_sites"] = {
+            s: round(v, 6) for s, v in sorted(
+                compile_sites.items(), key=lambda kv: -kv[1])}
     out.update(_bound_verdict(phases))
     if captures:
         out["straggler_captures"] = {

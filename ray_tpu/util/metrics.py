@@ -159,6 +159,18 @@ LOCK_WAIT_BUCKETS = (0.00001, 0.0001, 0.001, 0.01, 0.05, 0.25, 1.0,
 RESOURCES_LIVE_METRIC = "ray_tpu_resources_live"
 RESOURCE_LEAKS_METRIC = "ray_tpu_resource_leaks_total"
 
+# XLA-compilation sanitizer (devtools/xlasan.py, enabled with
+# RAY_TPU_XLASAN=1).  recompiles_total counts cache-growth events
+# BEYOND a site's first compile (the first trace is the price of
+# admission; every one after it is a storm candidate), tagged by the
+# jit construction site (file:line).  compile_seconds observes every
+# compile's wall time — untagged, one distribution per process;
+# per-site cumulative seconds live in the xlasan ledger.
+XLA_RECOMPILES_METRIC = "ray_tpu_xla_recompiles_total"
+XLA_COMPILE_SECONDS_METRIC = "ray_tpu_xla_compile_seconds"
+XLA_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0,
+                       600.0)
+
 # Inter-node object-transfer plane, auto-recorded node-side.
 # bytes_total tags: direction = in | out.  seconds tags: path =
 # stream (windowed binary plane) | multi (range-split, several

@@ -238,6 +238,26 @@ def leaksan_report(directory: Optional[str] = None) -> Dict[str, Any]:
     return leaksan.merged_report(directory)
 
 
+def xlasan_report(directory: Optional[str] = None) -> Dict[str, Any]:
+    """Merged XLA recompile/host-sync ledger (devtools/xlasan.py).
+
+    Requires running the workload with ``RAY_TPU_XLASAN=1``: every
+    process (driver, workers — the env var inherits) wraps ``jax.jit``
+    so each jit construction site accumulates compile count, wall
+    seconds, and argument shape/dtype deltas, and wraps
+    ``jax.block_until_ready``/``jax.device_get`` into a host-sync
+    ledger; each process drops a ``<pid>.json`` into the xlasan dir at
+    exit and this merges them with the calling process's live state.
+    Keys: ``processes``, ``budget``, ``sites`` (construction site ->
+    {label, calls, compiles, recompiles, seconds, deltas}), ``syncs``
+    (call site -> {kind, count, seconds}), and ``storms`` — sites
+    whose recompile count exceeds the budget
+    (``RAY_TPU_XLASAN_BUDGET``, default 2).  Like the other sanitizer
+    reports, this needs no initialized runtime."""
+    from ray_tpu.devtools import xlasan
+    return xlasan.merged_report(directory)
+
+
 def train_summary(run: Optional[str] = None) -> Dict[str, Any]:
     """Training telemetry rollup (train/telemetry.py): per-run step
     decomposition, live MFU/goodput, and straggler verdicts.
@@ -463,7 +483,8 @@ def metric_history(name: Optional[str] = None,
 
 
 def doctor(leak_min_age_s: float = 60.0,
-           gcs_stale_s: float = 15.0) -> Dict[str, Any]:
+           gcs_stale_s: float = 15.0,
+           sync_hot_count: int = 100) -> Dict[str, Any]:
     """Cluster health triage: one call that fuses the control-plane
     signals (GCS liveness + WAL health, node reachability, stall
     sentinel, slow-RPC captures, leak suspects, event-ring drops,
@@ -488,7 +509,13 @@ def doctor(leak_min_age_s: float = 60.0,
       gcs_wal_compact_ops), LOCK_CONTENTION (locksan witnessed a
       lock-order inversion), SERVE_SHEDDING (admission control shed
       requests), TRAIN_GOODPUT_LOW (productive fraction of an
-      instrumented run's wall clock below 50%).
+      instrumented run's wall clock below 50%), RECOMPILE_STORM (an
+      xlasan jit site recompiled past its budget — from the merged
+      ledger, with the ``ray_tpu_xla_recompiles_total`` metrics-
+      history ring as fallback for processes that died before their
+      dump), HOST_SYNC_HOT_LOOP (an xlasan-witnessed
+      block_until_ready/device_get call site fired at least
+      `sync_hot_count` times — a per-iteration host fence).
 
     Probes run independently — one failing (its subsystem not in use,
     its sanitizer not enabled) records a probe error and the rest
@@ -654,6 +681,61 @@ def doctor(leak_min_age_s: float = 60.0,
                 "detail": {"requests_shed": shed}})
     except Exception as exc:   # noqa: BLE001
         probe_errors.append({"probe": "serve", "error": repr(exc)})
+
+    # -- XLA recompile storms / hot host syncs (RAY_TPU_XLASAN=1) ------
+    _probe("xlasan")
+    try:
+        rep = xlasan_report()
+        storm_detail = {
+            s: rep["sites"][s] for s in rep.get("storms") or []
+            if s in (rep.get("sites") or {})}
+        # Metrics-history fallback: a worker that died before its
+        # atexit dump still streamed per-site recompile counts into
+        # the PR-16 history ring.
+        try:
+            from ray_tpu.util.metrics import XLA_RECOMPILES_METRIC
+            budget = int(rep.get("budget") or 2)
+            for row in metric_history(
+                    name=XLA_RECOMPILES_METRIC)["series"]:
+                samples = row.get("samples") or []
+                site = (row.get("tags") or {}).get("site", "?")
+                if samples and float(samples[-1][1]) > budget \
+                        and site not in storm_detail:
+                    storm_detail[site] = {
+                        "recompiles": float(samples[-1][1]),
+                        "source": "metrics_history"}
+        except Exception:   # noqa: BLE001 - ring needs a live runtime
+            pass
+        if storm_detail:
+            worst = max(storm_detail,
+                        key=lambda s: storm_detail[s].get(
+                            "recompiles", 0))
+            findings.append({
+                "code": "RECOMPILE_STORM", "severity": "warning",
+                "summary": (f"{len(storm_detail)} jit site(s) "
+                            "recompiled past the xlasan budget "
+                            f"(worst: {worst} x"
+                            f"{storm_detail[worst].get('recompiles')})"
+                            " — see `ray_tpu xlasan` for arg-shape "
+                            "deltas"),
+                "detail": {"budget": rep.get("budget"),
+                           "sites": dict(list(
+                               storm_detail.items())[:10])}})
+        hot_syncs = {
+            s: r for s, r in (rep.get("syncs") or {}).items()
+            if int(r.get("count") or 0) >= sync_hot_count}
+        if hot_syncs:
+            findings.append({
+                "code": "HOST_SYNC_HOT_LOOP", "severity": "warning",
+                "summary": (f"{len(hot_syncs)} call site(s) fenced "
+                            f"the host ≥{sync_hot_count} times "
+                            "(block_until_ready/device_get in a "
+                            "loop) — accumulate device-side and "
+                            "convert once"),
+                "detail": {"sites": dict(list(
+                    hot_syncs.items())[:10])}})
+    except Exception as exc:   # noqa: BLE001
+        probe_errors.append({"probe": "xlasan", "error": repr(exc)})
 
     # -- train goodput --------------------------------------------------
     # Telemetry snapshots live in the control-plane KV, whose node-side

@@ -1,0 +1,32 @@
+"""RT017 negative fixture: jits hoisted out of loops, statics
+hashable — nothing retraces."""
+import functools
+
+import jax
+
+double = jax.jit(lambda v: v * 2)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def step(x, n):
+    return x * n
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scale(x, factor):
+    return x * factor
+
+
+def run(xs):
+    out = []
+    for x in xs:
+        out.append(step(double(x), n=4))      # int static: hashable
+        out.append(scale(x, 2.0))             # float static: hashable
+    return out
+
+
+def build_once(xs):
+    # jit constructed once per call of the factory, not per item —
+    # the comprehension's first iterable is evaluated a single time.
+    f = jax.jit(lambda v: v + 1)
+    return [f(x) for x in xs]

@@ -1,0 +1,44 @@
+"""RT017 positive fixture: recompile hazards.
+
+A jit constructed (or a jitted def defined) inside a loop retraces
+every iteration, and an unhashable literal in a static position
+recompiles on every call.
+"""
+import functools
+
+import jax
+
+
+def retrace_every_item(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)     # RT017: jit built in the loop
+        out.append(f(x))
+    return out
+
+
+def redefine_every_item(xs):
+    acc = []
+    for x in xs:
+        @jax.jit                          # RT017: jitted def in loop
+        def g(v):
+            return v + 1
+        acc.append(g(x))
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def step(x, cfg):
+    return x * cfg["scale"]
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scale(x, factors):
+    return x * factors[0]
+
+
+def storm(x):
+    for i in range(8):
+        x = step(x, cfg={"scale": i})     # RT017: dict static kwarg
+        x = scale(x, [1.0, 2.0])          # RT017: list static positional
+    return x

@@ -1,0 +1,28 @@
+"""RT018 negative fixture: device-side accumulation with ONE sync
+after the loop, plus an annotated deliberate fence."""
+import jax
+import jax.numpy as jnp
+
+fwd = jax.jit(lambda v: v * 2)
+
+
+def train(xs):
+    losses = []
+    for x in xs:
+        losses.append(fwd(x))          # stays on device
+    # One conversion after the loop — not inside it.
+    return float(jnp.mean(jnp.stack(losses)))
+
+
+def stepper(xs):
+    for x in xs:
+        y = fwd(x)
+        # Deliberate per-step fence (telemetry device_step contract).
+        y.block_until_ready()  # ray-tpu: fence
+    return xs
+
+
+def report(xs):
+    history = [fwd(x) for x in xs]
+    host = jax.device_get(history)     # single fence, outside the loop
+    return [float(h) for h in host]

@@ -1,0 +1,27 @@
+"""RT018 positive fixture: host syncs on device values inside loops
+— the dispatch pipeline drains every iteration."""
+import jax
+
+fwd = jax.jit(lambda v: v * 2)
+
+
+def train(xs):
+    total = 0.0
+    for x in xs:
+        loss = fwd(x)
+        total += float(loss)       # RT018: float() on a jitted result
+    return total
+
+
+def drain(xs):
+    for x in xs:
+        y = fwd(x)
+        y.block_until_ready()      # RT018: per-iteration fence
+    return xs
+
+
+def pull(xs):
+    outs = []
+    for x in xs:
+        outs.append(jax.device_get(fwd(x)))   # RT018: device_get in loop
+    return outs

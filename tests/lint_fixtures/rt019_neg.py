@@ -1,0 +1,24 @@
+"""RT019 negative fixture: every spec/collective axis is declared by
+a mesh visible in the file; ranks match."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+mesh = Mesh(jax.devices(), ("dp", "tp"))
+mesh2 = make_mesh(MeshSpec(dp=2, fsdp=2))
+
+ok_single = P("dp")
+ok_tuple = P(("dp", "fsdp"), None, "tp")
+ok_sharding = NamedSharding(mesh, P("dp", "tp"))
+replicated = P(None, None)
+
+
+def reduce_loss(x):
+    return jax.lax.psum(x, "dp")
+
+
+placed = jax.device_put(
+    jnp.zeros((4, 8)),
+    NamedSharding(mesh, P("dp", "tp")))

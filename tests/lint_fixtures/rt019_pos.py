@@ -1,0 +1,20 @@
+"""RT019 positive fixture: PartitionSpec / collective axes that no
+mesh in the file declares, plus a spec wider than the array's rank."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("dp", "tp"))
+
+bad_single = P("mp")                    # RT019: 'mp' not on the mesh
+bad_tuple = P(("dp", "sp"), None)       # RT019: 'sp' not on the mesh
+bad_sharding = NamedSharding(mesh, P("dp", "model"))   # RT019: 'model'
+
+
+def reduce_loss(x):
+    return jax.lax.psum(x, "replica")   # RT019: collective axis unknown
+
+
+overwide = jax.device_put(
+    jnp.zeros((4, 8)),
+    NamedSharding(mesh, P("dp", "tp", None)))   # RT019: rank 2, spec 3

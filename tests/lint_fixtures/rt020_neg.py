@@ -1,0 +1,35 @@
+"""RT020 negative fixture: donation declared, and every donated
+argument is immediately rebound by its caller."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def update(params, opt_state, batch):
+    return params, opt_state
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state
+
+
+def run(params, opt_state, batches):
+    for b in batches:
+        params, opt_state = update(params, opt_state, b)
+    return params, opt_state
+
+
+def drive(state, batches):
+    for b in batches:
+        state = step(state, b)
+    return state
+
+
+@jax.jit
+def score(params, batch):
+    # Read-only consumer: returns a metric, not a successor state —
+    # nothing to donate.
+    loss = (params["w"] * batch).sum()
+    return loss

@@ -1,0 +1,28 @@
+"""RT020 positive fixture: a state->state jit without donation, and
+reads of an argument after it was passed in a donated position."""
+import functools
+
+import jax
+
+
+@jax.jit                        # RT020: takes+returns state, no donation
+def update(params, opt_state, batch):
+    new_params = params
+    return new_params, opt_state
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state
+
+
+def peek(state, batches):
+    out = step(state, batches[0])
+    return state, out           # RT020: state's buffer was donated
+
+
+def drive(state, batches):
+    out = None
+    for b in batches:
+        out = step(state, b)    # RT020: donated but never rebound
+    return out

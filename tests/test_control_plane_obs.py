@@ -282,6 +282,62 @@ def test_doctor_surfaces_event_ring_drops():
         ray_tpu.shutdown()
 
 
+def test_doctor_flags_recompile_storm_and_hot_syncs(tmp_path):
+    """The xlasan probe (ISSUE 17): a jit site recompiling past the
+    budget is a RECOMPILE_STORM warning, a block_until_ready call
+    site firing >= sync_hot_count times is HOST_SYNC_HOT_LOOP — both
+    keep exit 0 (they burn goodput, not the cluster)."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.devtools import xlasan
+
+    class FreshStatic:
+        def __init__(self):
+            self.scale = 2.0
+
+    def step(x, cfg):
+        return x * cfg.scale
+
+    # Point the ledger dir at an empty tmp dir so stale /tmp dumps
+    # from other runs can't leak into the merged report.
+    os.environ["RAY_TPU_XLASAN_DIR"] = str(tmp_path)
+    ray_tpu.init(num_cpus=2)
+    xlasan.reset()
+    xlasan.enable_for_testing()
+    try:
+        fn = jax.jit(step, static_argnums=(1,))
+        x = jnp.ones((4,))
+        for _ in range(4):            # 3 recompiles > default budget 2
+            fn(x, FreshStatic())
+        y = jax.jit(lambda v: v + 1)(x)
+        for _ in range(6):
+            jax.block_until_ready(y)
+        rep = state_api.doctor(sync_hot_count=5)
+        codes = {f["code"]: f for f in rep["findings"]}
+        storm = codes["RECOMPILE_STORM"]
+        assert storm["severity"] == "warning"
+        assert "recompiled past the xlasan budget" in storm["summary"]
+        assert any("test_control_plane_obs.py" in s
+                   for s in storm["detail"]["sites"]), storm["detail"]
+        hot = codes["HOST_SYNC_HOT_LOOP"]
+        assert hot["severity"] == "warning"
+        assert any("test_control_plane_obs.py" in s
+                   for s in hot["detail"]["sites"]), hot["detail"]
+        assert rep["exit_code"] == 0 and rep["healthy"]
+        assert "xlasan" in rep["probes"]
+        # A laxer sync threshold clears the hot-loop finding; the
+        # storm (count-based, not threshold-based) persists.
+        rep2 = state_api.doctor(sync_hot_count=1000)
+        codes2 = {f["code"] for f in rep2["findings"]}
+        assert "HOST_SYNC_HOT_LOOP" not in codes2
+        assert "RECOMPILE_STORM" in codes2
+    finally:
+        xlasan.disable_for_testing()
+        xlasan.reset()
+        os.environ.pop("RAY_TPU_XLASAN_DIR", None)
+        ray_tpu.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # shared percentile helpers (satellite: one implementation)
 # ---------------------------------------------------------------------------

@@ -13,9 +13,10 @@ import pytest
 from ray_tpu.devtools.lint import engine
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
-RULE_IDS = ["RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
+RULE_IDS = ["RT001", "RT002", "RT003", "RT005", "RT006",
             "RT007", "RT008", "RT009", "RT010", "RT011", "RT012",
-            "RT013", "RT014", "RT015", "RT016"]
+            "RT013", "RT014", "RT015", "RT016", "RT017", "RT018",
+            "RT019", "RT020"]
 
 
 def _fixture(rule_id: str, kind: str) -> str:
@@ -431,6 +432,83 @@ def test_module_cache_reuses_parse_and_invalidates_on_edit(tmp_path):
     assert len(res.findings) == 1          # edited content re-parsed
     with engine._module_cache_lock:
         assert engine._MODULE_CACHE[str(f)][1] is not cached
+
+
+# ---------------------------------------------------------------------------
+# RT017-RT020: XLA-rule specifics (the static half of xlasan)
+# ---------------------------------------------------------------------------
+def test_rt004_is_deprecated_alias_of_rt019():
+    """`--select RT004` keeps working and resolves to RT019 — both in
+    the engine API and through the CLI."""
+    assert engine.rule_aliases().get("RT004") == "RT019"
+    assert "RT004" not in engine.all_rules()
+    res = engine.lint_paths([_fixture("RT019", "pos")],
+                            select=["RT004"])
+    assert res.findings
+    assert all(f.rule_id == "RT019" for f in res.findings)
+    proc = _run_cli(_fixture("RT019", "pos"), "--select", "RT004",
+                    "--format", "json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert all(f["rule"] == "RT019" for f in payload["findings"])
+
+
+def test_cli_help_lists_rt004_alias():
+    proc = _run_cli("--help")
+    assert "RT004" in proc.stdout
+    assert "deprecated alias of RT019" in proc.stdout
+
+
+def test_rt018_fence_annotation_suppresses():
+    src = ("import jax\n"
+           "f = jax.jit(lambda v: v)\n"
+           "def loop(xs):\n"
+           "    for x in xs:\n"
+           "        y = f(x)\n"
+           "        y.block_until_ready()  # ray-tpu: fence\n")
+    assert engine.lint_source(src, select=["RT018"]) == []
+    fired = engine.lint_source(
+        src.replace("  # ray-tpu: fence", ""), select=["RT018"])
+    assert [f.rule_id for f in fired] == ["RT018"]
+
+
+def test_rt018_noqa_at_witness_suppresses():
+    src = ("import jax\n"
+           "def loop(xs):\n"
+           "    for x in xs:\n"
+           "        jax.device_get(x)  # ray-tpu: noqa[RT018]\n")
+    assert engine.lint_source(src, select=["RT018"]) == []
+
+
+def test_rt017_unhashable_static_names_the_witness_line():
+    src = ("import functools\n"
+           "import jax\n"
+           "@functools.partial(jax.jit, static_argnames=('cfg',))\n"
+           "def step(x, cfg):\n"
+           "    return x\n"
+           "def run(x):\n"
+           "    return step(x, cfg={'lr': 0.1})\n")
+    found = engine.lint_source(src, select=["RT017"])
+    assert len(found) == 1
+    assert found[0].line == 7
+    assert "recompiles" in found[0].message
+
+
+def test_rt019_mesh_as_parameter_file_is_skipped():
+    """A file that receives its mesh from a caller declares no axes —
+    RT019 must stay silent rather than flag every spec."""
+    src = ("from jax.sharding import PartitionSpec as P\n"
+           "def plan(mesh):\n"
+           "    return P('stage'), P(('dp', 'mp'))\n")
+    assert engine.lint_source(src, select=["RT019"]) == []
+
+
+def test_rt020_donation_via_keyword_in_jit_call():
+    src = ("import jax\n"
+           "def make(step):\n"
+           "    return jax.jit(step, donate_argnums=(0,))\n"
+           "update = None\n")
+    assert engine.lint_source(src, select=["RT020"]) == []
 
 
 def test_changed_files_from_repo_subdirectory(tmp_path):

@@ -132,6 +132,12 @@ def test_declared_builtin_names_are_legal():
     assert not metrics.RPC_QUEUE_DEPTH_METRIC.endswith("_total")
     assert not metrics.SCHED_PLACEMENT_SECONDS_METRIC.endswith(
         "_total")
+    # XLA sanitizer: recompiles is a counter (tagged by construction
+    # site); compile wall time is an untagged histogram.
+    assert _NAME.match(metrics.XLA_RECOMPILES_METRIC)
+    assert _NAME.match(metrics.XLA_COMPILE_SECONDS_METRIC)
+    assert metrics.XLA_RECOMPILES_METRIC.endswith("_total")
+    assert not metrics.XLA_COMPILE_SECONDS_METRIC.endswith("_total")
     for bs in (metrics.TASK_STAGE_BUCKETS, metrics.DEFAULT_BUCKETS,
                metrics.OBJECT_TRANSFER_BUCKETS,
                metrics.DRAIN_DURATION_BUCKETS,
@@ -139,7 +145,8 @@ def test_declared_builtin_names_are_legal():
                metrics.LOCK_WAIT_BUCKETS,
                metrics.TRAIN_STEP_BUCKETS,
                metrics.RPC_SERVER_BUCKETS,
-               metrics.SCHED_PLACEMENT_BUCKETS):
+               metrics.SCHED_PLACEMENT_BUCKETS,
+               metrics.XLA_COMPILE_BUCKETS):
         assert all(a < b for a, b in zip(bs, bs[1:]))
 
 

@@ -1,0 +1,481 @@
+"""XLA sanitizer, runtime half (devtools/xlasan.py): the jit-wrapper
+recompile ledger keyed by construction site, the host-sync ledger,
+dump/merge/CLI surfaces (exit 1 on a storm), telemetry's per-site
+`compile` goodput attribution, the RAY_TPU_XLASAN=1 acceptance drill,
+and regressions for the donation self-findings the static rules
+(RT017-RT020) flagged in rllib."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu.devtools import xlasan
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    xlasan.reset()
+    yield
+    xlasan.disable_for_testing()
+    xlasan.reset()
+
+
+class _FreshStatic:
+    """Hashable by identity, equal to nothing else: every instance is
+    a new jit static-arg cache key even when the payload is identical
+    — the classic RT017 unhashable-static storm in runtime form."""
+
+    def __init__(self, scale: float) -> None:
+        self.scale = scale
+
+
+# ---------------------------------------------------------------------------
+# wrapper mechanics (in-process, patched via enable_for_testing)
+# ---------------------------------------------------------------------------
+def test_storm_drill_attributes_recompiles_to_site():
+    import jax
+    import jax.numpy as jnp
+    xlasan.enable_for_testing()
+
+    def step(x, cfg):
+        return x * cfg.scale
+
+    f = jax.jit(step, static_argnums=(1,))
+    x = jnp.ones((8,))
+    for _ in range(4):
+        f(x, _FreshStatic(2.0))
+    rep = xlasan.report()
+    sites = {s: r for s, r in rep["sites"].items()
+             if r["label"] == "step"}
+    assert len(sites) == 1, rep["sites"]
+    (site, rec), = sites.items()
+    assert "test_xlasan.py" in site
+    assert rec["calls"] == 4 and rec["compiles"] == 4
+    assert rec["recompiles"] == 3
+    assert rec["deltas"][0] == "first compile"
+    # Nothing about the traced args changed, so the delta names the
+    # unhashable-static cause rather than a shape.
+    assert any("unhashable static arg" in d for d in rec["deltas"][1:])
+    # recompiles (3) > budget (2): the site is a storm.
+    assert site in rep["storms"]
+
+
+def test_shape_churn_delta_names_the_leaf():
+    import jax
+    import jax.numpy as jnp
+    xlasan.enable_for_testing()
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.ones((1,), jnp.float32))
+    f(jnp.ones((2,), jnp.float32))
+    (rec,) = xlasan.report()["sites"].values()
+    assert rec["compiles"] == 2
+    assert rec["deltas"][1] == "leaf 0: float32(1,) -> float32(2,)"
+
+
+def test_clean_hoisted_loop_has_zero_storms():
+    import jax
+    import jax.numpy as jnp
+    xlasan.enable_for_testing()
+    f = jax.jit(lambda x: x * 2)
+    x = jnp.ones((8,))
+    for _ in range(10):
+        f(x)
+    rep = xlasan.report()
+    (rec,) = rep["sites"].values()
+    assert rec["calls"] == 10 and rec["compiles"] == 1
+    assert rec["recompiles"] == 0
+    assert rep["storms"] == []
+
+
+def test_sync_sites_ledger():
+    import jax
+    import jax.numpy as jnp
+    xlasan.enable_for_testing()
+    y = jnp.ones((4,))
+    for _ in range(5):
+        jax.block_until_ready(y)
+    jax.device_get(y)
+    rep = xlasan.report()
+    kinds = {r["kind"]: r for r in rep["syncs"].values()}
+    assert kinds["block_until_ready"]["count"] == 5
+    assert kinds["device_get"]["count"] == 1
+    assert all("test_xlasan.py" in s for s in rep["syncs"])
+
+
+def test_take_recent_compiles_drains():
+    import jax
+    import jax.numpy as jnp
+    xlasan.enable_for_testing()
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.ones((2,)))
+    events = xlasan.take_recent_compiles()
+    assert len(events) == 1
+    site, secs = events[0]
+    assert "test_xlasan.py" in site and secs > 0
+    assert xlasan.take_recent_compiles() == []
+
+
+def test_disabled_hooks_do_not_track():
+    import jax
+    import jax.numpy as jnp
+    f = jax.jit(lambda x: x + 1)          # real jax.jit: no patch
+    f(jnp.ones((2,)))
+    rep = xlasan.report()
+    assert rep["sites"] == {} and rep["syncs"] == {}
+
+
+def test_budget_env_parsing(monkeypatch):
+    assert xlasan.budget() == xlasan.DEFAULT_BUDGET
+    monkeypatch.setenv(xlasan.ENV_BUDGET, "0")
+    assert xlasan.budget() == 0
+    monkeypatch.setenv(xlasan.ENV_BUDGET, "nope")
+    assert xlasan.budget() == xlasan.DEFAULT_BUDGET
+
+
+def test_recompile_metrics_registered():
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.util import metrics
+    xlasan.enable_for_testing()
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.ones((1,)))
+    f(jnp.ones((2,)))                     # second compile = recompile
+    with metrics._lock:
+        by_name = {m.name: m for m in metrics._registry}
+    rec = by_name[metrics.XLA_RECOMPILES_METRIC]
+    assert rec.kind == "counter" and rec.tag_keys == ("site",)
+    # The cell for our site exists (counter deltas drain on flush, so
+    # assert presence, not value).
+    assert any("test_xlasan.py" in dict(ts).get("site", "")
+               for ts in rec._cells)
+    hist = by_name[metrics.XLA_COMPILE_SECONDS_METRIC]
+    assert hist.kind == "histogram"
+    assert hist.boundaries == metrics.XLA_COMPILE_BUCKETS
+
+
+# ---------------------------------------------------------------------------
+# dump / merge / state surface
+# ---------------------------------------------------------------------------
+_FAKE_STORM = {
+    "pid": 222, "budget": 2,
+    "sites": {"train.py:10": {
+        "label": "train_step", "calls": 50, "compiles": 4,
+        "recompiles": 3, "seconds": 1.5,
+        "deltas": ["first compile",
+                   "same arg shapes/dtypes as previous compile — "
+                   "unhashable static arg or weak-type churn"]}},
+    "syncs": {"loop.py:7": {"kind": "block_until_ready",
+                            "count": 500, "seconds": 0.8}},
+    "storms": ["train.py:10"],
+}
+
+
+def test_dump_and_merged_report(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    xlasan.enable_for_testing()
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.ones((2,)))
+    path = xlasan.dump(str(tmp_path / "111.json"))
+    assert path and os.path.exists(path)
+    (tmp_path / "222.json").write_text(json.dumps(_FAKE_STORM))
+    xlasan.reset()                        # merge files only
+    merged = xlasan.merged_report(str(tmp_path))
+    assert merged["processes"] == 2
+    assert merged["compiles"] == 5 and merged["recompiles"] == 3
+    assert merged["storms"] == ["train.py:10"]
+    assert merged["sites"]["train.py:10"]["calls"] == 50
+    assert merged["syncs"]["loop.py:7"]["count"] == 500
+    # A second ledger for the SAME site sums into it.
+    dup = dict(_FAKE_STORM, pid=333)
+    (tmp_path / "333.json").write_text(json.dumps(dup))
+    merged = xlasan.merged_report(str(tmp_path))
+    assert merged["sites"]["train.py:10"]["recompiles"] == 6
+    assert merged["syncs"]["loop.py:7"]["count"] == 1000
+
+
+def test_dump_is_a_noop_when_nothing_tracked(tmp_path):
+    assert xlasan.dump(str(tmp_path / "x.json")) is None
+    assert not os.path.exists(tmp_path / "x.json")
+
+
+def test_state_xlasan_report_surface(tmp_path):
+    """state.xlasan_report works without an initialized runtime."""
+    from ray_tpu.util import state
+    (tmp_path / "222.json").write_text(json.dumps(_FAKE_STORM))
+    rep = state.xlasan_report(str(tmp_path))
+    assert rep["storms"] == ["train.py:10"]
+    assert rep["budget"] == xlasan.DEFAULT_BUDGET
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def _xlasan_cli(directory, *flags):
+    """Run cmd_xlasan in-process (argv-parsed like the real CLI, but
+    without a python startup per case); the subprocess acceptance
+    drill below exercises the `python -m ray_tpu xlasan` path once."""
+    import contextlib
+    import io
+
+    from ray_tpu.scripts import cli as cli_mod
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        code = cli_mod.main(["xlasan", "--dir", str(directory),
+                             *flags])
+
+    class _Result:
+        returncode = code
+        stdout = buf.getvalue()
+        stderr = ""
+    return _Result
+
+
+def test_cli_clean_storm_and_budget_override(tmp_path):
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    (clean_dir / "1.json").write_text(json.dumps(
+        {"pid": 1, "budget": 2,
+         "sites": {"a.py:1": {"label": "f", "calls": 9, "compiles": 1,
+                              "recompiles": 0, "seconds": 0.2,
+                              "deltas": ["first compile"]}},
+         "syncs": {}, "storms": []}))
+    cli = _xlasan_cli(clean_dir)
+    assert cli.returncode == 0, cli.stdout + cli.stderr
+    assert "0 recompile(s)" in cli.stdout
+
+    storm_dir = tmp_path / "storm"
+    storm_dir.mkdir()
+    (storm_dir / "2.json").write_text(json.dumps(_FAKE_STORM))
+    cli = _xlasan_cli(storm_dir)
+    assert cli.returncode == 1, cli.stdout + cli.stderr
+    assert "STORM" in cli.stdout and "train.py:10" in cli.stdout
+    # Storm sites print their recent arg-signature deltas.
+    assert "unhashable static arg" in cli.stdout
+    assert "loop.py:7" in cli.stdout
+    payload = json.loads(_xlasan_cli(storm_dir, "--json").stdout)
+    assert payload["storms"] == ["train.py:10"]
+    assert payload["recompiles"] == 3
+    # A looser budget clears the storm (exit 0).
+    cli = _xlasan_cli(storm_dir, "--budget", "10")
+    assert cli.returncode == 0, cli.stdout + cli.stderr
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    cli = _xlasan_cli(empty)
+    assert cli.returncode == 0
+    assert "no ledgers found" in cli.stdout
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill: RAY_TPU_XLASAN=1 end to end (env -> install ->
+# atexit ledger dump -> merged report -> CLI exit 1)
+# ---------------------------------------------------------------------------
+_DRILL = """
+import ray_tpu                      # arms the wrapper (env)
+import jax
+import jax.numpy as jnp
+
+class Cfg:
+    def __init__(self, scale):
+        self.scale = scale
+
+def step(x, cfg):
+    return x * cfg.scale
+
+f = jax.jit(step, static_argnums=(1,))
+x = jnp.ones((8,))
+for _ in range(5):
+    f(x, Cfg(2.0))                  # fresh static key: recompiles
+
+g = jax.jit(lambda x: x + 1)        # hoisted: compiles exactly once
+for _ in range(20):
+    g(x)
+print("DRILL_OK")
+"""
+
+
+def test_env_install_acceptance_drill(tmp_path):
+    env = dict(os.environ)
+    env["RAY_TPU_XLASAN"] = "1"
+    env["RAY_TPU_XLASAN_DIR"] = str(tmp_path)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run([sys.executable, "-c", _DRILL],
+                          capture_output=True, text=True,
+                          timeout=240, cwd=REPO_ROOT, env=env)
+    assert proc.returncode == 0, \
+        f"drill failed\nstdout:{proc.stdout}\nstderr:{proc.stderr}"
+    assert "DRILL_OK" in proc.stdout
+    merged = xlasan.merged_report(str(tmp_path))
+    assert merged["processes"] >= 1
+    storms = {s: merged["sites"][s] for s in merged["storms"]}
+    assert len(storms) == 1, merged["sites"]
+    (site, rec), = storms.items()
+    assert rec["label"] == "step"
+    assert rec["calls"] == 5 and rec["recompiles"] == 4
+    assert any("unhashable static arg" in d for d in rec["deltas"])
+    # The fixed (hoisted) loop never recompiled.
+    hoisted = [r for r in merged["sites"].values()
+               if r["calls"] == 20]
+    assert hoisted and hoisted[0]["recompiles"] == 0
+    cli = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "xlasan",
+         "--dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=240, cwd=REPO_ROOT)
+    assert cli.returncode == 1, cli.stdout + cli.stderr
+    assert "STORM" in cli.stdout
+
+
+# ---------------------------------------------------------------------------
+# telemetry attribution + overhead
+# ---------------------------------------------------------------------------
+def test_telemetry_compile_site_attribution():
+    """PR-13 telemetry's `compile` goodput class, broken down by jit
+    construction site: snapshots and the run rollup both carry
+    compile_sites when the wrapper is armed."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.train.telemetry import TrainTelemetry
+    xlasan.enable_for_testing()
+    tel = TrainTelemetry("xlasan_attr", client=None, publish=False,
+                         tokens_per_step=8)
+    try:
+        f = jax.jit(lambda x: x * 2)
+        x = jnp.ones((4,))
+        for _ in range(3):
+            with tel.device_step():
+                float(f(x).sum())
+            tel.end_step()
+        snap = tel.snapshot()
+        (site, secs), = snap["compile_sites"].items()
+        assert "test_xlasan.py" in site and secs > 0
+        summary = tel.summary()
+        assert site in summary["compile_sites"]
+        assert summary["compile_sites"][site] == pytest.approx(
+            secs, abs=1e-6)
+    finally:
+        tel.stop()
+
+
+def _offline_step_p50(run_name, steps=40):
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.train.telemetry import TrainTelemetry, _percentile
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.ones((8,))
+    f(x).block_until_ready()              # pay the compile up front
+    tel = TrainTelemetry(run_name, client=None, publish=False,
+                         tokens_per_step=8)
+    walls = []
+    try:
+        for _ in range(steps):
+            with tel.device_step():
+                float(f(x).sum())
+            walls.append(tel.end_step()["wall"])
+    finally:
+        tel.stop()
+    walls.sort()
+    return _percentile(walls, 0.50)
+
+
+def test_wrapper_overhead_does_not_regress_step_p50():
+    """The acceptance bound: RAY_TPU_XLASAN=1 must not meaningfully
+    move the offline-telemetry step p50 (the wrapper adds two cache
+    size probes and a dict update per call)."""
+    p50_off = _offline_step_p50("xlasan_ovh_off")
+    xlasan.enable_for_testing()
+    p50_on = _offline_step_p50("xlasan_ovh_on")
+    # Loose: 3x relative plus 2ms absolute headroom — a real
+    # regression (per-call tracing, host syncs) lands far above this.
+    assert p50_on <= p50_off * 3 + 2e-3, (p50_on, p50_off)
+
+
+# ---------------------------------------------------------------------------
+# self-applied fix regressions: donation vs target-network aliasing
+# (the RT020 sweep added donate_argnums to the rllib updates; the
+# pre-existing `target = params` aliases then broke under donation
+# and were replaced with deep copies in dqn/sac __init__ + sync)
+# ---------------------------------------------------------------------------
+def _dqn_batch(n=16):
+    import numpy as np
+    rng = np.random.RandomState(0)
+    return {
+        "obs": rng.randn(n, 4).astype(np.float32),
+        "actions": rng.randint(0, 2, size=n).astype(np.int32),
+        "rewards": rng.randn(n).astype(np.float32),
+        "next_obs": rng.randn(n, 4).astype(np.float32),
+        "dones": np.zeros(n, np.float32),
+        "discounts": np.full(n, 0.99, np.float32),
+    }
+
+
+def test_dqn_update_donation_requires_distinct_target():
+    import jax
+    import optax
+    from ray_tpu.rllib.dqn import init_policy, make_update_fn
+    opt = optax.adam(1e-3)
+    update, _ = make_update_fn(opt, 0.99, num_grad_steps=2,
+                               batch_size=8)
+    data = {k: jax.numpy.asarray(v) for k, v in _dqn_batch().items()}
+    rng = jax.random.PRNGKey(1)
+
+    # The old alias (self.target_params = self.params): params is
+    # donated, so the same buffers arriving as target_params is a
+    # use-after-donation the runtime rejects.
+    params = init_policy(jax.random.PRNGKey(0), 4, 2, hidden=8)
+    with pytest.raises(Exception, match="donat"):
+        update(params, params, opt.init(params), data, rng)
+
+    # The fix: a deep copy at init AND at every target sync survives
+    # back-to-back donated updates straddling a sync.
+    params = init_policy(jax.random.PRNGKey(0), 4, 2, hidden=8)
+    target = jax.tree.map(lambda x: x.copy(), params)
+    opt_state = opt.init(params)
+    params, opt_state, loss = update(params, target, opt_state,
+                                     data, rng)
+    target = jax.tree.map(lambda x: x.copy(), params)  # target sync
+    params, opt_state, loss = update(params, target, opt_state,
+                                     data, rng)
+    assert bool(jax.numpy.isfinite(loss))
+
+
+def test_sac_update_donation_requires_distinct_target_qs():
+    import jax
+    import numpy as np
+    import optax
+    from ray_tpu.rllib.sac import init_sac, make_update_fn
+    jnp = jax.numpy
+    update = make_update_fn(optax.adam(1e-3), optax.adam(1e-3),
+                            optax.adam(1e-3), gamma=0.99, tau=0.005,
+                            target_entropy=-1.0, num_grad_steps=2,
+                            batch_size=8, action_scale=1.0)
+    rng = np.random.RandomState(0)
+    n = 16
+    data = {"obs": jnp.asarray(rng.randn(n, 3), jnp.float32),
+            "actions": jnp.asarray(rng.randn(n, 1), jnp.float32),
+            "rewards": jnp.asarray(rng.randn(n), jnp.float32),
+            "next_obs": jnp.asarray(rng.randn(n, 3), jnp.float32),
+            "dones": jnp.zeros((n,), jnp.float32)}
+
+    def _state(aliased):
+        p = init_sac(jax.random.PRNGKey(0), 3, 1, hidden=8)
+        a_opt, c_opt, al_opt = (optax.adam(1e-3),) * 3
+        qs = {"q1": p["q1"], "q2": p["q2"]}
+        target_qs = qs if aliased else jax.tree.map(
+            lambda x: x.copy(), qs)
+        return (p["actor"], qs, target_qs, p["log_alpha"],
+                a_opt.init(p["actor"]), c_opt.init(qs),
+                al_opt.init(p["log_alpha"]))
+
+    # Aliased target_qs inside the donated state tuple: rejected.
+    with pytest.raises(Exception, match="donat"):
+        update(_state(aliased=True), data, jax.random.PRNGKey(2))
+    # Distinct buffers (the __init__ fix): trains.
+    state, closs, aloss, ent = update(_state(aliased=False), data,
+                                      jax.random.PRNGKey(2))
+    assert bool(jnp.isfinite(closs)) and bool(jnp.isfinite(aloss))
