@@ -21,6 +21,14 @@ a node-level hook (``dispatch``, ``serve.assign``, ``partition``), or
             | partition | preempt  (default: error)
     p       injection probability per eligible event (default 1.0)
     n       budget: total injections allowed; -1 = unlimited (default -1)
+    interval_s
+            storm spacing: minimum seconds between two firings of this
+            spec (default 0 = no spacing).  With ``n`` this makes a
+            whole failure storm ONE seeded, replayable entry — e.g.
+            ``node:kind=preempt:n=3:interval_s=5`` is three
+            preemptions at least 5s apart.  Rejected for the standing
+            kinds (partition / gcs_partition), which have no discrete
+            firings to space.
     lo_ms / hi_ms
             delay bounds for kind=delay (milliseconds)
     node    hex prefix of the target node id for kind=partition
@@ -97,12 +105,13 @@ _REFRESH_INTERVAL_S = 0.25
 
 class FaultSpec:
     __slots__ = ("site", "kind", "p", "budget", "lo_ms", "hi_ms", "node",
-                 "deadline_s", "down_s", "announced", "activated_ts")
+                 "deadline_s", "down_s", "interval_s", "announced",
+                 "activated_ts", "last_fired_ts")
 
     def __init__(self, site: str, kind: str = "error", p: float = 1.0,
                  n: int = -1, lo_ms: float = 0.0, hi_ms: float = 0.0,
                  node: str = "", deadline_s: float = 0.0,
-                 down_s: float = 0.0) -> None:
+                 down_s: float = 0.0, interval_s: float = 0.0) -> None:
         if kind not in FAULT_KINDS:
             raise ValueError(
                 f"unknown fault kind {kind!r} (valid: "
@@ -124,6 +133,12 @@ class FaultSpec:
         if down_s and kind not in ("kill_gcs", "gcs_partition"):
             raise ValueError(
                 "down_s only applies to kind=kill_gcs/gcs_partition")
+        if interval_s < 0.0:
+            raise ValueError(f"interval_s {interval_s} < 0")
+        if interval_s and kind in ("partition", "gcs_partition"):
+            raise ValueError(
+                "interval_s needs discrete firings; "
+                f"kind={kind} is a standing condition")
         self.site = site
         self.kind = kind
         self.p = p
@@ -138,10 +153,21 @@ class FaultSpec:
         # kind=kill_gcs: restart delay; kind=gcs_partition: partition
         # duration from first activation (0.0 = standing).
         self.down_s = down_s
+        # Storm spacing: a firing is suppressed until interval_s has
+        # passed since this spec's previous firing (n= gives the storm
+        # its size, interval_s its cadence).
+        self.interval_s = interval_s
         self.announced = False     # partition: trace once, not per check
         # gcs_partition: wall time the standing condition first matched
         # (its down_s window counts from here).
         self.activated_ts = 0.0
+        self.last_fired_ts = 0.0   # monotonic ts of the last firing
+
+    def _spaced_out(self, now: float) -> bool:
+        """Storm spacing check: True while the spec must hold fire
+        because interval_s has not elapsed since its last firing."""
+        return (self.interval_s > 0.0 and self.last_fired_ts > 0.0
+                and now - self.last_fired_ts < self.interval_s)
 
     def to_dict(self) -> Dict[str, Any]:
         out = {"site": self.site, "kind": self.kind, "p": self.p,
@@ -152,6 +178,8 @@ class FaultSpec:
             out["deadline_s"] = self.deadline_s
         if self.kind in ("kill_gcs", "gcs_partition"):
             out["down_s"] = self.down_s
+        if self.interval_s:
+            out["interval_s"] = self.interval_s
         if self.node:
             out["node"] = self.node
         return out
@@ -183,7 +211,8 @@ def parse_spec(spec: str) -> List[FaultSpec]:
                     kwargs["p"] = float(value)
                 elif key == "n":
                     kwargs["n"] = int(value)
-                elif key in ("lo_ms", "hi_ms", "deadline_s", "down_s"):
+                elif key in ("lo_ms", "hi_ms", "deadline_s", "down_s",
+                             "interval_s"):
                     kwargs[key] = float(value)
                 elif key == "node":
                     kwargs["node"] = value
@@ -316,11 +345,11 @@ class ChaosController:
     def inject(self, site: str, kind: str = "error", p: float = 1.0,
                n: int = -1, lo_ms: float = 0.0, hi_ms: float = 0.0,
                node: str = "", deadline_s: float = 0.0,
-               down_s: float = 0.0) -> None:
+               down_s: float = 0.0, interval_s: float = 0.0) -> None:
         """Add a fault spec at runtime (this process)."""
         spec = FaultSpec(site, kind=kind, p=p, n=n, lo_ms=lo_ms,
                          hi_ms=hi_ms, node=node, deadline_s=deadline_s,
-                         down_s=down_s)
+                         down_s=down_s, interval_s=interval_s)
         with self._lock:
             self._runtime_specs.append(spec)
             self._enabled = True
@@ -363,10 +392,13 @@ class ChaosController:
                     continue    # node-level kinds don't fire on rpcs
                 if spec.budget == 0:
                     continue
+                if spec._spaced_out(time.monotonic()):
+                    continue
                 if spec.p < 1.0 and self._rng.random() >= spec.p:
                     continue
                 if spec.budget > 0:
                     spec.budget -= 1
+                spec.last_fired_ts = time.monotonic()
                 self._record_locked(site, spec.kind)
                 if spec.kind == "delay":
                     delays.append(self._rng.uniform(spec.lo_ms,
@@ -413,10 +445,13 @@ class ChaosController:
             for spec in self._match(site):
                 if spec.kind != kind or spec.budget == 0:
                     continue
+                if spec._spaced_out(time.monotonic()):
+                    continue
                 if spec.p < 1.0 and self._rng.random() >= spec.p:
                     continue
                 if spec.budget > 0:
                     spec.budget -= 1
+                spec.last_fired_ts = time.monotonic()
                 self._record_locked(site, kind)
                 return spec.to_dict()
         return None

@@ -341,6 +341,37 @@ _D("train_mfu_halflife_s", float, 30.0,
    "Half-life of the exponentially decayed window behind the live "
    "tokens/s and MFU readouts (recent steps dominate; a paused run "
    "decays toward zero instead of averaging it away).")
+_D("train_elastic_enabled", bool, False,
+   "Elastic gang training (train/elastic.py): workers publish sharded "
+   "in-cluster checkpoints, and a preempted worker triggers a gang "
+   "RESIZE (survivors reshard from the object-store checkpoint and "
+   "continue at N-1) instead of a restart-from-disk at fixed world "
+   "size; the gang grows back when capacity heals.")
+_D("train_ckpt_interval_s", float, 30.0,
+   "Cadence of the elastic in-cluster sharded checkpoint: each worker "
+   "snapshots its shard of params/opt_state into the object store at "
+   "most this often (0 = every step — tests).  The keeper commits a "
+   "manifest once every member's shard for a step has arrived.")
+_D("train_ckpt_keep", int, 2,
+   "Committed in-cluster checkpoint steps the keeper pins at once; "
+   "older steps' shard refs are released only AFTER a newer manifest "
+   "is registered (never drop the last live copy).")
+_D("train_min_world_size", int, 1,
+   "Elastic shrink floor: a resize below this many workers is refused "
+   "and the failure falls through to the fixed-world restart path.")
+_D("train_elastic_poll_s", float, 0.25,
+   "How often an elastic worker checks the gang record for an epoch "
+   "change (resize) or a preemption notice, and the driver polls for "
+   "grow-back capacity.")
+_D("train_grow_retry_s", float, 2.0,
+   "Elastic grow-back probe cadence: after a shrink, the driver "
+   "attempts to re-expand the gang to its full world size at most "
+   "this often (each attempt spawns a replacement worker which "
+   "reshards from the in-cluster checkpoint).")
+_D("train_resize_thrash_per_min", float, 4.0,
+   "Doctor threshold for GANG_RESIZE_THRASH: a run whose resize rate "
+   "over its lifetime exceeds this many resizes/min is flagged — the "
+   "gang is spending its time resharding, not training.")
 _D("workflow_storage_dir", str, "",
    "Durable workflow storage root (default: ~/.ray_tpu/workflows). "
    "Deliberately outside the session dir so resume survives shutdown.")

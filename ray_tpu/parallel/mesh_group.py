@@ -59,14 +59,19 @@ class _MeshHostWorker:
                 # jax < 0.5 has no jax_num_cpu_devices option; the
                 # XLA_FLAGS override above provides the device count.
                 pass
-            try:
-                # Multi-host CPU collectives need gloo on jax 0.4.x
-                # ("Multiprocess computations aren't implemented on
-                # the CPU backend" otherwise).
-                jax.config.update(
-                    "jax_cpu_collectives_implementation", "gloo")
-            except AttributeError:
-                pass  # newer jax selects CPU collectives itself
+            if world > 1:
+                try:
+                    # Multi-host CPU collectives need gloo on jax
+                    # 0.4.x ("Multiprocess computations aren't
+                    # implemented on the CPU backend" otherwise).
+                    # World-1 gangs (elastic shrink floor) must NOT
+                    # set it: gloo requires a distributed client, and
+                    # a single host never calls
+                    # jax.distributed.initialize.
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except AttributeError:
+                    pass  # newer jax selects CPU collectives itself
 
     def choose_coordinator(self) -> str:
         """Rank 0 picks the coordinator address ON ITS OWN HOST — the
@@ -168,6 +173,10 @@ class MeshGroup:
         self._platform = platform
         self._devices_per_host = devices_per_host
         self.restarts = 0
+        # The PG was sized for num_hosts bundles; resize() can shrink
+        # below and grow back up to this, never beyond.
+        self.max_hosts = num_hosts
+        self.resizes = 0
         self._spawn_gang()
 
     def _spawn_gang(self) -> None:
@@ -210,6 +219,48 @@ class MeshGroup:
             except Exception:
                 pass
         self.restarts += 1
+        deadline = _time.monotonic() + retry_timeout_s
+        while True:
+            try:
+                self._spawn_gang()
+                return
+            except Exception:
+                for w in getattr(self, "workers", []):
+                    try:
+                        ray_tpu.kill(w)
+                    except Exception:
+                        pass
+                if _time.monotonic() > deadline:
+                    raise
+                _time.sleep(1.0)
+
+    def resize(self, new_num_hosts: int,
+               retry_timeout_s: float = 180.0) -> None:
+        """Re-rendezvous the gang at a DIFFERENT world size on the
+        same placement group (elastic shrink on preemption / grow-back
+        on heal — the train/elastic.py resize, at the mesh layer).
+
+        jax.distributed world membership is fixed at initialize(), so
+        a resize is necessarily a full re-rendezvous: kill all ranks,
+        respawn ``new_num_hosts`` of them on the first bundles, and
+        re-initialize with the new world size.  State survival is the
+        caller's job (reshard from an in-cluster checkpoint — the
+        TpuTrainer elastic path — or re-load from disk).  Grow is
+        bounded by ``max_hosts``: the placement group reserved exactly
+        that many bundles at construction."""
+        if not 1 <= new_num_hosts <= self.max_hosts:
+            raise ValueError(
+                f"new_num_hosts {new_num_hosts} not in "
+                f"[1, {self.max_hosts}] (the placement group has "
+                f"{self.max_hosts} bundles)")
+        import time as _time
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.num_hosts = new_num_hosts
+        self.resizes += 1
         deadline = _time.monotonic() + retry_timeout_s
         while True:
             try:

@@ -515,8 +515,24 @@ def _render_train_status(data: dict) -> str:
             f"{r.get('workers_reporting', 0)}"
             f"/{r.get('world_size', '?')} workers, "
             f"wall {float(r.get('wall_s') or 0):.1f}s, "
-            f"restarts {r.get('restarts', 0)}")
+            f"restarts {r.get('restarts', 0)}"
+            + (f", resizes {r.get('resize_count')}"
+               if r.get("resize_count") else ""))
         lines.append(f"  verdict: {r.get('verdict', 'n/a')}")
+        # Elastic resize history (train/elastic.py): direction,
+        # world-size transition, the checkpoint step resharded from,
+        # and the dead time the resize charged to resize_recovery.
+        for ev in (r.get("resizes") or [])[-6:]:
+            lines.append(
+                f"  resize {ev.get('direction', '?')}: "
+                f"{ev.get('from', '?')} -> {ev.get('to', '?')} workers"
+                f" @ ckpt step {ev.get('step', '?')}"
+                f" (+{float(ev.get('dead_s') or 0):.2f}s dead)")
+        cr = r.get("ckpt_reads") or {}
+        if any(int(v or 0) for v in cr.values()):
+            lines.append(
+                f"  ckpt restores: memory={int(cr.get('memory') or 0)}"
+                f" disk={int(cr.get('disk') or 0)}")
         tok = float(r.get("tokens_per_s") or 0.0)
         mfu = r.get("mfu")
         line = f"  tokens/s {tok:,.0f}"
@@ -915,7 +931,10 @@ def cmd_chaos(args) -> int:
     if not entries:
         print("no faults armed (set RAY_TPU_CHAOS_SPEC or pass --spec)")
     else:
-        _print_table(entries, ["site", "kind", "p", "n"])
+        cols = ["site", "kind", "p", "n"]
+        if any(e.get("interval_s") for e in entries):
+            cols.append("interval_s")   # storm spacing (preempt storms)
+        _print_table(entries, cols)
     print(f"fault kinds: {', '.join(FAULT_KINDS)}")
     return 0
 
@@ -1086,7 +1105,8 @@ def cmd_top(args) -> int:
 # shaped) paths regress when they RISE.  Higher-better wins ties
 # ("speedup_p50" is a speedup, not a latency).
 _BENCH_HIGHER = ("per_s", "_mb_s", "mbps", "throughput", "speedup",
-                 "goodput", "mfu", "tokens_s", "qps")
+                 "goodput", "goodput_fraction", "mfu", "tokens_s",
+                 "qps")
 _BENCH_LOWER = ("_us", "_ms", "_ns", "p50", "p95", "p99", "latency",
                 "seconds", "_s_", "overhead", "stall")
 

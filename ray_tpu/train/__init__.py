@@ -21,6 +21,15 @@ stamped with a monotonic ``_step`` index + ``_ts`` that survives
 resume-from-checkpoint.  Read it back with
 ``state.train_summary()``, the dashboard ``/api/train`` endpoint, or
 ``ray_tpu train status [--json]``.
+
+Elastic gang training (train/elastic.py): with
+``ScalingConfig(elastic=True)`` (or ``train_elastic_enabled``) the
+trainer resizes the gang in place on preemption — workers snapshot
+sharded state into the object store on a cadence, a per-run keeper
+actor registers consistent step manifests in the control-plane KV,
+survivors reshard from the in-cluster checkpoint (zero disk reads)
+at N−1, and the gang grows back when capacity heals.  Worker surface:
+``session.get_context().elastic()`` -> ``ElasticSession``.
 """
 
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
@@ -40,6 +49,9 @@ def __getattr__(name):
     if name == "TrainTelemetry":
         from ray_tpu.train.telemetry import TrainTelemetry
         return TrainTelemetry
+    if name in ("ElasticSession", "ResizeInterrupt"):
+        from ray_tpu.train import elastic
+        return getattr(elastic, name)
     raise AttributeError(name)
 
 
@@ -48,4 +60,5 @@ __all__ = [
     "CheckpointConfig", "DataParallelTrainer", "FailureConfig", "Result",
     "RunConfig", "ScalingConfig", "TpuTrainer", "CompiledTrainStep",
     "TrainState", "TrainTelemetry", "make_optimizer",
+    "ElasticSession", "ResizeInterrupt",
 ]

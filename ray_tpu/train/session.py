@@ -39,9 +39,13 @@ class TrainContext:
                  trial_dir: str, restore_checkpoint: Optional[str],
                  config: Dict[str, Any],
                  report_ns: Optional[str] = None,
-                 dataset_shards: Optional[Dict[str, Any]] = None
-                 ) -> None:
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 recovery_class: str = "restart_recovery") -> None:
         self._dataset_shards = dict(dataset_shards or {})
+        # Which goodput ledger class this worker's telemetry charges
+        # its restore gap to: "restart_recovery" for a fresh attempt,
+        # "resize_recovery" for an elastic grow-back replacement.
+        self._recovery_class = recovery_class
         self._world_size = world_size
         self._world_rank = world_rank
         self._trial_dir = trial_dir
@@ -68,6 +72,7 @@ class TrainContext:
         self._report_index: Optional[int] = None
         self._seq_lock = threading.Lock()
         self._telemetry = None
+        self._elastic = None
 
     # -- public API (mirrors ray.train context) -------------------------
     def get_world_size(self) -> int:
@@ -86,6 +91,13 @@ class TrainContext:
         """Checkpoint to resume from (set after failure restarts)."""
         if self._restore is None:
             return None
+        # Disk-read accounting: the elastic storm drill asserts ZERO
+        # restart-from-disk by checking this counter stays flat.
+        if self._telemetry is not None:
+            try:
+                self._telemetry.note_ckpt_read("disk")
+            except Exception:
+                pass
         return Checkpoint(self._restore)
 
     def telemetry(self, **kwargs):
@@ -98,10 +110,25 @@ class TrainContext:
             from ray_tpu.train import telemetry as telemetry_mod
             run = os.path.basename(
                 self._trial_dir.rstrip("/")) or self._trial_dir
+            kwargs.setdefault("recovery_class", self._recovery_class)
             self._telemetry = telemetry_mod.TrainTelemetry(
                 run, rank=self._world_rank,
                 world_size=self._world_size, **kwargs)
         return self._telemetry
+
+    def elastic(self):
+        """This worker's ElasticSession (train/elastic.py): gang
+        membership, in-cluster sharded checkpoint save/restore, and
+        the resize-aware allreduce.  Requires the trainer to be
+        running the elastic path (gang record + checkpoint keeper)."""
+        if self._elastic is None:
+            from ray_tpu.train import elastic as elastic_mod
+            run = os.path.basename(
+                self._trial_dir.rstrip("/")) or self._trial_dir
+            self._elastic = elastic_mod.ElasticSession(
+                run, self._world_rank,
+                telemetry_provider=lambda: self._telemetry)
+        return self._elastic
 
     def _stop_telemetry(self) -> None:
         tel, self._telemetry = self._telemetry, None

@@ -64,18 +64,29 @@ from ray_tpu.util import metrics as metrics_mod
 
 # Explicit phases a step can attribute time to; anything left over in
 # the step's wall clock lands in the implicit "idle" bucket.
-PHASES = ("data_wait", "compile", "step", "checkpoint", "sync")
+PHASES = ("data_wait", "compile", "step", "checkpoint", "sync",
+          "resize")
 
 # Goodput ledger classes: every wall-clock second of the run lands in
 # exactly one.  The five the goodput literature names (productive /
 # compile / input_wait / restart_recovery / idle) plus checkpoint and
-# sync split out so save/collective overhead is visible on its own.
+# sync split out so save/collective overhead is visible on its own,
+# and resize_recovery so an elastic gang resize (reshard from the
+# in-cluster checkpoint, train/elastic.py) is charged separately from
+# a restart-from-disk.
 LEDGER_CLASSES = ("productive", "compile", "input_wait", "checkpoint",
-                  "sync", "restart_recovery", "idle")
+                  "sync", "restart_recovery", "resize_recovery",
+                  "idle")
+
+# The ledger classes a restart gap may be charged to (TrainTelemetry
+# recovery_class=): the plain worker-restart path charges
+# restart_recovery; an elastic replacement worker charges
+# resize_recovery.
+RECOVERY_CLASSES = ("restart_recovery", "resize_recovery")
 
 _PHASE_TO_LEDGER = {"data_wait": "input_wait", "compile": "compile",
                     "step": "productive", "checkpoint": "checkpoint",
-                    "sync": "sync"}
+                    "sync": "sync", "resize": "resize_recovery"}
 
 # Control-plane KV namespaces.  Snapshots are keyed
 # "<run>\x1fw:<rank>" (worker snapshots) and "<run>\x1fs:<rank>"
@@ -220,7 +231,17 @@ class TrainTelemetry:
                  peak_flops: Optional[float] = None,
                  jit_fns: Iterable[Any] = (),
                  client: Any = "auto",
-                 publish: bool = True) -> None:
+                 publish: bool = True,
+                 recovery_class: str = "restart_recovery") -> None:
+        if recovery_class not in RECOVERY_CLASSES:
+            raise ValueError(
+                f"recovery_class {recovery_class!r} not in "
+                f"{RECOVERY_CLASSES}")
+        # Which ledger class the restore gap (last snapshot -> first
+        # breath of this session) is charged to: restart_recovery for
+        # the fixed-world restart path, resize_recovery for an elastic
+        # replacement worker rejoining after a gang resize.
+        self._recovery_class = recovery_class
         if client == "auto":
             from ray_tpu._private.client import get_global_client
             client = get_global_client()
@@ -258,6 +279,10 @@ class TrainTelemetry:
         # Per-jit-site compile seconds (xlasan attribution): which
         # construction site the run's `compile` ledger class went to.
         self._compile_sites: Dict[str, float] = {}
+        # Checkpoint-read accounting: how many restores this worker
+        # served from the in-cluster object-store checkpoint vs from
+        # disk — the elastic drill's zero-restart-from-disk witness.
+        self._ckpt_reads: Dict[str, int] = {"memory": 0, "disk": 0}
         self._window: deque = deque(
             maxlen=max(int(config.train_telemetry_window), 8))
         self._step_index = 0
@@ -353,13 +378,21 @@ class TrainTelemetry:
                 self._ledger[c] = float(v)
         for s, v in (snap.get("compile_sites") or {}).items():
             self._compile_sites[s] = float(v)
+        for src, v in (snap.get("ckpt_reads") or {}).items():
+            if src in self._ckpt_reads:
+                self._ckpt_reads[src] = int(v)
         self._step_index = int(snap.get("step_index") or 0)
-        self._restarts = int(snap.get("restarts") or 0) + 1
+        # An elastic replacement resuming after a gang resize is a
+        # RESIZE, not a restart — it's already counted by
+        # record_resize and must not inflate the restart column.
+        self._restarts = (int(snap.get("restarts") or 0)
+                          + (1 if self._recovery_class
+                             == "restart_recovery" else 0))
         self._t0 = float(snap.get("t0") or self._t0)
         frontier = float(snap.get("ledger_ts") or snap.get("ts")
                          or time.time())
         gap = max(0.0, time.time() - frontier)
-        self._ledger["restart_recovery"] += gap
+        self._ledger[self._recovery_class] += gap
 
     # -- step API --------------------------------------------------------
     def phase(self, name: str) -> _PhaseTimer:
@@ -378,6 +411,22 @@ class TrainTelemetry:
 
     def sync(self) -> _PhaseTimer:
         return _PhaseTimer(self, "sync")
+
+    def resize(self) -> _PhaseTimer:
+        """Time spent handling a gang resize (re-deriving the mesh,
+        pulling and resharding the in-cluster checkpoint) — lands in
+        the ledger's resize_recovery class."""
+        return _PhaseTimer(self, "resize")
+
+    def note_ckpt_read(self, source: str, n: int = 1) -> None:
+        """Count a checkpoint restore by where the bytes came from:
+        'memory' (in-cluster object-store shards) or 'disk'.  The
+        elastic storm drill asserts disk stays at ZERO."""
+        if source not in ("memory", "disk"):
+            raise ValueError(
+                f"ckpt read source {source!r} not in (memory, disk)")
+        with self._lock:
+            self._ckpt_reads[source] += int(n)
 
     def device_step(self, tokens: Optional[int] = None
                     ) -> _DeviceStepTimer:
@@ -581,6 +630,7 @@ class TrainTelemetry:
                        for c, v in self._ledger.items()},
             "compile_sites": {s: round(v, 6)
                               for s, v in self._compile_sites.items()},
+            "ckpt_reads": dict(self._ckpt_reads),
             "tokens_per_s": tokens_rate,
             "mfu": self._mfu_locked(tokens_rate),
             "flops_per_token": self._flops_per_token,
@@ -613,14 +663,24 @@ class TrainTelemetry:
             pass
 
     def _write_run_meta(self, state: str) -> None:
+        # Read-modify-write: the elastic driver's record_resize shares
+        # this key — a blind overwrite here would drop resize history
+        # recorded before this session came up (a shrink can land
+        # before rank 0's first breath).
+        try:
+            blob = self._client.kv_get(KV_RUNS_NS, self._run.encode())
+            meta = json.loads(blob) if blob else {}
+        except Exception:
+            meta = {}
+        meta["run"] = self._run
+        meta["started_ts"] = self._t0
+        meta["state"] = state
+        # record_resize owns world_size once a resize happened.
+        if "resizes" not in meta:
+            meta["world_size"] = self._world_size
         try:
             self._client.kv_put(KV_RUNS_NS, self._run.encode(),
-                                json.dumps({
-                                    "run": self._run,
-                                    "world_size": self._world_size,
-                                    "started_ts": self._t0,
-                                    "state": state,
-                                }).encode())
+                                json.dumps(meta).encode())
         except Exception:
             pass
 
@@ -886,6 +946,51 @@ def mark_run_state(client, run: str, state: str) -> None:
         pass
 
 
+def set_world_size_gauge(run: str, world_size: int) -> None:
+    """Driver-side: the run's CURRENT gang size
+    (``ray_tpu_train_world_size{run}``).  A per-run series — removed
+    by remove_run_gauges when the run finalizes (RT015)."""
+    metrics_mod.shared_gauge(
+        metrics_mod.TRAIN_WORLD_SIZE_METRIC,
+        "Current world size of an elastic train gang",
+        tag_keys=("run",)).set(float(world_size), tags={"run": run})
+
+
+def record_resize(client, run: str, direction: str, old_size: int,
+                  new_size: int, step: int,
+                  dead_s: float = 0.0) -> None:
+    """Driver-side elastic-resize bookkeeping: append the event to the
+    run meta (capped history — train status / doctor read it), bump
+    ``ray_tpu_train_resizes_total{direction}``, and move the world-size
+    gauge.  ``step`` is the checkpoint step the survivors resharded
+    from; ``dead_s`` the driver-observed resize dead time."""
+    if direction not in ("shrink", "grow"):
+        raise ValueError(f"direction {direction!r} not shrink/grow")
+    try:
+        blob = client.kv_get(KV_RUNS_NS, run.encode())
+        meta = json.loads(blob) if blob else {"run": run}
+    except Exception:
+        meta = {"run": run}
+    events = list(meta.get("resizes") or [])
+    events.append({"ts": time.time(), "direction": direction,
+                   "from": int(old_size), "to": int(new_size),
+                   "step": int(step), "dead_s": round(dead_s, 3)})
+    meta["resizes"] = events[-32:]       # capped: meta stays small
+    meta["resize_count"] = int(meta.get("resize_count") or 0) + 1
+    meta["world_size"] = int(new_size)
+    meta["updated_ts"] = time.time()
+    try:
+        client.kv_put(KV_RUNS_NS, run.encode(),
+                      json.dumps(meta).encode())
+    except Exception:
+        pass
+    metrics_mod.shared_counter(
+        metrics_mod.TRAIN_RESIZES_METRIC,
+        "Elastic gang resizes, by direction",
+        tag_keys=("direction",)).inc(tags={"direction": direction})
+    set_world_size_gauge(run, new_size)
+
+
 def remove_run_gauges(run: str, force: bool = True) -> None:
     """Zero a run's per-run gauge series even when THIS process never
     wrote them — cross-process cleanup for workers that died uncleanly
@@ -902,6 +1007,9 @@ def remove_run_gauges(run: str, force: bool = True) -> None:
         tag_keys=("run", "class"))
     for c in LEDGER_CLASSES:
         g.remove(tags={"run": run, "class": c}, force=force)
+    metrics_mod.shared_gauge(
+        metrics_mod.TRAIN_WORLD_SIZE_METRIC, tag_keys=("run",)
+    ).remove(tags={"run": run}, force=force)
 
 
 def _bound_verdict(phase_totals: Dict[str, float]) -> Dict[str, Any]:
@@ -941,6 +1049,7 @@ def summarize_run(meta: Dict[str, Any],
     restarts = 0
     step_samples: List[float] = []
     compile_sites: Dict[str, float] = {}
+    ckpt_reads: Dict[str, int] = {"memory": 0, "disk": 0}
     for snap in snaps.values():
         for p, v in (snap.get("phases") or {}).items():
             if p in phases:
@@ -950,6 +1059,9 @@ def summarize_run(meta: Dict[str, Any],
                 ledger[c] += float(v)
         for s, v in (snap.get("compile_sites") or {}).items():
             compile_sites[s] = compile_sites.get(s, 0.0) + float(v)
+        for src, v in (snap.get("ckpt_reads") or {}).items():
+            if src in ckpt_reads:
+                ckpt_reads[src] += int(v)
         wall = max(wall, float(snap.get("wall_s") or 0.0))
         step_index = max(step_index,
                          int(snap.get("step_index") or 0))
@@ -994,7 +1106,15 @@ def summarize_run(meta: Dict[str, Any],
         "stragglers": {
             str(r): v
             for r, v in straggler_verdicts(snaps).items()},
+        "ckpt_reads": ckpt_reads,
     }
+    # Elastic resize history lives on the run meta (the driver's
+    # record_resize writes it): surface it plus the CURRENT gang size
+    # so `ray_tpu train status` shows a resize as it happens.
+    if meta.get("resizes"):
+        out["resizes"] = meta["resizes"]
+        out["resize_count"] = int(meta.get("resize_count")
+                                  or len(meta["resizes"]))
     if compile_sites:
         # xlasan attribution: the `compile` ledger class broken down
         # by jit construction site, gang-summed, costliest first.

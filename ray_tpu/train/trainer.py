@@ -36,6 +36,11 @@ class ScalingConfig:
     use_tpu: bool = False
     chips_per_worker: int = 0          # TPU chips reserved per worker
     resources_per_worker: Optional[Dict[str, float]] = None
+    # Elastic gang training (train/elastic.py): shrink-in-place on
+    # preemption, grow back when capacity heals, resharding from the
+    # in-cluster checkpoint.  None defers to the
+    # `train_elastic_enabled` config knob.
+    elastic: Optional[bool] = None
 
 
 @dataclass
@@ -81,12 +86,13 @@ class _TrainWorker:
                  config: Dict[str, Any],
                  restore_checkpoint: Optional[str],
                  report_ns: str,
-                 dataset_shards: Optional[Dict[str, Any]] = None
-                 ) -> None:
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 recovery_class: str = "restart_recovery") -> None:
         self._ctx = session_mod.TrainContext(
             world_size=world_size, world_rank=rank, trial_dir=trial_dir,
             restore_checkpoint=restore_checkpoint, config=config,
-            report_ns=report_ns, dataset_shards=dataset_shards)
+            report_ns=report_ns, dataset_shards=dataset_shards,
+            recovery_class=recovery_class)
         session_mod.set_context(self._ctx)
 
     def run(self, fn_and_cfg) -> Optional[str]:
@@ -262,6 +268,20 @@ class TpuTrainer:
             actor_opts["resources"] = resources
         report_ns = f"train_reports/{trial_dir}/{attempt}"
 
+        from ray_tpu._private.config import config as _cfg
+        elastic_enabled = (s.elastic if s.elastic is not None
+                           else bool(_cfg.train_elastic_enabled))
+        if elastic_enabled:
+            if self._datasets:
+                raise ValueError(
+                    "elastic training does not support datasets= yet: "
+                    "streaming splits are fixed-world (pass batches "
+                    "through the loop config, or disable elastic)")
+            from ray_tpu.train import elastic as elastic_mod
+            return elastic_mod.run_elastic_attempt(
+                self, trial_dir, manager, restore, attempt, history,
+                actor_opts=actor_opts, report_ns=report_ns)
+
         # One streaming execution per named dataset, n per-rank feeds.
         # equal=True: SPMD training needs every rank to see the same
         # number of batches, or the stragglers hang in collectives —
@@ -284,7 +304,6 @@ class TpuTrainer:
 
         run_refs = [w.run.remote((self._fn, self._config))
                     for w in workers]
-        from ray_tpu._private.config import config as _cfg
         run_name = os.path.basename(trial_dir.rstrip("/"))
         straggler_check_s = float(_cfg.train_straggler_check_s)
         next_straggler_check = time.time() + straggler_check_s

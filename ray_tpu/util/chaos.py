@@ -35,11 +35,13 @@ __all__ = ["inject", "clear", "trace", "reset_trace", "refresh",
 def inject(site: str, kind: str = "error", p: float = 1.0, n: int = -1,
            lo_ms: float = 0.0, hi_ms: float = 0.0,
            node: str = "", deadline_s: float = 0.0,
-           down_s: float = 0.0) -> None:
+           down_s: float = 0.0, interval_s: float = 0.0) -> None:
     """Arm a fault at runtime (this process).  Raises ValueError for an
-    invalid kind/probability/bounds combination."""
+    invalid kind/probability/bounds combination.  ``n`` + ``interval_s``
+    describe a whole storm: n firings at least interval_s apart."""
     _chaos.inject(site, kind=kind, p=p, n=n, lo_ms=lo_ms, hi_ms=hi_ms,
-                  node=node, deadline_s=deadline_s, down_s=down_s)
+                  node=node, deadline_s=deadline_s, down_s=down_s,
+                  interval_s=interval_s)
 
 
 def clear(site: Optional[str] = None) -> None:

@@ -137,6 +137,14 @@ TRAIN_MFU_METRIC = "ray_tpu_train_mfu"
 TRAIN_TOKENS_PER_S_METRIC = "ray_tpu_train_tokens_per_second"
 TRAIN_GOODPUT_FRACTION_METRIC = "ray_tpu_train_goodput_fraction"
 TRAIN_STRAGGLERS_METRIC = "ray_tpu_train_stragglers_total"
+# Elastic gang resize (train/elastic.py): resizes_total counts gang
+# resizes tagged direction = shrink | grow; world_size is a per-run
+# gauge of the CURRENT gang size (removed when the run finalizes —
+# the RT015 dead-writer contract, like the other per-run train
+# gauges).  Resize dead time lands in the goodput ledger's
+# resize_recovery class, distinct from restart_recovery.
+TRAIN_RESIZES_METRIC = "ray_tpu_train_resizes_total"
+TRAIN_WORLD_SIZE_METRIC = "ray_tpu_train_world_size"
 
 # Concurrency sanitizer (devtools/locksan.py, enabled with
 # RAY_TPU_LOCKSAN=1).  wait_seconds observes how long acquire()

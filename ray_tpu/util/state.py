@@ -509,7 +509,11 @@ def doctor(leak_min_age_s: float = 60.0,
       gcs_wal_compact_ops), LOCK_CONTENTION (locksan witnessed a
       lock-order inversion), SERVE_SHEDDING (admission control shed
       requests), TRAIN_GOODPUT_LOW (productive fraction of an
-      instrumented run's wall clock below 50%), RECOMPILE_STORM (an
+      instrumented run's wall clock below 50%), GANG_RESIZE_THRASH
+      (an elastic run resized more often than
+      ``train_resize_thrash_per_min`` — capacity is flapping faster
+      than resharding can amortize; raise the grace window or stop
+      growing back), RECOMPILE_STORM (an
       xlasan jit site recompiled past its budget — from the merged
       ledger, with the ``ray_tpu_xla_recompiles_total`` metrics-
       history ring as fallback for processes that died before their
@@ -773,6 +777,25 @@ def doctor(leak_min_age_s: float = 60.0,
                     "detail": {"run": run,
                                "verdict": roll.get("verdict"),
                                "ledger": ledger}})
+            resizes = int(roll.get("resize_count") or 0)
+            wall = float(roll.get("wall_s") or 0.0)
+            thrash = float(config.train_resize_thrash_per_min)
+            if (resizes >= 2 and wall > 0 and thrash > 0
+                    and resizes / (wall / 60.0) > thrash):
+                findings.append({
+                    "code": "GANG_RESIZE_THRASH",
+                    "severity": "warning",
+                    "summary": (f"train run {run!r}: "
+                                f"{resizes} elastic resizes in "
+                                f"{wall:.0f}s of instrumented wall "
+                                f"clock (> {thrash:g}/min) — "
+                                "capacity is flapping faster than "
+                                "resharding amortizes"),
+                    "detail": {"run": run, "resizes": resizes,
+                               "wall_s": wall,
+                               "per_min": resizes / (wall / 60.0),
+                               "events": (roll.get("resizes")
+                                          or [])[-8:]}})
     except Exception as exc:   # noqa: BLE001
         probe_errors.append({"probe": "train", "error": repr(exc)})
 

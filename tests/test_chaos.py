@@ -283,6 +283,40 @@ def test_spec_parser_grammar():
         chaos_api.parse_spec("x:kind=partition")     # partition w/o node
 
 
+def test_spec_parser_storm_grammar():
+    """Storm params: n= repeats + interval_s= spacing describe one
+    replayable preemption storm in a single spec entry."""
+    (e,) = chaos_api.parse_spec(
+        "train.worker:kind=preempt:p=1.0:n=2"
+        ":deadline_s=0.3:interval_s=5")
+    assert e.kind == "preempt" and e.budget == 2
+    assert e.interval_s == 5.0 and e.deadline_s == 0.3
+    assert e.to_dict()["interval_s"] == 5.0
+    # No spacing armed -> the key stays out of the describe payload.
+    (quiet,) = chaos_api.parse_spec("rpc:kind=drop:n=1")
+    assert "interval_s" not in quiet.to_dict()
+    with pytest.raises(ValueError):
+        chaos_api.parse_spec("x:kind=drop:interval_s=-1")
+    with pytest.raises(ValueError):
+        # Standing conditions have no discrete firings to space.
+        chaos_api.parse_spec(
+            "x:kind=partition:node=ab:interval_s=5")
+
+
+def test_storm_spacing_gates_firings():
+    """interval_s suppresses a second firing until the spacing has
+    elapsed; the budget only decrements on real firings."""
+    from ray_tpu._private.chaos import ChaosController
+    c = ChaosController(
+        seed=7, spec="s:kind=preempt:p=1.0:n=2:interval_s=0.15")
+    assert c.fire_spec("s", "preempt") is not None
+    assert c.fire_spec("s", "preempt") is None      # spaced out
+    time.sleep(0.2)
+    assert c.fire_spec("s", "preempt") is not None  # storm continues
+    assert c.fire_spec("s", "preempt") is None      # budget exhausted
+    assert [k for _, _, k in c.trace()] == ["preempt", "preempt"]
+
+
 def test_chaos_cli_smoke(capsys):
     from ray_tpu.scripts.cli import main
     assert main(["chaos", "--spec",
@@ -291,6 +325,27 @@ def test_chaos_cli_smoke(capsys):
     assert "get_objects" in out and "drop" in out
     assert main(["chaos", "--spec", "x:kind=bogus"]) == 2
     assert main(["chaos", "--json"]) == 0
+
+
+def test_chaos_cli_storm_spec_fixture(capsys):
+    """CLI face of the storm grammar: a valid preempt-storm spec
+    renders its spacing column; misuse of the new keys exits 2."""
+    from ray_tpu.scripts.cli import main
+    assert main(["chaos", "--spec",
+                 "train.worker:kind=preempt:p=1.0:n=2"
+                 ":deadline_s=0.3:interval_s=5"]) == 0
+    out = capsys.readouterr().out
+    assert "preempt" in out and "interval_s" in out
+    # Bad value for a recognized storm key.
+    assert main(["chaos", "--spec",
+                 "train.worker:kind=preempt:interval_s=-2"]) == 2
+    assert "interval_s" in capsys.readouterr().err
+    # Spacing on a standing condition is a grammar error.
+    assert main(["chaos", "--spec",
+                 "x:kind=partition:node=ab:interval_s=1"]) == 2
+    # Unknown key still rejected.
+    assert main(["chaos", "--spec",
+                 "train.worker:kind=preempt:interval=5"]) == 2
 
 
 def test_legacy_env_spec_still_parses():

@@ -180,3 +180,32 @@ def _rank_sum_6(rank):
         NamedSharding(mesh, P("dp")), shard)
     return float(jax.jit(lambda v: jnp.sum(v),
                          out_shardings=NamedSharding(mesh, P()))(g))
+
+
+@pytest.mark.slow
+def test_resize_in_place(ray_start):
+    """Elastic resize at the mesh layer (the train/elastic.py resize):
+    the gang re-rendezvouses at a different world size on the SAME
+    placement group — shrink to 1 host, grow back to 2 — with grow
+    bounded by the bundles reserved at construction (slow: three
+    jax.distributed gang bring-ups; excluded from the tier-1 window)."""
+    mg = MeshGroup(num_hosts=2, devices_per_host=2, platform="cpu")
+    try:
+        assert [c["global"] for c in mg.device_counts()] == [4, 4]
+        mg.resize(1)
+        counts = mg.device_counts()
+        assert [c["global"] for c in counts] == [2]
+        assert counts[0]["rank"] == 0
+        mg.resize(2)
+        counts = mg.device_counts()
+        assert [c["global"] for c in counts] == [4, 4]
+        assert sorted(c["rank"] for c in counts) == [0, 1]
+        assert mg.resizes == 2
+        # Grow past the reserved bundles / shrink to nothing: refused.
+        with pytest.raises(ValueError):
+            mg.resize(3)
+        with pytest.raises(ValueError):
+            mg.resize(0)
+        assert mg.resizes == 2
+    finally:
+        mg.shutdown()

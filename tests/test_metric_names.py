@@ -79,6 +79,12 @@ def test_declared_builtin_names_are_legal():
     assert _NAME.match(metrics.TRAIN_GOODPUT_FRACTION_METRIC)
     assert _NAME.match(metrics.TRAIN_STRAGGLERS_METRIC)
     assert metrics.TRAIN_STRAGGLERS_METRIC.endswith("_total")
+    # Elastic resize plane: resizes is a counter (tagged by
+    # direction); the live world-size-by-run metric is a gauge.
+    assert _NAME.match(metrics.TRAIN_RESIZES_METRIC)
+    assert _NAME.match(metrics.TRAIN_WORLD_SIZE_METRIC)
+    assert metrics.TRAIN_RESIZES_METRIC.endswith("_total")
+    assert not metrics.TRAIN_WORLD_SIZE_METRIC.endswith("_total")
     # step_seconds is a histogram, the rest are gauges — no _total.
     assert not metrics.TRAIN_STEP_SECONDS_METRIC.endswith("_total")
     assert not metrics.TRAIN_MFU_METRIC.endswith("_total")

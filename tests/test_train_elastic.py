@@ -1,0 +1,597 @@
+"""Elastic gang training (train/elastic.py).
+
+Covers the ISSUE-20 acceptance surface: shard/unshard round-trips at
+any world size, the ManifestStore register-then-release ref-pinning
+order (the PR-4 "last borrow drops the replica" trap) + epoch freeze,
+a live checkpoint keeper pinning shards after the publisher drops its
+refs, the flagship preemption-storm drill (4-worker CPU gang shrinks
+to 3 in place with ZERO disk checkpoint reads, grows back to 4, keeps
+goodput >= 0.85 of the fixed-world baseline, and replays the seeded
+chaos trace identically), loss-curve equivalence across a resize via
+the weighted-mean allreduce, the resize accounting plane (metrics /
+train status / doctor GANG_RESIZE_THRASH), and the per-run gauge +
+ckpt-ref leak-ledger lifecycle.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import RunConfig, ScalingConfig, TpuTrainer
+from ray_tpu.train import elastic as elastic_mod
+from ray_tpu.train import telemetry as telemetry_mod
+from ray_tpu.train.elastic import (ManifestStore, shard_pytree,
+                                   unshard_pytree)
+from ray_tpu.util import state as state_api
+
+
+# ---------------------------------------------------------------------------
+# pure helpers (no runtime)
+# ---------------------------------------------------------------------------
+def test_shard_unshard_roundtrip_any_world_size():
+    """Exact round-trip at any nshards — including nshards > leading
+    dim (empty shards) and 0-d leaves (replicated) — is what makes
+    4 -> 3 -> 4 resharding a pure unshard+reshard."""
+    tree = {
+        "w": np.arange(10.0).reshape(10, 1),
+        "opt": [np.arange(7.0), np.float64(3.5)],
+        "meta": (np.arange(2.0),),
+    }
+    for n in (1, 2, 3, 4, 5):
+        shards = [shard_pytree(tree, i, n) for i in range(n)]
+        back = unshard_pytree(shards)
+        np.testing.assert_array_equal(back["w"], tree["w"])
+        np.testing.assert_array_equal(back["opt"][0], tree["opt"][0])
+        assert float(back["opt"][1]) == 3.5
+        np.testing.assert_array_equal(back["meta"][0], tree["meta"][0])
+        assert isinstance(back["meta"], tuple)
+    # 4 -> 3 -> 4: reshard through a different world size is lossless.
+    via3 = unshard_pytree([shard_pytree(tree, i, 3) for i in range(3)])
+    again = unshard_pytree(
+        [shard_pytree(via3, i, 4) for i in range(4)])
+    np.testing.assert_array_equal(again["w"], tree["w"])
+    with pytest.raises(ValueError):
+        shard_pytree(tree, 3, 3)
+    with pytest.raises(ValueError):
+        unshard_pytree([])
+
+
+class _FakeKV:
+    """Minimal control-plane KV recording operation order."""
+
+    def __init__(self):
+        self.store = {}
+        self.ops = []
+
+    def kv_put(self, ns, key, val):
+        self.ops.append(("put", ns, bytes(key)))
+        self.store[(ns, bytes(key))] = bytes(val)
+
+    def kv_get(self, ns, key):
+        return self.store.get((ns, bytes(key)))
+
+    def kv_del(self, ns, key):
+        self.ops.append(("del", ns, bytes(key)))
+        self.store.pop((ns, bytes(key)), None)
+
+    def kv_keys(self, ns, prefix=b""):
+        return [k for (n, k) in self.store if n == ns
+                and k.startswith(prefix)]
+
+
+def test_manifest_store_registers_before_releasing():
+    """The ref-pinning regression (satellite of the PR-4 trap): an old
+    manifest's shard refs are released only AFTER the newer manifest
+    is registered — in the log, every release of step s is preceded by
+    a register of some step > s."""
+    from ray_tpu.devtools import leaksan
+
+    leaksan.enable_for_testing()
+    leaksan.reset()
+    try:
+        kv = _FakeKV()
+        store = ManifestStore("ms_run", client=kv, keep=2)
+        for step in range(5):
+            committed = [store.publish(step, i, 3, f"ref-{step}-{i}")
+                         for i in range(3)]
+            # Only the slot-completing shard reports the commit.
+            assert committed == [None, None, step]
+        stats = store.stats()
+        assert stats["latest_step"] == 4
+        assert stats["committed_steps"] == [3, 4]   # keep=2
+        assert stats["refs_live"] == 6
+        assert stats["commits"] == 5 and stats["releases"] == 3
+        for pos, (what, s) in enumerate(store.log):
+            if what == "release":
+                assert any(w == "register" and rs > s
+                           for w, rs in store.log[:pos]), store.log
+        # The KV manifest was (re)registered before every release.
+        assert kv.ops[0] == ("put", elastic_mod.KV_CKPT_NS,
+                             b"ms_run")
+        man = __import__("pickle").loads(
+            kv.store[(elastic_mod.KV_CKPT_NS, b"ms_run")])
+        assert man["step"] == 4 and man["world_size"] == 3
+        assert sorted(man["shards"]) == [0, 1, 2]
+        # Replays at or below the latest commit are ignored.
+        assert store.publish(4, 0, 3, "stale") is None
+        assert store.publish(2, 1, 3, "stale") is None
+        assert store.stats()["refs_live"] == 6
+        # A partial slot orphaned below a commit is pruned with it.
+        store.publish(5, 0, 4, "orphan")
+        for i in range(3):
+            store.publish(6, i, 3, f"ref-6-{i}")
+        assert store.stats()["pending_slots"] == {}
+        # Teardown drops everything and deletes the KV manifest.
+        assert store.release_all() > 0
+        assert store.stats()["refs_live"] == 0
+        assert kv.kv_get(elastic_mod.KV_CKPT_NS, b"ms_run") is None
+        assert leaksan.live_counts().get("ckpt_shard", 0) == 0
+        assert leaksan.report()["anomalies"] == []
+    finally:
+        leaksan.disable_for_testing()
+        leaksan.reset()
+
+
+def test_manifest_store_epoch_freeze_pins_restore_point():
+    """freeze(epoch) must hand every member of an epoch the SAME
+    manifest and drop publishes that raced the resize — otherwise a
+    stale slot completing between two survivors' restores leaves the
+    gang at different steps (a deadlock in the KV allreduce)."""
+    from ray_tpu.devtools import leaksan
+
+    leaksan.enable_for_testing()
+    leaksan.reset()
+    try:
+        kv = _FakeKV()
+        store = ManifestStore("fz_run", client=kv, keep=2)
+        for i in range(4):
+            store.publish(3, i, 4, f"r3-{i}", epoch=0)
+        # A stale pre-resize slot is in flight (3 of 4 shards).
+        for i in range(3):
+            store.publish(4, i, 4, f"r4-{i}", epoch=0)
+        man1 = store.freeze(1)
+        assert man1["step"] == 3
+        # The partial slot was discarded by the freeze...
+        assert store.stats()["pending_slots"] == {}
+        # ...and the straggler's publish (old epoch) is rejected, so
+        # the manifest can no longer advance under epoch 1.
+        assert store.publish(4, 3, 4, "r4-3", epoch=0) is None
+        assert store.freeze(1)["step"] == 3
+        assert store.latest_step() == 3
+        # New-epoch publishes land normally.
+        for i in range(3):
+            store.publish(4, i, 3, f"n4-{i}", epoch=1)
+        assert store.latest_step() == 4
+        # A laggard asking about a superseded epoch gets the current
+        # restore point, and the freeze is undisturbed.
+        assert store.freeze(0)["step"] == 4
+        assert store.freeze(2)["step"] == 4
+        store.release_all()
+        assert leaksan.live_counts().get("ckpt_shard", 0) == 0
+        assert leaksan.report()["anomalies"] == []
+    finally:
+        leaksan.disable_for_testing()
+        leaksan.reset()
+
+
+# ---------------------------------------------------------------------------
+# live keeper (object-store pinning)
+# ---------------------------------------------------------------------------
+def test_keeper_pins_shards_after_publisher_drops_refs(ray_start):
+    """The keeper is the live owner: after the publishing side drops
+    its put refs, a reader can still resolve every shard out of the
+    latest manifest."""
+    run = "kp_run"
+    keeper = elastic_mod._CheckpointKeeper.options(
+        name=elastic_mod.keeper_name(run)).remote(run, 2)
+    try:
+        payloads = {}
+        for step in range(3):
+            arr = np.full(2048, float(step))
+            payloads[step] = arr
+            ref = ray_tpu.put(arr)
+            ray_tpu.get(keeper.publish.remote(step, 0, 1, [ref],
+                                              None, 0), timeout=60)
+            del ref                      # publisher drops its owner ref
+        assert ray_tpu.get(keeper.latest_step.remote(),
+                           timeout=60) == 2
+        stats = ray_tpu.get(keeper.stats.remote(), timeout=60)
+        assert stats["refs_live"] == 2   # keep=2: steps 1 and 2
+        man = ray_tpu.get(keeper.manifest_for_epoch.remote(0),
+                          timeout=60)
+        assert man["step"] == 2
+        got = ray_tpu.get(man["shards"][0], timeout=60)
+        np.testing.assert_array_equal(got, payloads[2])
+        # stop() releases every pinned block and the KV manifest.
+        assert ray_tpu.get(keeper.stop.remote(), timeout=60) == 2
+        assert ray_tpu.get(keeper.stats.remote(),
+                           timeout=60)["refs_live"] == 0
+        client = ray_tpu._ensure_connected()
+        assert elastic_mod.latest_manifest_step(client, run) is None
+    finally:
+        ray_tpu.kill(keeper)
+
+
+# ---------------------------------------------------------------------------
+# the flagship storm drill
+# ---------------------------------------------------------------------------
+def _storm_loop(config):
+    """Elastic worker loop: lockstep via the KV allreduce, a sharded
+    snapshot every step, resize-in-place on epoch change, graceful
+    exit on a preemption notice."""
+    import time as _t
+
+    import numpy as _np
+
+    from ray_tpu.train import session
+    from ray_tpu.train.elastic import ResizeInterrupt
+
+    ctx = session.get_context()
+    tel = ctx.telemetry(tokens_per_step=64)
+    es = ctx.elastic()
+    es.join()
+    rank = ctx.get_world_rank()
+    deadline = _t.monotonic() + 120.0
+    while rank not in es.members:        # grow race: epoch not yet up
+        if _t.monotonic() > deadline:
+            raise TimeoutError(f"rank {rank} never joined the gang")
+        _t.sleep(0.02)
+        es.sync()
+
+    total = int(config["total_steps"])
+    t, state = 0, {"w": _np.zeros(8), "n": _np.array(0.0)}
+    got = es.restore()                   # replacements resume mid-run
+    if got is not None:
+        t, state = got[0] + 1, got[1]
+    while t < total:
+        ev = es.sync()
+        if ev and ev["resized"]:
+            with tel.resize():
+                while rank not in es.members:
+                    if _t.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"rank {rank} dropped from the gang")
+                    _t.sleep(0.02)
+                    es.sync()
+                t, state = es.restore_or(t, state)
+            continue
+        if ev and ev.get("notice_deadline"):
+            es.save_shard(t - 1, state, force=True)
+            return                       # graceful preempt exit
+        with tel.device_step():
+            _t.sleep(float(config["step_s"]))
+            try:
+                g = es.allreduce(t, {"w": _np.ones(8)}, weight=1.0)
+            except ResizeInterrupt:
+                continue
+        state = {"w": state["w"] + g["w"], "n": state["n"] + 1.0}
+        es.save_shard(t, state)
+        tel.end_step()
+        if rank == 0 and (t % 25 == 0 or t == total - 1):
+            session.report({"step": t, "count": float(state["n"])})
+        t += 1
+
+
+def _set_elastic_knobs(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRAIN_CKPT_INTERVAL_S", "0")
+    monkeypatch.setenv("RAY_TPU_TRAIN_MIN_WORLD_SIZE", "2")
+    monkeypatch.setenv("RAY_TPU_TRAIN_GROW_RETRY_S", "0.4")
+    monkeypatch.setenv("RAY_TPU_TRAIN_ELASTIC_POLL_S", "0.02")
+    monkeypatch.setenv("RAY_TPU_TRAIN_TELEMETRY_PUBLISH_S", "0.1")
+
+
+@pytest.fixture
+def dash(ray_start):
+    import ray_tpu.dashboard as dashboard
+    httpd = dashboard.serve(port=0)
+    host, port = httpd.server_address[:2]
+    yield f"http://{host}:{port}"
+    httpd.shutdown()
+
+
+def test_elastic_storm_drill(ray_start, tmp_path, dash, monkeypatch,
+                             capsys):
+    """The acceptance drill: a seeded preemption storm (2 preempts,
+    2s apart, 0.25s drain notice) against a 4-worker CPU gang running
+    elastic.  The gang must shrink in place to 3 within the notice
+    window with ZERO restart-from-disk, grow back to 4 when the storm
+    passes, keep productive goodput >= 0.85 of a storm-free baseline,
+    account the dead time to resize_recovery, and surface all of it in
+    train status.  The same seeded storm then replays identically."""
+    from ray_tpu._private.chaos import chaos
+    from ray_tpu.scripts import cli
+    from ray_tpu.util import metrics
+
+    _set_elastic_knobs(monkeypatch)
+    loop_cfg = {"total_steps": 150, "step_s": 0.02}
+
+    def _run(name, storm):
+        chaos.clear()
+        chaos.reset_trace()
+        if storm:
+            chaos.inject("train.worker", kind="preempt", p=1.0, n=2,
+                         deadline_s=0.25, interval_s=2.0)
+        result = TpuTrainer(
+            _storm_loop, train_loop_config=loop_cfg,
+            scaling_config=ScalingConfig(num_workers=4, elastic=True),
+            run_config=RunConfig(name=name,
+                                 storage_path=str(tmp_path))).fit()
+        trace = chaos.trace()
+        chaos.clear()
+        return result, trace
+
+    result, trace1 = _run("el_storm", storm=True)
+    assert result.error is None, result.error
+    assert [(s, k) for _, s, k in trace1] == \
+        [("train.worker", "preempt")] * 2
+
+    summary = state_api.train_summary(run="el_storm")
+    # 2 shrinks + 2 grows, ending back at full width.
+    assert summary["resize_count"] == 4, summary.get("resizes")
+    dirs = [e["direction"] for e in summary["resizes"]]
+    assert dirs.count("shrink") == 2 and dirs.count("grow") == 2
+    assert summary["world_size"] == 4
+    for e in summary["resizes"]:
+        assert e["from"] - e["to"] in (-1, 1)
+        assert e["dead_s"] >= 0.0
+    # ZERO restart-from-disk: every restore came out of the object
+    # store, no fit-level restart happened, and nothing was charged
+    # to restart_recovery.
+    assert summary["ckpt_reads"]["disk"] == 0, summary["ckpt_reads"]
+    assert summary["ckpt_reads"]["memory"] >= 4
+    assert summary["restarts"] == 0
+    assert summary["ledger"]["restart_recovery"] == 0.0
+    assert summary["ledger"]["resize_recovery"] > 0.0, \
+        summary["ledger"]
+    # The loop made real progress across both resizes.
+    assert result.metrics["step"] == loop_cfg["total_steps"] - 1
+
+    # Resize counters moved, by direction.
+    scraped = metrics.scrape()
+    by_dir = {}
+    for s in scraped:
+        if s["name"] == metrics.TRAIN_RESIZES_METRIC:
+            by_dir[(s.get("tags") or {}).get("direction")] = s["value"]
+    assert by_dir.get("shrink", 0) >= 2, by_dir
+    assert by_dir.get("grow", 0) >= 2, by_dir
+    # The per-run world-size gauge was removed at finalize (RT015):
+    # push-model series are never deleted node-side, so removal reads
+    # as a final zero sample, not the last live value (4).
+    for s in scraped:
+        if (s["name"] == metrics.TRAIN_WORLD_SIZE_METRIC
+                and (s.get("tags") or {}).get("run") == "el_storm"):
+            assert s["value"] == 0.0, s
+
+    # train status renders the resize history and the read accounting.
+    assert cli.main(["train", "status", "--dashboard-url", dash]) == 0
+    text = capsys.readouterr().out
+    assert "resizes 4" in text, text
+    assert "resize shrink:" in text and "resize grow:" in text, text
+    assert "ckpt restores: memory=" in text, text
+
+    # Storm-free baseline on the same loop: the storm run keeps >=
+    # 0.85 of its productive goodput fraction.
+    base_result, base_trace = _run("el_base", storm=False)
+    assert base_result.error is None, base_result.error
+    assert base_trace == []
+    base = state_api.train_summary(run="el_base")
+    assert "resizes" not in base
+    assert base["goodput_fraction"] > 0.0
+    assert summary["goodput_fraction"] >= \
+        0.85 * base["goodput_fraction"], \
+        (summary["goodput_fraction"], base["goodput_fraction"])
+
+    # Replay: the same seeded storm produces the identical trace.
+    result2, trace2 = _run("el_storm2", storm=True)
+    assert result2.error is None, result2.error
+    assert trace2 == trace1, (trace1, trace2)
+    s2 = state_api.train_summary(run="el_storm2")
+    assert s2["resize_count"] == 4
+    assert s2["ckpt_reads"]["disk"] == 0
+
+
+# ---------------------------------------------------------------------------
+# loss-curve equivalence across a resize
+# ---------------------------------------------------------------------------
+def _sgd_loop(config):
+    """Linear regression by full-batch SGD where each member computes
+    the gradient over ITS row shard and the weighted-mean allreduce
+    reassembles the exact full-batch gradient at ANY world size."""
+    import json as _json
+    import time as _t
+
+    import numpy as _np
+
+    from ray_tpu.train import session
+    from ray_tpu.train.elastic import ResizeInterrupt
+
+    ctx = session.get_context()
+    ctx.telemetry(tokens_per_step=12)
+    es = ctx.elastic()
+    es.join()
+    rank = ctx.get_world_rank()
+    deadline = _t.monotonic() + 120.0
+    while rank not in es.members:
+        if _t.monotonic() > deadline:
+            raise TimeoutError(f"rank {rank} never joined the gang")
+        _t.sleep(0.02)
+        es.sync()
+
+    d, batch, lr = 6, 12, 0.05
+    rng = _np.random.default_rng(7)
+    w_true = rng.normal(size=d)
+    total = int(config["total_steps"])
+    t = 0
+    state = {"w": _np.zeros(d), "losses": _np.full(total, _np.nan)}
+    got = es.restore()
+    if got is not None:
+        t, state = got[0] + 1, got[1]
+    while t < total:
+        ev = es.sync()
+        if ev and ev["resized"]:
+            while rank not in es.members:
+                if _t.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rank {rank} dropped from the gang")
+                _t.sleep(0.02)
+                es.sync()
+            t, state = es.restore_or(t, state)
+            continue
+        if ev and ev.get("notice_deadline"):
+            es.save_shard(t - 1, state, force=True)
+            return
+        # Pace the loop so the grow-back lands mid-run, not in a race
+        # with the final step.
+        _t.sleep(0.02)
+        # The per-step batch is derived from the STEP, not the world
+        # size — any membership computes the same full batch.
+        brng = _np.random.default_rng(1000 + t)
+        x = brng.normal(size=(batch, d))
+        y = x @ w_true
+        members = es.members
+        rows = _np.array_split(_np.arange(batch),
+                               len(members))[members.index(rank)]
+        err = x[rows] @ state["w"] - y[rows]
+        grad = x[rows].T @ err / max(len(rows), 1)
+        loss = float(_np.mean(err ** 2))
+        try:
+            red = es.allreduce(
+                t, {"g": grad, "loss": _np.array(loss)},
+                weight=float(len(rows)))
+        except ResizeInterrupt:
+            continue
+        state = dict(state)
+        state["w"] = state["w"] - lr * red["g"]
+        state["losses"] = state["losses"].copy()
+        state["losses"][t] = float(red["loss"])
+        es.save_shard(t, state)
+        if rank == 0 and t == total - 1:
+            session.report({"step": t, "losses_json": _json.dumps(
+                [float(v) for v in state["losses"]])})
+        t += 1
+
+
+def test_loss_curve_equivalence_across_resize(ray_start, tmp_path,
+                                              monkeypatch):
+    """A 4-worker elastic gang that shrinks to 3 and grows back must
+    reproduce the FIXED 4-worker loss curve: with weight = shard rows,
+    the weighted-mean of per-shard gradients IS the full-batch
+    gradient at any world size."""
+    from ray_tpu._private.chaos import chaos
+
+    _set_elastic_knobs(monkeypatch)
+    loop_cfg = {"total_steps": 30}
+
+    def _run(name, storm):
+        chaos.clear()
+        chaos.reset_trace()
+        if storm:
+            chaos.inject("train.worker", kind="preempt", p=1.0, n=1,
+                         deadline_s=0.25)
+        result = TpuTrainer(
+            _sgd_loop, train_loop_config=loop_cfg,
+            scaling_config=ScalingConfig(num_workers=4, elastic=True),
+            run_config=RunConfig(name=name,
+                                 storage_path=str(tmp_path))).fit()
+        chaos.clear()
+        assert result.error is None, result.error
+        return json.loads(result.metrics["losses_json"])
+
+    fixed = _run("eq_fixed", storm=False)
+    elastic = _run("eq_elastic", storm=True)
+    assert len(fixed) == len(elastic) == loop_cfg["total_steps"]
+    assert not any(np.isnan(fixed)) and not any(np.isnan(elastic))
+    np.testing.assert_allclose(elastic, fixed, rtol=0, atol=1e-8)
+    # It actually trained (and actually resized).
+    assert fixed[-1] < 0.1 * fixed[0]
+    summary = state_api.train_summary(run="eq_elastic")
+    assert summary.get("resize_count", 0) >= 2, summary.get("resizes")
+
+
+def test_elastic_rejects_datasets(ray_start, tmp_path):
+    """Streaming dataset splits are fixed-world; elastic + datasets=
+    must fail loudly, not silently train on a stale shard layout."""
+    trainer = TpuTrainer(
+        lambda config=None: None,
+        scaling_config=ScalingConfig(num_workers=2, elastic=True),
+        run_config=RunConfig(name="el_ds", storage_path=str(tmp_path)),
+        datasets={"train": object()})
+    with pytest.raises(ValueError, match="elastic"):
+        trainer.fit()
+
+
+# ---------------------------------------------------------------------------
+# accounting plane
+# ---------------------------------------------------------------------------
+def test_world_size_gauge_and_resize_meta_lifecycle():
+    """record_resize appends capped history to the run meta and the
+    per-run world-size gauge registers with the leak ledger and
+    discharges on remove_run_gauges (RT015)."""
+    from ray_tpu.devtools import leaksan
+
+    leaksan.enable_for_testing()
+    try:
+        run = f"el_gauge_{os.getpid()}_{int(time.time() * 1000)}"
+        base = leaksan.live_counts().get("metric_series", 0)
+        kv = _FakeKV()
+        telemetry_mod.set_world_size_gauge(run, 4)
+        telemetry_mod.record_resize(kv, run, "shrink", 4, 3, 7,
+                                    dead_s=0.5)
+        telemetry_mod.record_resize(kv, run, "grow", 3, 4, 9)
+        assert leaksan.live_counts().get("metric_series", 0) > base
+        meta = json.loads(kv.store[(telemetry_mod.KV_RUNS_NS,
+                                    run.encode())])
+        assert meta["resize_count"] == 2
+        assert meta["world_size"] == 4
+        assert [e["direction"] for e in meta["resizes"]] == \
+            ["shrink", "grow"]
+        assert meta["resizes"][0]["dead_s"] == 0.5
+        with pytest.raises(ValueError):
+            telemetry_mod.record_resize(kv, run, "sideways", 4, 4, 0)
+        # The history is capped so the meta blob stays small.
+        for i in range(40):
+            telemetry_mod.record_resize(kv, run, "grow", 3, 4, i)
+        meta = json.loads(kv.store[(telemetry_mod.KV_RUNS_NS,
+                                    run.encode())])
+        assert len(meta["resizes"]) == 32
+        assert meta["resize_count"] == 42
+        telemetry_mod.remove_run_gauges(run)
+        assert leaksan.live_counts().get("metric_series", 0) == base
+    finally:
+        leaksan.disable_for_testing()
+
+
+def test_doctor_flags_resize_thrash(ray_start):
+    """A gang resizing faster than train_resize_thrash_per_min reads
+    as capacity churn eating goodput: doctor raises
+    GANG_RESIZE_THRASH with the rate and recent events."""
+    client = ray_tpu._ensure_connected()
+    run = "el_thrash"
+    for i in range(5):
+        telemetry_mod.record_resize(
+            client, run, "shrink" if i % 2 == 0 else "grow",
+            4 - i % 2, 3 + i % 2, i)
+    # One worker snapshot so the run has a wall clock to rate against.
+    client.kv_put(
+        telemetry_mod.KV_SNAP_NS,
+        f"{run}{telemetry_mod._SEP}w:0".encode(),
+        json.dumps({"rank": 0, "wall_s": 10.0, "phases": {},
+                    "ledger": {}, "step_index": 1,
+                    "window": []}).encode())
+    try:
+        rep = state_api.doctor()
+        hits = [f for f in rep["findings"]
+                if f["code"] == "GANG_RESIZE_THRASH"]
+        assert hits, [f["code"] for f in rep["findings"]]
+        f = hits[0]
+        assert f["severity"] == "warning"
+        assert f["detail"]["run"] == run
+        assert f["detail"]["resizes"] == 5
+        assert f["detail"]["per_min"] == pytest.approx(30.0)
+        assert len(f["detail"]["events"]) == 5
+    finally:
+        telemetry_mod.remove_run_gauges(run)
